@@ -1,0 +1,36 @@
+"""tpu-p2p: a TPU-native peer-to-peer network framework.
+
+Two backends behind one extension API (SURVEY.md section 7):
+
+- **sockets backend** (`Node`, `NodeConnection`): real TCP networking with
+  behavior and wire-format parity with the reference
+  (pj8912/python-p2p-network) — extend-a-Node-class or callback API, the
+  ten-event vocabulary, broadcast with exclude lists, str/dict/bytes payloads,
+  zlib/bzip2/lzma compression, connection limits, reconnect policies.
+- **sim backend** (`p2pnetwork_tpu.sim`, `p2pnetwork_tpu.models`): the new
+  pillar — populations of simulated nodes as JAX arrays, protocol rounds as
+  batched graph propagation (`lax.scan` over segment aggregation), sharded
+  across a TPU mesh with ring `ppermute` cross-shard edges
+  (`p2pnetwork_tpu.parallel`).
+
+The sim subpackages import JAX; this root module does not, so the sockets
+backend works standalone.
+"""
+
+from p2pnetwork_tpu import wire
+from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
+from p2pnetwork_tpu.node import Node
+from p2pnetwork_tpu.nodeconnection import NodeConnection
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Node",
+    "NodeConnection",
+    "NodeConfig",
+    "SimConfig",
+    "TopologyConfig",
+    "MeshConfig",
+    "wire",
+    "__version__",
+]

@@ -1,0 +1,531 @@
+"""Node orchestration for the sockets backend.
+
+``Node`` is the same concept as the reference's ``Node``
+[ref: p2pnetwork/node.py:13]: a TCP server plus peer registry plus
+broadcast/unicast sender, extended by subclassing its event methods or by
+passing a ``callback(event, main_node, connected_node, data)``
+[ref: node.py:24-29]. The full ten-event vocabulary, the
+``create_new_connection`` factory seam [ref: node.py:196-201] and the
+reconnect policy hook [ref: node.py:354-363] are preserved name-for-name, and
+the wire format interoperates with live reference nodes (see wire.py).
+
+Runtime design (deliberately different, SURVEY.md section 7): instead of one
+accept thread per node plus one thread per connection with 10 ms poll loops
+[ref: node.py:227-280, nodeconnection.py:186-229], each ``Node`` runs a single
+asyncio event loop on one background thread. All peer-registry state is
+mutated only from that loop, which designs out the reference's unlocked
+cross-thread list mutation (SURVEY.md section 2.3.6). Public methods are
+thread-safe facades that post onto the loop.
+
+Deliberate fixes over the reference (SURVEY.md section 2.3), each noted
+inline: single reconnect key (2.3.1), no mutable default argument (2.3.5),
+``message_count_rerr`` actually counts errors (2.3.7), EOF during the
+outbound handshake is an error instead of a phantom empty-id peer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Any, Callable, List, Optional, Union
+
+from p2pnetwork_tpu.config import NodeConfig
+from p2pnetwork_tpu.nodeconnection import NodeConnection
+from p2pnetwork_tpu.utils import EventLog, generate_id
+
+
+class Node:
+    """A peer node: TCP server, peer registry, broadcast, event hooks.
+
+    Constructor parity [ref: node.py:32]: ``Node(host, port, id=None,
+    callback=None, max_connections=0)``; ``config`` adds typed tunables the
+    reference hard-codes (SURVEY.md section 5 "Config"). Binding happens here,
+    so port conflicts surface at construction like the reference's
+    ``init_server`` [ref: node.py:92-98]. ``port=0`` binds an ephemeral port
+    and stores the chosen one on ``self.port``.
+    """
+
+    def __init__(self, host: str, port: int, id: Optional[str] = None,
+                 callback: Optional[Callable] = None, max_connections: int = 0,
+                 config: Optional[NodeConfig] = None):
+        self.host = host
+        self.port = port
+        self.callback = callback
+        self.config = config or NodeConfig()
+
+        # Set when the node should stop [ref: node.py:36].
+        self.terminate_flag = threading.Event()
+
+        # Peer registries [ref: node.py:46-52]. Only mutated on the loop.
+        self.nodes_inbound: List[NodeConnection] = []
+        self.nodes_outbound: List[NodeConnection] = []
+        self.reconnect_to_nodes: List[dict] = []
+
+        # Identity [ref: node.py:54-58].
+        self.id = generate_id(host, port) if id is None else str(id)
+
+        # Message counters [ref: node.py:64-67]; rerr is live here (2.3.7).
+        self.message_count_send = 0
+        self.message_count_recv = 0
+        self.message_count_rerr = 0
+
+        self.max_connections = max_connections  # [ref: node.py:70]
+        self.debug = False  # [ref: node.py:73]
+
+        # Structured event history (addition; SURVEY.md section 5 "Metrics").
+        self.event_log = EventLog()
+
+        # Bind now so errors surface in the constructor [ref: node.py:92-98].
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((self.host, self.port))
+        self.sock.listen(self.config.listen_backlog)
+        self.sock.setblocking(False)
+        if self.port == 0:
+            self.port = self.sock.getsockname()[1]
+        print(f"Initialisation of the Node on port: {self.port} on node ({self.id})")
+
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._stop_event: Optional[asyncio.Event] = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------- registry
+
+    @property
+    def all_nodes(self) -> List[NodeConnection]:
+        """All connected peers, inbound then outbound [ref: node.py:75-78]."""
+        return self.nodes_inbound + self.nodes_outbound
+
+    def debug_print(self, message: str) -> None:
+        """Print ``message`` when ``self.debug`` is set [ref: node.py:80-83]."""
+        if self.debug:
+            print(f"DEBUG ({self.id}): {message}")
+
+    def generate_id(self) -> str:
+        """Generate a fresh unique id [ref: node.py:85-90]."""
+        return generate_id(self.host, self.port)
+
+    def print_connections(self) -> None:
+        """Print an inbound/outbound connection overview [ref: node.py:100-104]."""
+        print("Node connection overview:")
+        print(f"Total nodes connected with us: {len(self.nodes_inbound)}")
+        print(f"Total nodes connected to     : {len(self.nodes_outbound)}")
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """Start the node's event loop thread and begin accepting peers.
+
+        The facade for ``threading.Thread.start`` in the reference's
+        inheritance design [ref: node.py:13]."""
+        if self._thread is not None:
+            raise RuntimeError("Node.start: node already started")
+        self._thread = threading.Thread(
+            target=self._run_loop, name=f"Node({self.host}:{self.port})", daemon=True
+        )
+        self._thread.start()
+        self._started.wait()
+
+    def _run_loop(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        """Loop body: serve, tick the reconnect registry, shut down cleanly.
+
+        The asyncio analog of the reference's accept loop + epilogue
+        [ref: node.py:227-280]."""
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        try:
+            self._server = await asyncio.start_server(self._handle_inbound, sock=self.sock)
+        except Exception as e:
+            self.debug_print(f"Node: could not start server: {e}")
+            self._started.set()
+            return
+        self._started.set()
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    await asyncio.wait_for(
+                        self._stop_event.wait(), timeout=self.config.reconnect_interval
+                    )
+                except asyncio.TimeoutError:
+                    # Periodic reconnect check; the reference runs this every
+                    # accept-loop tick [ref: node.py:265].
+                    await self._reconnect_tick()
+        finally:
+            await self._shutdown()
+
+    async def _shutdown(self) -> None:
+        """Stop epilogue [ref: node.py:269-280]: close server, stop peers, join."""
+        print("Node stopping...")
+        if self._server is not None:
+            self._server.close()
+        conns = list(self.all_nodes)
+        for conn in conns:
+            conn.stop()
+        for conn in conns:
+            await conn.wait_closed()
+        if self._server is not None:
+            # Python 3.12+: wait_closed() also waits for the connection
+            # transports start_server spawned, so it must come after the
+            # per-connection closes above or it deadlocks.
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                self.debug_print("Node: server.wait_closed timed out")
+        print("Node stopped")
+
+    def stop(self) -> None:
+        """Request the node to stop [ref: node.py:191-194].
+
+        Thread-safe and idempotent, like the reference's flag-set."""
+        self.node_request_to_stop()
+        self.terminate_flag.set()
+        loop, stop_event = self._loop, self._stop_event
+        if loop is not None and stop_event is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(stop_event.set)
+            except RuntimeError:
+                pass  # loop already closed — nothing left to stop
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the node's loop thread to finish (``Thread.join`` facade)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        """Whether the node's loop thread is running (``Thread`` facade)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------- inbound
+
+    async def _handle_inbound(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        """Accept-path: gate on max_connections, handshake, register, event.
+
+        Mirrors [ref: node.py:232-263]: receive the peer's ``"id:port"``
+        first, then send our id; the stored port is the peer's *server* port
+        when present (inbound port semantics, SURVEY.md section 2.3.8)."""
+        peername = writer.get_extra_info("peername") or ("?", 0)
+        try:
+            self.debug_print("Node: Wait for incoming connection")
+            # Connection-limit gate [ref: node.py:239]; 0 means unlimited.
+            if self.max_connections != 0 and len(self.nodes_inbound) >= self.max_connections:
+                self.debug_print(
+                    "New connection is closed. You have reached the maximum connection limit!"
+                )
+                writer.close()
+                return
+            handshake = await asyncio.wait_for(
+                reader.read(4096), timeout=self.config.connect_timeout
+            )
+            connected_node_id = handshake.decode("utf-8")
+            connected_node_port = peername[1]  # backward compat [ref: node.py:242]
+            if ":" in connected_node_id:
+                connected_node_id, port_str = connected_node_id.split(":")
+                connected_node_port = int(port_str)
+            writer.write(self.id.encode("utf-8"))  # [ref: node.py:246]
+            await writer.drain()
+
+            conn = self.create_new_connection(
+                (reader, writer), connected_node_id, peername[0], connected_node_port
+            )
+            conn.start()
+            self.nodes_inbound.append(conn)
+            self.inbound_node_connected(conn)
+        except Exception as e:
+            self.message_count_rerr += 1
+            try:
+                writer.close()
+            except Exception:
+                pass
+            self.inbound_node_connection_error(e)
+
+    # ------------------------------------------------------------- outbound
+
+    def connect_with_node(self, host: str, port: int, reconnect: bool = False) -> bool:
+        """Connect to a peer at ``host:port`` [ref: node.py:122-176].
+
+        Guard parity: self-connect refused (``False``), already-connected
+        host:port is a no-op (``True``), duplicate peer id after handshake
+        sends the reference's ``"CLOSING: ..."`` string and reports ``True``.
+        With ``reconnect=True`` the address is registered for automatic
+        reconnection [ref: node.py:165-169].
+
+        Thread-safe. When called from within an event handler (i.e. on the
+        node's own loop), the connection attempt is scheduled in the
+        background and this returns ``True`` if the guards pass; failures are
+        then reported through ``outbound_node_connection_error`` — the
+        reference's error channel [ref: node.py:173-176]. Use
+        :meth:`connect_with_node_async` in async code for the exact result.
+        """
+        if host == self.host and port == self.port:
+            print("connect_with_node: Cannot connect with yourself!!")
+            return False
+        for node in self.all_nodes:
+            if node.host == host and node.port == port:
+                print(f"connect_with_node: Already connected with this node ({node.id}).")
+                return True
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            self.debug_print("connect_with_node: node is not running — call start() first")
+            return False
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            loop.create_task(self.connect_with_node_async(host, port, reconnect))
+            return True
+        fut = asyncio.run_coroutine_threadsafe(
+            self.connect_with_node_async(host, port, reconnect), loop
+        )
+        return fut.result()
+
+    async def connect_with_node_async(self, host: str, port: int,
+                                      reconnect: bool = False) -> bool:
+        """Async core of :meth:`connect_with_node`; runs on the node's loop."""
+        if host == self.host and port == self.port:
+            print("connect_with_node: Cannot connect with yourself!!")
+            return False
+        for node in self.all_nodes:
+            if node.host == host and node.port == port:
+                print(f"connect_with_node: Already connected with this node ({node.id}).")
+                return True
+        node_ids = [node.id for node in self.all_nodes]
+        writer = None
+        try:
+            self.debug_print(f"connecting to {host} port {port}")
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=self.config.connect_timeout
+            )
+            # Plaintext id handshake, parity for interop [ref: node.py:148-150]:
+            # send "id:port", receive the peer's id.
+            writer.write(f"{self.id}:{self.port}".encode("utf-8"))
+            await writer.drain()
+            handshake = await asyncio.wait_for(
+                reader.read(4096), timeout=self.config.connect_timeout
+            )
+            if not handshake:
+                # Peer closed before completing the handshake (e.g. its
+                # connection limit). The reference would register a phantom
+                # empty-id peer here; we fail instead (deliberate fix).
+                raise ConnectionError("peer closed the connection during the handshake")
+            connected_node_id = handshake.decode("utf-8")
+
+            # Duplicate-peer guard [ref: node.py:153-156].
+            if self.id == connected_node_id or connected_node_id in node_ids:
+                writer.write("CLOSING: Already having a connection together".encode("utf-8"))
+                writer.close()
+                return True
+
+            conn = self.create_new_connection((reader, writer), connected_node_id, host, port)
+            conn.start()
+            self.nodes_outbound.append(conn)
+            self.outbound_node_connected(conn)
+
+            # Reconnect registration [ref: node.py:165-169]; single "trials"
+            # key — the reference writes "tries" but reads "trials"
+            # (SURVEY.md section 2.3.1).
+            if reconnect:
+                self.debug_print(
+                    f"connect_with_node: Reconnection check is enabled on node {host}:{port}"
+                )
+                self.reconnect_to_nodes.append({"host": host, "port": port, "trials": 0})
+            return True
+        except Exception as error:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            self.message_count_rerr += 1
+            self.debug_print(f"connect_with_node: Could not connect with node. ({error})")
+            self.outbound_node_connection_error(error)
+            return False
+
+    def disconnect_with_node(self, node: NodeConnection) -> None:
+        """Close one outbound connection [ref: node.py:178-189].
+
+        Fires ``node_disconnect_with_outbound_node`` before closing; peers we
+        did not initiate the connection to cannot be disconnected this way."""
+        if node in self.nodes_outbound:
+            self.node_disconnect_with_outbound_node(node)
+            node.stop()
+        else:
+            self.debug_print(
+                "Node disconnect_with_node: cannot disconnect with a node with which "
+                "we are not connected."
+            )
+
+    # ------------------------------------------------------------ messaging
+
+    def send_to_nodes(self, data: Union[str, dict, bytes],
+                      exclude: Optional[List[NodeConnection]] = None,
+                      compression: str = "none") -> None:
+        """Broadcast ``data`` to every connected peer not in ``exclude``.
+
+        [ref: node.py:106-112]; ``exclude`` defaults to ``None`` instead of a
+        shared mutable list (SURVEY.md section 2.3.5)."""
+        exclude = exclude or []
+        for n in self.all_nodes:
+            if n not in exclude:
+                self.send_to_node(n, data, compression)
+
+    def send_to_node(self, n: NodeConnection, data: Union[str, dict, bytes],
+                     compression: str = "none") -> None:
+        """Unicast ``data`` to peer ``n`` [ref: node.py:114-120].
+
+        Counter-then-membership-check order preserved [ref: node.py:116-117]."""
+        self.message_count_send += 1
+        if n in self.all_nodes:
+            n.send(data, compression=compression)
+        else:
+            self.debug_print("Node send_to_node: Could not send the data, node is not found!")
+
+    # ------------------------------------------------------------ factories
+
+    def create_new_connection(self, connection, id: str, host: str, port: int) -> NodeConnection:
+        """Factory seam for substituting a custom connection class
+        [ref: node.py:196-201]. ``connection`` is an asyncio
+        ``(StreamReader, StreamWriter)`` pair."""
+        return NodeConnection(self, connection, id, host, port)
+
+    # ------------------------------------------------------------ reconnect
+
+    async def _reconnect_tick(self) -> None:
+        """Re-establish registered outbound connections that dropped.
+
+        [ref: node.py:203-225] with the single-key fix (SURVEY.md 2.3.1): each
+        entry is ``{"host", "port", "trials"}``; the policy hook
+        ``node_reconnection_error`` decides retry (True) vs deregister
+        (False) per trial count."""
+        for entry in list(self.reconnect_to_nodes):
+            host, port = entry["host"], entry["port"]
+            self.debug_print(f"reconnect_nodes: Checking node {host}:{port}")
+            found = any(
+                n.host == host and n.port == port for n in self.nodes_outbound
+            )
+            if found:
+                entry["trials"] = 0
+                self.debug_print(f"reconnect_nodes: Node {host}:{port} still running!")
+                continue
+            entry["trials"] += 1
+            if self.node_reconnection_error(host, port, entry["trials"]):
+                await self.connect_with_node_async(host, port)
+            else:
+                self.debug_print(
+                    f"reconnect_nodes: Removing node ({host}:{port}) from the reconnection list!"
+                )
+                self.reconnect_to_nodes.remove(entry)
+
+    def reconnect_nodes(self) -> None:
+        """Manual trigger of one reconnect check [ref: node.py:203].
+
+        Thread-safe; from an event handler (i.e. on the node's own loop) the
+        check is scheduled in the background instead of awaited, since
+        blocking the loop on its own work would deadlock."""
+        loop = self._loop
+        if loop is None or not loop.is_running():
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            loop.create_task(self._reconnect_tick())
+        else:
+            asyncio.run_coroutine_threadsafe(self._reconnect_tick(), loop).result()
+
+    # -------------------------------------------------------------- events
+    #
+    # The ten-event Extension API [ref: node.py:282-363]: subclasses override
+    # these; each also dispatches to the optional callback with the exact
+    # event-name strings of the reference, and records into the event log.
+
+    def _dispatch(self, event: str, connected_node, data) -> None:
+        peer_id = getattr(connected_node, "id", None)
+        self.event_log.record(event, peer_id, data)
+        if self.callback is not None:
+            self.callback(event, self, connected_node, data)
+
+    def outbound_node_connected(self, node: NodeConnection) -> None:
+        """We successfully connected to ``node`` [ref: node.py:282-287]."""
+        self.debug_print(f"outbound_node_connected: {node.id}")
+        self._dispatch("outbound_node_connected", node, {})
+
+    def outbound_node_connection_error(self, exception: Exception) -> None:
+        """An outbound connection attempt failed [ref: node.py:289-293]."""
+        self.debug_print(f"outbound_node_connection_error: {exception}")
+        self._dispatch("outbound_node_connection_error", None, {"exception": exception})
+
+    def inbound_node_connected(self, node: NodeConnection) -> None:
+        """A peer connected to us [ref: node.py:295-299]."""
+        self.debug_print(f"inbound_node_connected: {node.id}")
+        self._dispatch("inbound_node_connected", node, {})
+
+    def inbound_node_connection_error(self, exception: Exception) -> None:
+        """Accepting a peer failed [ref: node.py:301-305]."""
+        self.debug_print(f"inbound_node_connection_error: {exception}")
+        self._dispatch("inbound_node_connection_error", None, {"exception": exception})
+
+    def node_disconnected(self, node: NodeConnection) -> None:
+        """Route a dead connection to the inbound/outbound variant
+        [ref: node.py:307-319], removing it from the registry."""
+        self.debug_print(f"node_disconnected: {node.id}")
+        if node in self.nodes_inbound:
+            self.nodes_inbound.remove(node)
+            self.inbound_node_disconnected(node)
+        if node in self.nodes_outbound:
+            self.nodes_outbound.remove(node)
+            self.outbound_node_disconnected(node)
+
+    def inbound_node_disconnected(self, node: NodeConnection) -> None:
+        """A peer that had connected to us went away [ref: node.py:321-326]."""
+        self.debug_print(f"inbound_node_disconnected: {node.id}")
+        self._dispatch("inbound_node_disconnected", node, {})
+
+    def outbound_node_disconnected(self, node: NodeConnection) -> None:
+        """A peer we had connected to went away [ref: node.py:328-332]."""
+        self.debug_print(f"outbound_node_disconnected: {node.id}")
+        self._dispatch("outbound_node_disconnected", node, {})
+
+    def node_message(self, node: NodeConnection, data) -> None:
+        """A peer sent us a message [ref: node.py:334-338]."""
+        self.debug_print(f"node_message: {node.id}: {data}")
+        self._dispatch("node_message", node, data)
+
+    def node_disconnect_with_outbound_node(self, node: NodeConnection) -> None:
+        """We are about to close an outbound connection [ref: node.py:340-345]."""
+        self.debug_print(f"node wants to disconnect with other outbound node: {node.id}")
+        self._dispatch("node_disconnect_with_outbound_node", node, {})
+
+    def node_request_to_stop(self) -> None:
+        """The node was asked to stop [ref: node.py:347-352].
+
+        Callback signature parity: the reference passes ``{}`` for the
+        connected-node argument here [ref: node.py:352]."""
+        self.debug_print("node is requested to stop!")
+        self.event_log.record("node_request_to_stop", None, {})
+        if self.callback is not None:
+            self.callback("node_request_to_stop", self, {}, {})
+
+    def node_reconnection_error(self, host: str, port: int, trials: int) -> bool:
+        """Reconnect policy hook [ref: node.py:354-363]: return ``True`` to
+        keep retrying ``host:port``, ``False`` to deregister it."""
+        self.debug_print(
+            f"node_reconnection_error: Reconnecting to node {host}:{port} (trials: {trials})"
+        )
+        return True
+
+    # ------------------------------------------------------------------ repr
+
+    def __str__(self) -> str:
+        return f"Node: {self.host}:{self.port}"
+
+    def __repr__(self) -> str:
+        return f"<Node {self.host}:{self.port} id: {self.id}>"

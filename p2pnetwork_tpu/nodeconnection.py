@@ -1,0 +1,314 @@
+"""Per-peer transport for the sockets backend.
+
+``NodeConnection`` has the same role and public surface as the reference's
+class of the same name [ref: p2pnetwork/nodeconnection.py:9]: it represents
+one TCP connection with a peer (inbound or outbound), owns framing /
+serialization / compression for that peer, delivers parsed messages upward
+through ``main_node.node_message`` [ref: nodeconnection.py:216] and reports
+its own death through ``main_node.node_disconnected``
+[ref: nodeconnection.py:228].
+
+The concurrency design is deliberately different (SURVEY.md section 7): the
+reference runs one OS thread per connection with a 10 ms poll loop
+[ref: nodeconnection.py:186-229]; here each connection is an asyncio task on
+its owning ``Node``'s event loop — no polling, no per-connection thread, and
+no data races because every piece of peer state is only ever touched from
+that one loop (the reference mutates shared lists from 3+ thread types with
+no locks, SURVEY.md section 2.3.6).
+
+Public surface parity:
+- ``send(data, encoding_type='utf-8', compression='none')``
+  [ref: nodeconnection.py:107]
+- ``stop()`` [ref: nodeconnection.py:162]
+- ``set_info/get_info`` and the ``info`` dict [ref: nodeconnection.py:231-235]
+- ``id``, ``host``, ``port``, ``main_node``, ``EOT_CHAR``, ``COMPR_CHAR``
+  attributes; ``__str__``/``__repr__`` [ref: nodeconnection.py:237-244]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional, Tuple, Union
+
+from p2pnetwork_tpu import wire
+
+#: The transport handed to ``create_new_connection`` — an asyncio stream pair.
+StreamPair = Tuple[asyncio.StreamReader, asyncio.StreamWriter]
+
+
+class NodeConnection:
+    """One peer connection: framing, serialization, compression, delivery.
+
+    Constructor signature mirrors the reference factory contract
+    [ref: node.py:196-201]: ``(main_node, connection, id, host, port)``, where
+    ``connection`` is the transport — an ``(StreamReader, StreamWriter)`` pair
+    here instead of a raw socket.
+    """
+
+    def __init__(self, main_node, connection: StreamPair, id: str, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.main_node = main_node
+        self.reader, self.writer = connection
+
+        # Parity: ids are always strings [ref: nodeconnection.py:35].
+        self.id = str(id)
+
+        # Exposed for parity with the reference's per-instance constants
+        # [ref: nodeconnection.py:38-41]; the codec itself lives in wire.py.
+        self.EOT_CHAR = wire.EOT_CHAR
+        self.COMPR_CHAR = wire.COMPR_CHAR
+
+        # Per-connection key/value store [ref: nodeconnection.py:44, :231-235].
+        self.info: dict = {}
+
+        # Parity flag; set by stop(). A threading.Event so non-loop threads
+        # can observe it, like the reference's flag [ref: nodeconnection.py:32].
+        self.terminate_flag = threading.Event()
+
+        self._decoder = wire.FrameDecoder(max_buffer=main_node.config.max_recv_buffer)
+        self._task: Optional[asyncio.Task] = None
+
+        self.main_node.debug_print(
+            f"NodeConnection.send: Started with client ({self.id}) '{self.host}:{self.port}'"
+        )
+
+    # ------------------------------------------------------------------ send
+
+    def compress(self, data: bytes, compression: str) -> Optional[bytes]:
+        """Compress ``data``; returns ``None`` for an unknown algorithm.
+
+        Behavior parity with [ref: nodeconnection.py:53-82] including the
+        debug-printed compression ratio [ref: nodeconnection.py:80]; the codec
+        wire format lives in :func:`wire.compress`.
+        """
+        self.main_node.debug_print(f"{self.id}:compress:{compression}")
+        try:
+            compressed = wire.compress(data, compression)
+        except wire.UnknownCompressionError:
+            self.main_node.debug_print(f"{self.id}:compress:Unknown compression")
+            return None
+        if data:
+            ratio = int(10000 * len(compressed) / len(data)) / 100
+            self.main_node.debug_print(f"{self.id}:compress:compression:{ratio}%")
+        return compressed
+
+    def decompress(self, compressed: bytes) -> bytes:
+        """Decompress a tagged payload [ref: nodeconnection.py:84-105]."""
+        return wire.decompress(compressed)
+
+    def parse_packet(self, packet: bytes) -> Union[str, dict, bytes]:
+        """Decode one de-framed packet [ref: nodeconnection.py:167-184].
+
+        Routes through ``self.decompress`` so subclasses overriding the codec
+        (e.g. to add encryption) affect the receive path, as in the reference
+        [ref: nodeconnection.py:171]."""
+        if packet.find(wire.COMPR_CHAR) == len(packet) - 1:
+            packet = self.decompress(packet[:-1])
+        return wire.decode_payload(packet)
+
+    def send(self, data: Union[str, dict, bytes], encoding_type: Optional[str] = None,
+             compression: str = "none") -> None:
+        """Serialize, frame and queue ``data`` for transmission.
+
+        Thread-safe: may be called from any thread (the write itself happens
+        on the owning node's event loop). ``encoding_type`` defaults to the
+        node's ``config.encoding`` (utf-8). Behavior parity with
+        [ref: nodeconnection.py:107-160]:
+
+        - str / dict / bytes dispatch (dict as JSON),
+        - invalid payload type -> debug message only,
+        - compression goes through ``self.compress`` so subclasses can
+          override the codec, as in the reference [ref: nodeconnection.py:119];
+          an unknown algorithm sends nothing (the reference's silent-drop,
+          nodeconnection.py:120-121) but ``message_count_rerr`` is
+          incremented (the reference defines that counter and never uses it,
+          SURVEY.md section 2.3.7),
+        - a transport failure closes the connection (the "issue #19" policy,
+          nodeconnection.py:123-126).
+        """
+        encoding = encoding_type or self.main_node.config.encoding
+        try:
+            raw = wire.encode_payload(data, encoding)
+        except TypeError:
+            self.main_node.debug_print(
+                "datatype used is not valid please use str, dict (will be send as json) or bytes"
+            )
+            return
+        except Exception as e:
+            self.main_node.debug_print(f"nodeconnection send: Error encoding data: {e}")
+            self.main_node.message_count_rerr += 1
+            return
+        if compression == "none":
+            frame = raw + wire.EOT_CHAR
+        else:
+            compressed = self.compress(raw, compression)
+            if compressed is None:
+                self.main_node.message_count_rerr += 1
+                return
+            frame = compressed + wire.COMPR_CHAR + wire.EOT_CHAR
+
+        loop = self.main_node._loop
+        if loop is None or loop.is_closed():
+            self.main_node.debug_print("nodeconnection send: node is not running")
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self._write(frame)
+        else:
+            try:
+                loop.call_soon_threadsafe(self._write, frame)
+            except RuntimeError:
+                self.main_node.debug_print("nodeconnection send: node is not running")
+
+    def _write(self, frame: bytes) -> None:
+        """Write one frame on the event loop; failure closes the connection."""
+        if self.terminate_flag.is_set():
+            return
+        try:
+            self.writer.write(frame)
+            # Backpressure bound: the reference's blocking sendall stalled the
+            # sender when the peer stopped reading; asyncio buffers instead.
+            # A peer that falls further behind than max_send_buffer is treated
+            # as a failed transport (same close-on-failure policy).
+            transport = self.writer.transport
+            if (transport is not None
+                    and transport.get_write_buffer_size() > self.main_node.config.max_send_buffer):
+                raise BufferError(
+                    f"peer is not reading: write buffer exceeds "
+                    f"{self.main_node.config.max_send_buffer} bytes"
+                )
+        except Exception as e:
+            self.main_node.debug_print(f"nodeconnection send: Error sending data to node: {e}")
+            self.main_node.message_count_rerr += 1
+            self.stop()  # "issue #19" policy [ref: nodeconnection.py:123-126]
+
+    # ------------------------------------------------------- receive lifecycle
+
+    def start(self) -> None:
+        """Start the receive task on the owning node's event loop.
+
+        Parity seam with ``thread_client.start()`` [ref: node.py:159, :249];
+        callable from the loop itself or from another thread.
+        """
+        loop = self.main_node._loop
+        if loop is None:
+            raise RuntimeError("NodeConnection.start: owning node is not running")
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self._task = loop.create_task(self._recv_loop())
+        else:
+            fut = asyncio.run_coroutine_threadsafe(self._spawn(), loop)
+            fut.result()
+
+    async def _spawn(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    async def _recv_loop(self) -> None:
+        """Receive chunks, de-frame, parse, deliver upward.
+
+        The asyncio analog of the reference's thread main loop
+        [ref: nodeconnection.py:186-229]: on EOF or error the connection is
+        closed and ``main_node.node_disconnected(self)`` fires exactly once
+        [ref: nodeconnection.py:228].
+        """
+        node = self.main_node
+        try:
+            while not self.terminate_flag.is_set():
+                chunk = await self.reader.read(node.config.recv_chunk)
+                if not chunk:  # EOF — peer closed
+                    break
+                try:
+                    for packet in self._decoder.feed(chunk):
+                        node.message_count_recv += 1  # [ref: nodeconnection.py:215]
+                        try:
+                            node.node_message(self, self.parse_packet(packet))
+                        except Exception as e:
+                            # A crashing user handler must not kill the
+                            # transport (in the reference it kills the recv
+                            # thread without cleanup).
+                            node.message_count_rerr += 1
+                            node.debug_print(f"node_message handler raised: {e!r}")
+                except wire.FrameOverflowError as e:
+                    node.message_count_rerr += 1
+                    node.debug_print(f"NodeConnection: {e}")
+                    break
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            node.debug_print("Unexpected error")
+            node.debug_print(str(e))
+        finally:
+            self.terminate_flag.set()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            node.node_disconnected(self)  # [ref: nodeconnection.py:228]
+            node.debug_print("NodeConnection: Stopped")
+
+    def stop(self) -> None:
+        """Request connection termination [ref: nodeconnection.py:162-165].
+
+        Thread-safe. Closing the transport wakes the receive task (its read
+        returns EOF), which then runs the disconnect epilogue.
+        """
+        self.terminate_flag.set()
+        loop = self.main_node._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def _close():
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            _close()
+        else:
+            try:
+                loop.call_soon_threadsafe(_close)
+            except RuntimeError:
+                pass  # loop closed between the check and the post — idempotent
+
+    async def wait_closed(self) -> None:
+        """Await full termination of the receive task (loop-side helper)."""
+        if self._task is not None:
+            try:
+                await self._task
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ info
+
+    def set_info(self, key: str, value: Any) -> None:
+        """Store auxiliary data on this connection [ref: nodeconnection.py:231]."""
+        self.info[key] = value
+
+    def get_info(self, key: str) -> Any:
+        """Fetch auxiliary data from this connection [ref: nodeconnection.py:234]."""
+        return self.info[key]
+
+    # ------------------------------------------------------------------ repr
+
+    def __str__(self) -> str:
+        return "NodeConnection: {}:{} <-> {}:{} ({})".format(
+            self.main_node.host, self.main_node.port, self.host, self.port, self.id
+        )
+
+    def __repr__(self) -> str:
+        return "<NodeConnection: Node {}:{} <-> Connection {}:{}>".format(
+            self.main_node.host, self.main_node.port, self.host, self.port
+        )

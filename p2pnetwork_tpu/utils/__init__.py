@@ -1,0 +1,6 @@
+"""Small shared utilities: node ids, debug printing, structured event log."""
+
+from p2pnetwork_tpu.utils.ids import generate_id
+from p2pnetwork_tpu.utils.logging import EventLog, EventRecord
+
+__all__ = ["generate_id", "EventLog", "EventRecord"]

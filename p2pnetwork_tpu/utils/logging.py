@@ -1,0 +1,53 @@
+"""Structured observability for the sockets backend.
+
+The reference's observability is a debug flag gating prints plus three integer
+counters [ref: p2pnetwork/node.py:64-67, :80-83] (SURVEY.md section 5
+"Metrics"). We keep the counters (same names, on ``Node``) and add a bounded
+structured event log so tests and applications can assert on event history
+instead of parsing stdout.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Deque, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class EventRecord:
+    """One framework event: name, monotonic timestamp, involved peer, data."""
+
+    event: str
+    timestamp: float
+    peer_id: Optional[str]
+    data: Any = None
+
+
+class EventLog:
+    """Bounded, thread-safe in-memory event history."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._events: Deque[EventRecord] = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, event: str, peer_id: Optional[str] = None, data: Any = None) -> None:
+        rec = EventRecord(event, time.monotonic(), peer_id, data)
+        with self._lock:
+            self._events.append(rec)
+
+    def snapshot(self) -> List[EventRecord]:
+        with self._lock:
+            return list(self._events)
+
+    def count(self, event: Optional[str] = None) -> int:
+        with self._lock:
+            if event is None:
+                return len(self._events)
+            return sum(1 for e in self._events if e.event == event)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()

@@ -1,0 +1,207 @@
+"""Wire format of the sockets backend.
+
+Byte-compatible with the reference implementation so that a tpu-p2p node can
+interoperate with a live reference node on the same network:
+
+- Frames are delimited by an EOT byte (``0x04``)
+  [ref: p2pnetwork/nodeconnection.py:38].
+- Compressed frames carry a trailing COMPR marker byte (``0x02``) just before
+  the EOT [ref: nodeconnection.py:41, :121].
+- A compressed payload is ``base64(compressed_bytes + algo_tag)`` where the
+  tag is the literal suffix ``b'zlib'`` / ``b'bzip2'`` / ``b'lzma'``
+  [ref: nodeconnection.py:63-70, :92-99].
+- Payloads are ``str`` (utf-8), ``dict`` (JSON) or raw ``bytes``
+  [ref: nodeconnection.py:114-156].
+- Parse order on receive: strip + decompress if marked, try utf-8 decode, try
+  JSON, fall back to str, fall back to raw bytes
+  [ref: nodeconnection.py:167-184].
+
+Everything in this module is a pure function (plus one small stateful stream
+decoder) so the wire format is unit-testable without sockets.
+
+Deliberate fixes over the reference (SURVEY.md section 2.3):
+- empty frames (EOT at buffer position 0) are consumed instead of wedging the
+  stream forever [ref bug: nodeconnection.py:211],
+- the receive buffer is bounded; exceeding it raises ``FrameOverflowError``
+  instead of growing without limit [ref bug: nodeconnection.py:206].
+
+Inherited wire-format limitation (kept for interop): raw ``bytes`` payloads
+containing the EOT byte ``0x04`` corrupt framing, exactly as in the
+reference. Sending such payloads with ``compression=`` enabled is safe —
+the base64 alphabet contains no control bytes.
+"""
+
+from __future__ import annotations
+
+import base64
+import bz2
+import json
+import lzma
+import zlib
+from typing import Iterator, Optional, Union
+
+Payload = Union[str, dict, list, bytes]
+
+#: End-of-transmission frame delimiter [ref: nodeconnection.py:38].
+EOT_CHAR = b"\x04"
+#: Marker appended to compressed payloads [ref: nodeconnection.py:41].
+COMPR_CHAR = b"\x02"
+
+#: algorithm name -> (compress fn, wire tag suffix) [ref: nodeconnection.py:63-70]
+_CODECS = {
+    "zlib": (lambda raw: zlib.compress(raw, 6), b"zlib"),
+    "bzip2": (bz2.compress, b"bzip2"),
+    "lzma": (lzma.compress, b"lzma"),
+}
+
+
+class UnknownCompressionError(ValueError):
+    """Raised when an unknown compression algorithm name is requested."""
+
+
+class FrameOverflowError(RuntimeError):
+    """Raised when the stream buffer exceeds its bound without an EOT."""
+
+
+def compress(raw: bytes, algorithm: str) -> bytes:
+    """Compress ``raw`` and tag it with the algorithm suffix, base64-encoded.
+
+    Wire format parity: ``base64(compressed + tag)`` [ref:
+    nodeconnection.py:63-70]. Unlike the reference (which returns ``None`` and
+    silently sends nothing, nodeconnection.py:72-74), an unknown algorithm
+    raises :class:`UnknownCompressionError` so callers can surface the error.
+    """
+    try:
+        fn, tag = _CODECS[algorithm]
+    except KeyError:
+        raise UnknownCompressionError(
+            f"unknown compression algorithm: {algorithm!r} "
+            f"(choose from {sorted(_CODECS)} or 'none')"
+        ) from None
+    return base64.b64encode(fn(raw) + tag)
+
+
+def decompress(blob: bytes) -> bytes:
+    """Base64-decode ``blob`` and decompress according to its tag suffix.
+
+    Mirrors the reference's tag sniffing [ref: nodeconnection.py:92-99]: an
+    unrecognised tag, or a codec failure, returns the b64-decoded bytes as-is
+    [ref: nodeconnection.py:100-101].
+    """
+    data = base64.b64decode(blob)
+    try:
+        if data[-4:] == b"zlib":
+            return zlib.decompress(data[:-4])
+        if data[-5:] == b"bzip2":
+            return bz2.decompress(data[:-5])
+        if data[-4:] == b"lzma":
+            return lzma.decompress(data[:-4])
+    except Exception:
+        pass
+    return data
+
+
+def encode_payload(data: Payload, encoding: str = "utf-8") -> bytes:
+    """Serialize a payload by type: str -> text, dict/list -> JSON, bytes raw.
+
+    [ref: nodeconnection.py:114/128/145; JSON for dicts at :131]. Raises
+    ``TypeError`` for unsupported types (the reference only debug-prints,
+    nodeconnection.py:158-160; callers preserve that behavior at the
+    connection layer).
+    """
+    if isinstance(data, str):
+        return data.encode(encoding)
+    if isinstance(data, (dict, list)):
+        return json.dumps(data).encode(encoding)
+    if isinstance(data, (bytes, bytearray)):
+        return bytes(data)
+    raise TypeError(
+        "datatype used is not valid please use str, dict (will be send as "
+        f"json) or bytes: got {type(data).__name__}"
+    )
+
+
+def encode_frame(
+    data: Payload, encoding: str = "utf-8", compression: str = "none"
+) -> bytes:
+    """Build one on-wire frame: payload [+ COMPR] + EOT.
+
+    [ref: nodeconnection.py:117 (plain) and :121 (compressed)].
+    """
+    raw = encode_payload(data, encoding)
+    if compression == "none":
+        return raw + EOT_CHAR
+    return compress(raw, compression) + COMPR_CHAR + EOT_CHAR
+
+
+def parse_packet(packet: bytes) -> Payload:
+    """Decode one de-framed packet back into str / dict / bytes.
+
+    Parse order parity [ref: nodeconnection.py:167-184]: a trailing COMPR
+    marker means decompress first; then utf-8 decode; then JSON; falling back
+    to the decoded str and finally the raw bytes.
+    """
+    # Parity: the reference treats a packet as compressed only when the FIRST
+    # 0x02 is the last byte [ref: nodeconnection.py:170] — endswith() would
+    # misfire on raw-bytes payloads containing an interior 0x02.
+    if packet.find(COMPR_CHAR) == len(packet) - 1:
+        packet = decompress(packet[:-1])
+    return decode_payload(packet)
+
+
+def decode_payload(packet: bytes) -> Payload:
+    """The utf-8 -> JSON -> str -> bytes fallback chain on decompressed bytes
+    [ref: nodeconnection.py:173-184]."""
+    try:
+        text = packet.decode("utf-8")
+    except UnicodeDecodeError:
+        return packet
+    try:
+        return json.loads(text)
+    except ValueError:
+        # JSONDecodeError, but also e.g. the int-digit-limit ValueError that
+        # json.loads raises for absurdly long numeric strings.
+        return text
+
+
+class FrameDecoder:
+    """Incremental EOT-delimited stream decoder with a bounded buffer.
+
+    Replaces the reference's inline buffer scan [ref: nodeconnection.py:206-218]
+    with two deliberate fixes (SURVEY.md section 2.3.2/2.3.3): empty frames are
+    consumed (an EOT at position 0 no longer wedges the stream), and the buffer
+    is bounded by ``max_buffer`` bytes.
+    """
+
+    def __init__(self, max_buffer: int = 64 * 1024 * 1024):
+        self.max_buffer = max_buffer
+        self._buffer = b""
+
+    def feed(self, chunk: bytes) -> Iterator[bytes]:
+        """Feed a received chunk; yield each complete (de-framed) packet."""
+        if not chunk:
+            return
+        self._buffer += chunk
+        start = 0
+        try:
+            while True:
+                eot = self._buffer.find(EOT_CHAR, start)
+                if eot < 0:
+                    break
+                yield self._buffer[start:eot]
+                start = eot + 1
+        finally:
+            if start:
+                self._buffer = self._buffer[start:]
+        if len(self._buffer) > self.max_buffer:
+            overflow = len(self._buffer)
+            self._buffer = b""
+            raise FrameOverflowError(
+                f"receive buffer exceeded {self.max_buffer} bytes "
+                f"({overflow} buffered) without an EOT delimiter"
+            )
+
+    @property
+    def pending(self) -> int:
+        """Number of buffered bytes not yet terminated by an EOT."""
+        return len(self._buffer)

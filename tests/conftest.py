@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform *before* any test imports jax,
+so the sharded propagation path (parallel/) is exercised on a real
+multi-device mesh without TPU hardware. Benchmarks (bench.py) run outside
+pytest and keep the real TPU backend.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

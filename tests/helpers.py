@@ -1,0 +1,50 @@
+"""Shared test helpers for the sockets backend.
+
+The reference synchronizes its integration tests with hard-coded
+``time.sleep`` barriers (SURVEY.md section 4), which makes them slow and
+flaky. These helpers replace the sleeps with condition polling with a real
+deadline."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float = 5.0,
+               interval: float = 0.01) -> bool:
+    """Poll ``predicate`` until it is true or ``timeout`` elapses."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def stop_all(nodes) -> None:
+    """Stop and join a set of nodes (stop() is idempotent by contract)."""
+    for n in nodes:
+        n.stop()
+    for n in nodes:
+        n.join(timeout=10.0)
+
+
+class EventRecorder:
+    """Callback that records (event, connected_id, data) tuples in order."""
+
+    def __init__(self):
+        self.events: List[tuple] = []
+
+    def __call__(self, event, main_node, connected_node, data):
+        cid = getattr(connected_node, "id", None)
+        self.events.append((event, cid, data))
+
+    def names(self) -> List[str]:
+        return [e[0] for e in self.events]
+
+    def count(self, name: str) -> int:
+        return sum(1 for e in self.events if e[0] == name)
+
+    def data_for(self, name: str) -> List:
+        return [e[2] for e in self.events if e[0] == name]

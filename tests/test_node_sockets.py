@@ -1,0 +1,348 @@
+"""Integration tests of the sockets backend.
+
+These re-create the *scenarios* of the reference suite
+(p2pnetwork/tests/test_node.py, SURVEY.md section 4) — topology bookkeeping,
+message delivery, event sequences, max_connections, ids — without its
+hard-coded sleeps: nodes bind ephemeral ports and tests wait on observable
+conditions. Reconnection, which the reference leaves as a TODO
+[ref: tests/test_node.py:5], is tested here too."""
+
+import pytest
+
+from p2pnetwork_tpu import Node, NodeConfig, NodeConnection
+from tests.helpers import EventRecorder, stop_all, wait_until
+
+
+def make_node(callback=None, max_connections=0, **kw):
+    node = Node("127.0.0.1", 0, callback=callback, max_connections=max_connections, **kw)
+    node.start()
+    return node
+
+
+class TestTopology:
+    def test_node_connection_bookkeeping(self):
+        # Scenario parity: reference test_node.py:15-59.
+        n1, n2 = make_node(), make_node()
+        try:
+            assert n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n1.nodes_outbound) == 1)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 1)
+            assert n1.nodes_outbound[0].id == n2.id
+            assert n2.nodes_inbound[0].id == n1.id
+            # Inbound port semantics (SURVEY.md 2.3.8): the stored port of an
+            # inbound connection is the peer's *server* port.
+            assert n2.nodes_inbound[0].port == n1.port
+            assert n1.all_nodes == n1.nodes_inbound + n1.nodes_outbound
+        finally:
+            stop_all([n1, n2])
+
+    def test_self_connect_refused(self):
+        n1 = make_node()
+        try:
+            assert n1.connect_with_node("127.0.0.1", n1.port) is False
+            assert n1.nodes_outbound == []
+        finally:
+            stop_all([n1])
+
+    def test_duplicate_connect_is_noop_true(self):
+        n1, n2 = make_node(), make_node()
+        try:
+            assert n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n1.nodes_outbound) == 1)
+            assert n1.connect_with_node("127.0.0.1", n2.port) is True
+            assert len(n1.nodes_outbound) == 1
+        finally:
+            stop_all([n1, n2])
+
+    def test_duplicate_id_guard(self):
+        # Two nodes with the same explicit id: second connection refused with
+        # the CLOSING handshake, reported True [ref: node.py:153-156].
+        n1 = make_node(id="same")
+        n2 = make_node()
+        n3 = make_node(id="same")
+        try:
+            assert n2.connect_with_node("127.0.0.1", n1.port)
+            assert wait_until(lambda: len(n2.nodes_outbound) == 1)
+            assert n2.connect_with_node("127.0.0.1", n3.port) is True
+            # No second outbound connection was registered.
+            assert len(n2.nodes_outbound) == 1
+        finally:
+            stop_all([n1, n2, n3])
+
+    def test_three_node_topology(self):
+        # Scenario parity: reference test_node.py:106-194.
+        n1, n2, n3 = make_node(), make_node(), make_node()
+        try:
+            assert n1.connect_with_node("127.0.0.1", n2.port)
+            assert n2.connect_with_node("127.0.0.1", n3.port)
+            assert n3.connect_with_node("127.0.0.1", n1.port)
+            assert wait_until(
+                lambda: all(
+                    len(n.nodes_inbound) == 1 and len(n.nodes_outbound) == 1
+                    for n in (n1, n2, n3)
+                )
+            )
+            assert n1.nodes_outbound[0].id == n2.id
+            assert n1.nodes_inbound[0].id == n3.id
+        finally:
+            stop_all([n1, n2, n3])
+
+    def test_disconnect_with_node(self):
+        rec1, rec2 = EventRecorder(), EventRecorder()
+        n1, n2 = make_node(rec1), make_node(rec2)
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 1)
+            n1.disconnect_with_node(n1.nodes_outbound[0])
+            assert wait_until(lambda: len(n1.nodes_outbound) == 0)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 0)
+            assert rec1.count("node_disconnect_with_outbound_node") == 1
+            assert rec1.count("outbound_node_disconnected") == 1
+            assert wait_until(lambda: rec2.count("inbound_node_disconnected") == 1)
+        finally:
+            stop_all([n1, n2])
+
+
+class TestMessaging:
+    def test_str_dict_bytes_delivery(self):
+        # Scenario parity: reference test_node.py:61-104 + dict/bytes payloads.
+        rec = EventRecorder()
+        n1, n2 = make_node(), make_node(rec)
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 1)
+            n1.send_to_nodes("hello")
+            n1.send_to_nodes({"k": "v", "n": 7})
+            n1.send_to_nodes(b"\x00\xffraw")
+            assert wait_until(lambda: rec.count("node_message") == 3)
+            assert rec.data_for("node_message") == ["hello", {"k": "v", "n": 7}, b"\x00\xffraw"]
+            assert n1.message_count_send == 3
+            assert n2.message_count_recv == 3
+        finally:
+            stop_all([n1, n2])
+
+    def test_exclude_list(self):
+        rec2, rec3 = EventRecorder(), EventRecorder()
+        n1, n2, n3 = make_node(), make_node(rec2), make_node(rec3)
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            n1.connect_with_node("127.0.0.1", n3.port)
+            assert wait_until(lambda: len(n1.nodes_outbound) == 2)
+            excluded = [c for c in n1.nodes_outbound if c.id == n3.id]
+            n1.send_to_nodes("only for n2", exclude=excluded)
+            assert wait_until(lambda: rec2.count("node_message") == 1)
+            assert rec3.count("node_message") == 0
+        finally:
+            stop_all([n1, n2, n3])
+
+    def test_send_to_unknown_node_counts_send(self):
+        # Parity: message_count_send increments before the membership check
+        # [ref: node.py:116-117].
+        n1, n2 = make_node(), make_node()
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 1)
+            foreign = n2.nodes_inbound[0]
+            n1.send_to_node(foreign, "nope")
+            assert n1.message_count_send == 1
+        finally:
+            stop_all([n1, n2])
+
+    def test_bidirectional_messaging(self):
+        rec1, rec2 = EventRecorder(), EventRecorder()
+        n1, n2 = make_node(rec1), make_node(rec2)
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: len(n2.nodes_inbound) == 1)
+            n1.send_to_nodes("ping")
+            assert wait_until(lambda: rec2.count("node_message") == 1)
+            n2.send_to_nodes("pong")
+            assert wait_until(lambda: rec1.count("node_message") == 1)
+            assert rec1.data_for("node_message") == ["pong"]
+        finally:
+            stop_all([n1, n2])
+
+
+class TestEvents:
+    def test_connect_event_sequence(self):
+        # Scenario parity: reference test_node.py:196-276 (event counts), with
+        # exact per-node assertions instead of order-tolerant branches.
+        rec1, rec2 = EventRecorder(), EventRecorder()
+        n1, n2 = make_node(rec1), make_node(rec2)
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: rec1.count("outbound_node_connected") == 1)
+            assert wait_until(lambda: rec2.count("inbound_node_connected") == 1)
+            n1.stop()
+            n1.join()
+            assert wait_until(lambda: rec2.count("inbound_node_disconnected") == 1)
+            assert rec1.count("node_request_to_stop") == 1
+        finally:
+            stop_all([n1, n2])
+
+    def test_subclass_override_parity(self):
+        # Scenario parity: reference test_node.py:278-396 — the same behavior
+        # is reachable by overriding the event methods instead of a callback.
+        log = []
+
+        class MyNode(Node):
+            def inbound_node_connected(self, node):
+                log.append(("in", node.id))
+                super().inbound_node_connected(node)
+
+            def node_message(self, node, data):
+                log.append(("msg", data))
+                super().node_message(node, data)
+
+        server = MyNode("127.0.0.1", 0)
+        server.start()
+        client = make_node()
+        try:
+            client.connect_with_node("127.0.0.1", server.port)
+            assert wait_until(lambda: len(server.nodes_inbound) == 1)
+            client.send_to_nodes("via-override")
+            assert wait_until(lambda: ("msg", "via-override") in log)
+            assert ("in", client.id) in log
+        finally:
+            stop_all([server, client])
+
+    def test_connection_error_event(self):
+        rec = EventRecorder()
+        n1 = make_node(rec)
+        try:
+            # Nothing listens on this port.
+            dead = Node("127.0.0.1", 0)
+            free_port = dead.port
+            dead.sock.close()
+            assert n1.connect_with_node("127.0.0.1", free_port) is False
+            assert rec.count("outbound_node_connection_error") == 1
+            assert n1.message_count_rerr >= 1  # rerr is live (SURVEY.md 2.3.7)
+        finally:
+            stop_all([n1])
+
+    def test_event_log_records_history(self):
+        n1, n2 = make_node(), make_node()
+        try:
+            n1.connect_with_node("127.0.0.1", n2.port)
+            assert wait_until(lambda: n2.event_log.count("inbound_node_connected") == 1)
+            n1.send_to_nodes("x")
+            assert wait_until(lambda: n2.event_log.count("node_message") == 1)
+            names = [e.event for e in n2.event_log.snapshot()]
+            assert names.index("inbound_node_connected") < names.index("node_message")
+        finally:
+            stop_all([n1, n2])
+
+
+class TestLimitsAndIds:
+    def test_max_connections(self):
+        # Scenario parity: reference test_node.py:398-455.
+        limited = make_node(max_connections=1)
+        n2, n3 = make_node(), make_node()
+        try:
+            assert n2.connect_with_node("127.0.0.1", limited.port)
+            assert wait_until(lambda: len(limited.nodes_inbound) == 1)
+            # Second connect is refused by the server; unlike the reference
+            # (which registers a phantom empty-id peer on the client) the
+            # client reports failure.
+            assert n3.connect_with_node("127.0.0.1", limited.port) is False
+            assert len(limited.nodes_inbound) == 1
+            assert n3.nodes_outbound == []
+        finally:
+            stop_all([limited, n2, n3])
+
+    def test_explicit_and_generated_ids(self):
+        # Scenario parity: reference test_node.py:457-483.
+        explicit = Node("127.0.0.1", 0, id=1234)
+        generated = Node("127.0.0.1", 0)
+        try:
+            assert explicit.id == "1234"  # coerced to str [ref: node.py:58]
+            assert isinstance(generated.id, str) and len(generated.id) == 128
+            assert generated.generate_id() != generated.id
+        finally:
+            explicit.sock.close()
+            generated.sock.close()
+
+
+class TestLifecycle:
+    def test_stop_is_idempotent(self):
+        n1 = make_node()
+        n1.stop()
+        n1.join()
+        n1.stop()  # after the loop is gone: still a no-op, no RuntimeError
+        assert not n1.is_alive()
+
+    def test_send_after_stop_is_harmless(self):
+        n1, n2 = make_node(), make_node()
+        n1.connect_with_node("127.0.0.1", n2.port)
+        assert wait_until(lambda: len(n1.nodes_outbound) == 1)
+        conn = n1.nodes_outbound[0]
+        stop_all([n1, n2])
+        conn.send("too late")  # loop closed — debug no-op, no exception
+
+    def test_reconnect_nodes_callable_from_event_handler(self):
+        # Calling the manual reconnect trigger from inside an event handler
+        # (on the node's own loop) must not deadlock.
+        class TriggerNode(Node):
+            def node_message(self, conn, data):
+                self.reconnect_nodes()
+                super().node_message(conn, data)
+
+        rec = EventRecorder()
+        server = TriggerNode("127.0.0.1", 0, callback=rec)
+        server.start()
+        client = make_node()
+        try:
+            client.connect_with_node("127.0.0.1", server.port)
+            assert wait_until(lambda: len(server.nodes_inbound) == 1)
+            client.send_to_nodes("poke")
+            assert wait_until(lambda: rec.count("node_message") == 1)
+        finally:
+            stop_all([server, client])
+
+
+class TestReconnect:
+    def test_reconnects_after_peer_restart(self):
+        # The reference leaves reconnection untested [ref: tests/test_node.py:5]
+        # and its implementation has the tries/trials KeyError (SURVEY.md
+        # 2.3.1). Here: a registered peer drops and comes back; the client
+        # re-establishes automatically.
+        cfg = NodeConfig(reconnect_interval=0.1)
+        server = make_node()
+        server_port = server.port
+        client = Node("127.0.0.1", 0, config=cfg)
+        client.start()
+        try:
+            assert client.connect_with_node("127.0.0.1", server_port, reconnect=True)
+            assert wait_until(lambda: len(client.nodes_outbound) == 1)
+            server.stop()
+            server.join()
+            assert wait_until(lambda: len(client.nodes_outbound) == 0)
+            # Restart a server on the same port.
+            server = Node("127.0.0.1", server_port)
+            server.start()
+            assert wait_until(lambda: len(client.nodes_outbound) == 1, timeout=10.0)
+            assert client.reconnect_to_nodes[0]["trials"] >= 0
+        finally:
+            stop_all([server, client])
+
+    def test_policy_hook_deregisters(self):
+        cfg = NodeConfig(reconnect_interval=0.05)
+
+        class GiveUpNode(Node):
+            def node_reconnection_error(self, host, port, trials):
+                return trials < 3  # stop retrying after 3 trials
+
+        server = make_node()
+        client = GiveUpNode("127.0.0.1", 0, config=cfg)
+        client.start()
+        try:
+            assert client.connect_with_node("127.0.0.1", server.port, reconnect=True)
+            assert wait_until(lambda: len(client.nodes_outbound) == 1)
+            port = server.port
+            server.stop()
+            server.join()
+            # With no server to come back, the policy hook gives up and the
+            # registry entry is removed.
+            assert wait_until(lambda: client.reconnect_to_nodes == [], timeout=10.0)
+        finally:
+            stop_all([server, client])

@@ -1,0 +1,87 @@
+"""Live interoperability with the reference implementation.
+
+The wire format (EOT framing, COMPR marker, tagged-b64 compression, plaintext
+id handshake) is designed to be byte-compatible with the reference so a
+tpu-p2p node can join a reference network (SURVEY.md section 7 step 1). When
+the reference package is available on disk these tests prove it by speaking
+to an actual reference ``Node`` over loopback; otherwise they skip."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from p2pnetwork_tpu import Node
+from tests.helpers import EventRecorder, stop_all, wait_until
+
+REFERENCE_PATH = "/root/reference"
+
+if not os.path.isdir(os.path.join(REFERENCE_PATH, "p2pnetwork")):
+    pytest.skip("reference implementation not available", allow_module_level=True)
+
+sys.path.insert(0, REFERENCE_PATH)
+from p2pnetwork.node import Node as ReferenceNode  # noqa: E402
+
+
+@pytest.fixture
+def ref_node():
+    # The reference cannot bind port 0 meaningfully (it never re-reads the
+    # chosen port), so pick a free port first.
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    received = []
+    node = ReferenceNode(
+        "127.0.0.1", port,
+        callback=lambda ev, mn, cn, d: received.append((ev, d)),
+    )
+    node.start()
+    yield node, port, received
+    node.stop()
+    node.join()
+
+
+def test_ours_connects_and_messages_reference(ref_node):
+    refnode, port, received = ref_node
+    ours = Node("127.0.0.1", 0)
+    ours.start()
+    try:
+        assert ours.connect_with_node("127.0.0.1", port)
+        assert wait_until(lambda: len(ours.nodes_outbound) == 1)
+        assert ours.nodes_outbound[0].id == refnode.id
+        assert wait_until(lambda: len(refnode.nodes_inbound) == 1)
+
+        ours.send_to_nodes("hello reference")
+        ours.send_to_nodes({"answer": 42})
+        ours.send_to_nodes("compressed hello", compression="zlib")
+        assert wait_until(
+            lambda: [d for e, d in received if e == "node_message"]
+            == ["hello reference", {"answer": 42}, "compressed hello"],
+            timeout=10.0,
+        )
+    finally:
+        stop_all([ours])
+
+
+def test_reference_connects_and_messages_ours(ref_node):
+    refnode, port, _ = ref_node
+    rec = EventRecorder()
+    ours = Node("127.0.0.1", 0, callback=rec)
+    ours.start()
+    try:
+        assert refnode.connect_with_node("127.0.0.1", ours.port)
+        assert wait_until(lambda: len(ours.nodes_inbound) == 1)
+        assert ours.nodes_inbound[0].id == refnode.id
+        # Inbound port semantics: the peer's server port from the handshake.
+        assert ours.nodes_inbound[0].port == port
+
+        refnode.send_to_nodes("hello tpu")
+        refnode.send_to_nodes({"k": [1, 2]}, compression="lzma")
+        assert wait_until(lambda: rec.count("node_message") == 2, timeout=10.0)
+        assert rec.data_for("node_message") == ["hello tpu", {"k": [1, 2]}]
+    finally:
+        stop_all([ours])

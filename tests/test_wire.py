@@ -1,0 +1,108 @@
+"""Unit tests for the wire format (framing, codecs, parsing).
+
+The reference has no unit tests at all — every test is a socket integration
+test (SURVEY.md section 4). Testing the codec as pure functions is one of the
+deliberate improvements."""
+
+import json
+
+import pytest
+
+from p2pnetwork_tpu import wire
+
+
+class TestCompression:
+    @pytest.mark.parametrize("algo", ["zlib", "bzip2", "lzma"])
+    def test_roundtrip(self, algo):
+        raw = b"hello p2p world " * 100
+        blob = wire.compress(raw, algo)
+        assert blob != raw
+        assert wire.decompress(blob) == raw
+
+    @pytest.mark.parametrize("algo,tag", [("zlib", b"zlib"), ("bzip2", b"bzip2"), ("lzma", b"lzma")])
+    def test_wire_format_is_b64_with_tag_suffix(self, algo, tag):
+        # Parity with reference nodeconnection.py:63-70: base64(comp + tag).
+        import base64
+
+        blob = wire.compress(b"data", algo)
+        decoded = base64.b64decode(blob)
+        assert decoded.endswith(tag)
+
+    def test_unknown_algorithm_raises(self):
+        with pytest.raises(wire.UnknownCompressionError):
+            wire.compress(b"data", "snappy")
+
+    def test_decompress_unknown_tag_returns_decoded(self):
+        import base64
+
+        blob = base64.b64encode(b"not compressed at all")
+        assert wire.decompress(blob) == b"not compressed at all"
+
+
+class TestPayloads:
+    def test_str_roundtrip(self):
+        frame = wire.encode_frame("hello")
+        assert frame == b"hello\x04"
+        assert wire.parse_packet(frame[:-1]) == "hello"
+
+    def test_dict_roundtrip(self):
+        data = {"k": [1, 2, 3], "nested": {"a": "b"}}
+        frame = wire.encode_frame(data)
+        assert frame.endswith(wire.EOT_CHAR)
+        assert wire.parse_packet(frame[:-1]) == data
+
+    def test_bytes_roundtrip(self):
+        payload = bytes(range(256))
+        frame = wire.encode_frame(payload)
+        assert wire.parse_packet(frame[:-1]) == payload
+
+    def test_numeric_string_stays_parsed_as_json(self):
+        # Parity quirk: the reference parses "42" back as the int 42 because
+        # json.loads runs on every utf-8 payload [ref: nodeconnection.py:176-181].
+        frame = wire.encode_frame("42")
+        assert wire.parse_packet(frame[:-1]) == 42
+
+    def test_invalid_type_raises(self):
+        with pytest.raises(TypeError):
+            wire.encode_payload(object())
+
+    @pytest.mark.parametrize("algo", ["zlib", "bzip2", "lzma"])
+    def test_compressed_frame_roundtrip(self, algo):
+        data = {"payload": "x" * 5000}
+        frame = wire.encode_frame(data, compression=algo)
+        assert frame.endswith(wire.COMPR_CHAR + wire.EOT_CHAR)
+        assert wire.parse_packet(frame[:-1]) == data
+
+
+class TestFrameDecoder:
+    def test_multiple_frames_in_one_chunk(self):
+        dec = wire.FrameDecoder()
+        chunk = wire.encode_frame("a") + wire.encode_frame({"b": 1}) + wire.encode_frame("c")
+        packets = list(dec.feed(chunk))
+        assert [wire.parse_packet(p) for p in packets] == ["a", {"b": 1}, "c"]
+        assert dec.pending == 0
+
+    def test_frame_split_across_chunks(self):
+        dec = wire.FrameDecoder()
+        frame = wire.encode_frame("x" * 10000)
+        packets = []
+        for i in range(0, len(frame), 4096):
+            packets.extend(dec.feed(frame[i : i + 4096]))
+        assert len(packets) == 1
+        assert wire.parse_packet(packets[0]) == "x" * 10000
+
+    def test_empty_frame_is_consumed(self):
+        # Deliberate fix of SURVEY.md 2.3.2: the reference's `while eot_pos > 0`
+        # never consumes an EOT at position 0 and wedges the stream.
+        dec = wire.FrameDecoder()
+        packets = list(dec.feed(wire.EOT_CHAR + wire.encode_frame("after")))
+        assert packets == [b"", b"after"]
+        assert dec.pending == 0
+
+    def test_buffer_bound_enforced(self):
+        # Deliberate fix of SURVEY.md 2.3.3 (unbounded recv buffer).
+        dec = wire.FrameDecoder(max_buffer=1024)
+        with pytest.raises(wire.FrameOverflowError):
+            list(dec.feed(b"x" * 2048))
+        # The decoder resets so the connection can report and die cleanly.
+        assert dec.pending == 0
