@@ -1,0 +1,47 @@
+"""Callback-style extension demo (sockets backend).
+
+The reference's alternative plugin style [ref: examples/
+my_own_p2p_application_callback.py]: instead of subclassing, pass a
+``callback(event, main_node, connected_node, data)``.
+Run: ``python examples/callback_application.py``
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu import Node
+
+
+def node_callback(event, main_node, connected_node, data):
+    peer = getattr(connected_node, "id", "?")
+    if event == "node_message":
+        print(f"  [{main_node.id}] {event} from {peer}: {data!r}")
+    else:
+        print(f"  [{main_node.id}] {event} ({peer})")
+
+
+def main():
+    alice = Node("127.0.0.1", 0, id="alice", callback=node_callback)
+    bob = Node("127.0.0.1", 0, id="bob", callback=node_callback)
+    alice.start()
+    bob.start()
+
+    alice.connect_with_node("127.0.0.1", bob.port)
+    time.sleep(0.3)
+    alice.send_to_nodes("hello bob")
+    bob.send_to_nodes({"reply": "hello alice"})
+    time.sleep(0.3)
+
+    # The structured event log records the same history for inspection.
+    print("bob's event log:", [e.event for e in bob.event_log.snapshot()])
+
+    for n in (alice, bob):
+        n.stop()
+    for n in (alice, bob):
+        n.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Sim backend demo: 100K-node flood as batched graph propagation.
+
+What the reference would need 100 000 threads and hours of 10 ms polls for
+[ref: p2pnetwork/nodeconnection.py:220] runs as one compiled scan.
+Run: ``python examples/flood_demo.py`` (CPU ok; TPU if available —
+set JAX_PLATFORMS=cpu to force CPU).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+from p2pnetwork_tpu.models import Flood
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim import graph as G
+
+
+def main():
+    n = 100_000
+    print(f"building {n}-node Watts-Strogatz graph ...")
+    g = G.watts_strogatz(n, 10, 0.1, seed=0)
+    print(f"  {g.n_edges} directed edges")
+
+    protocol = Flood(source=0)
+    t0 = time.perf_counter()
+    state, out = engine.run_until_coverage(
+        g, protocol, jax.random.key(0), coverage_target=0.99, max_rounds=64
+    )
+    jax.block_until_ready(state.seen)
+    first = time.perf_counter() - t0  # includes compile
+
+    t0 = time.perf_counter()
+    state, out = engine.run_until_coverage(
+        g, protocol, jax.random.key(0), coverage_target=0.99, max_rounds=64
+    )
+    jax.block_until_ready(state.seen)
+    steady = time.perf_counter() - t0
+
+    print(f"flood to 99% coverage: {int(out['rounds'])} rounds, "
+          f"{int(out['messages'])} messages")
+    print(f"  first run (with compile): {first*1000:.1f} ms")
+    print(f"  steady state:             {steady*1000:.1f} ms "
+          f"({int(out['messages'])/steady/1e6:.1f}M msgs/sec)")
+
+
+if __name__ == "__main__":
+    main()

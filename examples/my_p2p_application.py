@@ -1,0 +1,63 @@
+"""Three-node broadcast demo (sockets backend).
+
+The capability shown in the reference's examples/my_own_p2p_application.py:
+three nodes on localhost, a small topology, broadcasts observed via
+subclass hooks. Run: ``python examples/my_p2p_application.py``
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu import Node
+
+
+class MyNode(Node):
+    """Subclass-style extension: override the event hooks you care about."""
+
+    def inbound_node_connected(self, node):
+        print(f"  [{self.id}] peer connected: {node.id}")
+        super().inbound_node_connected(node)
+
+    def node_message(self, node, data):
+        print(f"  [{self.id}] message from {node.id}: {data!r}")
+        super().node_message(node, data)
+
+    def inbound_node_disconnected(self, node):
+        print(f"  [{self.id}] peer left: {node.id}")
+        super().inbound_node_disconnected(node)
+
+
+def main():
+    node1 = MyNode("127.0.0.1", 0, id="node-1")
+    node2 = MyNode("127.0.0.1", 0, id="node-2")
+    node3 = MyNode("127.0.0.1", 0, id="node-3")
+    nodes = [node1, node2, node3]
+    for n in nodes:
+        n.start()
+
+    # Triangle topology.
+    node1.connect_with_node("127.0.0.1", node2.port)
+    node2.connect_with_node("127.0.0.1", node3.port)
+    node3.connect_with_node("127.0.0.1", node1.port)
+    time.sleep(0.3)
+
+    print("broadcast from node-1:")
+    node1.send_to_nodes("ping from node-1")
+    time.sleep(0.3)
+
+    print("dict broadcast from node-2 (zlib-compressed):")
+    node2.send_to_nodes({"kind": "status", "height": 42}, compression="zlib")
+    time.sleep(0.3)
+
+    for n in nodes:
+        print(f"  [{n.id}] sent={n.message_count_send} recv={n.message_count_recv}")
+    for n in nodes:
+        n.stop()
+    for n in nodes:
+        n.join()
+
+
+if __name__ == "__main__":
+    main()

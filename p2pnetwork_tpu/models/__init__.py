@@ -1,0 +1,21 @@
+"""Protocol models for the simulation backend.
+
+The reference ships no protocols — users implement flooding/gossip/etc. in
+``node_message`` overrides [ref: README.md:20]. These are the batched,
+TPU-native forms of the protocols its users write by hand, all behind one
+``Protocol`` seam (models/base.py)."""
+
+from p2pnetwork_tpu.models.base import Protocol
+from p2pnetwork_tpu.models.flood import Flood, FloodState
+from p2pnetwork_tpu.models.gossip import Gossip, GossipState
+from p2pnetwork_tpu.models.sir import SIR, SIRState
+
+__all__ = [
+    "Protocol",
+    "Flood",
+    "FloodState",
+    "Gossip",
+    "GossipState",
+    "SIR",
+    "SIRState",
+]

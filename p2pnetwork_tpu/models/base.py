@@ -1,0 +1,36 @@
+"""The protocol seam of the simulation backend.
+
+The reference deliberately ships no protocol — users implement flooding /
+gossip / discovery in ``node_message`` overrides [ref: README.md:20,
+p2pnetwork/node.py:334]. The sim backend keeps that shape but batched: a
+protocol is a pair of pure, jittable functions over the whole population
+(SURVEY.md section 7 "hard parts" 1 — the honest bridge from asynchronous
+per-message callbacks to synchronous-round batched transitions):
+
+- ``init(graph, key) -> state``: per-node state as arrays (structs of arrays);
+- ``step(graph, state, key) -> (state, stats)``: one synchronous round, where
+  ``stats`` is a dict of scalar observables (device-side reductions — the
+  sim analog of the reference's message counters, SURVEY.md section 5).
+
+Protocol objects are dataclasses of static hyperparameters, so they hash
+stably into jit caches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol as TypingProtocol, Tuple
+
+import jax
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+State = Any
+Stats = Dict[str, jax.Array]
+
+
+class Protocol(TypingProtocol):
+    """Structural interface every sim protocol implements."""
+
+    def init(self, graph: Graph, key: jax.Array) -> State: ...
+
+    def step(self, graph: Graph, state: State, key: jax.Array) -> Tuple[State, Stats]: ...

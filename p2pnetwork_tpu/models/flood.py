@@ -1,0 +1,57 @@
+"""Flooding broadcast with seen-set dedup.
+
+The canonical protocol the reference tells users to write themselves
+[ref: README.md:20]: a node that receives a message for the first time
+re-broadcasts it to all its peers; a seen-set suppresses re-sends. In the
+reference this is per-node Python in ``node_message`` overrides fanned out
+over O(peers) sequential socket sends [ref: node.py:110-112]; here one round
+of the entire population is a single masked neighbor-OR (ops/segment.py) —
+the BASELINE.json north-star workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FloodState:
+    """Population state: who has the message, who got it last round."""
+
+    seen: jax.Array  # bool[N_pad]
+    frontier: jax.Array  # bool[N_pad] — nodes that first saw it last round
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class Flood:
+    """Single-source flood. ``source`` is the seed node index."""
+
+    source: int = 0
+    method: str = "auto"  # aggregation lowering, see ops/segment.py
+
+    def init(self, graph: Graph, key: jax.Array) -> FloodState:
+        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
+        seed = seed & graph.node_mask
+        return FloodState(seen=seed, frontier=seed)
+
+    def step(self, graph: Graph, state: FloodState, key: jax.Array):
+        """One synchronous round: frontier nodes broadcast; receivers that
+        had not seen the message join the next frontier."""
+        delivered = segment.propagate_or(graph, state.frontier, self.method)
+        new = delivered & ~state.seen & graph.node_mask
+        seen = state.seen | new
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            "messages": segment.frontier_messages(graph, state.frontier),
+            "coverage": jnp.sum(seen) / n_real,
+            "frontier": jnp.sum(new),
+        }
+        return FloodState(seen=seen, frontier=new), stats

@@ -1,0 +1,66 @@
+"""Push–pull gossip averaging.
+
+The second protocol family reference users build on ``node_message``
+[ref: README.md:20]: each node holds a value, repeatedly picks a random
+neighbor, and averages with it — randomized gossip consensus. In the sim
+backend one synchronous round is: every node draws one incoming neighbor
+uniformly from its neighbor row and moves halfway toward that neighbor's
+value (the synchronous-rounds form of push–pull averaging; BASELINE.json
+configs[2], 100K-node Barabási–Albert).
+
+Requires a graph built with a neighbor table (the default).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GossipState:
+    values: jax.Array  # f32[N_pad]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class Gossip:
+    """Randomized pairwise averaging toward consensus."""
+
+    #: Mixing weight toward the sampled neighbor (0.5 = halfway).
+    alpha: float = 0.5
+
+    def init(self, graph: Graph, key: jax.Array) -> GossipState:
+        if graph.neighbors is None:
+            raise ValueError("Gossip requires a graph with a neighbor table")
+        values = jax.random.normal(key, (graph.n_nodes_padded,), dtype=jnp.float32)
+        return GossipState(values=values * graph.node_mask)
+
+    def step(self, graph: Graph, state: GossipState, key: jax.Array):
+        n_pad = graph.n_nodes_padded
+        # Each node draws one slot uniformly from its neighbor row; when the
+        # table was width-capped (from_edges max_degree=) only the stored
+        # neighbors are candidates — sampling over the full in_degree would
+        # clamp excess slots onto the last column and bias toward it.
+        width = graph.neighbors.shape[1]
+        degree = jnp.maximum(jnp.minimum(graph.in_degree, width), 1)
+        slot = jax.random.randint(key, (n_pad,), 0, jnp.int32(2**31 - 1)) % degree
+        partner = jnp.take_along_axis(graph.neighbors, slot[:, None], axis=1)[:, 0]
+        has_neighbor = (graph.in_degree > 0) & graph.node_mask
+        pulled = state.values[partner]
+        mixed = (1.0 - self.alpha) * state.values + self.alpha * pulled
+        values = jnp.where(has_neighbor, mixed, state.values)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        mean = jnp.sum(values * graph.node_mask) / n_real
+        var = jnp.sum(jnp.where(graph.node_mask, (values - mean) ** 2, 0.0)) / n_real
+        stats = {
+            # One pull + one push per sampling node — the message-count analog.
+            "messages": 2 * jnp.sum(has_neighbor.astype(jnp.int32)),
+            "variance": var,
+            "mean": mean,
+        }
+        return GossipState(values=values), stats

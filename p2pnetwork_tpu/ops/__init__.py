@@ -1,0 +1,7 @@
+"""Aggregation primitives: segment/gather (segment.py), one-hot-matmul
+blocked (blocked.py), and the fused Pallas TPU kernel (pallas_edge.py)."""
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.ops.segment import frontier_messages, propagate_or, propagate_sum
+
+__all__ = ["segment", "propagate_or", "propagate_sum", "frontier_messages"]

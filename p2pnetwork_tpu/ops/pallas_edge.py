@@ -1,0 +1,102 @@
+"""Pallas TPU kernel for the edge-aggregation hot path.
+
+Same blocked scheme as ops/blocked.py — segment reduction as one-hot
+matmuls — but fused: the one-hot destination mask is generated *inside* the
+kernel (an iota + compare in VMEM) and consumed immediately by the MXU, so
+it never exists in HBM. The XLA einsum lowering materializes that mask at
+``edges * 128 * 4`` bytes (gigabytes at BASELINE scale); fusing it away
+makes the kernel's HBM traffic just the contributions and destinations —
+this is the bandwidth win that justifies a kernel (SURVEY.md section 7
+step 5).
+
+Grid: ``(n_blocks, width_tiles)``. Each step loads one ``[1, TILE_W]`` strip
+of edge contributions + local destinations for one 128-node output block,
+builds the ``[TILE_W, 128]`` one-hot in VMEM, and accumulates a
+``[1, TILE_W] @ [TILE_W, 128]`` partial product into the block's output row
+(output revisiting across the width dimension).
+
+Padded edge slots carry contribution 0, so no masking is needed in-kernel.
+On CPU (tests) the kernel runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from p2pnetwork_tpu.ops.blocked import BlockedEdges
+
+#: Edge-strip width per grid step.
+TILE_W = 512
+
+
+def _segsum_kernel(contrib_ref, dst_ref, out_ref, *, block: int, tile_w: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    contrib = contrib_ref[:]  # [1, TILE_W] f32
+    dst = dst_ref[:]  # [1, TILE_W] i32
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tile_w, block), 1)
+    onehot = (dst.reshape(tile_w, 1) == iota).astype(jnp.float32)
+    out_ref[:] += jnp.dot(contrib, onehot, preferred_element_type=jnp.float32)
+
+
+def _is_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@functools.partial(jax.jit, static_argnames=("block", "tile_w", "interpret"))
+def segment_sum_pallas(contrib: jax.Array, local_dst: jax.Array,
+                       block: int = 128, tile_w: int = TILE_W,
+                       interpret: bool | None = None):
+    """Blocked segment sum: ``out[n, b] = sum_w contrib[n, w] * (dst[n, w] == b)``.
+
+    ``contrib`` f32[NB, W] (masked slots must be 0), ``local_dst`` i32[NB, W]
+    with values in [0, block). Returns f32[NB, block].
+    """
+    nb, w = contrib.shape
+    if block % 128 != 0:
+        raise ValueError(f"block must be a multiple of 128 (lane width), got {block}")
+    if w % tile_w != 0:
+        pad = tile_w - w % tile_w
+        contrib = jnp.pad(contrib, ((0, 0), (0, pad)))
+        local_dst = jnp.pad(local_dst, ((0, 0), (0, pad)))
+        w += pad
+    if interpret is None:
+        interpret = _is_cpu()
+    kernel = functools.partial(_segsum_kernel, block=block, tile_w=tile_w)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb, w // tile_w),
+        in_specs=[
+            pl.BlockSpec((1, tile_w), lambda i, j: (i, j)),
+            pl.BlockSpec((1, tile_w), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, block), jnp.float32),
+        interpret=interpret,
+    )(contrib, local_dst)
+
+
+def propagate_sum_pallas(blocked: BlockedEdges, signal: jax.Array,
+                         node_mask: jax.Array, tile_w: int = TILE_W) -> jax.Array:
+    """Per-node incoming sum via the fused kernel. signal f32[N_pad] -> f32[N_pad]."""
+    contrib = signal[blocked.src] * blocked.mask.astype(signal.dtype)
+    out = segment_sum_pallas(
+        contrib.astype(jnp.float32), blocked.local_dst, blocked.block, tile_w
+    )
+    out = out.reshape(-1)[: node_mask.shape[0]]
+    return out * node_mask.astype(jnp.float32)
+
+
+def propagate_or_pallas(blocked: BlockedEdges, signal: jax.Array,
+                        node_mask: jax.Array, tile_w: int = TILE_W) -> jax.Array:
+    """Per-node incoming OR via the fused kernel (0/1 contributions)."""
+    out = propagate_sum_pallas(blocked, signal.astype(jnp.float32), node_mask, tile_w)
+    return (out > 0) & node_mask

@@ -1,0 +1,93 @@
+"""Edge-aggregation primitives — the hot ops of the simulation backend.
+
+One flooding/gossip round in the reference is an O(peers) sequential Python
+loop of socket sends per node [ref: p2pnetwork/node.py:110-112] plus a 10 ms
+poll per connection [ref: nodeconnection.py:220]. Here the same round is one
+batched aggregation over every edge of the population at once:
+
+- ``propagate_or``  — per-receiver OR of a boolean node signal (flooding:
+  "did any of my neighbors have the message?")
+- ``propagate_sum`` — per-receiver sum of a float node signal (gossip / SIR:
+  infection pressure, value accumulation)
+- ``frontier_messages`` — how many point-to-point messages this round
+  corresponds to (the sim-side ``message_count`` parity metric).
+
+Two lowerings, chosen by what the graph carries:
+
+- ``segment``: COO edges sorted by receiver -> ``jax.ops.segment_*`` with
+  ``indices_are_sorted=True``. General, handles any degree distribution.
+- ``gather``: padded neighbor table ``[N, max_degree]`` -> row-wise gather +
+  masked reduce along the degree axis. Dense, regular memory traffic that
+  maps well onto TPU vector units for quasi-regular graphs; this shape is
+  also what the Pallas kernel implements (ops/pallas_edge.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
+    """Per-node OR over incoming neighbors: ``out[v] = any(signal[u], u->v)``.
+
+    ``signal`` is bool[N_pad]; masked (padding) edges and nodes contribute
+    nothing. ``method`` is ``"segment"``, ``"gather"`` or ``"auto"`` (gather
+    when the graph carries a neighbor table).
+    """
+    if method == "auto":
+        method = "gather" if graph.neighbors is not None else "segment"
+    if method == "gather":
+        vals = signal[graph.neighbors] & graph.neighbor_mask
+        return jnp.any(vals, axis=1) & graph.node_mask
+    if method in ("blocked", "pallas"):
+        from p2pnetwork_tpu.ops import blocked as B
+        from p2pnetwork_tpu.ops import pallas_edge as PK
+
+        if graph.blocked is None:
+            raise ValueError(f"method={method!r} requires graph.with_blocked()")
+        fn = B.propagate_or_blocked if method == "blocked" else PK.propagate_or_pallas
+        return fn(graph.blocked, signal, graph.node_mask)
+    contrib = (signal[graph.senders] & graph.edge_mask).astype(jnp.int32)
+    agg = jax.ops.segment_max(
+        contrib,
+        graph.receivers,
+        num_segments=graph.n_nodes_padded,
+        indices_are_sorted=True,
+    )
+    return (agg > 0) & graph.node_mask
+
+
+def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
+    """Per-node sum over incoming neighbors: ``out[v] = sum(signal[u], u->v)``."""
+    if method == "auto":
+        method = "gather" if graph.neighbors is not None else "segment"
+    if method == "gather":
+        vals = signal[graph.neighbors] * graph.neighbor_mask.astype(signal.dtype)
+        return jnp.sum(vals, axis=1) * graph.node_mask.astype(signal.dtype)
+    if method in ("blocked", "pallas"):
+        from p2pnetwork_tpu.ops import blocked as B
+        from p2pnetwork_tpu.ops import pallas_edge as PK
+
+        if graph.blocked is None:
+            raise ValueError(f"method={method!r} requires graph.with_blocked()")
+        fn = B.propagate_sum_blocked if method == "blocked" else PK.propagate_sum_pallas
+        return fn(graph.blocked, signal, graph.node_mask)
+    contrib = signal[graph.senders] * graph.edge_mask.astype(signal.dtype)
+    agg = jax.ops.segment_sum(
+        contrib,
+        graph.receivers,
+        num_segments=graph.n_nodes_padded,
+        indices_are_sorted=True,
+    )
+    return agg * graph.node_mask.astype(signal.dtype)
+
+
+def frontier_messages(graph: Graph, frontier: jax.Array) -> jax.Array:
+    """Number of point-to-point sends this round: every node holding the
+    frontier flag sends to each of its outgoing edges — the batched
+    equivalent of the reference's per-edge ``send_to_nodes`` loop and its
+    ``message_count_send`` counter [ref: node.py:110-116]."""
+    return jnp.sum(jnp.where(frontier, graph.out_degree, 0))

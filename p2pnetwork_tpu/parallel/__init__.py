@@ -1,0 +1,7 @@
+"""Multi-device scale-out: ring meshes (mesh.py) and sharded graph
+propagation with ppermute ring exchange (sharded.py)."""
+
+from p2pnetwork_tpu.parallel.mesh import ring_mesh, shard_spec
+from p2pnetwork_tpu.parallel.sharded import ShardedGraph, flood, shard_graph
+
+__all__ = ["ring_mesh", "shard_spec", "ShardedGraph", "shard_graph", "flood"]

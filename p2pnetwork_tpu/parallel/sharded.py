@@ -1,0 +1,209 @@
+"""Sharded graph propagation: ring ``ppermute`` over a device mesh.
+
+This is the TPU-native replacement for the reference's only scaling story
+(one OS thread per peer, O(E) sequential socket sends, SURVEY.md section
+2.4). Design (SURVEY.md sections 5 "long-context" and 7 step 4):
+
+- **Node-partitioned state**: node ``v`` lives on shard ``v // block``;
+  per-node arrays (seen flags, values, statuses) are sharded on their
+  leading axis.
+- **Edge-partitioned adjacency, bucketed by source shard**: shard ``d``
+  holds every edge whose *receiver* it owns, grouped into ``S`` buckets by
+  the *sender*'s shard, ordered by ring distance (bucket ``t`` holds edges
+  from shard ``(d - t) mod S``).
+- **Ring exchange**: one propagation round runs ``S`` steps. At step ``t``
+  each shard holds the frontier block of shard ``(d - t) mod S`` (rotated by
+  ``lax.ppermute`` each step — neighbor traffic over ICI, the ring-attention
+  communication shape) and applies exactly the edge bucket that consumes it.
+  After ``S`` steps every cross-shard edge has been resolved with no
+  all-gather and no DCN hot spot; per-round stats come back via ``psum``.
+
+The whole multi-round propagation (scan over rounds, ring scan inside) is
+one ``shard_map``-ped, jitted XLA program — zero host round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS, ring_mesh
+from p2pnetwork_tpu.sim.graph import Graph, _round_up
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedGraph:
+    """A :class:`Graph` partitioned for an ``S``-shard ring.
+
+    ``bkt_*`` have global shape ``[S, S, E_bkt]`` — leading axis sharded
+    (one row per destination shard), second axis the ring step. Local edge
+    indices: ``bkt_src`` into the *rotating* frontier block, ``bkt_dst`` into
+    the shard's own node block. Within a bucket, edges are sorted by
+    destination so segment reductions see sorted ids.
+    """
+
+    bkt_src: jax.Array  # i32[S, S, E_bkt]
+    bkt_dst: jax.Array  # i32[S, S, E_bkt]
+    bkt_mask: jax.Array  # bool[S, S, E_bkt]
+    node_mask: jax.Array  # bool[S, B]
+    out_degree: jax.Array  # i32[S, B]
+    n_nodes: int = dataclasses.field(metadata=dict(static=True))
+    n_shards: int = dataclasses.field(metadata=dict(static=True))
+    block: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def n_nodes_padded(self) -> int:
+        return self.n_shards * self.block
+
+
+def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
+                edge_pad_multiple: int = 128) -> ShardedGraph:
+    """Partition ``graph`` for ``mesh`` (host-side; one-off setup).
+
+    Nodes are split into ``S`` contiguous blocks. Every active edge lands in
+    bucket ``(dst_shard, ring_step)`` where ``ring_step = (dst_shard -
+    src_shard) mod S`` — the step of the ring rotation at which the sender's
+    frontier block is resident on the receiver's shard.
+    """
+    S = mesh.shape[axis_name]
+    emask = np.asarray(graph.edge_mask)
+    senders = np.asarray(graph.senders)[emask]
+    receivers = np.asarray(graph.receivers)[emask]
+
+    block = _round_up(graph.n_nodes_padded, S) // S
+    src_shard = senders // block
+    dst_shard = receivers // block
+    step = (dst_shard - src_shard) % S
+
+    # Bucket sizes -> common padded width.
+    flat = dst_shard * S + step
+    counts = np.bincount(flat, minlength=S * S)
+    e_bkt = _round_up(max(int(counts.max()), 1), edge_pad_multiple)
+
+    bkt_src = np.zeros((S, S, e_bkt), dtype=np.int32)
+    bkt_dst = np.zeros((S, S, e_bkt), dtype=np.int32)
+    bkt_mask = np.zeros((S, S, e_bkt), dtype=bool)
+
+    # Sort edges by (bucket, local dst) so each bucket is dst-sorted.
+    order = np.lexsort((receivers, flat))
+    senders, receivers, flat = senders[order], receivers[order], flat[order]
+    offsets = np.zeros(S * S + 1, dtype=np.int64)
+    np.cumsum(np.bincount(flat, minlength=S * S), out=offsets[1:])
+    for d in range(S):
+        for t in range(S):
+            b = d * S + t
+            lo, hi = offsets[b], offsets[b + 1]
+            n = hi - lo
+            bkt_src[d, t, :n] = senders[lo:hi] % block
+            bkt_dst[d, t, :n] = receivers[lo:hi] % block
+            bkt_mask[d, t, :n] = True
+
+    node_mask = np.asarray(graph.node_mask)
+    node_mask = np.pad(node_mask, (0, S * block - node_mask.shape[0]))
+    out_degree = np.asarray(graph.out_degree)
+    out_degree = np.pad(out_degree, (0, S * block - out_degree.shape[0]))
+
+    shard = NamedSharding(mesh, P(axis_name))
+    dev = lambda x: jax.device_put(x, shard)  # noqa: E731
+    return ShardedGraph(
+        bkt_src=dev(bkt_src),
+        bkt_dst=dev(bkt_dst),
+        bkt_mask=dev(bkt_mask),
+        node_mask=dev(node_mask.reshape(S, block)),
+        out_degree=dev(out_degree.reshape(S, block).astype(np.int32)),
+        n_nodes=graph.n_nodes,
+        n_shards=S,
+        block=block,
+    )
+
+
+def _ring_perm(S: int):
+    """Send block to the next shard: after t applications, shard d holds the
+    block originally on shard (d - t) mod S."""
+    return [(i, (i + 1) % S) for i in range(S)]
+
+
+def _ring_rounds_or(axis_name, S, block, bkt_src, bkt_dst, bkt_mask,
+                    node_mask, out_degree, seen0, frontier0, rounds):
+    """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
+    full ring pass. All blocks carry a leading length-1 shard axis."""
+    bkt_src, bkt_dst, bkt_mask = bkt_src[0], bkt_dst[0], bkt_mask[0]
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+
+    def one_round(carry, _):
+        seen, frontier = carry  # [block] bool each
+
+        def ring_step(rc, bkt):
+            rot, acc = rc  # rot: frontier block resident this step
+            src, dst, m = bkt
+            contrib = (rot[src] & m).astype(jnp.int32)
+            delivered = jax.ops.segment_max(
+                contrib, dst, num_segments=block, indices_are_sorted=True
+            ) > 0
+            acc = acc | delivered
+            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
+            return (rot, acc), None
+
+        (_, delivered), _ = jax.lax.scan(
+            ring_step,
+            (frontier, jnp.zeros_like(seen)),
+            (bkt_src, bkt_dst, bkt_mask),
+        )
+        new = delivered & ~seen & node_mask_b
+        seen = seen | new
+        msgs = jax.lax.psum(
+            jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+        )
+        covered = jax.lax.psum(jnp.sum(seen.astype(jnp.int32)), axis_name)
+        return (seen, new), {"messages": msgs, "covered": covered}
+
+    (seen, frontier), stats = jax.lax.scan(
+        one_round, (seen0[0], frontier0[0]), None, length=rounds
+    )
+    return seen[None], frontier[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int):
+    """Build (and cache) the compiled sharded flood program for this shape."""
+    body = functools.partial(_ring_rounds_or, axis_name, S, block)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh,
+        in_specs=(spec,) * 7,
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
+          axis_name: str = DEFAULT_AXIS):
+    """Run ``rounds`` of single-source flood on the sharded graph.
+
+    Returns ``(seen [S, block] bool, stats dict of [rounds] arrays)`` — the
+    sharded equivalent of ``engine.run(graph, Flood(source), ...)``, and
+    bit-identical to it (tests/test_sharded.py).
+    """
+    S, block = sg.n_shards, sg.block
+    seen0 = jnp.zeros((S, block), dtype=bool).at[source // block, source % block].set(True)
+    frontier0 = seen0
+
+    fn = _flood_fn(mesh, axis_name, S, block, rounds)
+    seen, frontier, stats = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, sg.node_mask, sg.out_degree,
+        seen0, frontier0,
+    )
+    n_real = max(sg.n_nodes, 1)
+    stats = {
+        "messages": stats["messages"],
+        "coverage": stats["covered"].astype(jnp.float32) / n_real,
+    }
+    return seen, stats

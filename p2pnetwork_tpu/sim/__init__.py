@@ -1,0 +1,17 @@
+"""Simulation backend: populations of peers as device arrays.
+
+- ``graph``: static-shape peer graphs + generators
+- ``engine``: compiled round execution (scan / while_loop)
+- ``simnode``: JaxSimNode, the Node-API bridge
+- ``checkpoint``: save/resume of simulation state
+"""
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env as _apply_platform_env
+
+_apply_platform_env()
+
+from p2pnetwork_tpu.sim import checkpoint, engine, graph  # noqa: E402
+from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.sim.simnode import JaxSimNode, SimPeer
+
+__all__ = ["Graph", "JaxSimNode", "SimPeer", "checkpoint", "engine", "graph"]

@@ -1,0 +1,274 @@
+"""Peer-graph representation for the simulation backend.
+
+The reference keeps the peer topology as Python lists of live socket threads
+(`nodes_inbound`/`nodes_outbound` [ref: p2pnetwork/node.py:46-49]) and
+"broadcast" is a sequential Python loop over them [ref: node.py:110-112].
+Here the whole population's topology is device-resident arrays with static
+shapes, so one propagation round is one batched XLA computation (SURVEY.md
+section 7 step 2):
+
+- **COO edges sorted by receiver** (``senders``/``receivers``/``edge_mask``),
+  the general representation, feeding segment reductions;
+- an optional **padded neighbor table** (``neighbors``/``neighbor_mask``,
+  shape ``[N, max_degree]``), the gather-friendly representation that maps
+  well onto TPU vector loads for quasi-regular graphs (WS/ER).
+
+Static shapes everywhere: node count and edge count are padded (capacity
+padding + active masks), which is how dynamic topology (connect/disconnect,
+SURVEY.md section 7 "hard parts" 4) fits XLA's compile-once model — adding or
+dropping a peer flips mask bits, it does not recompile.
+
+Generators (Erdős–Rényi, Barabási–Albert, Watts–Strogatz, ring, complete)
+run host-side in numpy: graph construction is one-off setup, the hot path is
+propagation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """A static-shape peer graph on device.
+
+    An edge ``(senders[e], receivers[e])`` means messages flow sender ->
+    receiver (undirected topologies store both directions). Edges are sorted
+    by receiver so segment reductions can assume sorted segment ids.
+    """
+
+    senders: jax.Array  # i32[E_pad]
+    receivers: jax.Array  # i32[E_pad], non-decreasing
+    edge_mask: jax.Array  # bool[E_pad]
+    node_mask: jax.Array  # bool[N_pad]
+    in_degree: jax.Array  # i32[N_pad]  (active incoming edges per node)
+    out_degree: jax.Array  # i32[N_pad] (active outgoing edges per node)
+    # Gather representation: incoming neighbor list per node, or None.
+    neighbors: Optional[jax.Array]  # i32[N_pad, max_degree]
+    neighbor_mask: Optional[jax.Array]  # bool[N_pad, max_degree]
+    # Static metadata.
+    n_nodes: int = dataclasses.field(metadata=dict(static=True))
+    n_edges: int = dataclasses.field(metadata=dict(static=True))
+    # Optional blocked-edge representation (ops/blocked.py) feeding the
+    # matmul/Pallas aggregation paths; attach via with_blocked().
+    blocked: Optional[object] = None
+
+    @property
+    def n_nodes_padded(self) -> int:
+        return self.node_mask.shape[0]
+
+    @property
+    def n_edges_padded(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def max_degree(self) -> int:
+        return 0 if self.neighbors is None else self.neighbors.shape[1]
+
+    def with_blocked(self, block: int = 128) -> "Graph":
+        """Return a copy carrying the blocked-edge representation used by the
+        ``"blocked"`` (XLA einsum) and ``"pallas"`` aggregation methods."""
+        from p2pnetwork_tpu.ops.blocked import build_blocked
+
+        return dataclasses.replace(self, blocked=build_blocked(self, block))
+
+
+def from_edges(
+    senders,
+    receivers,
+    n_nodes: int,
+    *,
+    node_pad_multiple: int = 128,
+    edge_pad_multiple: int = 128,
+    build_neighbor_table: bool = True,
+    max_degree: Optional[int] = None,
+) -> Graph:
+    """Build a :class:`Graph` from host-side edge arrays.
+
+    Edges are sorted by receiver and padded to ``edge_pad_multiple``; nodes
+    are padded to ``node_pad_multiple`` (lane-friendly sizes keep XLA tiling
+    happy). Padded edges point at node index 0 but are masked out of every
+    aggregation. ``max_degree`` caps the neighbor table width (default: the
+    true maximum in-degree).
+    """
+    senders = np.asarray(senders, dtype=np.int32)
+    receivers = np.asarray(receivers, dtype=np.int32)
+    if senders.shape != receivers.shape:
+        raise ValueError("senders and receivers must have the same shape")
+    if senders.size and (senders.max() >= n_nodes or receivers.max() >= n_nodes):
+        raise ValueError("edge endpoint out of range")
+
+    order = np.argsort(receivers, kind="stable")
+    senders, receivers = senders[order], receivers[order]
+
+    n_pad = _round_up(max(n_nodes, 1), node_pad_multiple)
+    e = senders.size
+    e_pad = _round_up(max(e, 1), edge_pad_multiple)
+
+    s = np.zeros(e_pad, dtype=np.int32)
+    r = np.zeros(e_pad, dtype=np.int32)
+    s[:e], r[:e] = senders, receivers
+    emask = np.zeros(e_pad, dtype=bool)
+    emask[:e] = True
+    nmask = np.zeros(n_pad, dtype=bool)
+    nmask[:n_nodes] = True
+
+    in_deg = np.bincount(receivers, minlength=n_pad).astype(np.int32)
+    out_deg = np.bincount(senders, minlength=n_pad).astype(np.int32)
+
+    neighbors = neighbor_mask = None
+    if build_neighbor_table:
+        width = int(in_deg.max()) if e else 0
+        if max_degree is not None:
+            width = min(width, max_degree)
+        width = max(width, 1)
+        # receivers are sorted, so each node's incoming edges are contiguous.
+        starts = np.searchsorted(receivers, np.arange(n_pad))
+        ends = np.searchsorted(receivers, np.arange(n_pad), side="right")
+        slot = np.arange(width)
+        counts = np.minimum(ends - starts, width)
+        take = starts[:, None] + slot[None, :]
+        valid = slot[None, :] < counts[:, None]
+        take = np.where(valid, take, 0)
+        # A dummy pool entry keeps the (eagerly evaluated) gather in-bounds
+        # for zero-edge graphs; `valid` masks it out.
+        pool = senders if e else np.zeros(1, dtype=np.int32)
+        neighbors = np.where(valid, pool[np.minimum(take, max(e - 1, 0))], 0).astype(np.int32)
+        neighbor_mask = valid
+
+    return Graph(
+        senders=jnp.asarray(s),
+        receivers=jnp.asarray(r),
+        edge_mask=jnp.asarray(emask),
+        node_mask=jnp.asarray(nmask),
+        in_degree=jnp.asarray(in_deg),
+        out_degree=jnp.asarray(out_deg),
+        neighbors=None if neighbors is None else jnp.asarray(neighbors),
+        neighbor_mask=None if neighbor_mask is None else jnp.asarray(neighbor_mask),
+        n_nodes=n_nodes,
+        n_edges=e,
+    )
+
+
+def _undirect(src: np.ndarray, dst: np.ndarray):
+    """Duplicate each undirected edge into both directions."""
+    return np.concatenate([src, dst]), np.concatenate([dst, src])
+
+
+def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
+    """G(n, p) random graph (undirected).
+
+    For scale, the number of undirected edges is drawn from the matching
+    binomial and pairs are sampled uniformly (with collision dedup) instead
+    of materialising the O(n^2) adjacency — equivalent in distribution up to
+    the dedup, and the only tractable construction at millions of nodes.
+    """
+    rng = np.random.default_rng(seed)
+    n_pairs = n * (n - 1) // 2
+    m = rng.binomial(n_pairs, p) if n_pairs < 2**63 else int(p * n_pairs)
+    if m == 0:
+        return from_edges(np.zeros(0), np.zeros(0), n, **kw)
+    # Accumulate unique pairs until we have at least m, then subsample to
+    # exactly m uniformly — truncating the (sorted) unique keys instead would
+    # bias edges toward low-index nodes.
+    keys = np.zeros(0, dtype=np.int64)
+    draw = int(m * 1.2) + 16
+    while keys.size < m:
+        src = rng.integers(0, n, size=draw, dtype=np.int64)
+        dst = rng.integers(0, n, size=draw, dtype=np.int64)
+        keep = src != dst
+        lo, hi = np.minimum(src[keep], dst[keep]), np.maximum(src[keep], dst[keep])
+        keys = np.unique(np.concatenate([keys, lo * n + hi]))
+        draw *= 2
+    keys = rng.permutation(keys)[:m]
+    lo, hi = (keys // n).astype(np.int32), (keys % n).astype(np.int32)
+    return from_edges(*_undirect(lo, hi), n, **kw)
+
+
+def barabasi_albert(n: int, m: int, seed: int = 0, **kw) -> Graph:
+    """Barabási–Albert preferential attachment: each new node attaches ``m``
+    edges to existing nodes with probability proportional to degree
+    (implemented with the standard repeated-endpoints sampling trick)."""
+    if m < 1 or m >= n:
+        raise ValueError("barabasi_albert requires 1 <= m < n")
+    rng = np.random.default_rng(seed)
+    # Endpoint pool: every edge endpoint appears once; sampling uniformly
+    # from the pool is sampling proportional to degree.
+    src_list = []
+    dst_list = []
+    pool = list(range(m))  # seed clique targets
+    for v in range(m, n):
+        targets = set()
+        while len(targets) < m:
+            targets.add(pool[rng.integers(0, len(pool))] if pool else int(rng.integers(0, v)))
+        for t in targets:
+            src_list.append(v)
+            dst_list.append(t)
+            pool.append(v)
+            pool.append(t)
+    src = np.asarray(src_list, dtype=np.int32)
+    dst = np.asarray(dst_list, dtype=np.int32)
+    return from_edges(*_undirect(src, dst), n, **kw)
+
+
+def watts_strogatz(n: int, k: int, p: float, seed: int = 0, **kw) -> Graph:
+    """Watts–Strogatz small world: ring lattice with ``k`` neighbors per node
+    (k/2 each side), each edge rewired with probability ``p``. Vectorized —
+    this is the generator used for the million-node benchmark configs."""
+    if k % 2 != 0:
+        raise ValueError("watts_strogatz requires even k")
+    rng = np.random.default_rng(seed)
+    base = np.arange(n, dtype=np.int64)
+    srcs, dsts = [], []
+    for off in range(1, k // 2 + 1):
+        src = base
+        dst = (base + off) % n
+        rewire = rng.random(n) < p
+        new_dst = rng.integers(0, n, size=n)
+        dst = np.where(rewire, new_dst, dst)
+        self_loop = dst == src
+        dst = np.where(self_loop, (src + off) % n, dst)
+        srcs.append(src)
+        dsts.append(dst)
+    src = np.concatenate(srcs).astype(np.int32)
+    dst = np.concatenate(dsts).astype(np.int32)
+    return from_edges(*_undirect(src, dst), n, **kw)
+
+
+def ring(n: int, **kw) -> Graph:
+    """Simple bidirectional ring."""
+    base = np.arange(n, dtype=np.int32)
+    return from_edges(*_undirect(base, (base + 1) % n), n, **kw)
+
+
+def complete(n: int, **kw) -> Graph:
+    """Complete graph (every pair connected) — small n only."""
+    src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    keep = src != dst
+    return from_edges(src[keep].astype(np.int32), dst[keep].astype(np.int32), n, **kw)
+
+
+def build(topology) -> Graph:
+    """Build a graph from a :class:`p2pnetwork_tpu.config.TopologyConfig`."""
+    kind = topology.kind
+    if kind == "erdos_renyi":
+        return erdos_renyi(topology.n_nodes, topology.p, topology.seed)
+    if kind == "barabasi_albert":
+        return barabasi_albert(topology.n_nodes, topology.k, topology.seed)
+    if kind == "watts_strogatz":
+        return watts_strogatz(topology.n_nodes, topology.k, topology.p, topology.seed)
+    if kind == "ring":
+        return ring(topology.n_nodes)
+    if kind == "complete":
+        return complete(topology.n_nodes)
+    raise ValueError(f"unknown topology kind: {kind!r}")

@@ -1,0 +1,170 @@
+"""JaxSimNode — the bridge between the Node extension API and the sim engine.
+
+This is the north-star integration point (BASELINE.json): a ``Node``
+subclass slotting into the same extend-or-callback seam as every other node,
+whose "peers" are a simulated population in HBM instead of socket threads.
+It is still a real sockets node — it binds a port, accepts connections, and
+can broadcast to live peers — but its population-scale traffic happens as
+batched graph propagation.
+
+The semantic bridge, stated honestly (SURVEY.md section 7 "hard parts" 1):
+socket peers deliver asynchronous per-message callbacks; the simulated
+population advances in synchronous rounds. Events about the population
+arrive through the standard ``node_message`` hook [ref: p2pnetwork/
+node.py:334-338] with a :class:`SimPeer` stand-in as the connected node and
+one dict per completed round — so existing callback-based applications
+observe the simulation with no new API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from p2pnetwork_tpu.node import Node
+from p2pnetwork_tpu.sim import checkpoint as ckpt
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+class SimPeer:
+    """Stand-in for ``NodeConnection`` representing the simulated population.
+
+    Carries the connection surface events expose (``id``, ``host``, ``port``,
+    ``info``, ``set_info/get_info`` [ref: nodeconnection.py:231-235]) so
+    callbacks written against socket peers work unchanged. ``send`` is a
+    debug no-op: messages enter the simulation through protocol state, not a
+    socket."""
+
+    def __init__(self, main_node: Node, n_nodes: int):
+        self.main_node = main_node
+        self.id = f"sim:{n_nodes}-nodes"
+        self.host = "hbm"
+        self.port = 0
+        self.info: dict = {}
+
+    def send(self, data, encoding_type=None, compression="none") -> None:
+        self.main_node.debug_print(
+            "SimPeer.send: the simulated population is driven by protocol "
+            "state, not socket sends"
+        )
+
+    def stop(self) -> None:  # parity surface; nothing to stop
+        pass
+
+    def set_info(self, key: str, value: Any) -> None:
+        self.info[key] = value
+
+    def get_info(self, key: str) -> Any:
+        return self.info[key]
+
+    def __str__(self) -> str:
+        return f"SimPeer({self.id})"
+
+    __repr__ = __str__
+
+
+class JaxSimNode(Node):
+    """A ``Node`` whose population-scale peers live in HBM.
+
+    Usage::
+
+        node = JaxSimNode("127.0.0.1", 0, graph=g, protocol=Flood(source=0))
+        node.start()                  # normal sockets lifecycle
+        stats = node.run_rounds(10)   # 10 batched propagation rounds
+        node.stop(); node.join()
+
+    Each completed round fires ``node_message`` with
+    ``{"sim_round": r, **round_stats}``. ``sim_message_count`` accumulates
+    the simulated message volume — the population-scale analog of
+    ``message_count_send`` [ref: node.py:64-67]; the socket counters stay
+    reserved for real socket traffic.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 graph: Optional[Graph] = None, protocol=None, seed: int = 0,
+                 **node_kwargs):
+        super().__init__(host, port, **node_kwargs)
+        self.sim_graph: Optional[Graph] = None
+        self.sim_protocol = None
+        self.sim_state = None
+        self.sim_round = 0
+        self.sim_message_count = 0
+        self.sim_peer: Optional[SimPeer] = None
+        self._sim_key: Optional[jax.Array] = None
+        if graph is not None and protocol is not None:
+            self.attach_simulation(graph, protocol, seed=seed)
+
+    # ------------------------------------------------------------- plumbing
+
+    def attach_simulation(self, graph: Graph, protocol, seed: int = 0) -> None:
+        """Attach (or replace) the simulated population."""
+        self.sim_graph = graph
+        self.sim_protocol = protocol
+        self._sim_key = jax.random.key(seed)
+        self.sim_state = protocol.init(graph, self._sim_key)
+        self.sim_round = 0
+        self.sim_message_count = 0
+        self.sim_peer = SimPeer(self, graph.n_nodes)
+        self.debug_print(
+            f"attach_simulation: {graph.n_nodes} nodes / {graph.n_edges} edges, "
+            f"protocol {type(protocol).__name__}"
+        )
+
+    def _require_sim(self):
+        if self.sim_graph is None:
+            raise RuntimeError("JaxSimNode: no simulation attached; call attach_simulation()")
+
+    # ------------------------------------------------------------- stepping
+
+    def run_rounds(self, rounds: int) -> dict:
+        """Advance the population ``rounds`` synchronous rounds.
+
+        One compiled ``lax.scan`` on device; afterwards fires ``node_message``
+        once per round (aggregate stats dict) through the standard event
+        path. Returns the stacked stats as numpy arrays."""
+        self._require_sim()
+        # Per-segment key: deterministic in (seed, segment start).
+        seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
+        self.sim_state, stats = engine.run_from(
+            self.sim_graph, self.sim_protocol, self.sim_state, seg_key, rounds
+        )
+        host_stats = {k: np.asarray(v) for k, v in stats.items()}
+        for r in range(rounds):
+            round_stats = {k: host_stats[k][r].item() for k in host_stats}
+            if "messages" in round_stats:
+                self.sim_message_count += int(round_stats["messages"])
+            self.sim_round += 1
+            self.node_message(self.sim_peer, {"sim_round": self.sim_round, **round_stats})
+        return host_stats
+
+    def run_until_coverage(self, coverage_target: float = 0.99,
+                           max_rounds: int = 1024) -> dict:
+        """Device-side run-to-coverage (no per-round events; one summary
+        ``node_message`` at the end)."""
+        self._require_sim()
+        seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
+        self.sim_state, out = engine.run_until_coverage(
+            self.sim_graph, self.sim_protocol, seg_key,
+            coverage_target=coverage_target, max_rounds=max_rounds,
+        )
+        summary = {k: np.asarray(v).item() for k, v in out.items()}
+        self.sim_round += int(summary["rounds"])
+        self.sim_message_count += int(summary["messages"])
+        self.node_message(self.sim_peer, {"sim_run": True, **summary})
+        return summary
+
+    # ----------------------------------------------------------- checkpoint
+
+    def save_checkpoint(self, path: str) -> None:
+        """Persist (state, PRNG key, round) — see sim/checkpoint.py."""
+        self._require_sim()
+        ckpt.save(path, self.sim_state, self._sim_key, self.sim_round)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a checkpoint taken from a node with the same graph/protocol."""
+        self._require_sim()
+        template = self.sim_protocol.init(self.sim_graph, jax.random.key(0))
+        self.sim_state, self._sim_key, self.sim_round = ckpt.load(path, template)

@@ -1,0 +1,28 @@
+"""JAX platform-selection hardening.
+
+In some environments (including this image) a ``sitecustomize`` imports jax
+at interpreter startup, which snapshots config defaults before user code —
+so ``JAX_PLATFORMS=cpu`` set in the environment can be ignored and backend
+discovery may initialize (and hang on) an accelerator plugin. Re-applying
+the env var through ``jax.config`` is reliable in either import order.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` from the environment via jax.config.
+
+    No-op when the variable is unset or the backend is already initialized.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except (ImportError, RuntimeError):
+        pass

@@ -1,17 +1,28 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform *before* any test imports jax,
-so the sharded propagation path (parallel/) is exercised on a real
-multi-device mesh without TPU hardware. Benchmarks (bench.py) run outside
-pytest and keep the real TPU backend.
+Tests run JAX on a virtual 8-device CPU platform so the sharded propagation
+path (parallel/) is exercised on a real multi-device mesh without TPU
+hardware. Benchmarks (bench.py) run outside pytest and keep the real TPU.
+
+JAX_PLATFORMS is exported for any subprocesses tests may spawn, and applied
+to this process through jax.config via utils.jax_env (an env var alone is
+unreliable here — see that module's docstring). XLA_FLAGS is read at lazy
+backend-client creation, which has not happened yet at conftest time, so the
+host-platform device count takes effect.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Overwrite, not setdefault: this environment pre-sets JAX_PLATFORMS=axon
+# (the tunneled TPU); tests are defined to run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()

@@ -1,0 +1,89 @@
+"""Gossip + SIR protocol tests: exact determinism, physical invariants."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import SIR, Flood, Gossip  # noqa: E402
+from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+class TestGossip:
+    def test_variance_decays_to_consensus(self):
+        # BASELINE configs[2] shape: Barabási–Albert + push-pull averaging.
+        g = G.barabasi_albert(500, 4, seed=0)
+        _, stats = engine.run(g, Gossip(), jax.random.key(0), 50)
+        var = np.asarray(stats["variance"])
+        assert var[-1] < 0.05 * var[0]
+
+    def test_values_stay_in_initial_hull(self):
+        g = G.watts_strogatz(256, 4, 0.1, seed=1)
+        proto = Gossip()
+        key = jax.random.key(2)
+        state0 = proto.init(g, key)
+        v0 = np.asarray(state0.values)[: g.n_nodes]
+        state, _ = engine.run(g, proto, key, 30)
+        v = np.asarray(state.values)[: g.n_nodes]
+        assert v.min() >= v0.min() - 1e-5 and v.max() <= v0.max() + 1e-5
+
+    def test_deterministic(self):
+        g = G.ring(128)
+        key = jax.random.key(3)
+        s1, _ = engine.run(g, Gossip(), key, 10)
+        s2, _ = engine.run(g, Gossip(), key, 10)
+        np.testing.assert_array_equal(np.asarray(s1.values), np.asarray(s2.values))
+
+    def test_isolated_nodes_unchanged(self):
+        # Nodes 3/4 are disconnected from everything.
+        g = G.from_edges([0, 1], [1, 0], 5)
+        proto = Gossip()
+        key = jax.random.key(4)
+        state0 = proto.init(g, key)
+        state, _ = engine.run(g, proto, key, 5)
+        v0 = np.asarray(state0.values)
+        v = np.asarray(state.values)
+        np.testing.assert_array_equal(v[2:5], v0[2:5])
+
+
+class TestSIR:
+    def test_conservation_and_monotonicity(self):
+        g = G.watts_strogatz(1000, 6, 0.05, seed=5)
+        _, stats = engine.run(g, SIR(beta=0.4, gamma=0.2), jax.random.key(1), 40)
+        s = np.asarray(stats["s_frac"])
+        i = np.asarray(stats["i_frac"])
+        r = np.asarray(stats["r_frac"])
+        np.testing.assert_allclose(s + i + r, 1.0, atol=1e-5)
+        assert (np.diff(s) <= 1e-6).all()  # susceptibles never increase
+        assert (np.diff(r) >= -1e-6).all()  # recovered never decrease
+
+    def test_epidemic_spreads_from_source(self):
+        g = G.watts_strogatz(2000, 8, 0.1, seed=6)
+        _, stats = engine.run(g, SIR(beta=0.6, gamma=0.05), jax.random.key(2), 30)
+        assert float(np.asarray(stats["coverage"])[-1]) > 0.5
+
+    def test_no_transmission_when_beta_zero(self):
+        g = G.complete(32)
+        state, stats = engine.run(g, SIR(beta=0.0, gamma=0.5), jax.random.key(3), 10)
+        status = np.asarray(state.status)[:32]
+        # Only the source ever left S, and with gamma it recovered.
+        assert (status == SUSCEPTIBLE).sum() == 31
+        assert status[0] in (INFECTED, RECOVERED)
+
+    def test_statuses_valid_and_deterministic(self):
+        g = G.erdos_renyi(300, 0.03, seed=7)
+        key = jax.random.key(4)
+        s1, _ = engine.run(g, SIR(), key, 15)
+        s2, _ = engine.run(g, SIR(), key, 15)
+        np.testing.assert_array_equal(np.asarray(s1.status), np.asarray(s2.status))
+        assert set(np.unique(np.asarray(s1.status))) <= {0, 1, 2}
+
+    def test_run_until_coverage_works_for_sir(self):
+        g = G.watts_strogatz(1000, 8, 0.1, seed=8)
+        _, out = engine.run_until_coverage(
+            g, SIR(beta=0.9, gamma=0.0), jax.random.key(5),
+            coverage_target=0.9, max_rounds=100,
+        )
+        assert float(out["coverage"]) >= 0.9
