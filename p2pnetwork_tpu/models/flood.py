@@ -42,6 +42,12 @@ class Flood:
         seed = seed & graph.node_mask
         return FloodState(seen=seed, frontier=seed)
 
+    def coverage(self, graph: Graph, state: FloodState) -> jax.Array:
+        """Fraction of live nodes holding the message (resume seeding for
+        engine.run_until_coverage_from)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum(state.seen) / n_real
+
     def step(self, graph: Graph, state: FloodState, key: jax.Array):
         """One synchronous round: frontier nodes broadcast; receivers that
         had not seen the message join the next frontier."""

@@ -43,6 +43,11 @@ class SIR:
         status = status.at[self.source].set(INFECTED)
         return SIRState(status=status * graph.node_mask)
 
+    def coverage(self, graph: Graph, state: SIRState) -> jax.Array:
+        """Ever-infected fraction (matches the ``coverage`` stat)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum((state.status != SUSCEPTIBLE) & graph.node_mask) / n_real
+
     def step(self, graph: Graph, state: SIRState, key: jax.Array):
         k_inf, k_rec = jax.random.split(key)
         infected = (state.status == INFECTED) & graph.node_mask

@@ -9,11 +9,13 @@ makes the kernel's HBM traffic just the contributions and destinations —
 this is the bandwidth win that justifies a kernel (SURVEY.md section 7
 step 5).
 
-Grid: ``(n_blocks, width_tiles)``. Each step loads one ``[1, TILE_W]`` strip
-of edge contributions + local destinations for one 128-node output block,
-builds the ``[TILE_W, 128]`` one-hot in VMEM, and accumulates a
-``[1, TILE_W] @ [TILE_W, 128]`` partial product into the block's output row
-(output revisiting across the width dimension).
+Grid: ``(n_blocks / ROW_TILE, width_tiles)``. Each step loads a
+``[ROW_TILE, TILE_W]`` strip of edge contributions + local destinations for
+``ROW_TILE`` 128-node output blocks (the row batch keeps the sublane
+dimension divisible by 8, a Mosaic block-shape requirement on real TPUs),
+builds the ``[ROW_TILE, TILE_W, 128]`` one-hot in VMEM, and accumulates a
+batched ``[ROW_TILE, 1, TILE_W] @ [ROW_TILE, TILE_W, 128]`` partial product
+into the blocks' output rows (output revisiting across the width dimension).
 
 Padded edge slots carry contribution 0, so no masking is needed in-kernel.
 On CPU (tests) the kernel runs in interpreter mode.
@@ -32,6 +34,9 @@ from p2pnetwork_tpu.ops.blocked import BlockedEdges
 #: Edge-strip width per grid step.
 TILE_W = 512
 
+#: Node blocks processed per grid step (sublane-aligned row batch).
+ROW_TILE = 8
+
 
 def _segsum_kernel(contrib_ref, dst_ref, out_ref, *, block: int, tile_w: int):
     j = pl.program_id(1)
@@ -40,11 +45,21 @@ def _segsum_kernel(contrib_ref, dst_ref, out_ref, *, block: int, tile_w: int):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    contrib = contrib_ref[:]  # [1, TILE_W] f32
-    dst = dst_ref[:]  # [1, TILE_W] i32
-    iota = jax.lax.broadcasted_iota(jnp.int32, (tile_w, block), 1)
-    onehot = (dst.reshape(tile_w, 1) == iota).astype(jnp.float32)
-    out_ref[:] += jnp.dot(contrib, onehot, preferred_element_type=jnp.float32)
+    contrib = contrib_ref[:]  # [ROW_TILE, TILE_W] f32
+    dst = dst_ref[:]  # [ROW_TILE, TILE_W] i32
+    rows = contrib.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (rows, tile_w, block), 2)
+    onehot = (dst[:, :, None] == iota).astype(jnp.float32)
+    partial = jax.lax.dot_general(
+        contrib[:, None, :],  # [R, 1, W]
+        onehot,  # [R, W, B]
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        # Full f32 MXU passes: the default single-pass bf16 rounding loses
+        # ~2^-8 relative accuracy, which fails the sum path's f32 tests.
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [R, 1, B]
+    out_ref[:] += partial[:, 0, :]
 
 
 def _is_cpu() -> bool:
@@ -68,20 +83,27 @@ def segment_sum_pallas(contrib: jax.Array, local_dst: jax.Array,
         contrib = jnp.pad(contrib, ((0, 0), (0, pad)))
         local_dst = jnp.pad(local_dst, ((0, 0), (0, pad)))
         w += pad
+    nb_pad = nb
+    if nb % ROW_TILE != 0:
+        row_pad = ROW_TILE - nb % ROW_TILE
+        contrib = jnp.pad(contrib, ((0, row_pad), (0, 0)))
+        local_dst = jnp.pad(local_dst, ((0, row_pad), (0, 0)))
+        nb_pad += row_pad
     if interpret is None:
         interpret = _is_cpu()
     kernel = functools.partial(_segsum_kernel, block=block, tile_w=tile_w)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        grid=(nb, w // tile_w),
+        grid=(nb_pad // ROW_TILE, w // tile_w),
         in_specs=[
-            pl.BlockSpec((1, tile_w), lambda i, j: (i, j)),
-            pl.BlockSpec((1, tile_w), lambda i, j: (i, j)),
+            pl.BlockSpec((ROW_TILE, tile_w), lambda i, j: (i, j)),
+            pl.BlockSpec((ROW_TILE, tile_w), lambda i, j: (i, j)),
         ],
-        out_specs=pl.BlockSpec((1, block), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, block), jnp.float32),
+        out_specs=pl.BlockSpec((ROW_TILE, block), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, block), jnp.float32),
         interpret=interpret,
     )(contrib, local_dst)
+    return out[:nb]
 
 
 def propagate_sum_pallas(blocked: BlockedEdges, signal: jax.Array,

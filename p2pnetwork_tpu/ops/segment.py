@@ -30,16 +30,32 @@ import jax.numpy as jnp
 from p2pnetwork_tpu.sim.graph import Graph
 
 
+def _gather_ok(graph: Graph) -> bool:
+    return graph.neighbors is not None and graph.neighbors_complete
+
+
+def _require_complete_table(graph: Graph) -> None:
+    if graph.neighbors is None:
+        raise ValueError("method='gather' requires a graph with a neighbor table")
+    if not graph.neighbors_complete:
+        raise ValueError(
+            "method='gather' on a width-capped neighbor table "
+            "(from_edges(max_degree=...)) would silently drop edges; use "
+            "method='segment' for exact aggregation on this graph"
+        )
+
+
 def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
     """Per-node OR over incoming neighbors: ``out[v] = any(signal[u], u->v)``.
 
     ``signal`` is bool[N_pad]; masked (padding) edges and nodes contribute
     nothing. ``method`` is ``"segment"``, ``"gather"`` or ``"auto"`` (gather
-    when the graph carries a neighbor table).
+    when the graph carries a complete neighbor table).
     """
     if method == "auto":
-        method = "gather" if graph.neighbors is not None else "segment"
+        method = "gather" if _gather_ok(graph) else "segment"
     if method == "gather":
+        _require_complete_table(graph)
         vals = signal[graph.neighbors] & graph.neighbor_mask
         return jnp.any(vals, axis=1) & graph.node_mask
     if method in ("blocked", "pallas"):
@@ -63,8 +79,9 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
 def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
     """Per-node sum over incoming neighbors: ``out[v] = sum(signal[u], u->v)``."""
     if method == "auto":
-        method = "gather" if graph.neighbors is not None else "segment"
+        method = "gather" if _gather_ok(graph) else "segment"
     if method == "gather":
+        _require_complete_table(graph)
         vals = signal[graph.neighbors] * graph.neighbor_mask.astype(signal.dtype)
         return jnp.sum(vals, axis=1) * graph.node_mask.astype(signal.dtype)
     if method in ("blocked", "pallas"):

@@ -30,7 +30,3 @@ def ring_mesh(n_shards: Optional[int] = None, axis_name: str = DEFAULT_AXIS,
 def shard_spec(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
     """Sharding that splits an array's leading axis across the ring."""
     return NamedSharding(mesh, P(axis_name))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())

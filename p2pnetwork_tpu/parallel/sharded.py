@@ -88,7 +88,9 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     e_bkt = _round_up(max(int(counts.max()), 1), edge_pad_multiple)
 
     bkt_src = np.zeros((S, S, e_bkt), dtype=np.int32)
-    bkt_dst = np.zeros((S, S, e_bkt), dtype=np.int32)
+    # Pad destinations with block-1 so each bucket stays dst-sorted — the
+    # segment reductions in the ring body promise indices_are_sorted=True.
+    bkt_dst = np.full((S, S, e_bkt), block - 1, dtype=np.int32)
     bkt_mask = np.zeros((S, S, e_bkt), dtype=bool)
 
     # Sort edges by (bucket, local dst) so each bucket is dst-sorted.
@@ -137,24 +139,34 @@ def _ring_rounds_or(axis_name, S, block, bkt_src, bkt_dst, bkt_mask,
     bkt_src, bkt_dst, bkt_mask = bkt_src[0], bkt_dst[0], bkt_mask[0]
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
 
+    def apply_bucket(rot, src, dst, m):
+        contrib = (rot[src] & m).astype(jnp.int32)
+        return jax.ops.segment_max(
+            contrib, dst, num_segments=block, indices_are_sorted=True
+        ) > 0
+
     def one_round(carry, _):
         seen, frontier = carry  # [block] bool each
 
         def ring_step(rc, bkt):
             rot, acc = rc  # rot: frontier block resident this step
-            src, dst, m = bkt
-            contrib = (rot[src] & m).astype(jnp.int32)
-            delivered = jax.ops.segment_max(
-                contrib, dst, num_segments=block, indices_are_sorted=True
-            ) > 0
-            acc = acc | delivered
+            acc = acc | apply_bucket(rot, *bkt)
             rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
             return (rot, acc), None
 
-        (_, delivered), _ = jax.lax.scan(
-            ring_step,
-            (frontier, jnp.zeros_like(seen)),
-            (bkt_src, bkt_dst, bkt_mask),
+        # The last bucket is peeled out of the scan: after it is applied
+        # there is nothing left to rotate, so running its ppermute would be
+        # one wasted ICI collective per round.
+        if S > 1:
+            (rot, delivered), _ = jax.lax.scan(
+                ring_step,
+                (frontier, jnp.zeros_like(seen)),
+                (bkt_src[: S - 1], bkt_dst[: S - 1], bkt_mask[: S - 1]),
+            )
+        else:
+            rot, delivered = frontier, jnp.zeros_like(seen)
+        delivered = delivered | apply_bucket(
+            rot, bkt_src[S - 1], bkt_dst[S - 1], bkt_mask[S - 1]
         )
         new = delivered & ~seen & node_mask_b
         seen = seen | new

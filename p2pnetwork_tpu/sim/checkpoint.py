@@ -22,12 +22,15 @@ import jax
 import numpy as np
 
 
-def save(path: str, state: Any, key: jax.Array, round_index: int) -> None:
-    """Atomically write (state pytree, PRNG key, round counter) to ``path``."""
+def save(path: str, state: Any, key: jax.Array, round_index: int,
+         message_count: int = 0) -> None:
+    """Atomically write (state pytree, PRNG key, round counter, message
+    counter) to ``path``."""
     leaves, treedef = jax.tree_util.tree_flatten(state)
     payload = {f"leaf_{i}": np.asarray(jax.device_get(x)) for i, x in enumerate(leaves)}
     payload["__key__"] = np.asarray(jax.random.key_data(key))
     payload["__round__"] = np.asarray(round_index, dtype=np.int64)
+    payload["__messages__"] = np.asarray(message_count, dtype=np.int64)
     payload["__treedef__"] = np.frombuffer(str(treedef).encode(), dtype=np.uint8)
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -41,12 +44,12 @@ def save(path: str, state: Any, key: jax.Array, round_index: int) -> None:
         raise
 
 
-def load(path: str, template: Any) -> Tuple[Any, jax.Array, int]:
+def load(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
     """Load a checkpoint written by :func:`save`.
 
     ``template`` is a state pytree with the same structure (e.g. a freshly
     built ``protocol.init(...)``); its treedef validates the file.
-    Returns ``(state, key, round_index)``.
+    Returns ``(state, key, round_index, message_count)``.
     """
     with np.load(path) as data:
         _, treedef = jax.tree_util.tree_flatten(template)
@@ -59,4 +62,5 @@ def load(path: str, template: Any) -> Tuple[Any, jax.Array, int]:
         leaves = [data[f"leaf_{i}"] for i in range(n)]
         state = jax.tree_util.tree_unflatten(treedef, leaves)
         key = jax.random.wrap_key_data(data["__key__"])
-        return state, key, int(data["__round__"])
+        messages = int(data["__messages__"]) if "__messages__" in data.files else 0
+        return state, key, int(data["__round__"]), messages

@@ -64,7 +64,28 @@ def run_until_coverage(
     Requires the protocol's stats to include ``coverage`` and ``messages``
     (e.g. models.flood.Flood).
     """
-    state0 = protocol.init(graph, key)
+    return run_until_coverage_from(
+        graph, protocol, protocol.init(graph, key), key,
+        coverage_target=coverage_target, max_rounds=max_rounds,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
+def run_until_coverage_from(
+    graph: Graph,
+    protocol,
+    state0,
+    key: jax.Array,
+    *,
+    coverage_target: float = 0.99,
+    max_rounds: int = 1024,
+):
+    """Run-to-coverage continuing from an existing ``state0`` (resume path).
+
+    If the protocol exposes ``coverage(graph, state)`` (Flood, SIR do), the
+    loop starts from the true coverage of ``state0`` — resuming an
+    already-finished run executes zero rounds instead of one spurious one.
+    """
 
     def cond(carry):
         _, _, rounds, coverage, _ = carry
@@ -76,6 +97,11 @@ def run_until_coverage(
         state, stats = protocol.step(graph, state, sub)
         return (state, k, rounds + 1, stats["coverage"], messages + stats["messages"])
 
-    init = (state0, key, jnp.int32(0), jnp.float32(0.0), jnp.int32(0))
+    cov0 = (
+        jnp.float32(protocol.coverage(graph, state0))
+        if hasattr(protocol, "coverage")
+        else jnp.float32(0.0)
+    )
+    init = (state0, key, jnp.int32(0), cov0, jnp.int32(0))
     state, _, rounds, coverage, messages = jax.lax.while_loop(cond, body, init)
     return state, {"rounds": rounds, "coverage": coverage, "messages": messages}

@@ -59,6 +59,14 @@ class Graph:
     # Static metadata.
     n_nodes: int = dataclasses.field(metadata=dict(static=True))
     n_edges: int = dataclasses.field(metadata=dict(static=True))
+    # Whether the neighbor table holds EVERY incoming edge. False when
+    # from_edges(max_degree=...) capped the width — then each over-degree
+    # node's row is a uniform random subset of its in-edges (fine for
+    # Gossip's random partner draw, wrong for exact OR/sum aggregation,
+    # which must not silently drop edges).
+    neighbors_complete: bool = dataclasses.field(
+        default=True, metadata=dict(static=True)
+    )
     # Optional blocked-edge representation (ops/blocked.py) feeding the
     # matmul/Pallas aggregation paths; attach via with_blocked().
     blocked: Optional[object] = None
@@ -97,9 +105,11 @@ def from_edges(
 
     Edges are sorted by receiver and padded to ``edge_pad_multiple``; nodes
     are padded to ``node_pad_multiple`` (lane-friendly sizes keep XLA tiling
-    happy). Padded edges point at node index 0 but are masked out of every
-    aggregation. ``max_degree`` caps the neighbor table width (default: the
-    true maximum in-degree).
+    happy). Padded edges point at the LAST padded node index (keeping the
+    receiver array non-decreasing — the ``indices_are_sorted=True`` promise
+    the segment reductions rely on) and are masked out of every aggregation.
+    ``max_degree`` caps the neighbor table width (default: the true maximum
+    in-degree).
     """
     senders = np.asarray(senders, dtype=np.int32)
     receivers = np.asarray(receivers, dtype=np.int32)
@@ -116,7 +126,9 @@ def from_edges(
     e_pad = _round_up(max(e, 1), edge_pad_multiple)
 
     s = np.zeros(e_pad, dtype=np.int32)
-    r = np.zeros(e_pad, dtype=np.int32)
+    # Padding receivers with n_pad-1 (>= every active id) keeps the array
+    # sorted; padded contributions are zeroed by edge_mask either way.
+    r = np.full(e_pad, n_pad - 1, dtype=np.int32)
     s[:e], r[:e] = senders, receivers
     emask = np.zeros(e_pad, dtype=bool)
     emask[:e] = True
@@ -127,9 +139,11 @@ def from_edges(
     out_deg = np.bincount(senders, minlength=n_pad).astype(np.int32)
 
     neighbors = neighbor_mask = None
+    neighbors_complete = True
     if build_neighbor_table:
         width = int(in_deg.max()) if e else 0
         if max_degree is not None:
+            neighbors_complete = max_degree >= width
             width = min(width, max_degree)
         width = max(width, 1)
         # receivers are sorted, so each node's incoming edges are contiguous.
@@ -139,6 +153,16 @@ def from_edges(
         counts = np.minimum(ends - starts, width)
         take = starts[:, None] + slot[None, :]
         valid = slot[None, :] < counts[:, None]
+        # Over-degree rows get a uniform random subset of their in-edges
+        # (deterministic seed: graph construction stays reproducible). A
+        # plain prefix would bias Gossip's partner draw toward whichever
+        # senders happen to sort first.
+        capped = np.nonzero(ends - starts > width)[0]
+        if capped.size:
+            cap_rng = np.random.default_rng(0)
+            for v in capped:
+                pick = cap_rng.choice(ends[v] - starts[v], size=width, replace=False)
+                take[v] = starts[v] + np.sort(pick)
         take = np.where(valid, take, 0)
         # A dummy pool entry keeps the (eagerly evaluated) gather in-bounds
         # for zero-edge graphs; `valid` masks it out.
@@ -157,6 +181,7 @@ def from_edges(
         neighbor_mask=None if neighbor_mask is None else jnp.asarray(neighbor_mask),
         n_nodes=n_nodes,
         n_edges=e,
+        neighbors_complete=neighbors_complete,
     )
 
 
@@ -210,7 +235,7 @@ def barabasi_albert(n: int, m: int, seed: int = 0, **kw) -> Graph:
     for v in range(m, n):
         targets = set()
         while len(targets) < m:
-            targets.add(pool[rng.integers(0, len(pool))] if pool else int(rng.integers(0, v)))
+            targets.add(pool[rng.integers(0, len(pool))])
         for t in targets:
             src_list.append(v)
             dst_list.append(t)
@@ -240,9 +265,17 @@ def watts_strogatz(n: int, k: int, p: float, seed: int = 0, **kw) -> Graph:
         dst = np.where(self_loop, (src + off) % n, dst)
         srcs.append(src)
         dsts.append(dst)
-    src = np.concatenate(srcs).astype(np.int32)
-    dst = np.concatenate(dsts).astype(np.int32)
-    return from_edges(*_undirect(src, dst), n, **kw)
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    # A rewired target can collide with another (lattice or rewired) edge of
+    # the same node; drop duplicates so each undirected pair appears once —
+    # otherwise SIR would double-count that neighbor's infection pressure
+    # (the other generators dedup too).
+    lo, hi = np.minimum(src, dst), np.maximum(src, dst)
+    keys = np.unique(lo * np.int64(n) + hi)
+    lo = (keys // n).astype(np.int32)
+    hi = (keys % n).astype(np.int32)
+    return from_edges(*_undirect(lo, hi), n, **kw)
 
 
 def ring(n: int, **kw) -> Graph:

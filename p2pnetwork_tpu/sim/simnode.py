@@ -142,12 +142,12 @@ class JaxSimNode(Node):
 
     def run_until_coverage(self, coverage_target: float = 0.99,
                            max_rounds: int = 1024) -> dict:
-        """Device-side run-to-coverage (no per-round events; one summary
-        ``node_message`` at the end)."""
+        """Device-side run-to-coverage continuing from the current state
+        (no per-round events; one summary ``node_message`` at the end)."""
         self._require_sim()
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
-        self.sim_state, out = engine.run_until_coverage(
-            self.sim_graph, self.sim_protocol, seg_key,
+        self.sim_state, out = engine.run_until_coverage_from(
+            self.sim_graph, self.sim_protocol, self.sim_state, seg_key,
             coverage_target=coverage_target, max_rounds=max_rounds,
         )
         summary = {k: np.asarray(v).item() for k, v in out.items()}
@@ -159,12 +159,15 @@ class JaxSimNode(Node):
     # ----------------------------------------------------------- checkpoint
 
     def save_checkpoint(self, path: str) -> None:
-        """Persist (state, PRNG key, round) — see sim/checkpoint.py."""
+        """Persist (state, PRNG key, round, message count) — see
+        sim/checkpoint.py."""
         self._require_sim()
-        ckpt.save(path, self.sim_state, self._sim_key, self.sim_round)
+        ckpt.save(path, self.sim_state, self._sim_key, self.sim_round,
+                  self.sim_message_count)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint taken from a node with the same graph/protocol."""
         self._require_sim()
         template = self.sim_protocol.init(self.sim_graph, jax.random.key(0))
-        self.sim_state, self._sim_key, self.sim_round = ckpt.load(path, template)
+        (self.sim_state, self._sim_key, self.sim_round,
+         self.sim_message_count) = ckpt.load(path, template)

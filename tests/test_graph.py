@@ -133,3 +133,62 @@ class TestAggregation:
         g = G.from_edges([0, 0, 1], [1, 2, 2], 3)
         frontier = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
         assert int(segment.frontier_messages(g, frontier)) == 2
+
+
+class TestCappedNeighborTable:
+    """from_edges(max_degree=...) yields a sampled table — exact aggregation
+    must not silently use it (regression: auto/gather used to drop edges)."""
+
+    def _capped_hub(self):
+        # 9 in-neighbors of node 0, table capped at width 4.
+        src = np.arange(1, 10, dtype=np.int32)
+        dst = np.zeros(9, dtype=np.int32)
+        return G.from_edges(src, dst, 10, max_degree=4)
+
+    def test_flag_set(self):
+        assert not self._capped_hub().neighbors_complete
+        assert G.ring(16).neighbors_complete
+
+    def test_auto_falls_back_to_segment(self):
+        g = self._capped_hub()
+        signal = jnp.zeros(g.n_nodes_padded, dtype=bool).at[7].set(True)
+        # Sender 7 is outside the capped table; auto must still deliver.
+        out = np.asarray(segment.propagate_or(g, signal, "auto"))
+        assert out[0]
+
+    def test_explicit_gather_rejected(self):
+        g = self._capped_hub()
+        signal = jnp.zeros(g.n_nodes_padded, dtype=bool)
+        with pytest.raises(ValueError, match="width-capped"):
+            segment.propagate_or(g, signal, "gather")
+        with pytest.raises(ValueError, match="width-capped"):
+            segment.propagate_sum(g, signal.astype(jnp.float32), "gather")
+
+
+class TestPaddingSortedness:
+    """Padded receiver ids must keep the arrays non-decreasing — the
+    indices_are_sorted=True promise of every segment reduction (regression:
+    padding used to write zeros after the sorted active ids)."""
+
+    def test_receivers_non_decreasing_including_padding(self):
+        for g in [G.ring(10), G.watts_strogatz(100, 4, 0.3, seed=1),
+                  G.erdos_renyi(90, 0.05, seed=2)]:
+            r = np.asarray(g.receivers)
+            assert (np.diff(r) >= 0).all(), "receivers not sorted with padding"
+
+    def test_sharded_buckets_sorted_including_padding(self):
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded
+
+        g = G.watts_strogatz(256, 4, 0.2, seed=3)
+        sg = sharded.shard_graph(g, M.ring_mesh(4))
+        d = np.asarray(sg.bkt_dst)
+        assert (np.diff(d, axis=-1) >= 0).all(), "bucket dsts not sorted"
+
+    def test_watts_strogatz_no_duplicate_edges(self):
+        g = G.watts_strogatz(500, 6, 0.5, seed=4)
+        emask = np.asarray(g.edge_mask)
+        s = np.asarray(g.senders)[emask]
+        r = np.asarray(g.receivers)[emask]
+        keys = s.astype(np.int64) * 500 + r
+        assert np.unique(keys).size == keys.size

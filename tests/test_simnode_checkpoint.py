@@ -60,6 +60,28 @@ class TestJaxSimNode:
         assert out["coverage"] >= 0.99
         assert node.sim_round == out["rounds"]
 
+    def test_run_until_coverage_resumes_from_current_state(self):
+        # Regression: run_until_coverage used to silently re-init the
+        # protocol state, throwing away progress from earlier run_rounds.
+        g = G.watts_strogatz(1024, 8, 0.1, seed=1)
+        node = JaxSimNode(graph=g, protocol=Flood(source=0))
+        node.run_rounds(3)
+        seen_before = int(np.asarray(node.sim_state.seen).sum())
+        out = node.run_until_coverage(0.99)
+        # A fresh flood needs ~7 rounds on this graph; resuming after 3
+        # completed rounds must need strictly fewer.
+        fresh = JaxSimNode(graph=g, protocol=Flood(source=0))
+        fresh_out = fresh.run_until_coverage(0.99)
+        assert out["rounds"] < fresh_out["rounds"]
+        assert int(np.asarray(node.sim_state.seen).sum()) >= seen_before
+        assert node.sim_round == 3 + out["rounds"]
+        # Calling again on a finished run must be a no-op (regression: the
+        # loop used to seed coverage=0 and run one spurious round).
+        round_before = node.sim_round
+        again = node.run_until_coverage(0.99)
+        assert again["rounds"] == 0
+        assert node.sim_round == round_before
+
     def test_incremental_equals_one_shot(self):
         g = G.watts_strogatz(256, 4, 0.2, seed=2)
         a = JaxSimNode(graph=g, protocol=Flood(source=0), seed=7)
@@ -87,10 +109,11 @@ class TestCheckpoint:
         key = jax.random.key(5)
         state = proto.init(g, key)
         path = str(tmp_path / "sim.npz")
-        ckpt.save(path, state, key, 17)
-        loaded, lkey, lround = ckpt.load(path, proto.init(g, jax.random.key(0)))
+        ckpt.save(path, state, key, 17, message_count=4242)
+        loaded, lkey, lround, lmsgs = ckpt.load(path, proto.init(g, jax.random.key(0)))
         np.testing.assert_array_equal(np.asarray(loaded.status), np.asarray(state.status))
         assert lround == 17
+        assert lmsgs == 4242
         np.testing.assert_array_equal(
             jax.random.key_data(lkey), jax.random.key_data(key)
         )
@@ -122,3 +145,6 @@ class TestCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(a.sim_state.status), np.asarray(b.sim_state.status)
         )
+        # The message counter is part of the checkpoint: both nodes report
+        # the same cumulative total after the same 10 rounds.
+        assert a.sim_message_count == b.sim_message_count
