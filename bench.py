@@ -50,11 +50,11 @@ def main():
     from p2pnetwork_tpu.sim import graph as G
 
     g = G.watts_strogatz(n, k, 0.1, seed=0)
-    g = g.with_blocked()
+    g = g.with_blocked().with_hybrid()
     build_s = time.perf_counter() - t_build0
 
     platform = jax.devices()[0].platform
-    methods = ["gather", "segment", "pallas"]
+    methods = ["pallas", "hybrid"]
     results = {}
     for m in methods:
         try:

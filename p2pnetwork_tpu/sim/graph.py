@@ -37,6 +37,21 @@ def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
 
 
+def _padded_row_fill(starts: np.ndarray, counts: np.ndarray, width: int):
+    """Vectorized ragged-rows-to-padded-matrix fill.
+
+    Row ``i`` owns ``counts[i]`` consecutive items beginning at ``starts[i]``
+    in some flat pool array. Returns ``(take, valid)`` of shape
+    ``[rows, width]``: flat pool indices (0 where padded) and the padding
+    mask. Shared by the neighbor-table and blocked-edge builders — one fancy
+    index instead of a per-row Python loop.
+    """
+    slot = np.arange(width)
+    valid = slot[None, :] < counts[:, None]
+    take = np.where(valid, starts[:, None] + slot[None, :], 0)
+    return take, valid
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class Graph:
@@ -70,6 +85,9 @@ class Graph:
     # Optional blocked-edge representation (ops/blocked.py) feeding the
     # matmul/Pallas aggregation paths; attach via with_blocked().
     blocked: Optional[object] = None
+    # Optional diagonal+remainder representation (ops/diag.py) feeding the
+    # gather-free "hybrid" aggregation path; attach via with_hybrid().
+    hybrid: Optional[object] = None
 
     @property
     def n_nodes_padded(self) -> int:
@@ -89,6 +107,17 @@ class Graph:
         from p2pnetwork_tpu.ops.blocked import build_blocked
 
         return dataclasses.replace(self, blocked=build_blocked(self, block))
+
+    def with_hybrid(self, block: int = 128, max_diags: int = 64) -> "Graph":
+        """Return a copy carrying the diagonal+remainder representation used
+        by the ``"hybrid"`` aggregation method — circular-shift passes for
+        the graph's dominant diagonals (gather-free), the Pallas kernel for
+        the unstructured rest (ops/diag.py)."""
+        from p2pnetwork_tpu.ops.diag import build_hybrid
+
+        return dataclasses.replace(
+            self, hybrid=build_hybrid(self, block, max_diags)
+        )
 
 
 def from_edges(
@@ -149,21 +178,30 @@ def from_edges(
         # receivers are sorted, so each node's incoming edges are contiguous.
         starts = np.searchsorted(receivers, np.arange(n_pad))
         ends = np.searchsorted(receivers, np.arange(n_pad), side="right")
-        slot = np.arange(width)
-        counts = np.minimum(ends - starts, width)
-        take = starts[:, None] + slot[None, :]
-        valid = slot[None, :] < counts[:, None]
+        take, valid = _padded_row_fill(starts, np.minimum(ends - starts, width), width)
         # Over-degree rows get a uniform random subset of their in-edges
         # (deterministic seed: graph construction stays reproducible). A
         # plain prefix would bias Gossip's partner draw toward whichever
-        # senders happen to sort first.
+        # senders happen to sort first. Vectorized: rank random keys per
+        # edge within its row; an edge is kept iff its rank < width — a
+        # uniform width-subset for every capped row in one pass.
         capped = np.nonzero(ends - starts > width)[0]
         if capped.size:
             cap_rng = np.random.default_rng(0)
-            for v in capped:
-                pick = cap_rng.choice(ends[v] - starts[v], size=width, replace=False)
-                take[v] = starts[v] + np.sort(pick)
-        take = np.where(valid, take, 0)
+            deg = ends - starts
+            cap_edge = np.repeat(capped, deg[capped])
+            offs = np.arange(cap_edge.size) - np.repeat(
+                np.cumsum(deg[capped]) - deg[capped], deg[capped]
+            )
+            edge_idx = starts[cap_edge] + offs
+            keys = cap_rng.random(edge_idx.size)
+            # rank within row = position after sorting by (row, key)
+            order = np.lexsort((keys, cap_edge))
+            rank = np.empty_like(offs)
+            rank[order] = offs
+            kept = rank < width  # exactly `width` uniform survivors per row
+            resort = np.lexsort((edge_idx[kept], cap_edge[kept]))
+            take[capped] = edge_idx[kept][resort].reshape(capped.size, width)
         # A dummy pool entry keeps the (eagerly evaluated) gather in-bounds
         # for zero-edge graphs; `valid` masks it out.
         pool = senders if e else np.zeros(1, dtype=np.int32)

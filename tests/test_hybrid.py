@@ -1,0 +1,132 @@
+"""Hybrid (diagonal + blocked remainder) aggregation vs the segment
+reference — exact OR equality and close sum agreement on graphs with full,
+partial, and zero diagonal structure."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import Flood  # noqa: E402
+from p2pnetwork_tpu.ops import diag as D  # noqa: E402
+from p2pnetwork_tpu.ops import segment  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+@pytest.fixture(params=["ws", "er", "ba", "ring"])
+def graph(request):
+    make = {
+        # min_count floor is 128, so structured families here are built big
+        # enough that their lattice diagonals actually get extracted.
+        "ws": lambda: G.watts_strogatz(400, 6, 0.2, seed=0),
+        "er": lambda: G.erdos_renyi(500, 0.02, seed=1),
+        "ba": lambda: G.barabasi_albert(300, 4, seed=2),
+        "ring": lambda: G.ring(257),
+    }[request.param]
+    return make().with_hybrid()
+
+
+class TestHybridRepresentation:
+    def test_partition_is_lossless(self, graph):
+        """Diagonal edges + remainder edges == all edges, none counted twice."""
+        h = graph.hybrid
+        n_rem = (
+            0 if h.remainder is None else int(np.asarray(h.remainder.mask).sum())
+        )
+        assert h.n_diag_edges + n_rem == graph.n_edges
+
+    def test_diagonal_masks_match_edges(self, graph):
+        """Every masked (offset, v) slot is a real edge (v+off)%n -> v."""
+        h = graph.hybrid
+        emask = np.asarray(graph.edge_mask)
+        s = np.asarray(graph.senders)[emask]
+        r = np.asarray(graph.receivers)[emask]
+        edges = set(zip(s.tolist(), r.tolist()))
+        masks = np.asarray(h.masks)
+        for d, off in enumerate(h.offsets):
+            for v in np.nonzero(masks[d])[0]:
+                assert ((v + off) % h.n, v) in edges
+
+    def test_ring_has_no_remainder(self):
+        g = G.ring(257).with_hybrid()
+        assert g.hybrid.remainder is None
+        assert set(g.hybrid.offsets) == {1, 257 - 1}
+
+    def test_er_has_no_diagonals(self):
+        g = G.erdos_renyi(500, 0.02, seed=1).with_hybrid()
+        assert g.hybrid.offsets == ()
+
+
+class TestHybridEquality:
+    def test_or_matches_segment(self, graph):
+        key = jax.random.key(0)
+        signal = jax.random.uniform(key, (graph.n_nodes_padded,)) < 0.15
+        signal = signal & graph.node_mask
+        ref = segment.propagate_or(graph, signal, "segment")
+        out = segment.propagate_or(graph, signal, "hybrid")
+        assert (np.asarray(out) == np.asarray(ref)).all()
+
+    def test_sum_matches_segment(self, graph):
+        key = jax.random.key(1)
+        x = jax.random.normal(key, (graph.n_nodes_padded,), dtype=jnp.float32)
+        x = x * graph.node_mask
+        ref = np.asarray(segment.propagate_sum(graph, x, "segment"))
+        out = np.asarray(segment.propagate_sum(graph, x, "hybrid"))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_flood_end_to_end(self, graph):
+        ref_state, _ = engine.run(graph, Flood(source=0, method="segment"),
+                                  jax.random.key(0), 5)
+        state, _ = engine.run(graph, Flood(source=0, method="hybrid"),
+                              jax.random.key(0), 5)
+        assert (np.asarray(state.seen) == np.asarray(ref_state.seen)).all()
+
+
+def test_hybrid_requires_representation():
+    g = G.ring(200)
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool)
+    with pytest.raises(ValueError, match="with_hybrid"):
+        segment.propagate_or(g, sig, "hybrid")
+
+
+def test_wraparound_offsets_padded_nodes():
+    # n not a multiple of the 128 padding: the circular shift must wrap at n,
+    # not at n_padded, or boundary nodes read padding slots.
+    n = 300
+    g = G.ring(n).with_hybrid()
+    assert g.n_nodes_padded > n
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    out = np.asarray(segment.propagate_or(g, sig, "hybrid"))
+    expect = np.zeros(g.n_nodes_padded, dtype=bool)
+    expect[[1, n - 1]] = True
+    assert (out == expect).all()
+
+
+def test_duplicate_edges_counted_exactly():
+    # Regression: a mask slot can hold only one edge per (offset, receiver);
+    # duplicate user-supplied edges must spill to the remainder, not vanish.
+    n = 300
+    base = np.arange(n, dtype=np.int32)
+    s = np.concatenate([base, (base + 1) % n, [5, 5]])
+    r = np.concatenate([(base + 1) % n, base, [6, 6]])
+    g = G.from_edges(s, r, n).with_hybrid()
+    ones = jnp.ones(g.n_nodes_padded, dtype=jnp.float32) * g.node_mask
+    ref = np.asarray(segment.propagate_sum(g, ones, "segment"))
+    out = np.asarray(segment.propagate_sum(g, ones, "hybrid"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    assert out[6] == 4.0  # ring both sides + two duplicates
+
+
+def test_max_diags_cap_spills_to_remainder():
+    g = G.watts_strogatz(400, 6, 0.0, seed=0)  # 6 pure lattice diagonals
+    capped = g.with_hybrid(max_diags=2)
+    assert len(capped.hybrid.offsets) == 2
+    assert capped.hybrid.remainder is not None
+    key = jax.random.key(0)
+    sig = (jax.random.uniform(key, (g.n_nodes_padded,)) < 0.2) & g.node_mask
+    full = g.with_hybrid()
+    out_capped = segment.propagate_or(capped, sig, "hybrid")
+    out_full = segment.propagate_or(full, sig, "hybrid")
+    assert (np.asarray(out_capped) == np.asarray(out_full)).all()
