@@ -21,12 +21,14 @@ from p2pnetwork_tpu import wire
 from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
+from p2pnetwork_tpu.securenode import SecureNode
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Node",
     "NodeConnection",
+    "SecureNode",
     "NodeConfig",
     "SimConfig",
     "TopologyConfig",

@@ -1,0 +1,70 @@
+"""Auto-sharded (GSPMD) protocol execution: annotate shardings, let XLA
+insert the collectives.
+
+The explicit ring path (parallel/sharded.py) hand-places every ``ppermute``;
+this module is the complementary idiom from the JAX sharding playbook: put
+the graph's arrays on the mesh with named shardings and run the *unchanged*
+single-device engine — the compiler partitions the computation and inserts
+all-gathers/reduce-scatters where edges cross shards. Any protocol written
+against the engine (Flood, Gossip, SIR, user protocols) scales this way
+with zero protocol changes; the explicit ring remains the
+bandwidth-predictable path for the flood benchmark.
+
+Layouts: every per-node array is sharded on its leading (node) axis, every
+per-edge array on its edge axis, the neighbor table on rows. The blocked /
+hybrid representations are layout-specialized for the single-chip kernels
+and are dropped here (use method="segment" or "gather").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+def shard_graph_auto(graph: Graph, mesh: Mesh,
+                     axis_name: str = DEFAULT_AXIS) -> Graph:
+    """Return ``graph`` with its arrays placed on ``mesh``, node/edge axes
+    sharded. Shapes are already padded to multiples of 128, so any mesh of
+    up to 128 devices divides them evenly."""
+    # The compiler-inserted-collectives idiom needs Auto axes: under JAX's
+    # explicit sharding-in-types (the make_mesh default), a node-sharded
+    # gather by edge-sharded indices is a type error instead of an
+    # auto-partitioned program.
+    mesh = Mesh(
+        mesh.devices, mesh.axis_names,
+        axis_types=(jax.sharding.AxisType.Auto,) * len(mesh.axis_names),
+    )
+    spec = NamedSharding(mesh, P(axis_name))
+
+    def put(x):
+        return None if x is None else jax.device_put(x, spec)
+
+    return dataclasses.replace(
+        graph,
+        senders=put(graph.senders),
+        receivers=put(graph.receivers),
+        edge_mask=put(graph.edge_mask),
+        node_mask=put(graph.node_mask),
+        in_degree=put(graph.in_degree),
+        out_degree=put(graph.out_degree),
+        neighbors=put(graph.neighbors),
+        neighbor_mask=put(graph.neighbor_mask),
+        blocked=None,
+        hybrid=None,
+    )
+
+
+def run_auto(graph: Graph, protocol, key: jax.Array, rounds: int):
+    """Run ``rounds`` protocol rounds on an auto-sharded graph.
+
+    Identical semantics to ``engine.run`` (it IS engine.run — the shardings
+    on ``graph``'s arrays make GSPMD partition the compiled program)."""
+    from p2pnetwork_tpu.sim import engine
+
+    return engine.run(graph, protocol, key, rounds)

@@ -132,42 +132,66 @@ def _ring_perm(S: int):
     return [(i, (i + 1) % S) for i in range(S)]
 
 
-def _ring_rounds_or(axis_name, S, block, bkt_src, bkt_dst, bkt_mask,
-                    node_mask, out_degree, seen0, frontier0, rounds):
-    """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
-    full ring pass. All blocks carry a leading length-1 shard axis."""
-    bkt_src, bkt_dst, bkt_mask = bkt_src[0], bkt_dst[0], bkt_mask[0]
-    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+def _ring_pass(axis_name, S, frontier, buckets, apply_bucket, acc0, combine):
+    """One full ring rotation: apply bucket ``t`` to the block resident at
+    ring step ``t``, folding results with ``combine``.
 
-    def apply_bucket(rot, src, dst, m):
+    The last bucket is peeled out of the scan: after it is applied there is
+    nothing left to rotate, so running its ppermute would be one wasted ICI
+    collective per pass.
+    """
+    bkt_src, bkt_dst, bkt_mask = buckets
+
+    def ring_step(rc, bkt):
+        rot, acc = rc  # rot: frontier block resident this step
+        acc = combine(acc, apply_bucket(rot, *bkt))
+        rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
+        return (rot, acc), None
+
+    if S > 1:
+        (rot, acc), _ = jax.lax.scan(
+            ring_step,
+            (frontier, acc0),
+            (bkt_src[: S - 1], bkt_dst[: S - 1], bkt_mask[: S - 1]),
+        )
+    else:
+        rot, acc = frontier, acc0
+    return combine(acc, apply_bucket(rot, bkt_src[S - 1], bkt_dst[S - 1],
+                                     bkt_mask[S - 1]))
+
+
+def _bucket_or(block):
+    def apply(rot, src, dst, m):
         contrib = (rot[src] & m).astype(jnp.int32)
         return jax.ops.segment_max(
             contrib, dst, num_segments=block, indices_are_sorted=True
         ) > 0
 
+    return apply
+
+
+def _bucket_sum(block):
+    def apply(rot, src, dst, m):
+        contrib = rot[src] * m
+        return jax.ops.segment_sum(
+            contrib, dst, num_segments=block, indices_are_sorted=True
+        )
+
+    return apply
+
+
+def _ring_rounds_or(axis_name, S, block, bkt_src, bkt_dst, bkt_mask,
+                    node_mask, out_degree, seen0, frontier0, rounds):
+    """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
+    full ring pass. All blocks carry a leading length-1 shard axis."""
+    buckets = (bkt_src[0], bkt_dst[0], bkt_mask[0])
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    apply_bucket = _bucket_or(block)
+
     def one_round(carry, _):
         seen, frontier = carry  # [block] bool each
-
-        def ring_step(rc, bkt):
-            rot, acc = rc  # rot: frontier block resident this step
-            acc = acc | apply_bucket(rot, *bkt)
-            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
-            return (rot, acc), None
-
-        # The last bucket is peeled out of the scan: after it is applied
-        # there is nothing left to rotate, so running its ppermute would be
-        # one wasted ICI collective per round.
-        if S > 1:
-            (rot, delivered), _ = jax.lax.scan(
-                ring_step,
-                (frontier, jnp.zeros_like(seen)),
-                (bkt_src[: S - 1], bkt_dst[: S - 1], bkt_mask[: S - 1]),
-            )
-        else:
-            rot, delivered = frontier, jnp.zeros_like(seen)
-        delivered = delivered | apply_bucket(
-            rot, bkt_src[S - 1], bkt_dst[S - 1], bkt_mask[S - 1]
-        )
+        delivered = _ring_pass(axis_name, S, frontier, buckets, apply_bucket,
+                               jnp.zeros_like(seen), jnp.logical_or)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
@@ -219,3 +243,120 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
         "coverage": stats["covered"].astype(jnp.float32) / n_real,
     }
     return seen, stats
+
+
+def _ring_rounds_sir(axis_name, S, block, exact_rng,
+                     bkt_src, bkt_dst, bkt_mask, node_mask, out_degree,
+                     status0, round_keys, one_minus_beta, gamma, rounds):
+    """Per-shard body: ``rounds`` SIR rounds, infection pressure via a ring
+    sum pass. ``round_keys`` is replicated raw key data [rounds, ...];
+    ``beta``/``gamma`` are replicated scalars (runtime operands, so a
+    parameter sweep does not recompile per value).
+
+    ``exact_rng=True`` draws the full population's uniforms on every shard
+    and slices out this shard's block — O(N) per shard, but bit-identical to
+    the single-device engine (verification mode). ``exact_rng=False`` folds
+    the shard index into the key — O(block), the scalable default.
+    """
+    from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
+
+    buckets = (bkt_src[0], bkt_dst[0], bkt_mask[0])
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    apply_bucket = _bucket_sum(block)
+    my = jax.lax.axis_index(axis_name)
+
+    def draw(key, shape_full):
+        if exact_rng:
+            full = jax.random.uniform(key, (shape_full,))
+            return jax.lax.dynamic_slice(full, (my * block,), (block,))
+        return jax.random.uniform(jax.random.fold_in(key, my), (block,))
+
+    def one_round(status, rkey):
+        key = jax.random.wrap_key_data(rkey)
+        k_inf, k_rec = jax.random.split(key)
+        infected = (status == INFECTED) & node_mask_b
+        susceptible = (status == SUSCEPTIBLE) & node_mask_b
+
+        # pcast: a fresh constant is shard-invariant by type; the ring pass
+        # folds shard-varying blocks into it, so the accumulator must be
+        # marked varying up front (scan carries demand matching vma types).
+        acc0 = jax.lax.pcast(
+            jnp.zeros((block,), jnp.float32), (axis_name,), to="varying"
+        )
+        pressure = _ring_pass(
+            axis_name, S, infected.astype(jnp.float32), buckets, apply_bucket,
+            acc0, jnp.add,
+        )
+        # one_minus_beta arrives precomputed in f64 then cast, matching the
+        # engine's `jnp.power(1.0 - beta, ...)` constant bit-for-bit.
+        p_infect = 1.0 - jnp.power(one_minus_beta, pressure)
+        newly_infected = susceptible & (draw(k_inf, S * block) < p_infect)
+        recovers = infected & (draw(k_rec, S * block) < gamma)
+
+        status = jnp.where(newly_infected, INFECTED, status)
+        status = jnp.where(recovers, RECOVERED, status)
+
+        def frac(mask):
+            return jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), axis_name)
+
+        stats = {
+            "messages": jax.lax.psum(
+                jnp.sum(jnp.where(infected, out_degree_b, 0)), axis_name
+            ),
+            "s": frac((status == SUSCEPTIBLE) & node_mask_b),
+            "i": frac((status == INFECTED) & node_mask_b),
+            "r": frac((status == RECOVERED) & node_mask_b),
+        }
+        return status, stats
+
+    status, stats = jax.lax.scan(one_round, status0[0], round_keys)
+    return status[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+            exact_rng: bool):
+    body = functools.partial(_ring_rounds_sir, axis_name, S, block, exact_rng)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh,
+        in_specs=(spec,) * 6 + (P(), P(), P()),
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
+        axis_name: str = DEFAULT_AXIS, exact_rng: bool = False):
+    """Run ``rounds`` of SIR (models/sir.py) on the sharded graph.
+
+    Returns ``(status [S, block] i32, stats dict of [rounds] arrays)``. The
+    key schedule matches ``engine.run``'s, so with ``exact_rng=True`` and a
+    node count divisible by the shard count this is bit-identical to the
+    single-device engine (tests/test_sharded.py).
+    """
+    S, block = sg.n_shards, sg.block
+    source = protocol.source
+    status0 = (
+        jnp.zeros((S, block), dtype=jnp.int32)
+        .at[source // block, source % block].set(1)
+    )
+    # engine.run's schedule: one subkey per round off fold_in(key, 1).
+    round_keys = jax.random.key_data(
+        jax.random.split(jax.random.fold_in(key, 1), rounds)
+    )
+    fn = _sir_fn(mesh, axis_name, S, block, rounds, bool(exact_rng))
+    status, stats = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, sg.node_mask, sg.out_degree,
+        status0, round_keys,
+        jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
+    )
+    n_real = max(sg.n_nodes, 1)
+    return status, {
+        "messages": stats["messages"],
+        "s_frac": stats["s"].astype(jnp.float32) / n_real,
+        "i_frac": stats["i"].astype(jnp.float32) / n_real,
+        "r_frac": stats["r"].astype(jnp.float32) / n_real,
+        "coverage": (n_real - stats["s"]).astype(jnp.float32) / n_real,
+    }
