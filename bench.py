@@ -30,7 +30,10 @@ def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int 
         state, out = engine.run_until_coverage(
             graph, protocol, key, coverage_target=target, max_rounds=max_rounds
         )
-        jax.block_until_ready(state.seen)
+        # Synchronize via a real host transfer: on tunneled backends
+        # jax.block_until_ready can return before execution finishes, which
+        # would make these timings dispatch-only fiction.
+        out["rounds"] = int(out["rounds"])
         return out
 
     out = once()  # compile + warm up
@@ -49,8 +52,7 @@ def main():
     t_build0 = time.perf_counter()
     from p2pnetwork_tpu.sim import graph as G
 
-    g = G.watts_strogatz(n, k, 0.1, seed=0)
-    g = g.with_blocked().with_hybrid()
+    g = G.watts_strogatz(n, k, 0.1, seed=0, blocked=True, hybrid=True)
     build_s = time.perf_counter() - t_build0
 
     platform = jax.devices()[0].platform

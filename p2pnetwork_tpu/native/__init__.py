@@ -1,0 +1,150 @@
+"""Native (C++) host kernels with transparent numpy fallback.
+
+The device hot path of this framework is XLA/Pallas; the *host* hot path is
+graph construction — sorting multi-million-edge lists and deduplicating
+undirected pairs, which dominates wall clock at BASELINE scale when done
+with numpy's comparison sorts. ``graphcore.cpp`` implements them as LSD
+radix passes; this module compiles it on first use (``g++ -O3 -shared``,
+cached next to the source) and binds it with ctypes — no build system, no
+binding generator, and every entry point silently falls back to numpy when
+a compiler is unavailable (``force_fallback()`` pins that for tests).
+
+The reference has no native code at all (SURVEY.md section 2.1); this layer
+exists because the new framework builds graphs five orders of magnitude
+larger than a reference process would hold sockets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("graphcore.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_forced_fallback = False
+
+
+def force_fallback(enabled: bool = True) -> None:
+    """Disable (or re-enable) the native library — numpy paths only."""
+    global _forced_fallback
+    _forced_fallback = enabled
+
+
+def _so_candidates():
+    """Where the compiled library may live: next to the source (dev
+    checkout), else a per-user cache dir (read-only installs)."""
+    yield _SRC.with_name("libgraphcore.so")
+    cache = Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache"))
+    yield cache / "p2pnetwork_tpu" / "libgraphcore.so"
+
+
+def _compile() -> Optional[Path]:
+    """Compile (or find cached) libgraphcore.so; None means use numpy.
+
+    Every filesystem/toolchain failure is swallowed — the contract of this
+    module is a silent numpy fallback, never an import-time crash.
+    """
+    try:
+        src_mtime = _SRC.stat().st_mtime
+    except OSError:
+        return None  # source not shipped (e.g. a .py-only wheel)
+    for so in _so_candidates():
+        try:
+            if so.exists() and so.stat().st_mtime >= src_mtime:
+                return so
+        except OSError:
+            continue
+    for so in _so_candidates():
+        try:
+            so.parent.mkdir(parents=True, exist_ok=True)
+            # Build into a temp file then rename: concurrent importers must
+            # never dlopen a half-written .so.
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(so.parent))
+            os.close(fd)
+        except OSError:
+            continue
+        cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+            return so
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None  # compiler failure will not differ by directory
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _forced_fallback:
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _compile()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(so))
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.gc_sort_pairs_i32.argtypes = [i32p, i32p, ctypes.c_int64, i32p, i32p]
+            lib.gc_sort_pairs_i32.restype = None
+            lib.gc_sort_unique_i64.argtypes = [i64p, ctypes.c_int64]
+            lib.gc_sort_unique_i64.restype = ctypes.c_int64
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is loaded (compiles on first call)."""
+    return _load() is not None
+
+
+def sort_pairs(keys: np.ndarray, vals: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Stable sort of (keys, vals) by non-negative int32 ``keys``.
+
+    Equivalent to ``order = np.argsort(keys, kind="stable");
+    (keys[order], vals[order])`` — radix passes instead of comparison sort.
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.int32)
+    if keys.shape != vals.shape or keys.ndim != 1:
+        raise ValueError("sort_pairs expects two equal-length 1-D arrays")
+    lib = _load()
+    if lib is None or keys.size == 0:
+        order = np.argsort(keys, kind="stable")
+        return keys[order], vals[order]
+    out_k = np.empty_like(keys)
+    out_v = np.empty_like(vals)
+    lib.gc_sort_pairs_i32(keys, vals, keys.size, out_k, out_v)
+    return out_k, out_v
+
+
+def sort_unique(keys: np.ndarray) -> np.ndarray:
+    """Sorted unique non-negative int64 ``keys`` (``np.unique`` equivalent)."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if keys.ndim != 1:
+        raise ValueError("sort_unique expects a 1-D array")
+    lib = _load()
+    if lib is None or keys.size == 0:
+        return np.unique(keys)
+    buf = keys.copy()
+    m = lib.gc_sort_unique_i64(buf, buf.size)
+    return buf[:m]

@@ -1,0 +1,108 @@
+// graphcore: native kernels for host-side graph construction.
+//
+// The reference is pure Python end to end (SURVEY.md section 2.1: zero
+// native components), so nothing here is a port — this is the runtime-side
+// native layer of the TPU framework: the device hot path is XLA/Pallas,
+// and the host hot path (building million-node graphs: sorting edge lists,
+// deduplicating undirected pairs) is C++ behind a ctypes boundary with a
+// numpy fallback (p2pnetwork_tpu/native/__init__.py).
+//
+// Build: g++ -O3 -shared -fPIC graphcore.cpp -o libgraphcore.so
+// (done on demand by the Python loader; no build system required).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// One LSD counting pass: stable-sort (key, val) by bits [shift, shift+16).
+template <typename K>
+void counting_pass(const K* sk, const int32_t* sv, K* dk, int32_t* dv,
+                   int64_t n, int shift, int64_t* cnt) {
+    constexpr int64_t R = 1 << 16;
+    std::fill(cnt, cnt + R, 0);
+    for (int64_t i = 0; i < n; ++i) cnt[(sk[i] >> shift) & 0xFFFF]++;
+    int64_t sum = 0;
+    for (int64_t b = 0; b < R; ++b) {
+        int64_t c = cnt[b];
+        cnt[b] = sum;
+        sum += c;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t pos = cnt[(sk[i] >> shift) & 0xFFFF]++;
+        dk[pos] = sk[i];
+        dv[pos] = sv[i];
+    }
+}
+
+int passes_for(uint64_t max_key) {
+    int p = 1;
+    while (max_key >> (16 * p)) ++p;
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Stable sort of (key, val) int32 pairs by non-negative key.
+// out arrays must not alias the inputs.
+void gc_sort_pairs_i32(const int32_t* keys, const int32_t* vals, int64_t n,
+                       int32_t* out_keys, int32_t* out_vals) {
+    if (n <= 0) return;
+    int32_t mx = 0;
+    for (int64_t i = 0; i < n; ++i) mx = std::max(mx, keys[i]);
+    int np = passes_for((uint64_t)mx);
+    std::vector<int64_t> cnt(1 << 16);
+    std::vector<int32_t> tk(n), tv(n);
+    // Ping-pong between the temp and out buffers so the final pass lands in
+    // out; with an odd pass count start temp-first, else out-first.
+    int32_t* bufk[2] = {tk.data(), out_keys};
+    int32_t* bufv[2] = {tv.data(), out_vals};
+    int dst = (np % 2 == 1) ? 1 : 0;
+    const int32_t* sk = keys;
+    const int32_t* sv = vals;
+    for (int p = 0; p < np; ++p) {
+        counting_pass(sk, sv, bufk[dst], bufv[dst], n, 16 * p, cnt.data());
+        sk = bufk[dst];
+        sv = bufv[dst];
+        dst ^= 1;
+    }
+    if (sk != out_keys) {
+        std::memcpy(out_keys, sk, n * sizeof(int32_t));
+        std::memcpy(out_vals, sv, n * sizeof(int32_t));
+    }
+}
+
+// Sort non-negative int64 keys ascending, drop duplicates in place;
+// returns the unique count.
+int64_t gc_sort_unique_i64(int64_t* keys, int64_t n) {
+    if (n <= 0) return 0;
+    uint64_t mx = 0;
+    for (int64_t i = 0; i < n; ++i) mx = std::max(mx, (uint64_t)keys[i]);
+    int np = passes_for(mx);
+    constexpr int64_t R = 1 << 16;
+    std::vector<int64_t> cnt(R);
+    std::vector<int64_t> tmp(n);
+    int64_t* src = keys;
+    int64_t* dst = tmp.data();
+    for (int p = 0; p < np; ++p) {
+        int shift = 16 * p;
+        std::fill(cnt.begin(), cnt.end(), 0);
+        for (int64_t i = 0; i < n; ++i) cnt[(src[i] >> shift) & 0xFFFF]++;
+        int64_t sum = 0;
+        for (int64_t b = 0; b < R; ++b) {
+            int64_t c = cnt[b];
+            cnt[b] = sum;
+            sum += c;
+        }
+        for (int64_t i = 0; i < n; ++i) dst[cnt[(src[i] >> shift) & 0xFFFF]++] = src[i];
+        std::swap(src, dst);
+    }
+    if (src != keys) std::memcpy(keys, src, n * sizeof(int64_t));
+    return std::unique(keys, keys + n) - keys;
+}
+
+}  // extern "C"

@@ -63,7 +63,7 @@ class HybridEdges:
 
 def build_hybrid(
     graph,
-    block: int = 128,
+    block: int = 512,
     max_diags: int = 64,
     min_count: Optional[int] = None,
 ) -> HybridEdges:
@@ -74,10 +74,33 @@ def build_hybrid(
     array beats per-edge gather cost) and at most ``max_diags`` offsets are
     kept (compile-time unroll bound).
     """
-    n = graph.n_nodes
     emask = np.asarray(graph.edge_mask)
-    senders = np.asarray(graph.senders)[emask].astype(np.int64)
-    receivers = np.asarray(graph.receivers)[emask].astype(np.int64)
+    return build_hybrid_from_arrays(
+        np.asarray(graph.senders)[emask],
+        np.asarray(graph.receivers)[emask],
+        graph.n_nodes,
+        graph.n_nodes_padded,
+        block=block,
+        max_diags=max_diags,
+        min_count=min_count,
+    )
+
+
+def build_hybrid_from_arrays(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    n: int,
+    n_pad: int,
+    *,
+    block: int = 512,
+    max_diags: int = 64,
+    min_count: Optional[int] = None,
+) -> HybridEdges:
+    """:func:`build_hybrid` on host edge arrays (``receivers`` sorted
+    non-decreasing, active edges only) — lets graph construction build the
+    representation before anything is transferred to device."""
+    senders = senders.astype(np.int64)
+    receivers = receivers.astype(np.int64)
 
     if min_count is None:
         min_count = max(n // 256, 128)
@@ -113,9 +136,7 @@ def build_hybrid(
     remainder = None
     if rem_s.size:
         # The remainder inherits receiver-sortedness from the graph's edges.
-        remainder = build_blocked_from_arrays(
-            rem_s, rem_r, graph.n_nodes_padded, block
-        )
+        remainder = build_blocked_from_arrays(rem_s, rem_r, n_pad, block)
 
     return HybridEdges(
         masks=jnp.asarray(masks),

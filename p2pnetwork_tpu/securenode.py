@@ -28,6 +28,11 @@ Design (new, not a port — the reference's showcase used pycryptodome RSA):
   signer pins its key; later envelopes under a different key are
   rejected). The verified key is handed to the ``secure_message`` hook so
   applications can enforce stricter policies.
+- **Replay protection**: each verified (signer, nonce) pair is remembered
+  in a bounded window (``replay_window``, drop-oldest); a captured envelope
+  re-sent inside the window is rejected as ``"replayed nonce"``.
+  Applications needing protection beyond the window should timestamp their
+  payloads.
 - Valid messages fire the ``secure_message`` hook (and the ``"secure_message"``
   callback event); invalid ones fire ``secure_message_invalid``, count into
   ``message_count_rerr``, and are never delivered as payload.
@@ -40,6 +45,7 @@ explicit in ``self.scheme``).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import os
@@ -109,6 +115,13 @@ class SecureNode(Node):
             self._public_hex = ""
         # Pinned signer id -> public key hex (see trust_key / TOFU).
         self.known_keys: dict = {}
+        # Replay window: the most recent verified nonces per signer. A
+        # captured envelope re-sent within the window is rejected; the
+        # window is bounded (drop-oldest), so indefinite storage is not
+        # required and very old replays are an application-level concern
+        # (e.g. timestamp payloads if that matters).
+        self.replay_window = 4096
+        self._seen_nonces: dict = {}  # signer -> (set, deque)
         super().__init__(host, port, id=id, callback=callback,
                          max_connections=max_connections, **kw)
         if self.scheme == "ed25519":
@@ -144,6 +157,9 @@ class SecureNode(Node):
                 return True
             except Exception:
                 return False
+        if not isinstance(signature_hex, str):
+            return False  # compare_digest raises on non-str; a forgery must
+            # count as invalid, not crash the verification path
         expect = _hmac.new(self._network_key, digest_hex.encode(),
                            hashlib.sha512).hexdigest()
         return _hmac.compare_digest(expect, signature_hex)
@@ -201,14 +217,31 @@ class SecureNode(Node):
         public_key = envelope.get("public_key", "")
         if not self._verify(digest, envelope["signature"], public_key):
             return "bad signature"
+        signer = str(envelope["signer"])
         if self.scheme == "ed25519":
-            signer = str(envelope["signer"])
             pinned = self.known_keys.get(signer)
             if pinned is None:
                 self.known_keys[signer] = public_key  # trust-on-first-use
             elif pinned != public_key:
                 return f"key mismatch for signer {signer!r}"
+        if not self._record_nonce(signer, envelope["nonce"]):
+            return "replayed nonce"
         return None
+
+    def _record_nonce(self, signer: str, nonce) -> bool:
+        """Track ``nonce`` in the signer's replay window; False if seen."""
+        entry = self._seen_nonces.get(signer)
+        if entry is None:
+            entry = (set(), collections.deque())
+            self._seen_nonces[signer] = entry
+        seen, order = entry
+        if nonce in seen:
+            return False
+        seen.add(nonce)
+        order.append(nonce)
+        if len(order) > self.replay_window:
+            seen.discard(order.popleft())
+        return True
 
     def node_message(self, node, data) -> None:
         """Route envelopes through verification; pass other traffic through."""

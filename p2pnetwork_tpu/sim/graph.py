@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2pnetwork_tpu import native
+
 
 def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
@@ -108,7 +110,7 @@ class Graph:
 
         return dataclasses.replace(self, blocked=build_blocked(self, block))
 
-    def with_hybrid(self, block: int = 128, max_diags: int = 64) -> "Graph":
+    def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
         the graph's dominant diagonals (gather-free), the Pallas kernel for
@@ -129,6 +131,8 @@ def from_edges(
     edge_pad_multiple: int = 128,
     build_neighbor_table: bool = True,
     max_degree: Optional[int] = None,
+    blocked: bool = False,
+    hybrid: bool = False,
 ) -> Graph:
     """Build a :class:`Graph` from host-side edge arrays.
 
@@ -139,6 +143,12 @@ def from_edges(
     the segment reductions rely on) and are masked out of every aggregation.
     ``max_degree`` caps the neighbor table width (default: the true maximum
     in-degree).
+
+    ``blocked=True`` / ``hybrid=True`` attach those aggregation
+    representations *during* construction — same results as the
+    ``with_blocked()`` / ``with_hybrid()`` methods, but built from the
+    host-side arrays already in hand instead of pulling device arrays back
+    over the wire (a multi-second round trip at BASELINE scale).
     """
     senders = np.asarray(senders, dtype=np.int32)
     receivers = np.asarray(receivers, dtype=np.int32)
@@ -147,8 +157,7 @@ def from_edges(
     if senders.size and (senders.max() >= n_nodes or receivers.max() >= n_nodes):
         raise ValueError("edge endpoint out of range")
 
-    order = np.argsort(receivers, kind="stable")
-    senders, receivers = senders[order], receivers[order]
+    receivers, senders = native.sort_pairs(receivers, senders)
 
     n_pad = _round_up(max(n_nodes, 1), node_pad_multiple)
     e = senders.size
@@ -208,6 +217,16 @@ def from_edges(
         neighbors = np.where(valid, pool[np.minimum(take, max(e - 1, 0))], 0).astype(np.int32)
         neighbor_mask = valid
 
+    blocked_rep = hybrid_rep = None
+    if blocked:
+        from p2pnetwork_tpu.ops.blocked import build_blocked_from_arrays
+
+        blocked_rep = build_blocked_from_arrays(senders, receivers, n_pad)
+    if hybrid:
+        from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
+
+        hybrid_rep = build_hybrid_from_arrays(senders, receivers, n_nodes, n_pad)
+
     return Graph(
         senders=jnp.asarray(s),
         receivers=jnp.asarray(r),
@@ -220,6 +239,8 @@ def from_edges(
         n_nodes=n_nodes,
         n_edges=e,
         neighbors_complete=neighbors_complete,
+        blocked=blocked_rep,
+        hybrid=hybrid_rep,
     )
 
 
@@ -251,7 +272,7 @@ def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
         dst = rng.integers(0, n, size=draw, dtype=np.int64)
         keep = src != dst
         lo, hi = np.minimum(src[keep], dst[keep]), np.maximum(src[keep], dst[keep])
-        keys = np.unique(np.concatenate([keys, lo * n + hi]))
+        keys = native.sort_unique(np.concatenate([keys, lo * n + hi]))
         draw *= 2
     keys = rng.permutation(keys)[:m]
     lo, hi = (keys // n).astype(np.int32), (keys % n).astype(np.int32)
@@ -310,7 +331,7 @@ def watts_strogatz(n: int, k: int, p: float, seed: int = 0, **kw) -> Graph:
     # otherwise SIR would double-count that neighbor's infection pressure
     # (the other generators dedup too).
     lo, hi = np.minimum(src, dst), np.maximum(src, dst)
-    keys = np.unique(lo * np.int64(n) + hi)
+    keys = native.sort_unique(lo * np.int64(n) + hi)
     lo = (keys // n).astype(np.int32)
     hi = (keys % n).astype(np.int32)
     return from_edges(*_undirect(lo, hi), n, **kw)

@@ -117,6 +117,44 @@ class TestEnvelope:
         finally:
             stop_all([b])
 
+    def test_replayed_envelope_rejected(self):
+        a = SecureNode("127.0.0.1", 0, id="a")
+        b = SecureNode("127.0.0.1", 0, id="b")
+        try:
+            env = a.make_envelope({"tx": "pay", "amount": 5})
+            assert b.check_envelope(env) is None
+            assert b.check_envelope(env) == "replayed nonce"
+            # A fresh envelope with the same payload has a fresh nonce.
+            assert b.check_envelope(a.make_envelope({"tx": "pay", "amount": 5})) is None
+        finally:
+            stop_all([a, b])
+
+    def test_replay_window_is_bounded(self):
+        a = SecureNode("127.0.0.1", 0, id="a")
+        b = SecureNode("127.0.0.1", 0, id="b")
+        try:
+            b.replay_window = 3
+            envs = [a.make_envelope(i) for i in range(4)]
+            for env in envs:
+                assert b.check_envelope(env) is None
+            # envs[0] fell out of the window; envs[3] is still inside.
+            assert b.check_envelope(envs[0]) is None
+            assert b.check_envelope(envs[3]) == "replayed nonce"
+        finally:
+            stop_all([a, b])
+
+    def test_hmac_nonstring_signature_is_invalid_not_crash(self, monkeypatch):
+        import p2pnetwork_tpu.securenode as sn
+
+        monkeypatch.setattr(sn, "_HAVE_ED25519", False)
+        b = sn.SecureNode("127.0.0.1", 0, id="b", network_key=b"k")
+        try:
+            env = b.make_envelope("x")
+            env["signature"] = 123
+            assert b.check_envelope(env) == "bad signature"
+        finally:
+            stop_all([b])
+
     def test_stable_digest_across_key_order(self):
         d1 = payload_digest({"a": 1, "b": 2}, "s", "n")
         d2 = payload_digest({"b": 2, "a": 1}, "s", "n")
