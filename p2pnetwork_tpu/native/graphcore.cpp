@@ -38,8 +38,11 @@ void counting_pass(const K* sk, const int32_t* sv, K* dk, int32_t* dv,
 }
 
 int passes_for(uint64_t max_key) {
+    // Shift the key down instead of growing the shift count: a shift of
+    // >= 64 bits (keys >= 2^48 under the old form) is undefined behavior
+    // and an infinite loop on x86, where shift counts wrap mod 64.
     int p = 1;
-    while (max_key >> (16 * p)) ++p;
+    while (max_key >>= 16) ++p;
     return p;
 }
 

@@ -1,0 +1,106 @@
+"""Multi-host (DCN + ICI) mesh construction and process bootstrap.
+
+The reference's multi-machine story is hand-configured TCP: every process
+binds a host:port and users wire the topology by calling
+``connect_with_node`` with literal addresses [ref: README.md:70-105,
+examples/my_own_p2p_application.py]. The sim backend's story is JAX
+multi-process: one process per host, ``jax.distributed`` for rendezvous,
+and a device mesh spanning every chip in the job, with the slice-internal
+axis riding ICI and the cross-host axis riding DCN.
+
+The ring propagation in parallel/sharded.py is communication-shaped like
+ring attention: each step talks only to ring neighbors. The win on a
+multi-host job is therefore entirely in RING ORDER: lay the ring out
+ICI-major (all of a host's chips are consecutive), and S-1 of every S ring
+hops ride ICI; only the host-boundary hops cross DCN. That layout is what
+:func:`hierarchical_ring_mesh` builds — the ring path needs no code
+changes, just this device ordering.
+
+For compiler-inserted collectives (parallel/auto.py) the conventional 2-D
+mesh (:func:`mesh_2d`, axes ``("dcn", "ici")``) is provided: shard the
+node axis over ``ici`` and replicate (or data-parallel) over ``dcn``, the
+standard "never let a sharded matmul's collective cross DCN" recipe.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bootstrap ``jax.distributed`` for a multi-host job.
+
+    Arguments fall back to the standard environment (JAX_COORDINATOR_ADDRESS
+    / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or a TPU pod's built-in metadata —
+    jax.distributed.initialize() with no arguments auto-detects on Cloud
+    TPU). Returns True when running multi-process, False for the
+    single-process case (no-op — every code path below works unchanged).
+    """
+    env = os.environ
+    coordinator_address = coordinator_address or env.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        if jax.process_count() > 1:
+            return True  # already initialized (e.g. by the launcher)
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def _devices_host_major(devices: Optional[Sequence[jax.Device]] = None):
+    """All job devices ordered host-major (every host's chips consecutive),
+    host order by process index, chips by device id within a host."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return sorted(devs, key=lambda d: (d.process_index, d.id))
+
+
+def hierarchical_ring_mesh(
+    axis_name: str = DEFAULT_AXIS,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 1-D ring mesh over every device in the job, ICI-major.
+
+    Drop-in for ``mesh.ring_mesh`` in a multi-host job: with hosts'
+    chips consecutive on the ring, the sharded ring propagation crosses DCN
+    only at host boundaries (chips_per_host - 1 of every chips_per_host
+    hops stay on ICI).
+    """
+    devs = _devices_host_major(devices)
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def mesh_2d(
+    axis_names: tuple = ("dcn", "ici"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A ``[hosts, chips_per_host]`` mesh: leading axis crosses DCN, trailing
+    axis stays inside a host's ICI domain. For the auto-sharded path: put
+    the node/edge axes on ``ici`` and keep ``dcn`` for replication or
+    independent runs (parameter sweeps)."""
+    devs = _devices_host_major(devices)
+    n_hosts = max(len({d.process_index for d in devs}), 1)
+    per_host = len(devs) // n_hosts
+    if n_hosts * per_host != len(devs):
+        raise ValueError(
+            f"uneven device count: {len(devs)} devices over {n_hosts} hosts"
+        )
+    grid = np.array(devs).reshape(n_hosts, per_host)
+    return Mesh(grid, axis_names)

@@ -121,7 +121,11 @@ class SecureNode(Node):
         # required and very old replays are an application-level concern
         # (e.g. timestamp payloads if that matters).
         self.replay_window = 4096
-        self._seen_nonces: dict = {}  # signer -> (set, deque)
+        # Signer entries are themselves bounded (FIFO eviction): under
+        # TOFU any peer can mint fresh signer ids, and an unbounded
+        # signer->window dict would be a memory-exhaustion vector.
+        self.max_tracked_signers = 1024
+        self._seen_nonces: dict = {}  # signer -> (set, deque), insertion-ordered
         super().__init__(host, port, id=id, callback=callback,
                          max_connections=max_connections, **kw)
         if self.scheme == "ed25519":
@@ -207,6 +211,10 @@ class SecureNode(Node):
         for field in ("payload", "signer", "nonce", "hash", "signature"):
             if field not in envelope:
                 return f"missing field {field!r}"
+        if not isinstance(envelope["nonce"], str):
+            # A list nonce is JSON-legal and would verify, but an unhashable
+            # nonce must read as invalid, not blow up the replay tracking.
+            return "nonce must be a string"
         scheme = envelope.get("scheme", "ed25519")
         if scheme != self.scheme:
             return f"scheme mismatch: envelope {scheme}, local {self.scheme}"
@@ -232,6 +240,8 @@ class SecureNode(Node):
         """Track ``nonce`` in the signer's replay window; False if seen."""
         entry = self._seen_nonces.get(signer)
         if entry is None:
+            while len(self._seen_nonces) >= self.max_tracked_signers:
+                self._seen_nonces.pop(next(iter(self._seen_nonces)))
             entry = (set(), collections.deque())
             self._seen_nonces[signer] = entry
         seen, order = entry

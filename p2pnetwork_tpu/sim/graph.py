@@ -48,9 +48,11 @@ def _padded_row_fill(starts: np.ndarray, counts: np.ndarray, width: int):
     mask. Shared by the neighbor-table and blocked-edge builders — one fancy
     index instead of a per-row Python loop.
     """
-    slot = np.arange(width)
+    slot = np.arange(width, dtype=np.int32)
+    starts = starts.astype(np.int32, copy=False)
+    counts = counts.astype(np.int32, copy=False)
     valid = slot[None, :] < counts[:, None]
-    take = np.where(valid, starts[:, None] + slot[None, :], 0)
+    take = np.where(valid, starts[:, None] + slot[None, :], np.int32(0))
     return take, valid
 
 
@@ -249,6 +251,19 @@ def _undirect(src: np.ndarray, dst: np.ndarray):
     return np.concatenate([src, dst]), np.concatenate([dst, src])
 
 
+def _dedup_undirected(src: np.ndarray, dst: np.ndarray, n: int):
+    """Unique undirected pairs as (lo, hi) int32 arrays.
+
+    Encodes each pair as ``min*n + max`` (int64: safe to n ~ 3e9 pairs-of-
+    ids) and dedups with one native radix sort pass — shared by every
+    random generator so each undirected edge enters the graph exactly once
+    (duplicates would double-count infection pressure in SIR)."""
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    keys = native.sort_unique(lo * np.int64(n) + hi)
+    return (keys // n).astype(np.int32), (keys % n).astype(np.int32)
+
+
 def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
     """G(n, p) random graph (undirected).
 
@@ -280,29 +295,43 @@ def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
 
 
 def barabasi_albert(n: int, m: int, seed: int = 0, **kw) -> Graph:
-    """Barabási–Albert preferential attachment: each new node attaches ``m``
-    edges to existing nodes with probability proportional to degree
-    (implemented with the standard repeated-endpoints sampling trick)."""
+    """Barabási–Albert preferential attachment via the Bollobás linearized
+    chord diagram (LCD) construction — the rigorous formulation of the BA
+    process, chosen because it vectorizes exactly.
+
+    Sequential BA ("attach proportionally to current degree") looks
+    inherently serial: each attachment changes the degrees the next one
+    samples from. In the LCD form, mini-vertex ``i``'s target is a uniform
+    draw ``u_i`` over ``2i+1`` endpoint slots whose *layout* is fixed in
+    advance — slot ``2j`` holds mini-vertex ``j``, slot ``2j+1`` holds
+    ``j``'s (yet unresolved) target, slot ``2i`` means a self-loop — so a
+    node's appearance count equals its degree and the draw is exactly
+    degree-proportional. All draws happen up front; odd slots form pointer
+    chains to earlier draws, resolved in O(log chain) pointer-doubling
+    passes. ``m > 1`` contracts groups of ``m`` consecutive mini-vertices;
+    self-loops and duplicate pairs are dropped (so a node can end with
+    fewer than ``m`` attachments, as in the standard construction).
+    """
     if m < 1 or m >= n:
         raise ValueError("barabasi_albert requires 1 <= m < n")
     rng = np.random.default_rng(seed)
-    # Endpoint pool: every edge endpoint appears once; sampling uniformly
-    # from the pool is sampling proportional to degree.
-    src_list = []
-    dst_list = []
-    pool = list(range(m))  # seed clique targets
-    for v in range(m, n):
-        targets = set()
-        while len(targets) < m:
-            targets.add(pool[rng.integers(0, len(pool))])
-        for t in targets:
-            src_list.append(v)
-            dst_list.append(t)
-            pool.append(v)
-            pool.append(t)
-    src = np.asarray(src_list, dtype=np.int32)
-    dst = np.asarray(dst_list, dtype=np.int32)
-    return from_edges(*_undirect(src, dst), n, **kw)
+    N = n * m  # mini-vertices of the m=1 process
+    i = np.arange(N, dtype=np.int64)
+    u = (rng.random(N) * (2 * i + 1)).astype(np.int64)  # uniform on [0, 2i]
+    # Even slot -> resolved node id (slot 2i is the self-loop, = i). Odd
+    # slot -> the target of an earlier draw: follow the chain.
+    targets = np.where(u % 2 == 0, u // 2, np.int64(-1))
+    parent = np.where(u % 2 == 1, (u - 1) // 2, i)
+    unresolved = targets < 0
+    while unresolved.any():
+        targets = np.where(unresolved, targets[parent], targets)
+        parent = parent[parent]  # pointer doubling
+        unresolved = targets < 0
+    src = i // m
+    dst = targets // m
+    keep = src != dst  # drop self-loops (LCD produces them by design)
+    lo, hi = _dedup_undirected(src[keep], dst[keep], n)
+    return from_edges(*_undirect(lo, hi), n, **kw)
 
 
 def watts_strogatz(n: int, k: int, p: float, seed: int = 0, **kw) -> Graph:
@@ -327,13 +356,8 @@ def watts_strogatz(n: int, k: int, p: float, seed: int = 0, **kw) -> Graph:
     src = np.concatenate(srcs)
     dst = np.concatenate(dsts)
     # A rewired target can collide with another (lattice or rewired) edge of
-    # the same node; drop duplicates so each undirected pair appears once —
-    # otherwise SIR would double-count that neighbor's infection pressure
-    # (the other generators dedup too).
-    lo, hi = np.minimum(src, dst), np.maximum(src, dst)
-    keys = native.sort_unique(lo * np.int64(n) + hi)
-    lo = (keys // n).astype(np.int32)
-    hi = (keys % n).astype(np.int32)
+    # the same node; dedup so each undirected pair appears once.
+    lo, hi = _dedup_undirected(src, dst, n)
     return from_edges(*_undirect(lo, hi), n, **kw)
 
 

@@ -84,6 +84,24 @@ class TestGenerators:
         deg = np.asarray(g.out_degree)[:400]
         assert deg.max() > 3 * np.median(deg)  # hubs exist
 
+    def test_barabasi_albert_attachment_is_degree_proportional(self):
+        # LCD correctness signal beyond "hubs exist": early nodes accumulate
+        # far higher mean degree than late nodes, and mean degree ~= 2m.
+        g = G.barabasi_albert(3000, 3, seed=0)
+        deg = np.asarray(g.out_degree)[:3000]
+        assert abs(deg.mean() - 6.0) < 0.7
+        early, late = deg[:100].mean(), deg[2000:].mean()
+        assert early > 3 * late, f"no preferential attachment: {early} vs {late}"
+
+    def test_barabasi_albert_no_self_loops_or_duplicates(self):
+        g = G.barabasi_albert(500, 4, seed=1)
+        emask = np.asarray(g.edge_mask)
+        s = np.asarray(g.senders)[emask]
+        r = np.asarray(g.receivers)[emask]
+        assert (s != r).all()
+        keys = s.astype(np.int64) * g.n_nodes_padded + r
+        assert np.unique(keys).size == keys.size
+
     def test_watts_strogatz_degree(self):
         g = G.watts_strogatz(200, 4, 0.1, seed=3)
         deg = np.asarray(g.out_degree)[:200]

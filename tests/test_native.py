@@ -63,6 +63,14 @@ class TestSortUnique:
         native.sort_unique(keys)
         np.testing.assert_array_equal(keys, [5, 3, 3, 1])
 
+    def test_keys_above_2_48_terminate(self):
+        # Regression: pair keys reach ~n^2; for n >= 2^24 that exceeds 2^48,
+        # where the pass-count loop used to shift by >= 64 bits — undefined
+        # behavior that spins forever on x86.
+        rng = np.random.default_rng(2)
+        keys = rng.integers(2**48, 2**62, size=50_000, dtype=np.int64)
+        np.testing.assert_array_equal(native.sort_unique(keys), np.unique(keys))
+
 
 def test_graph_identical_native_vs_fallback():
     from p2pnetwork_tpu.sim import graph as G

@@ -143,6 +143,27 @@ class TestEnvelope:
         finally:
             stop_all([a, b])
 
+    def test_unhashable_nonce_is_invalid_not_crash(self):
+        a = SecureNode("127.0.0.1", 0, id="a")
+        b = SecureNode("127.0.0.1", 0, id="b")
+        try:
+            env = a.make_envelope("x")
+            env["nonce"] = ["not", "a", "string"]  # JSON-legal, unhashable
+            assert b.check_envelope(env) == "nonce must be a string"
+        finally:
+            stop_all([a, b])
+
+    def test_tracked_signer_count_is_bounded(self):
+        b = SecureNode("127.0.0.1", 0, id="b")
+        signers = [SecureNode("127.0.0.1", 0, id=f"s{i}") for i in range(5)]
+        try:
+            b.max_tracked_signers = 3
+            for s in signers:
+                assert b.check_envelope(s.make_envelope("hi")) is None
+            assert len(b._seen_nonces) == 3  # oldest signers evicted
+        finally:
+            stop_all([b] + signers)
+
     def test_hmac_nonstring_signature_is_invalid_not_crash(self, monkeypatch):
         import p2pnetwork_tpu.securenode as sn
 
