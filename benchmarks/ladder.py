@@ -1,0 +1,212 @@
+"""The BASELINE.json scale ladder: every config, one JSON line each.
+
+Configs (BASELINE.json "configs"):
+  0. 3-node localhost broadcast over real sockets — the CPU reference
+     anchor, the workload the reference's examples run
+     [ref: examples/my_own_p2p_application.py].
+  1. 1K-node Erdős–Rényi single-source flood, one chip.
+  2. 100K-node Barabási–Albert push-pull gossip averaging.
+  3. 1M-node Watts–Strogatz SIR rumor spread.
+  4. 1M + (with --full) 10M-node Watts–Strogatz seen-set flood — the
+     tx-flood config; the 10M graph specced for a v4-8 runs on ONE chip.
+
+Run: ``python benchmarks/ladder.py [--full]``. The headline driver metric
+stays in bench.py; this is the breadth harness.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+
+def emit(record):
+    print(json.dumps(record), flush=True)
+
+
+def _sync(stats_entry):
+    """Force device completion via a host transfer (block_until_ready can
+    return early on tunneled backends)."""
+    return float(stats_entry)
+
+
+def bench_sockets_anchor():
+    """Config 0: 3 real-socket nodes, timed broadcast delivery."""
+    import threading
+
+    from p2pnetwork_tpu import Node
+
+    got = threading.Semaphore(0)
+
+    class Counting(Node):
+        def node_message(self, node, data):
+            got.release()
+
+    nodes = [Counting("127.0.0.1", 0, id=f"n{i}") for i in range(3)]
+    try:
+        for n in nodes:
+            n.start()
+        nodes[0].connect_with_node("127.0.0.1", nodes[1].port)
+        nodes[1].connect_with_node("127.0.0.1", nodes[2].port)
+        nodes[2].connect_with_node("127.0.0.1", nodes[0].port)
+        deadline = time.monotonic() + 5
+        while sum(len(n.all_nodes) for n in nodes) < 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        n_msgs = 200
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            nodes[0].send_to_nodes(f"ping {i}")  # 2 deliveries each
+        for _ in range(2 * n_msgs):
+            got.acquire(timeout=10)
+        secs = time.perf_counter() - t0
+        emit({
+            "config": "3-node localhost broadcast (sockets, CPU anchor)",
+            "value": round(2 * n_msgs / secs, 1),
+            "unit": "delivered msgs/s",
+            "wall_s": round(secs, 4),
+        })
+    finally:
+        for n in nodes:
+            n.stop()
+        for n in nodes:
+            n.join(timeout=10)
+
+
+def bench_flood_1k():
+    import jax
+
+    from p2pnetwork_tpu.models import Flood
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    g = G.erdos_renyi(1000, 0.01, seed=0)
+    p = Flood(source=0, method="segment")
+    key = jax.random.key(0)
+    state, out = engine.run_until_coverage(g, p, key, coverage_target=0.99)
+    _ = int(out["rounds"])  # warm
+    t0 = time.perf_counter()
+    state, out = engine.run_until_coverage(g, p, key, coverage_target=0.99)
+    rounds = int(out["rounds"])
+    secs = time.perf_counter() - t0
+    emit({
+        "config": "1K ER flood (single chip)",
+        "value": round(secs * 1000, 3),
+        "unit": "ms to 99% coverage",
+        "rounds": rounds,
+        "messages": int(out["messages"]),
+    })
+
+
+def bench_gossip_100k():
+    import jax
+    import numpy as np
+
+    from p2pnetwork_tpu.models import Gossip
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    g = G.barabasi_albert(100_000, 4, seed=0, max_degree=128)
+    p = Gossip(alpha=0.5)
+    key = jax.random.key(0)
+    rounds = 30
+    state, stats = engine.run(g, p, key, rounds)
+    _ = _sync(stats["variance"][-1])  # warm
+    t0 = time.perf_counter()
+    state, stats = engine.run(g, p, key, rounds)
+    var_end = _sync(stats["variance"][-1])
+    secs = time.perf_counter() - t0
+    var = np.asarray(stats["variance"])
+    emit({
+        "config": "100K BA push-pull gossip (30 rounds)",
+        "value": round(rounds * g.n_nodes / secs / 1e6, 1),
+        "unit": "M node-updates/s",
+        "wall_s": round(secs, 4),
+        "variance_start": round(float(var[0]), 4),
+        "variance_end": round(var_end, 6),
+    })
+
+
+def bench_sir_1m():
+    import jax
+
+    from p2pnetwork_tpu.models import SIR
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    g = G.watts_strogatz(1_000_000, 10, 0.1, seed=0, hybrid=True,
+                         build_neighbor_table=False)
+    p = SIR(beta=0.3, gamma=0.05, source=0, method="hybrid")
+    key = jax.random.key(0)
+    rounds = 30
+    state, stats = engine.run(g, p, key, rounds)
+    _ = _sync(stats["coverage"][-1])  # warm
+    t0 = time.perf_counter()
+    state, stats = engine.run(g, p, key, rounds)
+    cov = _sync(stats["coverage"][-1])
+    secs = time.perf_counter() - t0
+    emit({
+        "config": "1M WS SIR rumor spread (30 rounds)",
+        "value": round(secs * 1000, 1),
+        "unit": "ms",
+        "coverage": round(cov, 4),
+        "messages": int(sum(stats["messages"].tolist())),
+        "msgs_per_s": round(float(sum(stats["messages"].tolist())) / secs / 1e6, 1),
+    })
+
+
+def bench_flood_big(n, label):
+    import jax
+
+    from p2pnetwork_tpu.models import Flood
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    t0 = time.perf_counter()
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, hybrid=True,
+                         build_neighbor_table=False)
+    build_s = time.perf_counter() - t0
+    p = Flood(source=0, method="hybrid")
+    key = jax.random.key(0)
+    state, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
+                                           max_rounds=64)
+    _ = int(out["rounds"])  # warm
+    t0 = time.perf_counter()
+    state, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
+                                           max_rounds=64)
+    rounds = int(out["rounds"])
+    secs = time.perf_counter() - t0
+    emit({
+        "config": label,
+        "value": round(secs, 4),
+        "unit": "s to 99% coverage",
+        "rounds": rounds,
+        "messages": int(out["messages"]),
+        "msgs_per_sec_per_chip": round(int(out["messages"]) / secs, 1),
+        "graph_build_s": round(build_s, 1),
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="include the 10M-node config (long graph build)")
+    args = ap.parse_args()
+
+    bench_sockets_anchor()
+    bench_flood_1k()
+    bench_gossip_100k()
+    bench_sir_1m()
+    bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
+    if args.full:
+        bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,7 +114,12 @@ class SecureNode(Node):
             self._network_key = network_key
             self._public_hex = ""
         # Pinned signer id -> public key hex (see trust_key / TOFU).
+        # Explicitly trusted pins are never evicted; TOFU-learned entries
+        # are bounded (oldest-learned evicted) — without a cap any peer
+        # could mint signer ids until memory runs out.
         self.known_keys: dict = {}
+        self.max_known_keys = 65536
+        self._explicit_pins: set = set()
         # Replay window: the most recent verified nonces per signer. A
         # captured envelope re-sent within the window is rejected; the
         # window is bounded (drop-oldest), so indefinite storage is not
@@ -130,14 +135,27 @@ class SecureNode(Node):
                          max_connections=max_connections, **kw)
         if self.scheme == "ed25519":
             self.known_keys[self.id] = self._public_hex
+            self._explicit_pins.add(self.id)  # own key is never evicted
 
     def trust_key(self, signer_id: str, public_key_hex: str) -> None:
         """Pin ``signer_id`` to a public key (out-of-band distribution).
 
         Envelopes claiming that signer under any other key are rejected.
-        Without a pin, the first verified envelope pins its key
+        Explicit pins are permanent (never evicted from the bounded TOFU
+        table). Without a pin, the first verified envelope pins its key
         (trust-on-first-use)."""
         self.known_keys[str(signer_id)] = public_key_hex
+        self._explicit_pins.add(str(signer_id))
+
+    def _tofu_pin(self, signer: str, public_key_hex: str) -> None:
+        """Learn a key on first use, evicting the oldest learned (never an
+        explicitly trusted) entry when the table is full."""
+        if len(self.known_keys) >= self.max_known_keys:
+            for k in self.known_keys:
+                if k not in self._explicit_pins:
+                    del self.known_keys[k]
+                    break
+        self.known_keys[signer] = public_key_hex
 
     # ------------------------------------------------------------------ keys
 
@@ -229,7 +247,7 @@ class SecureNode(Node):
         if self.scheme == "ed25519":
             pinned = self.known_keys.get(signer)
             if pinned is None:
-                self.known_keys[signer] = public_key  # trust-on-first-use
+                self._tofu_pin(signer, public_key)  # trust-on-first-use
             elif pinned != public_key:
                 return f"key mismatch for signer {signer!r}"
         if not self._record_nonce(signer, envelope["nonce"]):
@@ -237,13 +255,20 @@ class SecureNode(Node):
         return None
 
     def _record_nonce(self, signer: str, nonce) -> bool:
-        """Track ``nonce`` in the signer's replay window; False if seen."""
-        entry = self._seen_nonces.get(signer)
+        """Track ``nonce`` in the signer's replay window; False if seen.
+
+        Signer entries are evicted least-recently-ACTIVE (each accepted
+        message refreshes its signer), so flushing a victim's window by
+        minting fresh signers requires outpacing the victim's own traffic
+        — plain FIFO would let one burst of new ids evict an active signer
+        and reopen replays of its captured envelopes.
+        """
+        entry = self._seen_nonces.pop(signer, None)
         if entry is None:
             while len(self._seen_nonces) >= self.max_tracked_signers:
                 self._seen_nonces.pop(next(iter(self._seen_nonces)))
             entry = (set(), collections.deque())
-            self._seen_nonces[signer] = entry
+        self._seen_nonces[signer] = entry  # (re)insert at the fresh end
         seen, order = entry
         if nonce in seen:
             return False

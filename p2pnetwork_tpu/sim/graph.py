@@ -48,11 +48,16 @@ def _padded_row_fill(starts: np.ndarray, counts: np.ndarray, width: int):
     mask. Shared by the neighbor-table and blocked-edge builders — one fancy
     index instead of a per-row Python loop.
     """
-    slot = np.arange(width, dtype=np.int32)
-    starts = starts.astype(np.int32, copy=False)
-    counts = counts.astype(np.int32, copy=False)
+    # int32 halves the temporaries for the (rows x width) tables, but only
+    # when every index fits — beyond 2^31 edge slots int32 would wrap to
+    # negative fancy indices and silently build a wrong table.
+    big = starts.size and int(starts.max()) + width >= 2**31
+    dtype = np.int64 if big else np.int32
+    slot = np.arange(width, dtype=dtype)
+    starts = starts.astype(dtype, copy=False)
+    counts = counts.astype(dtype, copy=False)
     valid = slot[None, :] < counts[:, None]
-    take = np.where(valid, starts[:, None] + slot[None, :], np.int32(0))
+    take = np.where(valid, starts[:, None] + slot[None, :], dtype(0))
     return take, valid
 
 

@@ -164,6 +164,40 @@ class TestEnvelope:
         finally:
             stop_all([b] + signers)
 
+    def test_active_signer_survives_eviction_pressure(self):
+        # LRU, not FIFO: a signer that keeps messaging must not be flushed
+        # by a burst of fresh signer ids (which would reopen replays).
+        b = SecureNode("127.0.0.1", 0, id="b")
+        victim = SecureNode("127.0.0.1", 0, id="victim")
+        minted = [SecureNode("127.0.0.1", 0, id=f"m{i}") for i in range(4)]
+        try:
+            b.max_tracked_signers = 3
+            captured = victim.make_envelope("pay me")
+            assert b.check_envelope(captured) is None
+            for s in minted[:2]:
+                assert b.check_envelope(s.make_envelope("x")) is None
+            # victim stays active -> refreshed to the fresh end
+            assert b.check_envelope(victim.make_envelope("again")) is None
+            for s in minted[2:]:
+                assert b.check_envelope(s.make_envelope("x")) is None
+            assert b.check_envelope(captured) == "replayed nonce"
+        finally:
+            stop_all([b, victim] + minted)
+
+    def test_known_keys_bounded_but_explicit_pins_kept(self):
+        b = SecureNode("127.0.0.1", 0, id="b")
+        alice = SecureNode("127.0.0.1", 0, id="alice")
+        minted = [SecureNode("127.0.0.1", 0, id=f"k{i}") for i in range(4)]
+        try:
+            b.max_known_keys = 3
+            b.trust_key("alice", alice.public_key_hex)
+            for s in minted:
+                assert b.check_envelope(s.make_envelope("x")) is None
+            assert len(b.known_keys) <= 3 + 1  # bounded (pin exempt)
+            assert b.known_keys["alice"] == alice.public_key_hex  # never evicted
+        finally:
+            stop_all([b, alice] + minted)
+
     def test_hmac_nonstring_signature_is_invalid_not_crash(self, monkeypatch):
         import p2pnetwork_tpu.securenode as sn
 
