@@ -44,9 +44,13 @@ class Flood:
 
     def coverage(self, graph: Graph, state: FloodState) -> jax.Array:
         """Fraction of live nodes holding the message (resume seeding for
-        engine.run_until_coverage_from)."""
+        engine.run_until_coverage_from).
+
+        The numerator is masked: after mid-run node failures
+        (sim/failures.py) ``seen`` can hold dead nodes, and counting them
+        would report coverage > 1 and spuriously stop run-to-coverage."""
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
-        return jnp.sum(state.seen) / n_real
+        return jnp.sum(state.seen & graph.node_mask) / n_real
 
     def step(self, graph: Graph, state: FloodState, key: jax.Array):
         """One synchronous round: frontier nodes broadcast; receivers that
@@ -57,7 +61,9 @@ class Flood:
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         stats = {
             "messages": segment.frontier_messages(graph, state.frontier),
-            "coverage": jnp.sum(seen) / n_real,
+            # Masked numerator: dead-but-seen nodes (mid-run failures) must
+            # not push coverage past 1.
+            "coverage": jnp.sum(seen & graph.node_mask) / n_real,
             "frontier": jnp.sum(new),
         }
         return FloodState(seen=seen, frontier=new), stats

@@ -4,14 +4,18 @@
 - ``engine``: compiled round execution (scan / while_loop)
 - ``simnode``: JaxSimNode, the Node-API bridge
 - ``checkpoint``: save/resume of simulation state
+- ``failures``: fault injection (node/edge liveness masks)
 """
 
 from p2pnetwork_tpu.utils.jax_env import apply_platform_env as _apply_platform_env
 
 _apply_platform_env()
 
-from p2pnetwork_tpu.sim import checkpoint, engine, graph  # noqa: E402
+from p2pnetwork_tpu.sim import checkpoint, engine, failures, graph  # noqa: E402
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.sim.simnode import JaxSimNode, SimPeer
 
-__all__ = ["Graph", "JaxSimNode", "SimPeer", "checkpoint", "engine", "graph"]
+__all__ = [
+    "Graph", "JaxSimNode", "SimPeer", "checkpoint", "engine", "failures",
+    "graph",
+]

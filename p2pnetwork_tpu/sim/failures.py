@@ -1,0 +1,193 @@
+"""Fault injection: node and edge failures as first-class, testable inputs.
+
+The reference's failure story is reactive — a send/recv error tears down
+that connection [ref: nodeconnection.py:123-126, :201-204] and reconnect
+policy decides retry-vs-giveup [ref: node.py:203-225]. There is no way to
+*inject* failures. In the sim backend failure is a feature (SURVEY.md
+section 5 "Failure detection"): killing nodes or links flips mask bits in
+device arrays — same shapes, no recompile, the next round simply routes
+around (or into) the damage. That makes partition tolerance, epidemic
+die-out, and coverage-under-churn testable properties (SURVEY.md section 7
+hard part 4: capacity-padded adjacency + active masks).
+
+Every function returns a NEW Graph with every carried representation
+(COO masks, degrees, neighbor table, blocked kernel layout, hybrid
+diagonals) consistently re-masked, entirely device-side. Failures are
+fail-stop and one-way on the returned copy — keep the original Graph
+object around to "restore" (it is immutable and untouched).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+def _check_ids_in_range(ids, bound: int, what: str) -> None:
+    """Host-side bounds check (JAX scatter silently drops out-of-bounds
+    indices — a typo'd id would silently leave the graph undamaged).
+    Skipped for traced ids, which cannot be inspected."""
+    try:
+        arr = np.asarray(ids)
+    except Exception:
+        return
+    if arr.size and (arr.min() < 0 or arr.max() >= bound):
+        raise ValueError(f"{what} id out of range [0, {bound})")
+
+
+def _degrees(graph: Graph, edge_mask: jax.Array):
+    """(in_degree, out_degree) recomputed from a surviving-edge mask."""
+    live = edge_mask.astype(jnp.int32)
+    in_degree = jax.ops.segment_sum(
+        live, graph.receivers,
+        num_segments=graph.n_nodes_padded, indices_are_sorted=True,
+    )
+    out_degree = jnp.zeros(graph.n_nodes_padded, jnp.int32).at[
+        graph.senders].add(live)
+    return in_degree, out_degree
+
+
+def _remask_blocked(blocked, node_alive: jax.Array):
+    """Re-mask a BlockedEdges for the given per-node liveness."""
+    if blocked is None:
+        return None
+    nb, w = blocked.src.shape
+    block_base = jnp.arange(nb, dtype=jnp.int32)[:, None] * blocked.block
+    global_dst = jnp.minimum(block_base + blocked.local_dst,
+                             node_alive.shape[0] - 1)
+    mask = blocked.mask & node_alive[blocked.src] & node_alive[global_dst]
+    return dataclasses.replace(blocked, mask=mask)
+
+
+def _remask_hybrid(hybrid, node_alive: jax.Array):
+    """Re-mask a HybridEdges: diagonal masks need both endpoints alive."""
+    if hybrid is None:
+        return None
+    core = node_alive[: hybrid.n]
+    if len(hybrid.offsets):
+        # mask[d, v] needs v alive and (v + off) % n alive.
+        src_alive = jnp.stack(
+            [jnp.roll(core, -off) for off in hybrid.offsets], axis=0
+        )
+        masks = hybrid.masks & core[None, :] & src_alive
+    else:
+        masks = hybrid.masks
+    return dataclasses.replace(
+        hybrid,
+        masks=masks,
+        remainder=_remask_blocked(hybrid.remainder, node_alive),
+    )
+
+
+def with_node_liveness(graph: Graph, node_alive: jax.Array) -> Graph:
+    """Apply a liveness mask (bool[N_pad]; False = failed) to ``graph``.
+
+    An edge is active iff it was active and both endpoints live; degrees
+    are recomputed from the surviving edges; the neighbor table and the
+    blocked/hybrid kernel layouts are re-masked in place (no host rebuild,
+    no recompile — shapes are unchanged).
+    """
+    node_mask = graph.node_mask & node_alive
+    edge_mask = (
+        graph.edge_mask & node_mask[graph.senders] & node_mask[graph.receivers]
+    )
+    in_degree, out_degree = _degrees(graph, edge_mask)
+    neighbors = graph.neighbors
+    neighbor_mask = graph.neighbor_mask
+    if neighbor_mask is not None:
+        neighbor_mask = (
+            neighbor_mask & node_mask[:, None] & node_mask[neighbors]
+        )
+    return dataclasses.replace(
+        graph,
+        node_mask=node_mask,
+        edge_mask=edge_mask,
+        in_degree=in_degree,
+        out_degree=out_degree,
+        neighbor_mask=neighbor_mask,
+        blocked=_remask_blocked(graph.blocked, node_mask),
+        hybrid=_remask_hybrid(graph.hybrid, node_mask),
+    )
+
+
+def fail_nodes(graph: Graph, node_ids) -> Graph:
+    """Fail-stop the given node ids (crashed peers: they neither send nor
+    receive; their edges die with them)."""
+    _check_ids_in_range(node_ids, graph.n_nodes_padded, "node")
+    ids = jnp.asarray(node_ids, dtype=jnp.int32)
+    alive = jnp.ones(graph.n_nodes_padded, dtype=bool).at[ids].set(False)
+    return with_node_liveness(graph, alive)
+
+
+def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
+    """Apply a per-edge liveness mask (bool[E_pad]; False = cut link).
+
+    Directed: cutting one direction of an undirected pair leaves the other
+    alive. Degrees are recomputed; a complete neighbor table is re-masked
+    exactly (slot ``s`` of row ``v`` is COO edge ``starts[v] + s``, so the
+    edge mask scatters straight into the table); a width-capped table has
+    lost its slot->edge mapping and is dropped. Graphs carrying the
+    blocked/hybrid kernel layouts must use node failures or rebuild —
+    their edge order differs and a silent partial update would be wrong.
+    """
+    if graph.blocked is not None or graph.hybrid is not None:
+        raise ValueError(
+            "edge-level failures on a graph with blocked/hybrid "
+            "representations would desynchronize them; use fail_nodes / "
+            "with_node_liveness, or rebuild from the surviving edge list"
+        )
+    edge_mask = graph.edge_mask & edge_alive
+    in_degree, out_degree = _degrees(graph, edge_mask)
+    neighbors = graph.neighbors
+    neighbor_mask = graph.neighbor_mask
+    if neighbor_mask is not None:
+        if graph.neighbors_complete:
+            starts = jnp.searchsorted(
+                graph.receivers, jnp.arange(graph.n_nodes_padded)
+            )
+            width = neighbors.shape[1]
+            take = starts[:, None] + jnp.arange(width)[None, :]
+            take = jnp.minimum(take, graph.n_edges_padded - 1)
+            neighbor_mask = neighbor_mask & edge_mask[take]
+        else:
+            # Capped rows are a random edge subset; the slot->edge map is
+            # gone, so the table cannot be re-masked exactly.
+            neighbors = None
+            neighbor_mask = None
+    return dataclasses.replace(
+        graph,
+        edge_mask=edge_mask,
+        in_degree=in_degree,
+        out_degree=out_degree,
+        neighbors=neighbors,
+        neighbor_mask=neighbor_mask,
+    )
+
+
+def fail_edges(graph: Graph, edge_ids) -> Graph:
+    """Cut specific links (indices into the edge arrays)."""
+    _check_ids_in_range(edge_ids, graph.n_edges_padded, "edge")
+    ids = jnp.asarray(edge_ids, dtype=jnp.int32)
+    alive = jnp.ones(graph.n_edges_padded, dtype=bool).at[ids].set(False)
+    return with_edge_liveness(graph, alive)
+
+
+def random_node_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
+    """Fail each live node independently with probability ``frac`` —
+    the churn model for coverage-under-failure experiments."""
+    alive = ~(
+        jax.random.bernoulli(key, frac, (graph.n_nodes_padded,))
+        & graph.node_mask
+    )
+    return with_node_liveness(graph, alive)
+
+
+def random_edge_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
+    """Cut each live directed edge independently with probability ``frac``."""
+    cut = jax.random.bernoulli(key, frac, (graph.n_edges_padded,))
+    return with_edge_liveness(graph, ~cut)
