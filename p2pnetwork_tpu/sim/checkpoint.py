@@ -10,6 +10,12 @@ one (tests/test_checkpoint.py proves that).
 Format: a single ``.npz`` (atomic rename on save). The state's tree
 structure is recorded so loads verify against the template; arrays come
 back as numpy and are device-put lazily by the first jitted use.
+
+For sharded / multi-host runs use :func:`save_orbax` / :func:`load_orbax`:
+the npz path funnels every shard through one host, while orbax writes each
+process's shards in parallel and restores arrays WITH their shardings (the
+template's shardings are applied on load, so a resumed multi-chip run does
+not round-trip through host memory).
 """
 
 from __future__ import annotations
@@ -64,3 +70,78 @@ def load(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
         key = jax.random.wrap_key_data(data["__key__"])
         messages = int(data["__messages__"]) if "__messages__" in data.files else 0
         return state, key, int(data["__round__"]), messages
+
+
+def save_orbax(path: str, state: Any, key: jax.Array, round_index: int,
+               message_count: int = 0) -> None:
+    """Checkpoint via orbax (sharding-preserving, multi-host-parallel).
+
+    ``path`` is a directory (created/overwritten). All hosts of a
+    multi-process job must call this collectively.
+    """
+    import orbax.checkpoint as ocp
+
+    payload = {
+        "state": state,
+        "key_data": jax.random.key_data(key),
+        "round_index": np.int64(round_index),
+        "message_count": np.int64(message_count),
+    }
+    # Context-manage: each StandardCheckpointer owns async worker threads;
+    # a checkpoint-every-N-rounds loop must not leak one pool per save.
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), payload, force=True)
+        ckptr.wait_until_finished()
+
+
+def load_orbax(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
+    """Restore a :func:`save_orbax` checkpoint.
+
+    ``template`` supplies structure, dtypes, AND shardings: pass a state
+    built the way the resumed run will use it (e.g. on the same mesh) and
+    the restored arrays land sharded the same way, no host round-trip.
+    Returns ``(state, key, round_index, message_count)``.
+    """
+    import orbax.checkpoint as ocp
+
+    # Every leaf gets an explicit sharding: omitting one makes orbax fall
+    # back to the sharding recorded at save time, which it documents as
+    # unsafe across device topologies — exactly the resume-on-a-different-
+    # slice case this API exists for. Leaves without a sharding (and the
+    # bookkeeping scalars) are replicated over the template's mesh when it
+    # has one, else placed on the default device.
+    meshes = [
+        leaf.sharding.mesh
+        for leaf in jax.tree.leaves(template)
+        if isinstance(getattr(leaf, "sharding", None), jax.sharding.NamedSharding)
+    ]
+    if meshes:
+        default_sharding = jax.sharding.NamedSharding(
+            meshes[0], jax.sharding.PartitionSpec()
+        )
+    else:
+        default_sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    def abstract(x):
+        x = jax.numpy.asarray(x)
+        sharding = getattr(x, "sharding", None) or default_sharding
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    key_data = jax.random.key_data(jax.random.key(0))
+    target = {
+        "state": jax.tree.map(abstract, template),
+        "key_data": jax.ShapeDtypeStruct(
+            key_data.shape, key_data.dtype, sharding=default_sharding
+        ),
+        "round_index": jax.ShapeDtypeStruct((), np.int64, sharding=default_sharding),
+        "message_count": jax.ShapeDtypeStruct((), np.int64, sharding=default_sharding),
+    }
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.abspath(path), target)
+    key = jax.random.wrap_key_data(restored["key_data"])
+    return (
+        restored["state"],
+        key,
+        int(restored["round_index"]),
+        int(restored["message_count"]),
+    )

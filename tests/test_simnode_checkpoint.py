@@ -127,6 +127,38 @@ class TestCheckpoint:
         with pytest.raises(ValueError, match="structure mismatch"):
             ckpt.load(path, sir_state)
 
+    def test_orbax_roundtrip_preserves_sharding(self, tmp_path):
+        from p2pnetwork_tpu.parallel import mesh as M
+
+        mesh = M.ring_mesh(8)
+        g = G.watts_strogatz(1024, 6, 0.1, seed=1)
+        proto = Flood(source=0)
+        key = jax.random.key(3)
+        state = proto.init(g, key)
+        sharded_seen = jax.device_put(state.seen, M.shard_spec(mesh))
+        import dataclasses
+
+        state = dataclasses.replace(state, seen=sharded_seen)
+        path = str(tmp_path / "orbax_ckpt")
+        ckpt.save_orbax(path, state, key, 9, message_count=77)
+
+        template = dataclasses.replace(
+            proto.init(g, jax.random.key(0)),
+            seen=jax.device_put(
+                proto.init(g, jax.random.key(0)).seen, M.shard_spec(mesh)
+            ),
+        )
+        loaded, lkey, lround, lmsgs = ckpt.load_orbax(path, template)
+        assert lround == 9 and lmsgs == 77
+        np.testing.assert_array_equal(
+            np.asarray(loaded.seen), np.asarray(state.seen)
+        )
+        np.testing.assert_array_equal(
+            jax.random.key_data(lkey), jax.random.key_data(key)
+        )
+        # restored WITH the template's sharding, not funneled to one device
+        assert len(loaded.seen.sharding.device_set) == 8
+
     def test_resume_is_bit_identical(self, tmp_path):
         # Run 10 rounds straight vs save@5 -> load -> 5 more: same result.
         g = G.watts_strogatz(512, 6, 0.1, seed=4)
