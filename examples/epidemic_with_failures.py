@@ -42,7 +42,9 @@ def main():
     gf = failures.random_node_failures(g, jax.random.key(99), 0.4)
 
     print("epidemic continues on the damaged graph: 25 more rounds")
-    state, stats = engine.run_from(gf, proto, state, key, 25)
+    # Fresh key: reusing `key` would replay the first rounds' exact
+    # infection/recovery draws in the continuation.
+    state, stats = engine.run_from(gf, proto, state, jax.random.fold_in(key, 15), 25)
     print(f"  ever-infected (of survivors): "
           f"{float(np.asarray(stats['coverage'])[-1]):.1%}, "
           f"still infected: {float(np.asarray(stats['i_frac'])[-1]):.1%}")

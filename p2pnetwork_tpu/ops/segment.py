@@ -24,6 +24,8 @@ Two lowerings, chosen by what the graph carries:
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
@@ -45,13 +47,36 @@ def _require_complete_table(graph: Graph) -> None:
         )
 
 
+def _dynamic_or(graph: Graph, signal: jax.Array) -> jax.Array:
+    """OR-aggregate the dynamic edge region (sim/topology.py), if any."""
+    contrib = (signal[graph.dyn_senders] & graph.dyn_mask).astype(jnp.int32)
+    agg = jax.ops.segment_max(
+        contrib, graph.dyn_receivers, num_segments=graph.n_nodes_padded
+    )
+    return (agg > 0) & graph.node_mask
+
+
+def _dynamic_sum(graph: Graph, signal: jax.Array) -> jax.Array:
+    """Sum-aggregate the dynamic edge region (sim/topology.py), if any."""
+    contrib = signal[graph.dyn_senders] * graph.dyn_mask.astype(signal.dtype)
+    agg = jax.ops.segment_sum(
+        contrib, graph.dyn_receivers, num_segments=graph.n_nodes_padded
+    )
+    return agg * graph.node_mask.astype(signal.dtype)
+
+
 def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
     """Per-node OR over incoming neighbors: ``out[v] = any(signal[u], u->v)``.
 
     ``signal`` is bool[N_pad]; masked (padding) edges and nodes contribute
     nothing. ``method`` is ``"segment"``, ``"gather"`` or ``"auto"`` (gather
-    when the graph carries a complete neighbor table).
+    when the graph carries a complete neighbor table). Dynamic edges
+    (sim/topology.py) are folded in for every method.
     """
+    if graph.dyn_senders is not None:
+        static = dataclasses.replace(graph, dyn_senders=None,
+                                     dyn_receivers=None, dyn_mask=None)
+        return propagate_or(static, signal, method) | _dynamic_or(graph, signal)
     if method == "auto":
         method = "gather" if _gather_ok(graph) else "segment"
     if method == "gather":
@@ -83,7 +108,12 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
 
 
 def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
-    """Per-node sum over incoming neighbors: ``out[v] = sum(signal[u], u->v)``."""
+    """Per-node sum over incoming neighbors: ``out[v] = sum(signal[u], u->v)``.
+    Dynamic edges (sim/topology.py) are folded in for every method."""
+    if graph.dyn_senders is not None:
+        static = dataclasses.replace(graph, dyn_senders=None,
+                                     dyn_receivers=None, dyn_mask=None)
+        return propagate_sum(static, signal, method) + _dynamic_sum(graph, signal)
     if method == "auto":
         method = "gather" if _gather_ok(graph) else "segment"
     if method == "gather":

@@ -5,17 +5,24 @@
 - ``simnode``: JaxSimNode, the Node-API bridge
 - ``checkpoint``: save/resume of simulation state
 - ``failures``: fault injection (node/edge liveness masks)
+- ``topology``: runtime joins/connects (capacity-padded dynamic edges)
 """
 
 from p2pnetwork_tpu.utils.jax_env import apply_platform_env as _apply_platform_env
 
 _apply_platform_env()
 
-from p2pnetwork_tpu.sim import checkpoint, engine, failures, graph  # noqa: E402
+from p2pnetwork_tpu.sim import (  # noqa: E402
+    checkpoint,
+    engine,
+    failures,
+    graph,
+    topology,
+)
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.sim.simnode import JaxSimNode, SimPeer
 
 __all__ = [
     "Graph", "JaxSimNode", "SimPeer", "checkpoint", "engine", "failures",
-    "graph",
+    "graph", "topology",
 ]

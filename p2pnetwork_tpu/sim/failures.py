@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import dataclasses
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -40,8 +42,10 @@ def _check_ids_in_range(ids, bound: int, what: str) -> None:
         raise ValueError(f"{what} id out of range [0, {bound})")
 
 
-def _degrees(graph: Graph, edge_mask: jax.Array):
-    """(in_degree, out_degree) recomputed from a surviving-edge mask."""
+def _degrees(graph: Graph, edge_mask: jax.Array,
+             dyn_mask: Optional[jax.Array] = None):
+    """(in_degree, out_degree) recomputed from surviving-edge masks —
+    static COO plus the dynamic region (sim/topology.py), if present."""
     live = edge_mask.astype(jnp.int32)
     in_degree = jax.ops.segment_sum(
         live, graph.receivers,
@@ -49,6 +53,10 @@ def _degrees(graph: Graph, edge_mask: jax.Array):
     )
     out_degree = jnp.zeros(graph.n_nodes_padded, jnp.int32).at[
         graph.senders].add(live)
+    if dyn_mask is not None:
+        dlive = dyn_mask.astype(jnp.int32)
+        in_degree = in_degree.at[graph.dyn_receivers].add(dlive)
+        out_degree = out_degree.at[graph.dyn_senders].add(dlive)
     return in_degree, out_degree
 
 
@@ -96,7 +104,15 @@ def with_node_liveness(graph: Graph, node_alive: jax.Array) -> Graph:
     edge_mask = (
         graph.edge_mask & node_mask[graph.senders] & node_mask[graph.receivers]
     )
-    in_degree, out_degree = _degrees(graph, edge_mask)
+    dyn_mask = graph.dyn_mask
+    if dyn_mask is not None:
+        # Dynamic links (sim/topology.py) die with either endpoint too.
+        dyn_mask = (
+            dyn_mask
+            & node_mask[graph.dyn_senders]
+            & node_mask[graph.dyn_receivers]
+        )
+    in_degree, out_degree = _degrees(graph, edge_mask, dyn_mask)
     neighbors = graph.neighbors
     neighbor_mask = graph.neighbor_mask
     if neighbor_mask is not None:
@@ -107,6 +123,7 @@ def with_node_liveness(graph: Graph, node_alive: jax.Array) -> Graph:
         graph,
         node_mask=node_mask,
         edge_mask=edge_mask,
+        dyn_mask=dyn_mask,
         in_degree=in_degree,
         out_degree=out_degree,
         neighbor_mask=neighbor_mask,
@@ -142,7 +159,7 @@ def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
             "with_node_liveness, or rebuild from the surviving edge list"
         )
     edge_mask = graph.edge_mask & edge_alive
-    in_degree, out_degree = _degrees(graph, edge_mask)
+    in_degree, out_degree = _degrees(graph, edge_mask, graph.dyn_mask)
     neighbors = graph.neighbors
     neighbor_mask = graph.neighbor_mask
     if neighbor_mask is not None:

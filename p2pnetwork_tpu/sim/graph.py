@@ -97,6 +97,11 @@ class Graph:
     # Optional diagonal+remainder representation (ops/diag.py) feeding the
     # gather-free "hybrid" aggregation path; attach via with_hybrid().
     hybrid: Optional[object] = None
+    # Dynamic edge region (sim/topology.py): unsorted COO slots for links
+    # added at runtime; folded into every aggregation method.
+    dyn_senders: Optional[jax.Array] = None  # i32[K]
+    dyn_receivers: Optional[jax.Array] = None  # i32[K]
+    dyn_mask: Optional[jax.Array] = None  # bool[K]
 
     @property
     def n_nodes_padded(self) -> int:

@@ -1,0 +1,182 @@
+"""Dynamic topology: peers joining and links forming at runtime.
+
+The reference mutates topology freely — ``connect_with_node`` adds a live
+peer [ref: node.py:122], the accept loop admits inbound ones
+[ref: node.py:227-280]. XLA programs have static shapes, so the sim
+backend's version is capacity planning (SURVEY.md section 7 hard part 4):
+
+- **Node capacity** already exists: ``node_mask`` padding rows are
+  allocated-but-dead peers, and :func:`join_node` activates one.
+- **Edge capacity** is a *dynamic edge region*: ``with_capacity`` reserves
+  ``extra_edges`` slots in separate (unsorted) COO arrays; :func:`connect`
+  fills the next free slots device-side. Every aggregation method folds
+  the dynamic region in through one extra (unsorted) segment pass
+  (ops/segment.py), so flood/SIR/gossip aggregation see new links
+  immediately with no recompile and no rebuild.
+
+Static-layout representations that bake in edge order (neighbor table for
+partner *sampling*, blocked/hybrid kernel layouts for the *static* edges)
+keep serving the static edges; the dynamic region rides alongside them.
+Leaves are sim/failures.py. When the dynamic region fills up or churn
+accumulates, consolidate: rebuild via ``from_edges`` with the merged edge
+list (one-off host cost, amortized over many rounds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.sim.graph import Graph, _round_up
+
+
+def with_capacity(graph: Graph, extra_edges: int = 0,
+                  extra_nodes: int = 0) -> Graph:
+    """Reserve headroom for runtime topology growth (host-side, one-off).
+
+    ``extra_nodes`` grows the node padding (new dead rows to activate
+    later); ``extra_edges`` allocates the dynamic edge region. Node growth
+    changes array shapes, so do it at build time, before compiling.
+    """
+    g = graph
+    if extra_nodes:
+        n_pad_new = _round_up(g.n_nodes_padded + extra_nodes, 128)
+        grow = n_pad_new - g.n_nodes_padded
+        pad1 = lambda x, fill=0: jnp.pad(x, (0, grow), constant_values=fill)  # noqa: E731
+        neighbors = g.neighbors
+        neighbor_mask = g.neighbor_mask
+        if neighbors is not None:
+            neighbors = jnp.pad(neighbors, ((0, grow), (0, 0)))
+            neighbor_mask = jnp.pad(neighbor_mask, ((0, grow), (0, 0)))
+        if g.blocked is not None or g.hybrid is not None:
+            raise ValueError(
+                "with_capacity(extra_nodes=...) on a graph carrying "
+                "blocked/hybrid layouts: build those after growing, or "
+                "pass capacity to the generator instead"
+            )
+        g = dataclasses.replace(
+            g,
+            node_mask=pad1(g.node_mask, False),
+            in_degree=pad1(g.in_degree),
+            out_degree=pad1(g.out_degree),
+            neighbors=neighbors,
+            neighbor_mask=neighbor_mask,
+        )
+    if extra_edges:
+        k = _round_up(extra_edges, 128)
+        if g.dyn_senders is not None:
+            # Grow the existing region — replacing it would silently drop
+            # every runtime link made so far.
+            g = dataclasses.replace(
+                g,
+                dyn_senders=jnp.pad(g.dyn_senders, (0, k)),
+                dyn_receivers=jnp.pad(g.dyn_receivers, (0, k)),
+                dyn_mask=jnp.pad(g.dyn_mask, (0, k)),
+            )
+        else:
+            g = dataclasses.replace(
+                g,
+                dyn_senders=jnp.zeros(k, jnp.int32),
+                dyn_receivers=jnp.zeros(k, jnp.int32),
+                dyn_mask=jnp.zeros(k, bool),
+            )
+    return g
+
+
+def _require_dynamic(graph: Graph) -> None:
+    if graph.dyn_senders is None:
+        raise ValueError(
+            "no dynamic edge capacity: build with "
+            "topology.with_capacity(graph, extra_edges=...) first"
+        )
+
+
+def connect(graph: Graph, senders, receivers, *,
+            undirected: bool = True) -> Graph:
+    """Add links at runtime (device-side; no recompile).
+
+    Fills the next free dynamic slots. ``undirected=True`` (the
+    reference's TCP-connection semantic: traffic flows both ways
+    [ref: nodeconnection.py]) stores both directions. Raises at trace time
+    never — slot exhaustion is a host-side check when inputs are concrete.
+    """
+    _require_dynamic(graph)
+    from p2pnetwork_tpu.sim.failures import _check_ids_in_range
+
+    _check_ids_in_range(senders, graph.n_nodes_padded, "node")
+    _check_ids_in_range(receivers, graph.n_nodes_padded, "node")
+    s = jnp.asarray(senders, jnp.int32).reshape(-1)
+    r = jnp.asarray(receivers, jnp.int32).reshape(-1)
+    if undirected:
+        s, r = jnp.concatenate([s, r]), jnp.concatenate([r, s])
+    free = ~graph.dyn_mask
+    try:
+        if int(jnp.sum(free)) < s.size:
+            raise ValueError(
+                f"dynamic edge region full "
+                f"({graph.dyn_senders.shape[0]} slots); consolidate with "
+                f"from_edges or reserve more via with_capacity"
+            )
+    except jax.errors.TracerArrayConversionError:
+        pass  # traced: caller guarantees capacity
+    # First-free-slot allocation: disconnect() leaves holes, and writing at
+    # used-count would overwrite live edges past them.
+    slots = jnp.nonzero(free, size=s.size, fill_value=0)[0]
+    dyn_s = graph.dyn_senders.at[slots].set(s)
+    dyn_r = graph.dyn_receivers.at[slots].set(r)
+    dyn_m = graph.dyn_mask.at[slots].set(True)
+    in_degree = graph.in_degree.at[r].add(1)
+    out_degree = graph.out_degree.at[s].add(1)
+    return dataclasses.replace(
+        graph,
+        dyn_senders=dyn_s,
+        dyn_receivers=dyn_r,
+        dyn_mask=dyn_m,
+        in_degree=in_degree,
+        out_degree=out_degree,
+    )
+
+
+def disconnect(graph: Graph, senders, receivers, *,
+               undirected: bool = True) -> Graph:
+    """Remove dynamic links (matched by endpoint pair; static edges are
+    removed with sim/failures.py)."""
+    _require_dynamic(graph)
+    s = jnp.asarray(senders, jnp.int32).reshape(-1)
+    r = jnp.asarray(receivers, jnp.int32).reshape(-1)
+    if undirected:
+        s, r = jnp.concatenate([s, r]), jnp.concatenate([r, s])
+    hit = (
+        (graph.dyn_senders[:, None] == s[None, :])
+        & (graph.dyn_receivers[:, None] == r[None, :])
+    ).any(axis=1) & graph.dyn_mask
+    in_degree = graph.in_degree - jax.ops.segment_sum(
+        hit.astype(jnp.int32), graph.dyn_receivers,
+        num_segments=graph.n_nodes_padded,
+    )
+    out_degree = graph.out_degree - jnp.zeros(
+        graph.n_nodes_padded, jnp.int32
+    ).at[graph.dyn_senders].add(hit.astype(jnp.int32))
+    return dataclasses.replace(
+        graph,
+        dyn_mask=graph.dyn_mask & ~hit,
+        in_degree=in_degree,
+        out_degree=out_degree,
+    )
+
+
+def join_node(graph: Graph, node_id: int, peers) -> Graph:
+    """Activate a spare (padding) node and connect it to ``peers`` — the
+    sim analog of a new peer starting up and dialing its bootstrap set
+    [ref: node.py:122]."""
+    _require_dynamic(graph)
+    from p2pnetwork_tpu.sim.failures import _check_ids_in_range
+
+    _check_ids_in_range([node_id], graph.n_nodes_padded, "node")
+    node_mask = graph.node_mask.at[node_id].set(True)
+    g = dataclasses.replace(graph, node_mask=node_mask)
+    peers = jnp.asarray(peers, jnp.int32).reshape(-1)
+    return connect(g, jnp.full(peers.shape, node_id, jnp.int32), peers)

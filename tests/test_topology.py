@@ -1,0 +1,172 @@
+"""Dynamic topology: runtime connects/joins must be visible to every
+aggregation method immediately, with exact degree bookkeeping."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import Flood  # noqa: E402
+from p2pnetwork_tpu.ops import segment  # noqa: E402
+from p2pnetwork_tpu.sim import engine, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _brute_or(g, signal):
+    sig = np.asarray(signal)
+    out = np.zeros(g.n_nodes_padded, dtype=bool)
+    emask = np.asarray(g.edge_mask)
+    for a, b in zip(np.asarray(g.senders)[emask], np.asarray(g.receivers)[emask]):
+        out[b] |= sig[a]
+    if g.dyn_mask is not None:
+        dm = np.asarray(g.dyn_mask)
+        for a, b in zip(np.asarray(g.dyn_senders)[dm],
+                        np.asarray(g.dyn_receivers)[dm]):
+            out[b] |= sig[a]
+    return out & np.asarray(g.node_mask)
+
+
+class TestConnect:
+    def test_new_edge_seen_by_all_methods(self):
+        g = G.watts_strogatz(500, 4, 0.2, seed=0, blocked=True, hybrid=True)
+        g = topology.with_capacity(g, extra_edges=16)
+        g = topology.connect(g, [3], [441])
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[3].set(True)
+        ref = _brute_or(g, sig)
+        assert ref[441]  # sanity: the new link carries
+        for method in ("segment", "gather", "pallas", "hybrid"):
+            out = np.asarray(segment.propagate_or(g, sig, method))
+            np.testing.assert_array_equal(out, ref, err_msg=method)
+
+    def test_undirected_both_ways(self):
+        g = topology.with_capacity(G.ring(200), extra_edges=8)
+        g = topology.connect(g, [0], [100])
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[100].set(True)
+        out = np.asarray(segment.propagate_or(g, sig, "segment"))
+        assert out[0] and out[99] and out[101]
+
+    def test_degrees_updated(self):
+        g = topology.with_capacity(G.ring(200), extra_edges=8)
+        g2 = topology.connect(g, [0], [100])
+        assert int(np.asarray(g2.in_degree)[0]) == 3
+        assert int(np.asarray(g2.out_degree)[100]) == 3
+        g3 = topology.disconnect(g2, [0], [100])
+        assert int(np.asarray(g3.in_degree)[0]) == 2
+        assert int(np.asarray(g3.out_degree)[100]) == 2
+
+    def test_capacity_exhaustion_raises(self):
+        g = topology.with_capacity(G.ring(200), extra_edges=4)
+        # 128-slot minimum allocation: fill it, then overflow
+        s = np.arange(64, dtype=np.int32)
+        g = topology.connect(g, s, (s + 7) % 200)  # 128 directed slots
+        with pytest.raises(ValueError, match="dynamic edge region full"):
+            topology.connect(g, [0], [9])
+
+    def test_requires_capacity(self):
+        with pytest.raises(ValueError, match="with_capacity"):
+            topology.connect(G.ring(100), [0], [5])
+
+
+class TestJoin:
+    def test_join_bridges_into_flood(self):
+        # 200 real nodes, padding rows beyond are spare peers.
+        g = G.ring(200)
+        assert g.n_nodes_padded >= 201
+        g = topology.with_capacity(g, extra_edges=8)
+        new_id = 200  # a padding row
+        g2 = topology.join_node(g, new_id, [0, 100])
+        state, _ = engine.run(g2, Flood(source=new_id), jax.random.key(0), 60)
+        seen = np.asarray(state.seen)
+        assert seen[new_id] and seen[:200].all()  # reaches the whole ring
+
+    def test_flood_mid_run_topology_change(self):
+        # Partitioned ring: flood stalls; a runtime connect bridges it.
+        # Once stalled the frontier is empty — like the reference, holders
+        # do not spontaneously re-send to new peers — so the resume models
+        # re-announcement: frontier reset to the seen set.
+        import dataclasses
+
+        from p2pnetwork_tpu.sim import failures
+
+        g = topology.with_capacity(G.ring(100), extra_edges=8)
+        g_cut = failures.fail_nodes(g, [25, 75])
+        proto = Flood(source=0)
+        state, _ = engine.run(g_cut, proto, jax.random.key(0), 60)
+        assert not np.asarray(state.seen)[26:75].any()
+        g_bridged = topology.connect(g_cut, [10], [50])
+        reannounce = dataclasses.replace(state, frontier=state.seen)
+        state2, _ = engine.run_from(g_bridged, proto, reannounce,
+                                    jax.random.key(0), 60)
+        seen = np.asarray(state2.seen)[:100]
+        alive = np.asarray(g_bridged.node_mask)[:100]
+        assert (seen | ~alive).all()  # every live node reached
+
+    def test_messages_count_dynamic_edges(self):
+        g = topology.with_capacity(G.ring(200), extra_edges=8)
+        g = topology.connect(g, [0], [100])
+        frontier = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+        msgs = int(segment.frontier_messages(g, frontier))
+        assert msgs == 3  # two ring edges + the new link
+
+
+def test_reconnect_after_disconnect_does_not_clobber():
+    # Regression: slot allocation by used-count overwrote live edges that
+    # sat past holes left by disconnect().
+    g = topology.with_capacity(G.ring(200), extra_edges=8)
+    g = topology.connect(g, [0], [100])
+    g = topology.connect(g, [5], [150])
+    g = topology.disconnect(g, [0], [100])
+    g = topology.connect(g, [7], [170])
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[5].set(True)
+    out = np.asarray(segment.propagate_or(g, sig, "segment"))
+    assert out[150]  # 5<->150 must survive the reconnect
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[7].set(True)
+    assert np.asarray(segment.propagate_or(g, sig, "segment"))[170]
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    assert not np.asarray(segment.propagate_or(g, sig, "segment"))[100]
+
+
+def test_node_failure_kills_dynamic_edges():
+    # Regression: a crashed peer kept transmitting over its dynamic links.
+    from p2pnetwork_tpu.sim import failures
+
+    g = topology.with_capacity(G.ring(200), extra_edges=8)
+    g = topology.connect(g, [0], [100])
+    gf = failures.fail_nodes(g, [0])
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    out = np.asarray(segment.propagate_or(gf, sig, "segment"))
+    assert not out.any()  # dead sender: neither ring nor dynamic edges fire
+    assert int(np.asarray(gf.in_degree)[100]) == 2  # dyn edge degree gone
+    assert int(np.asarray(gf.out_degree)[100]) == 2
+
+
+def test_grow_capacity_preserves_links():
+    # Regression: re-running with_capacity zeroed the dynamic region.
+    g = topology.with_capacity(G.ring(200), extra_edges=4)
+    g = topology.connect(g, [0], [100])
+    g = topology.with_capacity(g, extra_edges=256)
+    assert g.dyn_mask.shape[0] >= 256
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    assert np.asarray(segment.propagate_or(g, sig, "segment"))[100]
+
+
+def test_connect_out_of_range_raises():
+    g = topology.with_capacity(G.ring(200), extra_edges=8)
+    with pytest.raises(ValueError, match="node id out of range"):
+        topology.connect(g, [0], [5000])
+    with pytest.raises(ValueError, match="node id out of range"):
+        topology.join_node(g, 5000, [0])
+
+
+def test_with_capacity_extra_nodes():
+    g = G.ring(128)  # n_pad == 128, no spare rows
+    assert g.n_nodes_padded == 128
+    g2 = topology.with_capacity(g, extra_nodes=5, extra_edges=8)
+    assert g2.n_nodes_padded == 256
+    assert int(np.asarray(g2.node_mask).sum()) == 128
+    g3 = topology.join_node(g2, 128, [0])
+    assert int(np.asarray(g3.node_mask).sum()) == 129
+    sig = jnp.zeros(g3.n_nodes_padded, dtype=bool).at[128].set(True)
+    out = np.asarray(segment.propagate_or(g3, sig, "segment"))
+    assert out[0]
