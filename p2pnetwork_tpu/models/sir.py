@@ -54,8 +54,10 @@ class SIR:
         susceptible = (state.status == SUSCEPTIBLE) & graph.node_mask
 
         # k = number of infected in-neighbors; P(infected) = 1 - (1-beta)^k.
+        # 0/1 indicator sums are exact in single-pass MXU mode (the bf16
+        # input rounding is lossless on 0/1; accumulation is f32).
         pressure = segment.propagate_sum(
-            graph, infected.astype(jnp.float32), self.method
+            graph, infected.astype(jnp.float32), self.method, exact=False
         )
         p_infect = 1.0 - jnp.power(1.0 - self.beta, pressure)
         u = jax.random.uniform(k_inf, pressure.shape)

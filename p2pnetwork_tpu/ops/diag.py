@@ -176,13 +176,16 @@ def propagate_or_hybrid(
 
 
 def propagate_sum_hybrid(
-    hybrid: HybridEdges, signal: jax.Array, node_mask: jax.Array
+    hybrid: HybridEdges, signal: jax.Array, node_mask: jax.Array,
+    exact: bool = True,
 ) -> jax.Array:
-    """Per-node sum over incoming edges: diagonals by shift, rest by kernel."""
+    """Per-node sum over incoming edges: diagonals by shift, rest by kernel.
+    ``exact=False``: single-pass MXU for the remainder (see ops/segment.py)."""
     from p2pnetwork_tpu.ops import pallas_edge as PK
 
     n_pad = node_mask.shape[0]
     out = jnp.pad(_diag_sum(hybrid, signal[: hybrid.n]), (0, n_pad - hybrid.n))
     if hybrid.remainder is not None:
-        out = out + PK.propagate_sum_pallas(hybrid.remainder, signal, node_mask)
+        out = out + PK.propagate_sum_pallas(hybrid.remainder, signal, node_mask,
+                                            exact=exact)
     return out * node_mask.astype(out.dtype)

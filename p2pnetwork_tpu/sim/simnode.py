@@ -156,6 +156,57 @@ class JaxSimNode(Node):
         self.node_message(self.sim_peer, {"sim_run": True, **summary})
         return summary
 
+    # ------------------------------------------------------------- topology
+
+    def _sim_topology_event(self, change: str) -> None:
+        """Population topology changes surface through ``node_message``
+        (like round stats) — SimPeer is not in the socket registries, so
+        the inbound/outbound disconnect dispatcher correctly ignores it."""
+        alive = int(np.asarray(self.sim_graph.node_mask.sum()))
+        self.node_message(
+            self.sim_peer, {"sim_topology": change, "alive_nodes": alive}
+        )
+
+    def fail_sim_nodes(self, node_ids) -> None:
+        """Fail-stop simulated peers (sim/failures.py) — the population
+        analog of peers dropping [ref: node.py:307-319]."""
+        self._require_sim()
+        from p2pnetwork_tpu.sim import failures
+
+        self.sim_graph = failures.fail_nodes(self.sim_graph, node_ids)
+        self._sim_topology_event("fail_nodes")
+
+    def inject_sim_churn(self, frac: float, seed: Optional[int] = None) -> None:
+        """Randomly fail ``frac`` of the live simulated population.
+
+        Each call draws fresh randomness by default (an internal counter
+        folds into the node's sim key) — a fixed seed would re-select the
+        same, already-dead nodes on every call after the first. Pass
+        ``seed`` only to reproduce one specific churn event.
+        """
+        self._require_sim()
+        from p2pnetwork_tpu.sim import failures
+
+        if seed is not None:
+            key = jax.random.key(seed)
+        else:
+            self._churn_count += 1
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._sim_key, 0x0C0C), self._churn_count
+            )
+        self.sim_graph = failures.random_node_failures(self.sim_graph, key, frac)
+        self._sim_topology_event("churn")
+
+    def connect_sim_nodes(self, senders, receivers) -> None:
+        """Add links between simulated peers at runtime (sim/topology.py;
+        the population analog of ``connect_with_node`` [ref: node.py:122]).
+        The graph needs dynamic capacity (``topology.with_capacity``)."""
+        self._require_sim()
+        from p2pnetwork_tpu.sim import topology
+
+        self.sim_graph = topology.connect(self.sim_graph, senders, receivers)
+        self._sim_topology_event("connect")
+
     # ----------------------------------------------------------- checkpoint
 
     def save_checkpoint(self, path: str) -> None:

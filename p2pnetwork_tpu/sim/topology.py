@@ -94,14 +94,37 @@ def _require_dynamic(graph: Graph) -> None:
         )
 
 
+def _edge_exists(graph: Graph, s: jax.Array, r: jax.Array) -> jax.Array:
+    """bool[B]: is each directed (s, r) pair already a live edge (static or
+    dynamic)? Device-side brute compare — B is a connect batch (small); a
+    bulk topology change should rebuild via from_edges instead."""
+    static = jnp.any(
+        (graph.senders[None, :] == s[:, None])
+        & (graph.receivers[None, :] == r[:, None])
+        & graph.edge_mask[None, :],
+        axis=1,
+    )
+    dyn = jnp.any(
+        (graph.dyn_senders[None, :] == s[:, None])
+        & (graph.dyn_receivers[None, :] == r[:, None])
+        & graph.dyn_mask[None, :],
+        axis=1,
+    )
+    return static | dyn
+
+
 def connect(graph: Graph, senders, receivers, *,
             undirected: bool = True) -> Graph:
     """Add links at runtime (device-side; no recompile).
 
     Fills the next free dynamic slots. ``undirected=True`` (the
     reference's TCP-connection semantic: traffic flows both ways
-    [ref: nodeconnection.py]) stores both directions. Raises at trace time
-    never — slot exhaustion is a host-side check when inputs are concrete.
+    [ref: nodeconnection.py]) stores both directions. Connecting an
+    already-connected pair is a no-op, like the reference's duplicate
+    ``connect_with_node`` [ref: node.py:136-139] — a silent parallel edge
+    would double-count infection pressure and inflate degrees. Slot
+    exhaustion is a host-side check when inputs are concrete; under jit
+    the caller guarantees capacity.
     """
     _require_dynamic(graph)
     from p2pnetwork_tpu.sim.failures import _check_ids_in_range
@@ -112,24 +135,35 @@ def connect(graph: Graph, senders, receivers, *,
     r = jnp.asarray(receivers, jnp.int32).reshape(-1)
     if undirected:
         s, r = jnp.concatenate([s, r]), jnp.concatenate([r, s])
+    # Drop pairs that already exist, and duplicates within the batch
+    # (keep each pair's first occurrence).
+    pair = s.astype(jnp.int64) * graph.n_nodes_padded + r
+    dup_prior = (pair[:, None] == pair[None, :]) & jnp.tril(
+        jnp.ones((s.size, s.size), bool), k=-1
+    )
+    valid = ~_edge_exists(graph, s, r) & ~dup_prior.any(axis=1)
     free = ~graph.dyn_mask
     try:
-        if int(jnp.sum(free)) < s.size:
+        if int(jnp.sum(valid)) > int(jnp.sum(free)):
             raise ValueError(
                 f"dynamic edge region full "
                 f"({graph.dyn_senders.shape[0]} slots); consolidate with "
                 f"from_edges or reserve more via with_capacity"
             )
-    except jax.errors.TracerArrayConversionError:
+    except jax.errors.ConcretizationTypeError:
         pass  # traced: caller guarantees capacity
     # First-free-slot allocation: disconnect() leaves holes, and writing at
-    # used-count would overwrite live edges past them.
+    # used-count would overwrite live edges past them. Invalid (duplicate)
+    # entries write mask=False, so their slot stays free.
     slots = jnp.nonzero(free, size=s.size, fill_value=0)[0]
-    dyn_s = graph.dyn_senders.at[slots].set(s)
-    dyn_r = graph.dyn_receivers.at[slots].set(r)
-    dyn_m = graph.dyn_mask.at[slots].set(True)
-    in_degree = graph.in_degree.at[r].add(1)
-    out_degree = graph.out_degree.at[s].add(1)
+    dyn_s = graph.dyn_senders.at[slots].set(
+        jnp.where(valid, s, graph.dyn_senders[slots]))
+    dyn_r = graph.dyn_receivers.at[slots].set(
+        jnp.where(valid, r, graph.dyn_receivers[slots]))
+    dyn_m = graph.dyn_mask.at[slots].max(valid)
+    add = valid.astype(jnp.int32)
+    in_degree = graph.in_degree.at[r].add(add)
+    out_degree = graph.out_degree.at[s].add(add)
     return dataclasses.replace(
         graph,
         dyn_senders=dyn_s,

@@ -94,6 +94,39 @@ class TestJaxSimNode:
             np.asarray(a.sim_state.seen), np.asarray(b.sim_state.seen)
         )
 
+    def test_fail_and_connect_sim_nodes(self):
+        from p2pnetwork_tpu.sim import topology
+
+        rec = EventRecorder()
+        g = topology.with_capacity(G.ring(200), extra_edges=16)
+        node = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0,
+                         callback=rec)
+        node.fail_sim_nodes([25, 75])  # partition the ring
+        node.run_rounds(140)  # ring radius within the cut component is 124
+        seen = np.asarray(node.sim_state.seen)[:100]
+        assert not seen[26:75].any()
+        topo_events = [d for d in rec.data_for("node_message")
+                       if isinstance(d, dict) and "sim_topology" in d]
+        assert topo_events and topo_events[0]["sim_topology"] == "fail_nodes"
+        assert topo_events[0]["alive_nodes"] == 198
+        node.connect_sim_nodes([10], [50])  # bridge + re-announce
+        import dataclasses
+
+        node.sim_state = dataclasses.replace(
+            node.sim_state, frontier=node.sim_state.seen
+        )
+        node.run_rounds(140)
+        seen = np.asarray(node.sim_state.seen)
+        alive = np.asarray(node.sim_graph.node_mask)
+        assert (seen | ~alive)[:200].all()
+
+    def test_inject_sim_churn(self):
+        node = JaxSimNode(graph=G.watts_strogatz(1000, 4, 0.1, seed=0),
+                          protocol=Flood(source=0), seed=0)
+        node.inject_sim_churn(0.5, seed=1)
+        alive = int(np.asarray(node.sim_graph.node_mask).sum())
+        assert 380 < alive < 620
+
     def test_sim_peer_send_is_noop(self):
         g = G.ring(128)
         node = JaxSimNode(graph=g, protocol=Flood(source=0))
