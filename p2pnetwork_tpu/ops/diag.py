@@ -111,8 +111,14 @@ def build_hybrid_from_arrays(
     masks = np.zeros((0, n), dtype=bool)
     if off.size:
         counts = np.bincount(off)
-        order = np.argsort(counts)[::-1]
-        kept = [int(o) for o in order[:max_diags] if counts[o] >= min_count and o != 0]
+        # Filter (self-loops, below-threshold) BEFORE truncating to
+        # max_diags — a frequent self-loop offset ranking in the top
+        # max_diags must not displace a qualifying real diagonal into the
+        # per-edge remainder. Vectorized: `counts` has up to n entries.
+        ok = counts >= min_count
+        ok[0] = False
+        cand = np.flatnonzero(ok)
+        kept = [int(o) for o in cand[np.argsort(counts[cand])[::-1]][:max_diags]]
         if kept:
             offsets = tuple(kept)
             masks = np.zeros((len(kept), n), dtype=bool)

@@ -94,6 +94,7 @@ class JaxSimNode(Node):
         self.sim_message_count = 0
         self.sim_peer: Optional[SimPeer] = None
         self._sim_key: Optional[jax.Array] = None
+        self._churn_count = 0
         if graph is not None and protocol is not None:
             self.attach_simulation(graph, protocol, seed=seed)
 
@@ -107,6 +108,7 @@ class JaxSimNode(Node):
         self.sim_state = protocol.init(graph, self._sim_key)
         self.sim_round = 0
         self.sim_message_count = 0
+        self._churn_count = 0
         self.sim_peer = SimPeer(self, graph.n_nodes)
         self.debug_print(
             f"attach_simulation: {graph.n_nodes} nodes / {graph.n_edges} edges, "

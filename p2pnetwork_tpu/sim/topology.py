@@ -135,11 +135,14 @@ def connect(graph: Graph, senders, receivers, *,
     r = jnp.asarray(receivers, jnp.int32).reshape(-1)
     if undirected:
         s, r = jnp.concatenate([s, r]), jnp.concatenate([r, s])
-    # Drop pairs that already exist, and duplicates within the batch
-    # (keep each pair's first occurrence).
-    pair = s.astype(jnp.int64) * graph.n_nodes_padded + r
-    dup_prior = (pair[:, None] == pair[None, :]) & jnp.tril(
-        jnp.ones((s.size, s.size), bool), k=-1
+    # Drop pairs that already exist, and duplicates within the batch (keep
+    # each pair's first occurrence). Componentwise compare — an encoded
+    # s * n_pad + r key would silently wrap in int32 (x64 is off) for
+    # million-node graphs and alias distinct pairs.
+    dup_prior = (
+        (s[:, None] == s[None, :])
+        & (r[:, None] == r[None, :])
+        & jnp.tril(jnp.ones((s.size, s.size), bool), k=-1)
     )
     valid = ~_edge_exists(graph, s, r) & ~dup_prior.any(axis=1)
     free = ~graph.dyn_mask
@@ -153,15 +156,24 @@ def connect(graph: Graph, senders, receivers, *,
     except jax.errors.ConcretizationTypeError:
         pass  # traced: caller guarantees capacity
     # First-free-slot allocation: disconnect() leaves holes, and writing at
-    # used-count would overwrite live edges past them. Invalid (duplicate)
-    # entries write mask=False, so their slot stays free.
-    slots = jnp.nonzero(free, size=s.size, fill_value=0)[0]
-    dyn_s = graph.dyn_senders.at[slots].set(
-        jnp.where(valid, s, graph.dyn_senders[slots]))
-    dyn_r = graph.dyn_receivers.at[slots].set(
-        jnp.where(valid, r, graph.dyn_receivers[slots]))
-    dyn_m = graph.dyn_mask.at[slots].max(valid)
-    add = valid.astype(jnp.int32)
+    # used-count would overwrite live edges past them. Valid entries are
+    # compacted onto the free slots (pos = rank among valid entries), so a
+    # batch mixing duplicates with new links never consumes slots for the
+    # duplicates. Entries past the real free count — and invalid entries —
+    # get the out-of-bounds sentinel K, which scatter drops instead of
+    # silently destroying whatever edge lives in slot 0.
+    K = graph.dyn_mask.shape[0]
+    free_slots = jnp.nonzero(free, size=K, fill_value=K)[0]
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    applied = valid & (pos < jnp.sum(free.astype(jnp.int32)))
+    slots = jnp.where(applied, free_slots[jnp.clip(pos, 0, K - 1)], K)
+    dyn_s = graph.dyn_senders.at[slots].set(s, mode="drop")
+    dyn_r = graph.dyn_receivers.at[slots].set(r, mode="drop")
+    dyn_m = graph.dyn_mask.at[slots].set(True, mode="drop")
+    # Degrees track only the entries actually written, so even a traced
+    # overflow (where the host-side check above cannot run) leaves degrees
+    # and edges consistent — the overflow entries are dropped whole.
+    add = applied.astype(jnp.int32)
     in_degree = graph.in_degree.at[r].add(add)
     out_degree = graph.out_degree.at[s].add(add)
     return dataclasses.replace(

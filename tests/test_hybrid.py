@@ -130,3 +130,20 @@ def test_max_diags_cap_spills_to_remainder():
     out_capped = segment.propagate_or(capped, sig, "hybrid")
     out_full = segment.propagate_or(full, sig, "hybrid")
     assert (np.asarray(out_capped) == np.asarray(out_full)).all()
+
+
+def test_self_loops_do_not_displace_diagonals():
+    # Regression (ADVICE r1, low): offset-0 (self-loop) filtering happened
+    # AFTER the max_diags truncation, so frequent self-loops could evict a
+    # qualifying real diagonal into the per-edge remainder.
+    from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
+
+    n = 256
+    base = np.arange(n, dtype=np.int32)
+    # offset 0 on every node (count n), offset 1 (count n), offset 2 (n-1).
+    s = np.concatenate([base, (base + 1) % n, ((base + 2) % n)[:-1]])
+    r = np.concatenate([base, base, base[:-1]])
+    order = np.argsort(r, kind="stable")
+    h = build_hybrid_from_arrays(s[order], r[order], n, n,
+                                 max_diags=2, min_count=16)
+    assert sorted(h.offsets) == [1, 2]

@@ -127,6 +127,20 @@ class TestJaxSimNode:
         alive = int(np.asarray(node.sim_graph.node_mask).sum())
         assert 380 < alive < 620
 
+    def test_inject_sim_churn_default_seed(self):
+        # Regression: the documented default path (no seed) crashed with
+        # AttributeError because _churn_count was never initialized.
+        node = JaxSimNode(graph=G.watts_strogatz(1000, 4, 0.1, seed=0),
+                          protocol=Flood(source=0), seed=0)
+        node.inject_sim_churn(0.3)
+        alive1 = int(np.asarray(node.sim_graph.node_mask).sum())
+        assert 600 < alive1 < 800
+        # A second call draws FRESH randomness: more nodes die (a repeated
+        # key would re-select the same, already-dead set).
+        node.inject_sim_churn(0.3)
+        alive2 = int(np.asarray(node.sim_graph.node_mask).sum())
+        assert alive2 < alive1
+
     def test_sim_peer_send_is_noop(self):
         g = G.ring(128)
         node = JaxSimNode(graph=g, protocol=Flood(source=0))

@@ -170,3 +170,30 @@ def test_with_capacity_extra_nodes():
     sig = jnp.zeros(g3.n_nodes_padded, dtype=bool).at[128].set(True)
     out = np.asarray(segment.propagate_or(g3, sig, "segment"))
     assert out[0]
+
+def test_connect_duplicates_at_near_capacity_do_not_corrupt():
+    # Regression (ADVICE r1, high): with free slots scarce, a batch mixing
+    # already-existing pairs with new ones padded the free-slot list with
+    # index 0 and scattered a new edge over whatever lived in slot 0.
+    g = topology.with_capacity(G.ring(200), extra_edges=4)  # 128 slots
+    g = topology.connect(g, [0], [7])  # slots 0,1: the victim edge
+    s = np.arange(1, 63, dtype=np.int32)  # 62 pairs -> 124 slots: 2 free
+    g = topology.connect(g, s, s + 80)
+    assert int(np.asarray(g.dyn_mask).sum()) == 126
+    # Batch: one duplicate pair (0<->7) + one new pair (190<->20).
+    g = topology.connect(g, [0, 190], [7, 20])
+    # The duplicate must be a no-op; the new pair must land; edge 0->7
+    # must survive.
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    assert np.asarray(segment.propagate_or(g, sig, "segment"))[7]
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[190].set(True)
+    assert np.asarray(segment.propagate_or(g, sig, "segment"))[20]
+    sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[20].set(True)
+    assert np.asarray(segment.propagate_or(g, sig, "segment"))[190]
+    assert int(np.asarray(g.dyn_mask).sum()) == 128
+    # Degrees stay in sync with the edges (the bug left in_degree counting
+    # a destroyed edge).
+    # 2 ring + 0<->7 + 87<->7 (from the bulk batch) = 4; the bug left a
+    # fifth phantom count for the destroyed slot-0 edge.
+    assert int(np.asarray(g.in_degree)[7]) == 4
+    assert int(np.asarray(g.out_degree)[7]) == 4
