@@ -1,0 +1,57 @@
+"""Dict-payload demo (sockets backend).
+
+The capability shown in the reference's
+examples/my_own_p2p_application_using_dict.py:29 — structured (JSON)
+payloads broadcast around a three-node ring and delivered as dicts, the
+type round-trip handled by the wire layer [ref: p2pnetwork/
+nodeconnection.py:128-143, :173-184].
+Run: ``python examples/dict_application.py``
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu import Node
+
+
+def on_event(event, main_node, connected_node, data):
+    if event == "node_message":
+        assert isinstance(data, dict), f"expected dict, got {type(data)}"
+        print(f"  [{main_node.id}] dict from {connected_node.id}: {data}")
+
+
+def main():
+    node1 = Node("127.0.0.1", 0, id="node-1", callback=on_event)
+    node2 = Node("127.0.0.1", 0, id="node-2", callback=on_event)
+    node3 = Node("127.0.0.1", 0, id="node-3", callback=on_event)
+    nodes = [node1, node2, node3]
+    for n in nodes:
+        n.start()
+
+    # Ring topology, as in the reference script.
+    node1.connect_with_node("127.0.0.1", node2.port)
+    node2.connect_with_node("127.0.0.1", node3.port)
+    node3.connect_with_node("127.0.0.1", node1.port)
+    time.sleep(0.2)
+
+    print("dict broadcast from node-1:")
+    node1.send_to_nodes({"name": "demo", "number": 11})
+    time.sleep(0.3)
+
+    print("nested dict unicast node-2 -> node-3:")
+    peer = node2.nodes_outbound[0]
+    node2.send_to_node(peer, {"kind": "block", "header": {"height": 7, "txs": [1, 2, 3]}})
+    time.sleep(0.3)
+
+    for n in nodes:
+        print(f"  [{n.id}] sent={n.message_count_send} recv={n.message_count_recv}")
+    for n in nodes:
+        n.stop()
+    for n in nodes:
+        n.join()
+
+
+if __name__ == "__main__":
+    main()
