@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.utils import accum
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "rounds"))
@@ -45,7 +46,6 @@ def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int):
     return state, stats
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
 def run_until_coverage(
     graph: Graph,
     protocol,
@@ -59,7 +59,8 @@ def run_until_coverage(
     Device-side early exit via ``lax.while_loop`` — the whole
     run-to-99%-coverage measurement executes as one XLA program with zero
     host synchronization per round. Returns (final_state, dict with
-    ``rounds``, ``coverage``, ``messages`` totals).
+    ``rounds``, ``coverage``, ``messages`` totals; ``messages`` is an exact
+    Python int — see :func:`run_until_coverage_from`).
 
     Requires the protocol's stats to include ``coverage`` and ``messages``
     (e.g. models.flood.Flood).
@@ -70,7 +71,6 @@ def run_until_coverage(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
 def run_until_coverage_from(
     graph: Graph,
     protocol,
@@ -85,23 +85,48 @@ def run_until_coverage_from(
     If the protocol exposes ``coverage(graph, state)`` (Flood, SIR do), the
     loop starts from the true coverage of ``state0`` — resuming an
     already-finished run executes zero rounds instead of one spurious one.
-    """
 
+    ``messages`` in the returned dict is an exact Python int: the loop
+    accumulates device-side in a two-limb (hi, lo) counter (utils/accum.py)
+    so totals past 2^31 — routine at 10M-node scale — do not wrap int32.
+    """
+    state, rounds, coverage, hi, lo = _coverage_loop(
+        graph, protocol, state0, key,
+        coverage_target=coverage_target, max_rounds=max_rounds,
+    )
+    return state, {
+        "rounds": rounds,
+        "coverage": coverage,
+        "messages": accum.value((hi, lo)),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
+def _coverage_loop(
+    graph: Graph,
+    protocol,
+    state0,
+    key: jax.Array,
+    *,
+    coverage_target: float,
+    max_rounds: int,
+):
     def cond(carry):
-        _, _, rounds, coverage, _ = carry
+        _, _, rounds, coverage, _, _ = carry
         return (coverage < coverage_target) & (rounds < max_rounds)
 
     def body(carry):
-        state, k, rounds, _, messages = carry
+        state, k, rounds, _, hi, lo = carry
         k, sub = jax.random.split(k)
         state, stats = protocol.step(graph, state, sub)
-        return (state, k, rounds + 1, stats["coverage"], messages + stats["messages"])
+        hi, lo = accum.add((hi, lo), stats["messages"])
+        return (state, k, rounds + 1, stats["coverage"], hi, lo)
 
     cov0 = (
         jnp.float32(protocol.coverage(graph, state0))
         if hasattr(protocol, "coverage")
         else jnp.float32(0.0)
     )
-    init = (state0, key, jnp.int32(0), cov0, jnp.int32(0))
-    state, _, rounds, coverage, messages = jax.lax.while_loop(cond, body, init)
-    return state, {"rounds": rounds, "coverage": coverage, "messages": messages}
+    init = (state0, key, jnp.int32(0), cov0, *accum.zero())
+    state, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
+    return state, rounds, coverage, hi, lo

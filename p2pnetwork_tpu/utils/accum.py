@@ -1,0 +1,49 @@
+"""Wide message accounting without enabling global x64.
+
+The reference's counters are unbounded Python ints [ref: p2pnetwork/
+node.py:64-67]; the sim engine's device-side counters are not. With JAX's
+default 32-bit mode a 10M-node / 100M-edge run reaches ~1e8 messages per
+round, so a few dozen full-frontier rounds silently wrap an int32
+accumulator. Enabling ``jax_enable_x64`` globally is the wrong fix — it
+flips every default dtype (``jax.random.uniform`` becomes f64, breaking RNG
+bit-parity contracts and TPU-unfriendly f64 math everywhere).
+
+Instead: a two-limb accumulator. ``lo`` is uint32 (addition wraps mod 2^32
+by definition, and a wrap is detected as ``lo + x < lo``); ``hi`` counts
+2^32 carries in int32. Range: 2^63 messages — per-round counts stay int32,
+which is structurally safe because a round's message count is bounded by
+the directed edge count, and edge indices are int32 already.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Acc = Tuple[jax.Array, jax.Array]  # (hi: i32, lo: u32)
+
+
+def zero() -> Acc:
+    """A fresh accumulator (loop-carry friendly: two scalars)."""
+    return (jnp.int32(0), jnp.uint32(0))
+
+
+def add(acc: Acc, x: jax.Array) -> Acc:
+    """Add a non-negative int32/uint32 scalar; jittable.
+
+    Unsigned overflow is well-defined wraparound, and since ``x < 2^32``
+    each add carries at most one: carry happened iff the wrapped sum is
+    smaller than either operand.
+    """
+    hi, lo = acc
+    lo2 = lo + x.astype(jnp.uint32)
+    return (hi + (lo2 < lo).astype(jnp.int32), lo2)
+
+
+def value(acc: Acc) -> int:
+    """Combine to an exact Python int (host-side; forces a transfer)."""
+    hi, lo = acc
+    return (int(np.asarray(hi)) << 32) + int(np.uint32(np.asarray(lo)))

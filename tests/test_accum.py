@@ -1,0 +1,80 @@
+"""Message accounting past int32 (utils/accum.py).
+
+The reference's counters are unbounded Python ints [ref: p2pnetwork/
+node.py:64-67]; the engine's device-side run-to-coverage accumulator must
+not wrap where a 10M-node run's totals routinely exceed 2^31.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim import graph as G
+from p2pnetwork_tpu.utils import accum
+
+
+class TestAccum:
+    def test_exact_past_int32(self):
+        acc = accum.zero()
+        big = jnp.int32(2**31 - 1)
+        for _ in range(4):
+            acc = accum.add(acc, big)
+        assert accum.value(acc) == 4 * (2**31 - 1)  # 8589934588 > 2^31
+
+    def test_matches_python_sum_random(self):
+        rng = np.random.default_rng(0)
+        xs = rng.integers(0, 2**31, size=64, dtype=np.int64)
+        acc = accum.zero()
+        for x in xs:
+            acc = accum.add(acc, jnp.int32(x))
+        assert accum.value(acc) == int(xs.sum())
+
+    def test_jittable_in_scan(self):
+        def body(acc, x):
+            return accum.add(acc, x), None
+
+        xs = jnp.full((100,), 2**31 - 1, dtype=jnp.int32)
+        acc, _ = jax.jit(lambda: jax.lax.scan(body, accum.zero(), xs))()
+        assert accum.value(acc) == 100 * (2**31 - 1)
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class _BigCounter:
+    """Synthetic protocol: every round claims 2^31 - 1 messages, so five
+    rounds overflow an int32 accumulator by 5x."""
+
+    per_round: int = 2**31 - 1
+
+    def init(self, graph, key):
+        return jnp.float32(0.0)
+
+    def coverage(self, graph, state):
+        return state
+
+    def step(self, graph, state, key):
+        state = state + jnp.float32(0.2)
+        return state, {"coverage": state, "messages": jnp.int32(self.per_round)}
+
+
+class TestEngineWideMessages:
+    def test_run_until_coverage_totals_past_int32(self):
+        g = G.ring(4)
+        _, out = engine.run_until_coverage(
+            g, _BigCounter(), jax.random.key(0), coverage_target=0.99
+        )
+        rounds = int(np.asarray(out["rounds"]))
+        assert rounds == 5
+        assert isinstance(out["messages"], int)
+        assert out["messages"] == rounds * (2**31 - 1)  # > 2^33
+
+    def test_flood_totals_still_match_per_round_sum(self):
+        g = G.watts_strogatz(512, 6, 0.1, seed=0)
+        from p2pnetwork_tpu.models.flood import Flood
+
+        _, out = engine.run_until_coverage(g, Flood(source=0), jax.random.key(0))
+        rounds = int(np.asarray(out["rounds"]))
+        _, stats = engine.run(g, Flood(source=0), jax.random.key(0), rounds)
+        assert out["messages"] == int(np.asarray(stats["messages"]).sum())
