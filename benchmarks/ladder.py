@@ -192,6 +192,41 @@ def bench_flood_big(n, label):
     })
 
 
+def bench_churn_connect():
+    """Runtime connect cost vs graph size: the membership probe is a
+    searchsorted window scan (sim/topology.py), so a connect batch should
+    cost about the same at 100K and at 1M nodes — not 10x more."""
+    import jax
+
+    from p2pnetwork_tpu.sim import graph as G
+    from p2pnetwork_tpu.sim import topology
+
+    batch = 64
+    rng_s = [(i * 37) % 99_000 for i in range(batch)]
+    rng_r = [(i * 91 + 13) % 99_000 for i in range(batch)]
+    times = {}
+    for n in (100_000, 1_000_000):
+        g = G.watts_strogatz(n, 10, 0.1, seed=0, build_neighbor_table=False)
+        g = topology.with_capacity(g, extra_edges=4 * batch)
+        s = jax.numpy.asarray(rng_s, jax.numpy.int32)
+        r = jax.numpy.asarray(rng_r, jax.numpy.int32)
+        g2 = topology.connect(g, s, r, check_capacity=False)
+        jax.block_until_ready(g2.dyn_mask)  # warm (compile)
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            g2 = topology.connect(g, s, r, check_capacity=False)
+            jax.block_until_ready(g2.dyn_mask)
+        times[n] = (time.perf_counter() - t0) / reps
+    emit({
+        "config": f"runtime connect, {batch}-link batch (no capacity sync)",
+        "value": round(times[1_000_000] * 1e3, 3),
+        "unit": "ms/batch at 1M nodes (10M edges)",
+        "ms_at_100k": round(times[100_000] * 1e3, 3),
+        "scaling_1m_over_100k": round(times[1_000_000] / times[100_000], 2),
+    })
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
@@ -202,6 +237,7 @@ def main():
     bench_flood_1k()
     bench_gossip_100k()
     bench_sir_1m()
+    bench_churn_connect()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
     if args.full:
         bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)")

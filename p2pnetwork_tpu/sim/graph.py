@@ -91,6 +91,13 @@ class Graph:
     neighbors_complete: bool = dataclasses.field(
         default=True, metadata=dict(static=True)
     )
+    # Widest contiguous run of one receiver id among the LIVE (unpadded)
+    # COO entries — i.e. the max static in-degree at build. The padding
+    # tail (receiver n_pad-1) can extend that id's physical run far wider;
+    # consumers must mask with edge_mask, as the membership probe does.
+    # Static so runtime probes (sim/topology.py connect) can scan a
+    # [B, max_in_span] window instead of comparing against all E edges.
+    max_in_span: int = dataclasses.field(default=0, metadata=dict(static=True))
     # Optional blocked-edge representation (ops/blocked.py) feeding the
     # matmul/Pallas aggregation paths; attach via with_blocked().
     blocked: Optional[object] = None
@@ -187,6 +194,10 @@ def from_edges(
 
     in_deg = np.bincount(receivers, minlength=n_pad).astype(np.int32)
     out_deg = np.bincount(senders, minlength=n_pad).astype(np.int32)
+    # The padding tail (receiver n_pad-1, edge_mask False) extends that id's
+    # run but can never match a probe — edge_mask excludes it — so the
+    # window only needs to span the widest LIVE run.
+    max_in_span = max(int(in_deg.max()) if e else 0, 1)
 
     neighbors = neighbor_mask = None
     neighbors_complete = True
@@ -251,6 +262,7 @@ def from_edges(
         n_nodes=n_nodes,
         n_edges=e,
         neighbors_complete=neighbors_complete,
+        max_in_span=max_in_span,
         blocked=blocked_rep,
         hybrid=hybrid_rep,
     )

@@ -171,6 +171,59 @@ def test_with_capacity_extra_nodes():
     out = np.asarray(segment.propagate_or(g3, sig, "segment"))
     assert out[0]
 
+def test_edge_exists_probe_matches_brute():
+    # The searchsorted window probe must agree with the O(B*E) broadcast
+    # compare it replaced, on a degree-skewed graph (BA), for a batch mixing
+    # existing static edges, existing dynamic edges, dead-edge pairs, and
+    # absent pairs — including the padded last node id, whose receiver run
+    # includes the COO padding tail.
+    import dataclasses
+
+    from p2pnetwork_tpu.sim import failures
+
+    g = topology.with_capacity(G.barabasi_albert(300, 3, seed=1), extra_edges=8)
+    g = topology.connect(g, [7], [250])
+    g = failures.fail_nodes(g, [17])
+    emask = np.asarray(g.edge_mask)
+    s_static = np.asarray(g.senders)[emask][:10]
+    r_static = np.asarray(g.receivers)[emask][:10]
+    dead = ~np.asarray(g.edge_mask) & (np.asarray(g.senders) == 17)
+    qs = np.concatenate([
+        s_static, [7, 250], np.asarray(g.senders)[dead][:2],
+        [0, 5, g.n_nodes_padded - 1],
+    ]).astype(np.int32)
+    qr = np.concatenate([
+        r_static, [250, 7], np.asarray(g.receivers)[dead][:2],
+        [299, 299, g.n_nodes_padded - 1],
+    ]).astype(np.int32)
+    fast = np.asarray(topology._edge_exists(g, jnp.asarray(qs), jnp.asarray(qr)))
+    brute = np.asarray(
+        topology._edge_exists(
+            dataclasses.replace(g, max_in_span=0), jnp.asarray(qs), jnp.asarray(qr)
+        )
+    )
+    np.testing.assert_array_equal(fast, brute)
+    assert fast[:12].all() and not fast[12:].any()
+
+
+def test_connect_batch_no_capacity_check_jittable():
+    # The sustained-churn path: check_capacity=False must trace cleanly
+    # (no host sync) and produce the same graph as the checked path.
+    g0 = topology.with_capacity(G.ring(200), extra_edges=16)
+
+    @jax.jit
+    def step(g, s, r):
+        return topology.connect(g, s, r, check_capacity=False)
+
+    s = jnp.asarray([0, 3], jnp.int32)
+    r = jnp.asarray([100, 103], jnp.int32)
+    g_jit = step(g0, s, r)
+    g_ref = topology.connect(g0, s, r)
+    np.testing.assert_array_equal(np.asarray(g_jit.dyn_mask), np.asarray(g_ref.dyn_mask))
+    np.testing.assert_array_equal(np.asarray(g_jit.dyn_senders), np.asarray(g_ref.dyn_senders))
+    np.testing.assert_array_equal(np.asarray(g_jit.in_degree), np.asarray(g_ref.in_degree))
+
+
 def test_connect_duplicates_at_near_capacity_do_not_corrupt():
     # Regression (ADVICE r1, high): with free slots scarce, a batch mixing
     # already-existing pairs with new ones padded the free-slot list with
