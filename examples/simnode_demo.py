@@ -22,17 +22,34 @@ def observer(event, main_node, connected_node, data):
 
 
 def main():
-    g = G.watts_strogatz(10_000, 8, 0.05, seed=0)
-    node = JaxSimNode(
-        "127.0.0.1", 0,
-        graph=g, protocol=SIR(beta=0.3, gamma=0.15, source=0),
-        callback=observer,
+    import numpy as np
+
+    from p2pnetwork_tpu.sim import topology
+
+    g = topology.with_capacity(
+        G.watts_strogatz(10_000, 8, 0.05, seed=0), extra_edges=32
     )
+    proto = SIR(beta=0.3, gamma=0.15, source=0)
+    node = JaxSimNode("127.0.0.1", 0, graph=g, protocol=proto, callback=observer)
     print(f"simulating SIR on {g.n_nodes} nodes / {g.n_edges} edges")
     node.run_rounds(15)
     print(f"total simulated messages: {node.sim_message_count}")
+
+    # Topology churn is state: fail 5% of peers, add a few runtime links...
+    node.inject_sim_churn(0.05)
+    node.connect_sim_nodes([1, 2, 3], [5001, 5002, 5003])
+    alive = int(np.asarray(node.sim_graph.node_mask).sum())
     node.save_checkpoint("/tmp/sir_demo.npz")
-    print("checkpoint saved to /tmp/sir_demo.npz (resume with load_checkpoint)")
+    print(f"checkpoint saved with {alive} live nodes + runtime links")
+
+    # ...and a restored node resumes on the damaged/grown network, not the
+    # pristine build — no manual damage re-application.
+    resumed = JaxSimNode(graph=g, protocol=proto, callback=observer)
+    resumed.load_checkpoint("/tmp/sir_demo.npz")
+    r_alive = int(np.asarray(resumed.sim_graph.node_mask).sum())
+    print(f"restored node sees {r_alive} live nodes "
+          f"(topology restored: {r_alive == alive})")
+    resumed.run_rounds(5)
 
 
 if __name__ == "__main__":

@@ -5,7 +5,10 @@ run [ref: p2pnetwork/node.py:85-90] (SURVEY.md section 5 "Checkpoint").
 For multi-million-node simulations, resumability is table stakes: a
 checkpoint is the protocol state pytree plus the PRNG key and round counter
 — everything needed to make a resumed run bit-identical to an uninterrupted
-one (tests/test_checkpoint.py proves that).
+one (tests/test_checkpoint.py proves that). Topology mutations (failures,
+runtime links) are state too — the reference's peer lists live on the node
+object [ref: p2pnetwork/node.py:46-52] — captured/re-applied via
+:func:`topology_state` / :func:`apply_topology_state`.
 
 Format: a single ``.npz`` (atomic rename on save). The state's tree
 structure is recorded so loads verify against the template; arrays come
@@ -20,12 +23,179 @@ not round-trip through host memory).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import tempfile
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+
+def topology_state(graph) -> Dict[str, Any]:
+    """The graph's runtime-mutable leaves, as a checkpointable pytree.
+
+    The reference's topology IS its state — the peer lists live on the node
+    object [ref: p2pnetwork/node.py:46-52] — so a faithful checkpoint must
+    capture what failures (sim/failures.py) and dynamic links
+    (sim/topology.py) did to the graph: liveness masks, degrees, the
+    dynamic edge region, and the derived masks of every attached
+    aggregation representation. The static arrays (edge lists, neighbor
+    ids, kernel layouts) are NOT stored — they are reconstructed by
+    attaching the same pristine graph and re-applying this state via
+    :func:`apply_topology_state`.
+    """
+    ts: Dict[str, Any] = {
+        "node_mask": graph.node_mask,
+        "edge_mask": graph.edge_mask,
+        "in_degree": graph.in_degree,
+        "out_degree": graph.out_degree,
+    }
+    if graph.neighbor_mask is not None:
+        ts["neighbor_mask"] = graph.neighbor_mask
+    if graph.dyn_senders is not None:
+        ts["dyn_senders"] = graph.dyn_senders
+        ts["dyn_receivers"] = graph.dyn_receivers
+        ts["dyn_mask"] = graph.dyn_mask
+    if graph.blocked is not None:
+        ts["blocked_mask"] = graph.blocked.mask
+    if graph.hybrid is not None:
+        ts["hybrid_masks"] = graph.hybrid.masks
+        if graph.hybrid.remainder is not None:
+            ts["hybrid_remainder_mask"] = graph.hybrid.remainder.mask
+    return ts
+
+
+def apply_topology_state(graph, ts: Dict[str, Any]):
+    """Re-apply a :func:`topology_state` onto a structurally-equal graph.
+
+    ``graph`` must carry the same representations (dynamic capacity,
+    neighbor table, blocked/hybrid layouts) and shapes as the graph the
+    state was saved from — typically the same pristine construction the
+    original run attached. Returns a new Graph whose mutation state
+    (failed nodes, cut edges, runtime links, degrees) is exactly the
+    saved one.
+    """
+    def _shape(name, current):
+        saved_shape = tuple(np.shape(ts[name]))
+        if current is None or saved_shape != tuple(current.shape):
+            raise ValueError(
+                f"topology state mismatch for {name!r}: saved shape "
+                f"{saved_shape}, graph has "
+                f"{None if current is None else tuple(current.shape)} — "
+                f"attach the same graph construction the checkpoint came from"
+            )
+
+    ts = {k: jax.numpy.asarray(v) for k, v in ts.items()}  # npz gives numpy;
+    # raw numpy leaves would break .at[] updates (connect after restore) and
+    # re-pay host->device transfer on every subsequent jit call.
+
+    expected = set(topology_state(graph).keys())
+    got = set(ts.keys())
+    drop_neighbor_table = False
+    if expected - got == {"neighbor_mask"} and not graph.neighbors_complete:
+        # The checkpointed run dropped its width-capped neighbor table
+        # (fail_edges on an incomplete table loses the slot->edge map);
+        # mirror that on the attached graph instead of rejecting a valid
+        # checkpoint the docs say to restore onto the pristine build.
+        drop_neighbor_table = True
+        expected.discard("neighbor_mask")
+    if expected != got:
+        raise ValueError(
+            f"topology state keys mismatch: checkpoint has {sorted(got)}, "
+            f"attached graph expects {sorted(expected)} — attach a graph "
+            f"with the same representations (capacity, neighbor table, "
+            f"blocked/hybrid) as the one checkpointed"
+        )
+
+    for name, cur in (
+        ("node_mask", graph.node_mask),
+        ("edge_mask", graph.edge_mask),
+        ("in_degree", graph.in_degree),
+        ("out_degree", graph.out_degree),
+    ):
+        _shape(name, cur)
+    kw: Dict[str, Any] = {
+        "node_mask": ts["node_mask"],
+        "edge_mask": ts["edge_mask"],
+        "in_degree": ts["in_degree"],
+        "out_degree": ts["out_degree"],
+    }
+    if "neighbor_mask" in ts:
+        _shape("neighbor_mask", graph.neighbor_mask)
+        kw["neighbor_mask"] = ts["neighbor_mask"]
+    elif drop_neighbor_table:
+        kw["neighbors"] = None
+        kw["neighbor_mask"] = None
+    if "dyn_senders" in ts:
+        _shape("dyn_senders", graph.dyn_senders)
+        kw["dyn_senders"] = ts["dyn_senders"]
+        kw["dyn_receivers"] = ts["dyn_receivers"]
+        kw["dyn_mask"] = ts["dyn_mask"]
+    if "blocked_mask" in ts:
+        _shape("blocked_mask", graph.blocked.mask)
+        kw["blocked"] = dataclasses.replace(graph.blocked, mask=ts["blocked_mask"])
+    if "hybrid_masks" in ts:
+        _shape("hybrid_masks", graph.hybrid.masks)
+        remainder = graph.hybrid.remainder
+        if "hybrid_remainder_mask" in ts:
+            _shape("hybrid_remainder_mask", remainder.mask)
+            remainder = dataclasses.replace(
+                remainder, mask=ts["hybrid_remainder_mask"]
+            )
+        kw["hybrid"] = dataclasses.replace(
+            graph.hybrid, masks=ts["hybrid_masks"], remainder=remainder
+        )
+    return dataclasses.replace(graph, **kw)
+
+
+def load_node_payload(path: str, graph, protocol_state_template) -> Tuple[
+        Dict[str, Any], jax.Array, int, int]:
+    """Load a JaxSimNode checkpoint (payload dict with ``protocol``,
+    ``topology``, ``churn_count`` keys) written by
+    ``JaxSimNode.save_checkpoint``.
+
+    Owns the format-level tolerances:
+
+    - A run that hit ``fail_edges`` on a width-capped neighbor table
+      dropped the table, so its checkpoint legitimately lacks
+      ``neighbor_mask`` — when the straight load rejects the structure and
+      the attached graph's table is droppable (incomplete), retry with a
+      table-less template and let :func:`apply_topology_state` mirror the
+      drop.
+    - Legacy checkpoints (pre-topology format: the protocol state was the
+      root pytree) still load — they carry no topology, so the graph
+      resumes exactly as attached.
+    """
+    ts_template = topology_state(graph)
+
+    def _template(ts):
+        return {
+            "protocol": protocol_state_template,
+            "topology": ts,
+            "churn_count": np.int64(0),
+        }
+
+    try:
+        return load(path, _template(ts_template))
+    except ValueError as err:
+        if "neighbor_mask" in ts_template and not graph.neighbors_complete:
+            ts2 = dict(ts_template)
+            ts2.pop("neighbor_mask")
+            try:
+                return load(path, _template(ts2))
+            except ValueError:
+                pass
+        try:
+            state, key, rnd, msgs = load(path, protocol_state_template)
+        except ValueError:
+            raise err  # genuinely mismatched, not just old-format
+        payload = {
+            "protocol": state,
+            "topology": topology_state(graph),  # as-attached (no-op apply)
+            "churn_count": np.int64(0),
+        }
+        return payload, key, rnd, msgs
 
 
 def save(path: str, state: Any, key: jax.Array, round_index: int,

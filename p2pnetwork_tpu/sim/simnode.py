@@ -212,15 +212,44 @@ class JaxSimNode(Node):
     # ----------------------------------------------------------- checkpoint
 
     def save_checkpoint(self, path: str) -> None:
-        """Persist (state, PRNG key, round, message count) — see
-        sim/checkpoint.py."""
+        """Persist protocol state, PRNG key, round/message counters, AND the
+        topology mutation state (failed nodes, cut edges, runtime links,
+        churn counter) — see sim/checkpoint.py. Topology is state here for
+        the same reason the reference keeps its peer lists on the node
+        object [ref: p2pnetwork/node.py:46-52]: a restored run must see the
+        network as it was, not as it was built."""
         self._require_sim()
-        ckpt.save(path, self.sim_state, self._sim_key, self.sim_round,
+        payload = {
+            "protocol": self.sim_state,
+            "topology": ckpt.topology_state(self.sim_graph),
+            "churn_count": np.int64(self._churn_count),
+        }
+        ckpt.save(path, payload, self._sim_key, self.sim_round,
                   self.sim_message_count)
 
     def load_checkpoint(self, path: str) -> None:
-        """Restore a checkpoint taken from a node with the same graph/protocol."""
+        """Restore a checkpoint taken from a node with the same (pristine)
+        graph construction and protocol.
+
+        The attached graph supplies the static arrays; the checkpoint's
+        topology state is re-applied onto it, so a run that failed nodes or
+        grew links resumes on exactly the damaged/grown network — and the
+        churn counter is restored, so the next ``inject_sim_churn()`` draws
+        fresh randomness instead of replaying pre-checkpoint draws."""
         self._require_sim()
-        template = self.sim_protocol.init(self.sim_graph, jax.random.key(0))
-        (self.sim_state, self._sim_key, self.sim_round,
-         self.sim_message_count) = ckpt.load(path, template)
+        proto_template = self.sim_protocol.init(self.sim_graph, jax.random.key(0))
+        payload, key, rnd, msgs = ckpt.load_node_payload(
+            path, self.sim_graph, proto_template
+        )
+        # Validate everything (including topology shapes) BEFORE mutating
+        # the node — a rejected load must leave it untouched, not holding a
+        # foreign protocol state against its own graph.
+        new_graph = ckpt.apply_topology_state(self.sim_graph, payload["topology"])
+        # Device-put the protocol leaves (npz gives numpy): raw numpy would
+        # re-pay host->device transfer on every subsequent jit dispatch.
+        self.sim_state = jax.tree.map(jax.numpy.asarray, payload["protocol"])
+        self._sim_key = key
+        self.sim_round = rnd
+        self.sim_message_count = msgs
+        self.sim_graph = new_graph
+        self._churn_count = int(payload["churn_count"])

@@ -206,6 +206,174 @@ class TestCheckpoint:
         # restored WITH the template's sharding, not funneled to one device
         assert len(loaded.seen.sharding.device_set) == 8
 
+    def test_topology_survives_checkpoint(self, tmp_path):
+        # The reference's peer lists ARE its state [ref: p2pnetwork/
+        # node.py:46-52]: a run that failed nodes, churned, and grew links
+        # must restore onto the damaged/grown network — no manual damage
+        # re-application — and continue bit-identically.
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(
+            G.watts_strogatz(600, 6, 0.1, seed=4), extra_edges=16
+        )
+        proto = SIR(beta=0.5, gamma=0.2)
+        path = str(tmp_path / "topo.npz")
+
+        a = JaxSimNode(graph=g, protocol=proto, seed=9)
+        a.run_rounds(3)
+        a.fail_sim_nodes([10, 20, 30])
+        a.inject_sim_churn(0.1)
+        a.connect_sim_nodes([5, 7], [505, 597])
+        a.run_rounds(2)
+        a.save_checkpoint(path)
+        a.run_rounds(5)
+
+        b = JaxSimNode(graph=g, protocol=proto, seed=9)
+        b.load_checkpoint(path)
+        # The restored graph is the mutated one, not the pristine build.
+        for field in ("node_mask", "edge_mask", "in_degree", "out_degree",
+                      "neighbor_mask", "dyn_senders", "dyn_receivers",
+                      "dyn_mask"):
+            got_a = np.asarray(getattr(a.sim_graph, field))
+            got_b = np.asarray(getattr(b.sim_graph, field))
+            np.testing.assert_array_equal(got_b, got_a, err_msg=field)
+        assert int(np.asarray(b.sim_graph.node_mask).sum()) < 600
+        b.run_rounds(5)
+        np.testing.assert_array_equal(
+            np.asarray(a.sim_state.status), np.asarray(b.sim_state.status)
+        )
+        # The churn counter is state too: the NEXT churn event must draw the
+        # same fresh randomness on both, not replay pre-checkpoint draws.
+        a.inject_sim_churn(0.1)
+        b.inject_sim_churn(0.1)
+        np.testing.assert_array_equal(
+            np.asarray(a.sim_graph.node_mask), np.asarray(b.sim_graph.node_mask)
+        )
+
+    def test_topology_checkpoint_with_kernel_layouts(self, tmp_path):
+        # blocked/hybrid kernel masks are re-masked by failures; restoring
+        # must bring THOSE back too, or the fast aggregation paths would
+        # disagree with the COO truth on the restored node.
+        from p2pnetwork_tpu.ops import segment
+
+        g = G.watts_strogatz(512, 6, 0.1, seed=1, blocked=True, hybrid=True)
+        proto = Flood(source=0)
+        path = str(tmp_path / "kern.npz")
+        a = JaxSimNode(graph=g, protocol=proto, seed=0)
+        a.fail_sim_nodes([3, 141, 399])
+        a.save_checkpoint(path)
+
+        b = JaxSimNode(graph=g, protocol=proto, seed=0)
+        b.load_checkpoint(path)
+        sig = np.zeros(g.n_nodes_padded, dtype=bool)
+        sig[[2, 140, 400]] = True
+        ref = np.asarray(segment.propagate_or(b.sim_graph, jax.numpy.asarray(sig), "segment"))
+        for method in ("blocked", "pallas", "hybrid"):
+            out = np.asarray(segment.propagate_or(b.sim_graph, jax.numpy.asarray(sig), method))
+            np.testing.assert_array_equal(out, ref, err_msg=method)
+
+    def test_connect_works_after_restore(self, tmp_path):
+        # Regression: apply_topology_state installed raw numpy arrays from
+        # the npz, so the first post-restore connect crashed on .at[].
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(G.ring(200), extra_edges=16)
+        proto = Flood(source=0)
+        path = str(tmp_path / "grow.npz")
+        a = JaxSimNode(graph=g, protocol=proto, seed=0)
+        a.connect_sim_nodes([0], [100])
+        a.save_checkpoint(path)
+        b = JaxSimNode(graph=g, protocol=proto, seed=0)
+        b.load_checkpoint(path)
+        b.connect_sim_nodes([2], [101])  # must not crash
+        assert int(np.asarray(b.sim_graph.dyn_mask).sum()) == 4
+
+    def test_restore_after_capped_table_dropped(self, tmp_path):
+        # Regression: fail_edges on a width-capped neighbor table drops the
+        # table; the checkpoint then lacks neighbor_mask and restoring onto
+        # the documented pristine construction was rejected outright.
+        from p2pnetwork_tpu.sim import failures
+
+        g = G.barabasi_albert(300, 3, seed=1, max_degree=2)
+        assert not g.neighbors_complete
+        proto = SIR(beta=0.4, gamma=0.1)
+        path = str(tmp_path / "capped.npz")
+        a = JaxSimNode(graph=g, protocol=proto, seed=5)
+        a.run_rounds(2)
+        a.sim_graph = failures.fail_edges(a.sim_graph, [0, 1])
+        a.run_rounds(2)
+        a.save_checkpoint(path)
+        a.run_rounds(3)
+
+        b = JaxSimNode(graph=g, protocol=proto, seed=5)
+        b.load_checkpoint(path)
+        # The restore mirrors the drop instead of erroring...
+        assert b.sim_graph.neighbors is None and b.sim_graph.neighbor_mask is None
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_graph.edge_mask), np.asarray(a.sim_graph.edge_mask)
+        )
+        # ...and the run continues bit-identically.
+        b.run_rounds(3)
+        np.testing.assert_array_equal(
+            np.asarray(a.sim_state.status), np.asarray(b.sim_state.status)
+        )
+
+    def test_topology_mismatch_rejected(self, tmp_path):
+        from p2pnetwork_tpu.sim import topology
+
+        g_cap = topology.with_capacity(G.ring(200), extra_edges=16)
+        proto = Flood(source=0)
+        path = str(tmp_path / "mismatch.npz")
+        a = JaxSimNode(graph=g_cap, protocol=proto, seed=0)
+        a.save_checkpoint(path)
+        # Restoring onto a graph WITHOUT the dynamic region must fail
+        # loudly, not silently drop the runtime links.
+        b = JaxSimNode(graph=G.ring(200), protocol=proto, seed=0)
+        with pytest.raises(ValueError, match="structure mismatch|keys mismatch"):
+            b.load_checkpoint(path)
+
+    def test_legacy_protocol_only_checkpoint_still_loads(self, tmp_path):
+        # Pre-topology-format checkpoints (protocol state as the root
+        # pytree) must keep loading: protocol state restores, the graph
+        # resumes as attached, and the restored leaves are device arrays.
+        g = G.watts_strogatz(512, 6, 0.1, seed=4)
+        proto = SIR(beta=0.5, gamma=0.2)
+        path = str(tmp_path / "legacy.npz")
+        state = proto.init(g, jax.random.key(9))
+        ckpt.save(path, state, jax.random.key(9), 7, message_count=123)
+
+        b = JaxSimNode(graph=g, protocol=proto, seed=9)
+        b.load_checkpoint(path)
+        assert b.sim_round == 7 and b.sim_message_count == 123
+        assert isinstance(b.sim_state.status, jax.Array)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state.status), np.asarray(state.status)
+        )
+        b.run_rounds(2)  # still a working node
+
+    def test_rejected_load_leaves_node_untouched(self, tmp_path):
+        # Regression: same tree STRUCTURE but different shapes passed the
+        # treedef check, mutated the node, then failed topology validation
+        # — leaving a 384-wide protocol state on a 256-wide graph.
+        from p2pnetwork_tpu.sim import topology
+
+        proto = Flood(source=0)
+        path = str(tmp_path / "foreign.npz")
+        a = JaxSimNode(graph=topology.with_capacity(G.ring(300), extra_edges=16),
+                       protocol=proto, seed=0)
+        a.run_rounds(2)
+        a.save_checkpoint(path)
+        b = JaxSimNode(graph=topology.with_capacity(G.ring(200), extra_edges=16),
+                       protocol=proto, seed=0)
+        b.run_rounds(1)
+        round_before = b.sim_round
+        seen_before = np.asarray(b.sim_state.seen).copy()
+        with pytest.raises(ValueError, match="topology state mismatch"):
+            b.load_checkpoint(path)
+        assert b.sim_round == round_before
+        np.testing.assert_array_equal(np.asarray(b.sim_state.seen), seen_before)
+        b.run_rounds(2)  # still a working node
+
     def test_resume_is_bit_identical(self, tmp_path):
         # Run 10 rounds straight vs save@5 -> load -> 5 more: same result.
         g = G.watts_strogatz(512, 6, 0.1, seed=4)
