@@ -19,7 +19,23 @@ This is the TPU-native replacement for the reference's only scaling story
   all-gather and no DCN hot spot; per-round stats come back via ``psum``.
 
 The whole multi-round propagation (scan over rounds, ring scan inside) is
-one ``shard_map``-ped, jitted XLA program — zero host round-trips.
+one ``shard_map``-ped, jitted XLA program — zero host round-trips;
+:func:`flood_until_coverage` adds the device-side early-exit
+``lax.while_loop`` so the north-star run-to-99% measurement runs multi-chip.
+
+**Topology churn is first-class here too** — the reference's identity is
+mutating a live network (connects add peers [ref: p2pnetwork/node.py:122],
+errors tear connections down [ref: nodeconnection.py:123-126]), and at the
+scale this path targets that must work on the sharded representation:
+
+- :func:`with_node_liveness` / :func:`fail_nodes` /
+  :func:`random_node_failures` re-mask ``bkt_mask`` / ``node_mask`` /
+  ``out_degree`` device-side — same shapes, no recompile, mirroring
+  sim/failures.py.
+- :func:`with_capacity` reserves a **dynamic edge region**: per-(dst-shard,
+  ring-step) unsorted COO slots ``[S, S, K]`` that every ring pass folds in
+  alongside the static buckets, so :func:`connect`-ed links carry traffic
+  immediately — no re-shard, no recompile (mirroring sim/topology.py).
 """
 
 from __future__ import annotations
@@ -35,6 +51,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS, ring_mesh
 from p2pnetwork_tpu.sim.graph import Graph, _round_up
+from p2pnetwork_tpu.utils import accum
 
 
 @jax.tree_util.register_dataclass
@@ -47,6 +64,11 @@ class ShardedGraph:
     indices: ``bkt_src`` into the *rotating* frontier block, ``bkt_dst`` into
     the shard's own node block. Within a bucket, edges are sorted by
     destination so segment reductions see sorted ids.
+
+    ``dyn_*`` (optional, via :func:`with_capacity`) is the dynamic edge
+    region: same ``[S, S, K]`` bucket layout, but unsorted — runtime
+    :func:`connect` fills free slots and every ring pass applies the
+    dynamic bucket of the resident step alongside the static one.
     """
 
     bkt_src: jax.Array  # i32[S, S, E_bkt]
@@ -57,10 +79,31 @@ class ShardedGraph:
     n_nodes: int = dataclasses.field(metadata=dict(static=True))
     n_shards: int = dataclasses.field(metadata=dict(static=True))
     block: int = dataclasses.field(metadata=dict(static=True))
+    dyn_src: Optional[jax.Array] = None  # i32[S, S, K]
+    dyn_dst: Optional[jax.Array] = None  # i32[S, S, K]
+    dyn_mask: Optional[jax.Array] = None  # bool[S, S, K]
 
     @property
     def n_nodes_padded(self) -> int:
         return self.n_shards * self.block
+
+    @property
+    def dyn_capacity(self) -> int:
+        return 0 if self.dyn_src is None else self.dyn_src.shape[-1]
+
+
+def _dyn_or_empty(sg: ShardedGraph):
+    """The dynamic bucket triple, or zero-width placeholders (K == 0 makes
+    the ring pass skip the dynamic group at trace time — one code path,
+    no extra compile-cache key)."""
+    if sg.dyn_src is not None:
+        return sg.dyn_src, sg.dyn_dst, sg.dyn_mask
+    S = sg.n_shards
+    return (
+        jnp.zeros((S, S, 0), jnp.int32),
+        jnp.zeros((S, S, 0), jnp.int32),
+        jnp.zeros((S, S, 0), bool),
+    )
 
 
 def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
@@ -71,11 +114,19 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     bucket ``(dst_shard, ring_step)`` where ``ring_step = (dst_shard -
     src_shard) mod S`` — the step of the ring rotation at which the sender's
     frontier block is resident on the receiver's shard.
+
+    A graph carrying live dynamic edges (sim/topology.py) is sharded
+    losslessly: its runtime links are folded into the static buckets (this
+    IS the documented consolidation path — re-shard when churn accumulates).
     """
     S = mesh.shape[axis_name]
     emask = np.asarray(graph.edge_mask)
     senders = np.asarray(graph.senders)[emask]
     receivers = np.asarray(graph.receivers)[emask]
+    if graph.dyn_mask is not None:
+        dmask = np.asarray(graph.dyn_mask)
+        senders = np.concatenate([senders, np.asarray(graph.dyn_senders)[dmask]])
+        receivers = np.concatenate([receivers, np.asarray(graph.dyn_receivers)[dmask]])
 
     block = _round_up(graph.n_nodes_padded, S) // S
     src_shard = senders // block
@@ -126,25 +177,408 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     )
 
 
+# --------------------------------------------------------------- churn ops
+
+
+def with_capacity(sg: ShardedGraph, extra_edges: int) -> ShardedGraph:
+    """Reserve ``extra_edges`` dynamic slots per (dst-shard, ring-step)
+    bucket — any distribution of that many directed links is guaranteed to
+    fit whichever bucket it lands in. Host-side, one-off; growing an
+    existing region preserves every runtime link."""
+    K = _round_up(max(extra_edges, 1), 8)
+    S = sg.n_shards
+    if sg.dyn_src is not None:
+        grow = K
+        pad = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, grow)))  # noqa: E731
+        return dataclasses.replace(
+            sg,
+            dyn_src=pad(sg.dyn_src),
+            dyn_dst=pad(sg.dyn_dst),
+            dyn_mask=pad(sg.dyn_mask),
+        )
+    return dataclasses.replace(
+        sg,
+        dyn_src=jnp.zeros((S, S, K), jnp.int32),
+        dyn_dst=jnp.zeros((S, S, K), jnp.int32),
+        dyn_mask=jnp.zeros((S, S, K), bool),
+    )
+
+
+def _mesh_of(sg: ShardedGraph) -> Mesh:
+    """The mesh the graph's arrays live on (set by shard_graph's
+    device_put; churn ops run shard_map programs over it)."""
+    mesh = sg.bkt_src.sharding.mesh
+    if isinstance(mesh, jax.sharding.AbstractMesh):  # pragma: no cover
+        raise ValueError("ShardedGraph arrays carry an abstract mesh; "
+                         "device_put them on a concrete mesh first")
+    return mesh
+
+
+def _remask_body(axis_name, S, block,
+                 bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                 node_mask, alive):
+    """Per-shard liveness re-mask: an edge survives iff both endpoints do.
+
+    Runs under shard_map. The source block of bucket ``t`` is the block
+    resident after ``t`` ring rotations, so the per-step source liveness is
+    collected with the same ppermute ring the propagation uses. Out-degree
+    counts are computed per bucket on the receiver's shard, then carried
+    back to the sender's shard with a reverse-rotating Horner accumulation:
+    ``out[s] = sum_t cnt[(s+t) mod S, t]``.
+    """
+    nm = node_mask[0] & alive[0]  # [B]
+
+    # masks_by_t[t] = liveness of the block resident at ring step t
+    # (= shard (d - t) mod S's block, exactly what bkt_src[t] indexes).
+    def collect(rot, _):
+        return jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S)), rot
+
+    _, masks_by_t = jax.lax.scan(collect, nm, None, length=S)
+
+    def remask_group(src, dst, mask):  # [S, W] each
+        if src.shape[-1] == 0:
+            return mask, jnp.zeros((S, block), jnp.int32)
+        src_alive = jnp.take_along_axis(masks_by_t, src, axis=1)
+        dst_alive = nm[dst]
+        mask = mask & src_alive & dst_alive
+        cnt = jax.vmap(
+            lambda m, s: jax.ops.segment_sum(
+                m.astype(jnp.int32), s, num_segments=block
+            )
+        )(mask, src)  # [S_t, B] — counts for the sender block of each step
+        return mask, cnt
+
+    bkt_mask_b, cnt_s = remask_group(bkt_src[0], bkt_dst[0], bkt_mask[0])
+    dyn_mask_b, cnt_d = remask_group(dyn_src[0], dyn_dst[0], dyn_mask[0])
+    cnt = cnt_s + cnt_d  # [S_t, B]
+
+    # Horner: acc <- cnt_t + rot_back(acc), t = S-1 .. 0, where rot_back
+    # moves each block one shard backward along the ring.
+    back = [((i + 1) % S, i) for i in range(S)]
+
+    def horner(acc, cnt_t):
+        return cnt_t + jax.lax.ppermute(acc, axis_name, perm=back), None
+
+    if S > 1:
+        out_degree, _ = jax.lax.scan(horner, cnt[S - 1], cnt[: S - 1],
+                                     reverse=True)
+    else:
+        out_degree = cnt[0]
+    return bkt_mask_b[None], dyn_mask_b[None], nm[None], out_degree[None]
+
+
+@functools.lru_cache(maxsize=64)
+def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int):
+    body = functools.partial(_remask_body, axis_name, S, block)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=(spec,) * 4,
+    )
+    return jax.jit(fn)
+
+
+def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
+    """Apply a liveness mask (False = failed) to the sharded graph —
+    the sharded mirror of sim/failures.with_node_liveness. ``alive`` is
+    bool, global ``[S*block]`` or already-blocked ``[S, block]``.
+
+    Entirely device-side, shapes unchanged: the compiled flood/SIR/coverage
+    programs are NOT recompiled, the next round simply routes around the
+    damage — same no-recompile property as the single-device path.
+    """
+    alive = jnp.asarray(alive).reshape(sg.n_shards, sg.block)
+    mesh = _mesh_of(sg)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block)
+    bkt_mask, dyn_mask, node_mask, out_degree = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+        dyn_src, dyn_dst, dyn_mask, sg.node_mask, alive,
+    )
+    return dataclasses.replace(
+        sg,
+        bkt_mask=bkt_mask,
+        node_mask=node_mask,
+        out_degree=out_degree,
+        dyn_mask=dyn_mask if sg.dyn_mask is not None else None,
+    )
+
+
+def fail_nodes(sg: ShardedGraph, node_ids) -> ShardedGraph:
+    """Fail-stop the given global node ids (sharded mirror of
+    sim/failures.fail_nodes)."""
+    ids = np.asarray(node_ids, dtype=np.int64)
+    if ids.size and (ids.min() < 0 or ids.max() >= sg.n_nodes_padded):
+        raise ValueError(f"node id out of range [0, {sg.n_nodes_padded})")
+    alive = jnp.ones(sg.n_nodes_padded, bool).at[
+        jnp.asarray(ids, dtype=jnp.int32)].set(False)
+    return with_node_liveness(sg, alive)
+
+
+def random_node_failures(sg: ShardedGraph, key: jax.Array,
+                         frac: float) -> ShardedGraph:
+    """Fail each live node independently with probability ``frac``. Draws
+    over the full padded population, so when ``S*block == n_pad`` the
+    failure set is bit-identical to sim/failures.random_node_failures with
+    the same key."""
+    alive = ~(
+        jax.random.bernoulli(key, frac, (sg.n_nodes_padded,)).reshape(
+            sg.n_shards, sg.block
+        )
+        & sg.node_mask
+    )
+    return with_node_liveness(sg, alive)
+
+
+def _pad_queries(S, *arrays, multiple=16):
+    """Pad query vectors to a length multiple (fewer retraces across call
+    sites). Padding rows get dst shard ``S`` — matching no shard, they are
+    inert in every probe/scatter body."""
+    q = arrays[0].size
+    q_pad = _round_up(max(q, 1), multiple)
+    out = []
+    for i, a in enumerate(arrays):
+        fill = S if i == 0 else 0  # first array is the dst-shard vector
+        out.append(np.pad(a, (0, q_pad - q), constant_values=fill))
+    return out
+
+
+def _member_body(axis_name, S,
+                 bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                 d, t, sl, rl):
+    """Replicated queries in, replicated answers out: each shard probes the
+    buckets it owns (d == my shard); a psum ORs the per-shard verdicts."""
+    my = jax.lax.axis_index(axis_name)
+    mine = d == my
+
+    def probe(src, dst, m):  # [S_t, W] locals
+        if src.shape[-1] == 0:
+            return jnp.zeros(d.shape, bool)
+        rows_s = src[0][t]  # [Q, W] — t is a local (unsharded) axis
+        rows_d = dst[0][t]
+        rows_m = m[0][t]
+        return ((rows_s == sl[:, None]) & (rows_d == rl[:, None]) & rows_m
+                ).any(axis=1)
+
+    hit = (probe(bkt_src, bkt_dst, bkt_mask)
+           | probe(dyn_src, dyn_dst, dyn_mask)) & mine
+    return jax.lax.psum(hit.astype(jnp.int32), axis_name) > 0
+
+
+@functools.lru_cache(maxsize=64)
+def _member_fn(mesh: Mesh, axis_name: str, S: int):
+    body = functools.partial(_member_body, axis_name, S)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 6 + (P(),) * 4,
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+def _scatter_body(axis_name, S, block,
+                  dyn_src, dyn_dst, dyn_mask, out_degree,
+                  d, t, k, sl, rl):
+    """Write new dynamic edges into the owning shard's bucket slots and bump
+    the sender shard's out-degrees. Non-owned queries route to an
+    out-of-bounds row and are dropped by the scatter."""
+    my = jax.lax.axis_index(axis_name)
+    tt = jnp.where(d == my, t, S)  # OOB row -> dropped
+    ds = dyn_src[0].at[tt, k].set(sl, mode="drop")
+    dd = dyn_dst[0].at[tt, k].set(rl, mode="drop")
+    dm = dyn_mask[0].at[tt, k].set(True, mode="drop")
+    sender_mine = ((d - t) % S == my) & (d < S)
+    bb = jnp.where(sender_mine, sl, block)  # OOB -> dropped
+    od = out_degree[0].at[bb].add(1, mode="drop")
+    return ds[None], dd[None], dm[None], od[None]
+
+
+@functools.lru_cache(maxsize=64)
+def _scatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
+    body = functools.partial(_scatter_body, axis_name, S, block)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 4 + (P(),) * 5,
+        out_specs=(spec,) * 4,
+    )
+    return jax.jit(fn)
+
+
+def connect(sg: ShardedGraph, senders, receivers, *,
+            undirected: bool = True) -> ShardedGraph:
+    """Add links between global node ids at runtime (sharded mirror of
+    sim/topology.connect; the population analog of ``connect_with_node``
+    [ref: p2pnetwork/node.py:122]).
+
+    Each new directed edge lands in its (dst-shard, ring-step) dynamic
+    bucket; already-existing pairs (static or dynamic) are dropped, like
+    the reference's duplicate-connect no-op [ref: node.py:136-139]. The
+    existence probe and the slot writes are shard_map programs (each shard
+    handles the queries it owns); only slot allocation is orchestrated
+    host-side over the small ``[S, S, K]`` occupancy mask — connect is an
+    event, not the hot path.
+    """
+    if sg.dyn_src is None:
+        raise ValueError(
+            "no dynamic edge capacity: reserve slots with "
+            "sharded.with_capacity(sg, extra_edges=...) first"
+        )
+    S, B, K = sg.n_shards, sg.block, sg.dyn_capacity
+    mesh = _mesh_of(sg)
+    axis = mesh.axis_names[0]
+    s = np.asarray(senders, np.int64).reshape(-1)
+    r = np.asarray(receivers, np.int64).reshape(-1)
+    if s.size and (min(s.min(), r.min()) < 0
+                   or max(s.max(), r.max()) >= sg.n_nodes_padded):
+        raise ValueError(f"node id out of range [0, {sg.n_nodes_padded})")
+    if undirected:
+        s, r = np.concatenate([s, r]), np.concatenate([r, s])
+
+    # Drop duplicates within the batch (first occurrence wins).
+    _, first = np.unique(s * np.int64(sg.n_nodes_padded) + r, return_index=True)
+    keep = np.zeros(s.size, bool)
+    keep[first] = True
+
+    # Drop pairs that already exist — each shard probes the exact bucket
+    # the pair would occupy (O(Q * E_bkt) on its own rows, not O(Q * E)).
+    d = (r // B).astype(np.int32)
+    t = ((d - s // B) % S).astype(np.int32)
+    sl = (s % B).astype(np.int32)
+    rl = (r % B).astype(np.int32)
+    dp, tp, slp, rlp = _pad_queries(S, d, t, sl, rl)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    exists = np.asarray(_member_fn(mesh, axis, S)(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        jnp.asarray(dp), jnp.asarray(tp), jnp.asarray(slp), jnp.asarray(rlp),
+    ))[: d.size]
+    keep &= ~exists
+    if not keep.any():
+        return sg
+
+    d, t, sl, rl = d[keep], t[keep], sl[keep], rl[keep]
+    # Free-slot allocation per bucket (host-side; dyn_mask is S*S*K bools).
+    dmask = np.array(sg.dyn_mask)  # mutable copy
+    slots = np.empty(d.size, np.int32)
+    for i in range(d.size):
+        free = np.nonzero(~dmask[d[i], t[i]])[0]
+        if not free.size:
+            raise ValueError(
+                f"dynamic bucket ({d[i]}, {t[i]}) full ({K} slots); "
+                f"re-shard via shard_graph (consolidation) or reserve more "
+                f"via with_capacity"
+            )
+        slots[i] = free[0]
+        dmask[d[i], t[i], free[0]] = True
+
+    dp, tp, kp, slp, rlp = _pad_queries(S, d, t, slots, sl, rl)
+    dyn_src, dyn_dst, dyn_mask, out_degree = _scatter_fn(mesh, axis, S, B)(
+        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree,
+        jnp.asarray(dp), jnp.asarray(tp), jnp.asarray(kp),
+        jnp.asarray(slp), jnp.asarray(rlp),
+    )
+    return dataclasses.replace(
+        sg, dyn_src=dyn_src, dyn_dst=dyn_dst, dyn_mask=dyn_mask,
+        out_degree=out_degree,
+    )
+
+
+def _unscatter_body(axis_name, S, block,
+                    dyn_src, dyn_dst, dyn_mask, out_degree, d, t, sl, rl):
+    """Clear matching dynamic edges on the owning shard; psum the removal
+    verdicts so the sender's shard can decrement its out-degrees."""
+    my = jax.lax.axis_index(axis_name)
+    mine = d == my
+    rows_s = dyn_src[0][t]  # [Q, K]
+    rows_d = dyn_dst[0][t]
+    rows_m = dyn_mask[0][t]
+    hit = (rows_s == sl[:, None]) & (rows_d == rl[:, None]) & rows_m
+    hit = hit & mine[:, None]
+    tt = jnp.where(mine, t, S)
+    dm = dyn_mask[0].at[tt].min(~hit, mode="drop")
+    removed = jax.lax.psum(hit.any(axis=1).astype(jnp.int32), axis_name)
+    sender_mine = ((d - t) % S == my) & (d < S)
+    bb = jnp.where(sender_mine, sl, block)
+    od = out_degree[0].at[bb].add(-removed, mode="drop")
+    return dm[None], od[None]
+
+
+@functools.lru_cache(maxsize=64)
+def _unscatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
+    body = functools.partial(_unscatter_body, axis_name, S, block)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec,) * 4 + (P(),) * 4,
+        out_specs=(spec,) * 2,
+    )
+    return jax.jit(fn)
+
+
+def disconnect(sg: ShardedGraph, senders, receivers, *,
+               undirected: bool = True) -> ShardedGraph:
+    """Remove runtime links (matched by endpoint pair; static edges are
+    removed with :func:`fail_nodes` / a re-shard)."""
+    if sg.dyn_src is None:
+        raise ValueError("graph has no dynamic edge region")
+    S, B = sg.n_shards, sg.block
+    mesh = _mesh_of(sg)
+    s = np.asarray(senders, np.int64).reshape(-1)
+    r = np.asarray(receivers, np.int64).reshape(-1)
+    if undirected:
+        s, r = np.concatenate([s, r]), np.concatenate([r, s])
+    # Dedup queries: a pair listed twice must decrement degrees once.
+    _, first = np.unique(s * np.int64(sg.n_nodes_padded) + r, return_index=True)
+    s, r = s[np.sort(first)], r[np.sort(first)]
+    d = (r // B).astype(np.int32)
+    t = ((d - s // B) % S).astype(np.int32)
+    sl = (s % B).astype(np.int32)
+    rl = (r % B).astype(np.int32)
+    dp, tp, slp, rlp = _pad_queries(S, d, t, sl, rl)
+    dyn_mask, out_degree = _unscatter_fn(mesh, mesh.axis_names[0], S, B)(
+        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree,
+        jnp.asarray(dp), jnp.asarray(tp), jnp.asarray(slp), jnp.asarray(rlp),
+    )
+    return dataclasses.replace(sg, dyn_mask=dyn_mask, out_degree=out_degree)
+
+
+# --------------------------------------------------------------- ring pass
+
+
 def _ring_perm(S: int):
     """Send block to the next shard: after t applications, shard d holds the
     block originally on shard (d - t) mod S."""
     return [(i, (i + 1) % S) for i in range(S)]
 
 
-def _ring_pass(axis_name, S, frontier, buckets, apply_bucket, acc0, combine):
-    """One full ring rotation: apply bucket ``t`` to the block resident at
-    ring step ``t``, folding results with ``combine``.
+def _ring_pass(axis_name, S, frontier, groups, acc0, combine):
+    """One full ring rotation. ``groups`` is a sequence of
+    ``(src [S, W], dst [S, W], mask [S, W], apply_fn)`` bucket groups —
+    static (dst-sorted) and dynamic (unsorted) edges ride the same
+    rotation; at step ``t`` each group's bucket ``t`` consumes the resident
+    block, folding results with ``combine``.
 
     The last bucket is peeled out of the scan: after it is applied there is
     nothing left to rotate, so running its ppermute would be one wasted ICI
-    collective per pass.
+    collective per pass. Zero-width groups (unused dynamic capacity) are
+    skipped at trace time.
     """
-    bkt_src, bkt_dst, bkt_mask = buckets
+    groups = [g for g in groups if g[0].shape[-1] > 0]
+    arrays = []
+    for src, dst, m, _ in groups:
+        arrays += [src, dst, m]
 
-    def ring_step(rc, bkt):
+    def apply_all(acc, rot, bkt_arrays):
+        for gi, (_, _, _, fn) in enumerate(groups):
+            bs, bd, bm = bkt_arrays[3 * gi: 3 * gi + 3]
+            acc = combine(acc, fn(rot, bs, bd, bm))
+        return acc
+
+    def ring_step(rc, bkt_arrays):
         rot, acc = rc  # rot: frontier block resident this step
-        acc = combine(acc, apply_bucket(rot, *bkt))
+        acc = apply_all(acc, rot, bkt_arrays)
         rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
         return (rot, acc), None
 
@@ -152,53 +586,79 @@ def _ring_pass(axis_name, S, frontier, buckets, apply_bucket, acc0, combine):
         (rot, acc), _ = jax.lax.scan(
             ring_step,
             (frontier, acc0),
-            (bkt_src[: S - 1], bkt_dst[: S - 1], bkt_mask[: S - 1]),
+            tuple(a[: S - 1] for a in arrays),
         )
     else:
         rot, acc = frontier, acc0
-    return combine(acc, apply_bucket(rot, bkt_src[S - 1], bkt_dst[S - 1],
-                                     bkt_mask[S - 1]))
+    return apply_all(acc, rot, tuple(a[S - 1] for a in arrays))
 
 
-def _bucket_or(block):
+def _bucket_or(block, sorted_dst=True):
     def apply(rot, src, dst, m):
         contrib = (rot[src] & m).astype(jnp.int32)
         return jax.ops.segment_max(
-            contrib, dst, num_segments=block, indices_are_sorted=True
+            contrib, dst, num_segments=block, indices_are_sorted=sorted_dst
         ) > 0
 
     return apply
 
 
-def _bucket_sum(block):
+def _bucket_sum(block, sorted_dst=True):
     def apply(rot, src, dst, m):
         contrib = rot[src] * m
         return jax.ops.segment_sum(
-            contrib, dst, num_segments=block, indices_are_sorted=True
+            contrib, dst, num_segments=block, indices_are_sorted=sorted_dst
         )
 
     return apply
 
 
-def _ring_rounds_or(axis_name, S, block, bkt_src, bkt_dst, bkt_mask,
+def _groups_or(block, buckets, dyn_buckets):
+    return [
+        (*buckets, _bucket_or(block, sorted_dst=True)),
+        (*dyn_buckets, _bucket_or(block, sorted_dst=False)),
+    ]
+
+
+def _groups_sum(block, buckets, dyn_buckets):
+    return [
+        (*buckets, _bucket_sum(block, sorted_dst=True)),
+        (*dyn_buckets, _bucket_sum(block, sorted_dst=False)),
+    ]
+
+
+# -------------------------------------------------------------------- flood
+
+
+def _ring_rounds_or(axis_name, S, block,
+                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     node_mask, out_degree, seen0, frontier0, rounds):
     """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
     full ring pass. All blocks carry a leading length-1 shard axis."""
-    buckets = (bkt_src[0], bkt_dst[0], bkt_mask[0])
+    groups = _groups_or(
+        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    )
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
-    apply_bucket = _bucket_or(block)
+    # Live-count denominator, like models/flood.py — under failures the
+    # coverage must be of SURVIVORS, or dead-but-seen nodes push it past 1.
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
 
     def one_round(carry, _):
         seen, frontier = carry  # [block] bool each
-        delivered = _ring_pass(axis_name, S, frontier, buckets, apply_bucket,
+        delivered = _ring_pass(axis_name, S, frontier, groups,
                                jnp.zeros_like(seen), jnp.logical_or)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
             jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
         )
-        covered = jax.lax.psum(jnp.sum(seen.astype(jnp.int32)), axis_name)
-        return (seen, new), {"messages": msgs, "covered": covered}
+        covered = jax.lax.psum(
+            jnp.sum((seen & node_mask_b).astype(jnp.int32)), axis_name
+        )
+        return (seen, new), {"messages": msgs, "coverage": covered / n_live}
 
     (seen, frontier), stats = jax.lax.scan(
         one_round, (seen0[0], frontier0[0]), None, length=rounds
@@ -214,10 +674,17 @@ def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int):
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
-        in_specs=(spec,) * 7,
+        in_specs=(spec,) * 10,
         out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)
+
+
+def _flood_seed(sg: ShardedGraph, source: int):
+    S, block = sg.n_shards, sg.block
+    seed = jnp.zeros((S, block), dtype=bool).at[
+        source // block, source % block].set(True)
+    return seed & sg.node_mask  # dead source seeds nothing (Flood.init parity)
 
 
 def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
@@ -226,27 +693,115 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
 
     Returns ``(seen [S, block] bool, stats dict of [rounds] arrays)`` — the
     sharded equivalent of ``engine.run(graph, Flood(source), ...)``, and
-    bit-identical to it (tests/test_sharded.py).
+    bit-identical to it (tests/test_sharded.py), including under runtime
+    failures and connects.
     """
     S, block = sg.n_shards, sg.block
-    seen0 = jnp.zeros((S, block), dtype=bool).at[source // block, source % block].set(True)
-    frontier0 = seen0
-
+    seen0 = _flood_seed(sg, source)
     fn = _flood_fn(mesh, axis_name, S, block, rounds)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     seen, frontier, stats = fn(
-        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, sg.node_mask, sg.out_degree,
-        seen0, frontier0,
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        sg.node_mask, sg.out_degree, seen0, seen0,
     )
-    n_real = max(sg.n_nodes, 1)
-    stats = {
-        "messages": stats["messages"],
-        "coverage": stats["covered"].astype(jnp.float32) / n_real,
-    }
     return seen, stats
 
 
+# --------------------------------------------------- flood-to-coverage
+
+
+def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
+                      bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                      node_mask, out_degree, seen0, frontier0):
+    """Per-shard body: flood until the psum'd live coverage reaches the
+    target — the device-side early-exit ``lax.while_loop`` of
+    engine.run_until_coverage, multi-chip. The psum makes ``covered``
+    identical on every shard, so the loop condition is replicated-consistent
+    by construction. Messages accumulate in the two-limb counter
+    (utils/accum.py) — multi-chip totals wrap int32 even sooner."""
+    groups = _groups_or(
+        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    )
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def cond(carry):
+        _, _, rounds, covered, _, _ = carry
+        return (covered / n_live < coverage_target) & (rounds < max_rounds)
+
+    def body(carry):
+        seen, frontier, rounds, _, hi, lo = carry
+        delivered = _ring_pass(axis_name, S, frontier, groups,
+                               jnp.zeros_like(seen), jnp.logical_or)
+        new = delivered & ~seen & node_mask_b
+        seen = seen | new
+        msgs = jax.lax.psum(
+            jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+        )
+        hi, lo = accum.add((hi, lo), msgs)
+        covered = jax.lax.psum(jnp.sum((seen & node_mask_b).astype(jnp.int32)),
+                               axis_name)
+        return seen, new, rounds + 1, covered, hi, lo
+
+    seen0_b = seen0[0]
+    covered0 = jax.lax.psum(
+        jnp.sum((seen0_b & node_mask_b).astype(jnp.int32)), axis_name
+    )
+    init = (seen0_b, frontier0[0], jnp.int32(0), covered0, *accum.zero())
+    seen, _, rounds, covered, hi, lo = jax.lax.while_loop(cond, body, init)
+    return seen[None], rounds, covered / n_live, hi, lo
+
+
+@functools.lru_cache(maxsize=64)
+def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                  max_rounds: int):
+    body = functools.partial(_ring_coverage_or, axis_name, S, block)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh,
+        in_specs=(P(),) + (spec,) * 10,
+        out_specs=(spec, P(), P(), P(), P()),
+    )
+    return jax.jit(fn)
+
+
+def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
+                         coverage_target: float = 0.99,
+                         max_rounds: int = 1024,
+                         axis_name: str = DEFAULT_AXIS):
+    """Flood until coverage of the LIVE population reaches the target —
+    the north-star run-to-99% measurement (engine.run_until_coverage), on
+    the multi-chip path. One XLA program, zero host round-trips per round.
+
+    Returns ``(seen [S, block] bool, dict(rounds, coverage, messages))``
+    with ``messages`` an exact Python int.
+    """
+    S, block = sg.n_shards, sg.block
+    seen0 = _flood_seed(sg, source)
+    fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    seen, rounds, coverage, hi, lo = fn(
+        jnp.float32(coverage_target),
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        sg.node_mask, sg.out_degree, seen0, seen0,
+    )
+    return seen, {
+        "rounds": rounds,
+        "coverage": coverage,
+        "messages": accum.value((hi, lo)),
+    }
+
+
+# ---------------------------------------------------------------------- SIR
+
+
 def _ring_rounds_sir(axis_name, S, block, exact_rng,
-                     bkt_src, bkt_dst, bkt_mask, node_mask, out_degree,
+                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                     node_mask, out_degree,
                      status0, round_keys, one_minus_beta, gamma, rounds):
     """Per-shard body: ``rounds`` SIR rounds, infection pressure via a ring
     sum pass. ``round_keys`` is replicated raw key data [rounds, ...];
@@ -260,9 +815,15 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
     """
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
 
-    buckets = (bkt_src[0], bkt_dst[0], bkt_mask[0])
+    groups = _groups_sum(
+        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    )
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
-    apply_bucket = _bucket_sum(block)
+    # Live-count denominator (models/sir.py parity under failures).
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
     my = jax.lax.axis_index(axis_name)
 
     def draw(key, shape_full):
@@ -284,8 +845,7 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
             jnp.zeros((block,), jnp.float32), (axis_name,), to="varying"
         )
         pressure = _ring_pass(
-            axis_name, S, infected.astype(jnp.float32), buckets, apply_bucket,
-            acc0, jnp.add,
+            axis_name, S, infected.astype(jnp.float32), groups, acc0, jnp.add,
         )
         # one_minus_beta arrives precomputed in f64 then cast, matching the
         # engine's `jnp.power(1.0 - beta, ...)` constant bit-for-bit.
@@ -297,15 +857,16 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
         status = jnp.where(recovers, RECOVERED, status)
 
         def frac(mask):
-            return jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), axis_name)
+            return jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), axis_name) / n_live
 
         stats = {
             "messages": jax.lax.psum(
                 jnp.sum(jnp.where(infected, out_degree_b, 0)), axis_name
             ),
-            "s": frac((status == SUSCEPTIBLE) & node_mask_b),
-            "i": frac((status == INFECTED) & node_mask_b),
-            "r": frac((status == RECOVERED) & node_mask_b),
+            "s_frac": frac((status == SUSCEPTIBLE) & node_mask_b),
+            "i_frac": frac((status == INFECTED) & node_mask_b),
+            "r_frac": frac((status == RECOVERED) & node_mask_b),
+            "coverage": frac((status != SUSCEPTIBLE) & node_mask_b),
         }
         return status, stats
 
@@ -321,7 +882,7 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
-        in_specs=(spec,) * 6 + (P(), P(), P()),
+        in_specs=(spec,) * 9 + (P(), P(), P()),
         out_specs=(spec, P()),
     )
     return jax.jit(fn)
@@ -341,22 +902,17 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
     status0 = (
         jnp.zeros((S, block), dtype=jnp.int32)
         .at[source // block, source % block].set(1)
-    )
+    ) * sg.node_mask  # dead source seeds nothing (SIR.init parity)
     # engine.run's schedule: one subkey per round off fold_in(key, 1).
     round_keys = jax.random.key_data(
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
     fn = _sir_fn(mesh, axis_name, S, block, rounds, bool(exact_rng))
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     status, stats = fn(
-        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, sg.node_mask, sg.out_degree,
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        sg.node_mask, sg.out_degree,
         status0, round_keys,
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
     )
-    n_real = max(sg.n_nodes, 1)
-    return status, {
-        "messages": stats["messages"],
-        "s_frac": stats["s"].astype(jnp.float32) / n_real,
-        "i_frac": stats["i"].astype(jnp.float32) / n_real,
-        "r_frac": stats["r"].astype(jnp.float32) / n_real,
-        "coverage": (n_real - stats["s"]).astype(jnp.float32) / n_real,
-    }
+    return status, stats

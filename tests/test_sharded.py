@@ -129,6 +129,240 @@ def test_sharded_sir_scalable_rng_is_plausible():
     assert float(np.asarray(stats["coverage"])[-1]) > 0.5
 
 
+class TestShardedChurn:
+    """Failures and runtime links on the SHARDED representation — the same
+    no-recompile mask flips as sim/failures.py / sim/topology.py, parity-
+    tested bit-exact against the single-device engine."""
+
+    def test_fail_nodes_matches_single_device(self):
+        from p2pnetwork_tpu.sim import failures
+
+        g = G.watts_strogatz(512, 6, 0.2, seed=0)
+        mesh = M.ring_mesh(8)
+        sg0 = sharded.shard_graph(g, mesh)
+        # Empty failure set (a computed churn set can be empty) is a no-op,
+        # like the sim counterpart — regression: float64 scatter indices.
+        np.testing.assert_array_equal(
+            np.asarray(sharded.fail_nodes(sg0, []).node_mask),
+            np.asarray(sg0.node_mask),
+        )
+        sg = sharded.fail_nodes(sg0, [3, 200, 400])
+        gf = failures.fail_nodes(g, [3, 200, 400])
+        rounds = 6
+
+        seen_sh, stats_sh = sharded.flood(sg, mesh, source=0, rounds=rounds)
+        ref_state, ref_stats = engine.run(gf, Flood(source=0), jax.random.key(0), rounds)
+        assert (
+            np.asarray(seen_sh).reshape(-1)[: g.n_nodes]
+            == np.asarray(ref_state.seen)[: g.n_nodes]
+        ).all()
+        np.testing.assert_array_equal(
+            np.asarray(stats_sh["messages"]), np.asarray(ref_stats["messages"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats_sh["coverage"]), np.asarray(ref_stats["coverage"]),
+            rtol=1e-6,
+        )
+
+    def test_random_failures_bit_identical(self):
+        from p2pnetwork_tpu.sim import failures
+
+        # 1024 = 8 * 128: S*block == n_pad, so the failure draw is the
+        # same bernoulli mask as the single-device path.
+        g = G.watts_strogatz(1024, 6, 0.2, seed=1)
+        mesh = M.ring_mesh(8)
+        key = jax.random.key(42)
+        sg = sharded.random_node_failures(sharded.shard_graph(g, mesh), key, 0.3)
+        gf = failures.random_node_failures(g, key, 0.3)
+        np.testing.assert_array_equal(
+            np.asarray(sg.node_mask).reshape(-1), np.asarray(gf.node_mask)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sg.out_degree).reshape(-1), np.asarray(gf.out_degree)
+        )
+        seen_sh, _ = sharded.flood(sg, mesh, source=0, rounds=5)
+        ref_state, _ = engine.run(gf, Flood(source=0), jax.random.key(0), 5)
+        assert (
+            np.asarray(seen_sh).reshape(-1)[: g.n_nodes]
+            == np.asarray(ref_state.seen)[: g.n_nodes]
+        ).all()
+
+    def test_connect_matches_single_device(self):
+        from p2pnetwork_tpu.sim import topology
+
+        g = G.watts_strogatz(512, 4, 0.1, seed=2)
+        mesh = M.ring_mesh(8)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 16)
+        sg = sharded.connect(sg, [10, 77], [400, 205])
+
+        gc = topology.with_capacity(g, extra_edges=16)
+        gc = topology.connect(gc, [10, 77], [400, 205])
+
+        np.testing.assert_array_equal(
+            np.asarray(sg.out_degree).reshape(-1), np.asarray(gc.out_degree)
+        )
+        rounds = 6
+        seen_sh, stats_sh = sharded.flood(sg, mesh, source=0, rounds=rounds)
+        ref_state, ref_stats = engine.run(gc, Flood(source=0), jax.random.key(0), rounds)
+        assert (
+            np.asarray(seen_sh).reshape(-1)[: g.n_nodes]
+            == np.asarray(ref_state.seen)[: g.n_nodes]
+        ).all()
+        np.testing.assert_array_equal(
+            np.asarray(stats_sh["messages"]), np.asarray(ref_stats["messages"])
+        )
+
+    def test_connect_bridges_partition(self):
+        # The reference's identity: topology mutation on a LIVE network
+        # [ref: p2pnetwork/node.py:122]. A partitioned ring stalls the
+        # flood; a runtime connect bridges it — with the same compiled
+        # program (shapes unchanged).
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.fail_nodes(sharded.shard_graph(g, mesh), [64, 192])
+        seen, _ = sharded.flood(sg, mesh, source=0, rounds=128)
+        flat = np.asarray(seen).reshape(-1)
+        assert not flat[65:192].any()  # far side unreachable
+        sg = sharded.with_capacity(sg, 8)
+        sg = sharded.connect(sg, [32], [128])
+        seen2, _ = sharded.flood(sg, mesh, source=0, rounds=128)
+        flat2 = np.asarray(seen2).reshape(-1)[:256]
+        alive = np.asarray(sg.node_mask).reshape(-1)[:256]
+        assert (flat2 | ~alive).all()
+
+    def test_connect_duplicate_is_noop(self):
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 8)
+        sg = sharded.connect(sg, [0], [100])
+        before = int(np.asarray(sg.dyn_mask).sum())
+        assert before == 2  # both directions
+        sg2 = sharded.connect(sg, [0, 0], [100, 1])  # dup pair + static edge
+        assert int(np.asarray(sg2.dyn_mask).sum()) == before
+        np.testing.assert_array_equal(
+            np.asarray(sg2.out_degree), np.asarray(sg.out_degree)
+        )
+
+    def test_disconnect(self):
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 8)
+        sg = sharded.connect(sg, [0, 5], [100, 150])
+        sg = sharded.disconnect(sg, [0, 0], [100, 100])  # dup query: once
+        assert int(np.asarray(sg.dyn_mask).sum()) == 2  # 5<->150 survives
+        out = np.asarray(sg.out_degree).reshape(-1)
+        assert out[0] == 2 and out[100] == 2  # back to ring degrees
+        assert out[5] == 3 and out[150] == 3
+
+    def test_failures_kill_dynamic_links(self):
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 8)
+        sg = sharded.connect(sg, [0], [100])
+        sg = sharded.fail_nodes(sg, [0])
+        gc = topology.connect(topology.with_capacity(g, extra_edges=8), [0], [100])
+        gc = failures.fail_nodes(gc, [0])
+        np.testing.assert_array_equal(
+            np.asarray(sg.out_degree).reshape(-1), np.asarray(gc.out_degree)
+        )
+        seen, _ = sharded.flood(sg, mesh, source=100, rounds=4)
+        ref, _ = engine.run(gc, Flood(source=100), jax.random.key(0), 4)
+        assert (
+            np.asarray(seen).reshape(-1)[:256] == np.asarray(ref.seen)[:256]
+        ).all()
+
+    def test_sir_under_churn_exact_parity(self):
+        from p2pnetwork_tpu.models import SIR
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=3)
+        mesh = M.ring_mesh(8)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 16)
+        sg = sharded.fail_nodes(sg, [9, 500])
+        sg = sharded.connect(sg, [4], [900])
+
+        gc = topology.with_capacity(g, extra_edges=16)
+        gc = failures.fail_nodes(gc, [9, 500])
+        gc = topology.connect(gc, [4], [900])
+
+        proto = SIR(beta=0.4, gamma=0.15, source=3, method="segment")
+        status_sh, stats_sh = sharded.sir(
+            sg, mesh, proto, jax.random.key(7), 8, exact_rng=True
+        )
+        ref_state, ref_stats = engine.run(gc, proto, jax.random.key(7), 8)
+        np.testing.assert_array_equal(
+            np.asarray(status_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.status)[: g.n_nodes],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stats_sh["messages"]), np.asarray(ref_stats["messages"])
+        )
+        for k in ("s_frac", "i_frac", "r_frac", "coverage"):
+            np.testing.assert_allclose(
+                np.asarray(stats_sh[k]), np.asarray(ref_stats[k]), rtol=1e-6
+            )
+
+    def test_shard_graph_consolidates_dynamic_edges(self):
+        # Re-sharding a churned Graph is the documented consolidation path:
+        # runtime links fold into the static buckets losslessly.
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(G.ring(256), extra_edges=8)
+        g = topology.connect(g, [0], [128])
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        assert int(np.asarray(sg.bkt_mask).sum()) == g.n_edges + 2
+        seen, _ = sharded.flood(sg, mesh, source=0, rounds=3)
+        ref, _ = engine.run(g, Flood(source=0), jax.random.key(0), 3)
+        assert (
+            np.asarray(seen).reshape(-1)[:256] == np.asarray(ref.seen)[:256]
+        ).all()
+
+
+class TestShardedCoverage:
+    def test_until_coverage_matches_engine(self):
+        g = G.watts_strogatz(512, 6, 0.2, seed=0)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        seen, out = sharded.flood_until_coverage(sg, mesh, source=0)
+        ref_state, ref_out = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0)
+        )
+        assert int(np.asarray(out["rounds"])) == int(np.asarray(ref_out["rounds"]))
+        assert out["messages"] == ref_out["messages"]
+        np.testing.assert_allclose(
+            float(np.asarray(out["coverage"])),
+            float(np.asarray(ref_out["coverage"])), rtol=1e-6,
+        )
+        assert (
+            np.asarray(seen).reshape(-1)[: g.n_nodes]
+            == np.asarray(ref_state.seen)[: g.n_nodes]
+        ).all()
+
+    def test_until_coverage_under_churn(self):
+        from p2pnetwork_tpu.sim import failures
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=5)
+        mesh = M.ring_mesh(8)
+        key = jax.random.key(11)
+        sg = sharded.random_node_failures(sharded.shard_graph(g, mesh), key, 0.2)
+        gf = failures.random_node_failures(g, key, 0.2)
+        _, out = sharded.flood_until_coverage(sg, mesh, source=0)
+        _, ref_out = engine.run_until_coverage(gf, Flood(source=0), jax.random.key(0))
+        assert int(np.asarray(out["rounds"])) == int(np.asarray(ref_out["rounds"]))
+        assert out["messages"] == ref_out["messages"]
+
+    def test_max_rounds_cap(self):
+        g = G.ring(256)  # diameter 128: can't reach 99% in 3 rounds
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        _, out = sharded.flood_until_coverage(sg, mesh, source=0, max_rounds=3)
+        assert int(np.asarray(out["rounds"])) == 3
+        assert float(np.asarray(out["coverage"])) < 0.99
+
+
 class TestAutoSharding:
     @pytest.mark.parametrize("protocol_name", ["flood", "sir", "gossip"])
     def test_auto_matches_single_device(self, protocol_name):
