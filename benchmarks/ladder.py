@@ -192,6 +192,39 @@ def bench_flood_big(n, label):
     })
 
 
+def bench_gossip_sharded():
+    """Sharded (ring ppermute) gossip on every available device — the
+    multi-chip path of configs[2]; on one chip this measures the S=1 ring
+    overhead vs the single-device entry above."""
+    import jax
+
+    from p2pnetwork_tpu.models import Gossip
+    from p2pnetwork_tpu.parallel import mesh as M
+    from p2pnetwork_tpu.parallel import sharded
+    from p2pnetwork_tpu.sim import graph as G
+
+    n_dev = len(jax.devices())
+    mesh = M.ring_mesh(n_dev)
+    g = G.barabasi_albert(100_000, 4, seed=0, max_degree=128)
+    sg = sharded.shard_graph(g, mesh)
+    p = Gossip(alpha=0.5)
+    key = jax.random.key(0)
+    rounds = 30
+    vals, stats = sharded.gossip(sg, mesh, p, key, rounds)
+    _ = _sync(stats["variance"][-1])  # warm
+    t0 = time.perf_counter()
+    vals, stats = sharded.gossip(sg, mesh, p, key, rounds)
+    var_end = _sync(stats["variance"][-1])
+    secs = time.perf_counter() - t0
+    emit({
+        "config": f"100K BA push-pull gossip, sharded ring ({n_dev} dev, 30 rounds)",
+        "value": round(rounds * g.n_nodes / secs / 1e6, 1),
+        "unit": "M node-updates/s",
+        "wall_s": round(secs, 4),
+        "variance_end": round(var_end, 6),
+    })
+
+
 def bench_churn_connect():
     """Runtime connect cost vs graph size: the membership probe is a
     searchsorted window scan (sim/topology.py), so a connect batch should
@@ -236,6 +269,7 @@ def main():
     bench_sockets_anchor()
     bench_flood_1k()
     bench_gossip_100k()
+    bench_gossip_sharded()
     bench_sir_1m()
     bench_churn_connect()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")

@@ -76,12 +76,18 @@ class ShardedGraph:
     bkt_mask: jax.Array  # bool[S, S, E_bkt]
     node_mask: jax.Array  # bool[S, B]
     out_degree: jax.Array  # i32[S, B]
+    in_degree: jax.Array  # i32[S, B]
     n_nodes: int = dataclasses.field(metadata=dict(static=True))
     n_shards: int = dataclasses.field(metadata=dict(static=True))
     block: int = dataclasses.field(metadata=dict(static=True))
     dyn_src: Optional[jax.Array] = None  # i32[S, S, K]
     dyn_dst: Optional[jax.Array] = None  # i32[S, S, K]
     dyn_mask: Optional[jax.Array] = None  # bool[S, S, K]
+    # Partner-sampling table for Gossip: GLOBAL neighbor ids per node
+    # (present when the source Graph carried a neighbor table). The mask is
+    # re-masked by liveness, like the single-device table.
+    neighbors: Optional[jax.Array] = None  # i32[S, B, W]
+    neighbors_mask: Optional[jax.Array] = None  # bool[S, B, W]
 
     @property
     def n_nodes_padded(self) -> int:
@@ -158,10 +164,16 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
             bkt_dst[d, t, :n] = receivers[lo:hi] % block
             bkt_mask[d, t, :n] = True
 
-    node_mask = np.asarray(graph.node_mask)
-    node_mask = np.pad(node_mask, (0, S * block - node_mask.shape[0]))
-    out_degree = np.asarray(graph.out_degree)
-    out_degree = np.pad(out_degree, (0, S * block - out_degree.shape[0]))
+    pad_n = S * block - graph.n_nodes_padded
+    node_mask = np.pad(np.asarray(graph.node_mask), (0, pad_n))
+    out_degree = np.pad(np.asarray(graph.out_degree), (0, pad_n))
+    in_degree = np.pad(np.asarray(graph.in_degree), (0, pad_n))
+    neighbors = neighbors_mask = None
+    if graph.neighbors is not None:
+        neighbors = np.pad(np.asarray(graph.neighbors), ((0, pad_n), (0, 0)))
+        neighbors_mask = np.pad(
+            np.asarray(graph.neighbor_mask), ((0, pad_n), (0, 0))
+        )
 
     shard = NamedSharding(mesh, P(axis_name))
     dev = lambda x: jax.device_put(x, shard)  # noqa: E731
@@ -171,9 +183,16 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         bkt_mask=dev(bkt_mask),
         node_mask=dev(node_mask.reshape(S, block)),
         out_degree=dev(out_degree.reshape(S, block).astype(np.int32)),
+        in_degree=dev(in_degree.reshape(S, block).astype(np.int32)),
         n_nodes=graph.n_nodes,
         n_shards=S,
         block=block,
+        neighbors=None if neighbors is None else dev(
+            neighbors.reshape(S, block, -1)
+        ),
+        neighbors_mask=None if neighbors_mask is None else dev(
+            neighbors_mask.reshape(S, block, -1)
+        ),
     )
 
 
@@ -216,7 +235,7 @@ def _mesh_of(sg: ShardedGraph) -> Mesh:
 
 def _remask_body(axis_name, S, block,
                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                 node_mask, alive):
+                 neighbors, neighbors_mask, node_mask, alive):
     """Per-shard liveness re-mask: an edge survives iff both endpoints do.
 
     Runs under shard_map. The source block of bucket ``t`` is the block
@@ -237,7 +256,8 @@ def _remask_body(axis_name, S, block,
 
     def remask_group(src, dst, mask):  # [S, W] each
         if src.shape[-1] == 0:
-            return mask, jnp.zeros((S, block), jnp.int32)
+            zero = jnp.zeros((S, block), jnp.int32)
+            return mask, zero, zero[0]
         src_alive = jnp.take_along_axis(masks_by_t, src, axis=1)
         dst_alive = nm[dst]
         mask = mask & src_alive & dst_alive
@@ -246,11 +266,18 @@ def _remask_body(axis_name, S, block,
                 m.astype(jnp.int32), s, num_segments=block
             )
         )(mask, src)  # [S_t, B] — counts for the sender block of each step
-        return mask, cnt
+        # In-degrees are local: every bucket's receivers are this shard's.
+        cnt_in = jax.vmap(
+            lambda m, r: jax.ops.segment_sum(
+                m.astype(jnp.int32), r, num_segments=block
+            )
+        )(mask, dst).sum(axis=0)  # [B]
+        return mask, cnt, cnt_in
 
-    bkt_mask_b, cnt_s = remask_group(bkt_src[0], bkt_dst[0], bkt_mask[0])
-    dyn_mask_b, cnt_d = remask_group(dyn_src[0], dyn_dst[0], dyn_mask[0])
+    bkt_mask_b, cnt_s, in_s = remask_group(bkt_src[0], bkt_dst[0], bkt_mask[0])
+    dyn_mask_b, cnt_d, in_d = remask_group(dyn_src[0], dyn_dst[0], dyn_mask[0])
     cnt = cnt_s + cnt_d  # [S_t, B]
+    in_degree = in_s + in_d  # [B]
 
     # Horner: acc <- cnt_t + rot_back(acc), t = S-1 .. 0, where rot_back
     # moves each block one shard backward along the ring.
@@ -264,7 +291,22 @@ def _remask_body(axis_name, S, block,
                                      reverse=True)
     else:
         out_degree = cnt[0]
-    return bkt_mask_b[None], dyn_mask_b[None], nm[None], out_degree[None]
+
+    # Partner-table re-mask (mirrors sim/failures.py's
+    # `neighbor_mask & node_mask[:, None] & node_mask[neighbors]`): the
+    # neighbor ids are global, so their liveness comes from the collected
+    # ring blocks — neighbor p lives on shard p // block, resident at ring
+    # step (my - p // block) mod S.
+    my = jax.lax.axis_index(axis_name)
+    if neighbors.shape[-1] > 0:
+        p_shard = neighbors[0] // block  # [B, W]
+        p_local = neighbors[0] % block
+        nbr_alive = masks_by_t[(my - p_shard) % S, p_local]
+        nbr_mask = neighbors_mask[0] & nm[:, None] & nbr_alive
+    else:
+        nbr_mask = neighbors_mask[0]
+    return (bkt_mask_b[None], dyn_mask_b[None], nm[None], out_degree[None],
+            in_degree[None], nbr_mask[None])
 
 
 @functools.lru_cache(maxsize=64)
@@ -273,8 +315,8 @@ def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     spec = P(axis_name)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec,) * 8,
-        out_specs=(spec,) * 4,
+        in_specs=(spec,) * 10,
+        out_specs=(spec,) * 6,
     )
     return jax.jit(fn)
 
@@ -291,17 +333,25 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
     alive = jnp.asarray(alive).reshape(sg.n_shards, sg.block)
     mesh = _mesh_of(sg)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    if sg.neighbors is not None:
+        neighbors, neighbors_mask = sg.neighbors, sg.neighbors_mask
+    else:
+        neighbors = jnp.zeros((sg.n_shards, sg.block, 0), jnp.int32)
+        neighbors_mask = jnp.zeros((sg.n_shards, sg.block, 0), bool)
     fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block)
-    bkt_mask, dyn_mask, node_mask, out_degree = fn(
+    bkt_mask, dyn_mask, node_mask, out_degree, in_degree, nbr_mask = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, sg.node_mask, alive,
+        dyn_src, dyn_dst, dyn_mask, neighbors, neighbors_mask,
+        sg.node_mask, alive,
     )
     return dataclasses.replace(
         sg,
         bkt_mask=bkt_mask,
         node_mask=node_mask,
         out_degree=out_degree,
+        in_degree=in_degree,
         dyn_mask=dyn_mask if sg.dyn_mask is not None else None,
+        neighbors_mask=nbr_mask if sg.neighbors_mask is not None else None,
     )
 
 
@@ -379,20 +429,23 @@ def _member_fn(mesh: Mesh, axis_name: str, S: int):
 
 
 def _scatter_body(axis_name, S, block,
-                  dyn_src, dyn_dst, dyn_mask, out_degree,
+                  dyn_src, dyn_dst, dyn_mask, out_degree, in_degree,
                   d, t, k, sl, rl):
     """Write new dynamic edges into the owning shard's bucket slots and bump
-    the sender shard's out-degrees. Non-owned queries route to an
-    out-of-bounds row and are dropped by the scatter."""
+    the sender shard's out-degrees / receiver shard's in-degrees. Non-owned
+    queries route to an out-of-bounds row and are dropped by the scatter."""
     my = jax.lax.axis_index(axis_name)
-    tt = jnp.where(d == my, t, S)  # OOB row -> dropped
+    mine = d == my
+    tt = jnp.where(mine, t, S)  # OOB row -> dropped
     ds = dyn_src[0].at[tt, k].set(sl, mode="drop")
     dd = dyn_dst[0].at[tt, k].set(rl, mode="drop")
     dm = dyn_mask[0].at[tt, k].set(True, mode="drop")
     sender_mine = ((d - t) % S == my) & (d < S)
     bb = jnp.where(sender_mine, sl, block)  # OOB -> dropped
     od = out_degree[0].at[bb].add(1, mode="drop")
-    return ds[None], dd[None], dm[None], od[None]
+    ii = jnp.where(mine, rl, block)
+    ideg = in_degree[0].at[ii].add(1, mode="drop")
+    return ds[None], dd[None], dm[None], od[None], ideg[None]
 
 
 @functools.lru_cache(maxsize=64)
@@ -401,8 +454,8 @@ def _scatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     spec = P(axis_name)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec,) * 4 + (P(),) * 5,
-        out_specs=(spec,) * 4,
+        in_specs=(spec,) * 5 + (P(),) * 5,
+        out_specs=(spec,) * 5,
     )
     return jax.jit(fn)
 
@@ -474,19 +527,22 @@ def connect(sg: ShardedGraph, senders, receivers, *,
         dmask[d[i], t[i], free[0]] = True
 
     dp, tp, kp, slp, rlp = _pad_queries(S, d, t, slots, sl, rl)
-    dyn_src, dyn_dst, dyn_mask, out_degree = _scatter_fn(mesh, axis, S, B)(
-        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree,
+    dyn_src, dyn_dst, dyn_mask, out_degree, in_degree = _scatter_fn(
+        mesh, axis, S, B
+    )(
+        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree, sg.in_degree,
         jnp.asarray(dp), jnp.asarray(tp), jnp.asarray(kp),
         jnp.asarray(slp), jnp.asarray(rlp),
     )
     return dataclasses.replace(
         sg, dyn_src=dyn_src, dyn_dst=dyn_dst, dyn_mask=dyn_mask,
-        out_degree=out_degree,
+        out_degree=out_degree, in_degree=in_degree,
     )
 
 
 def _unscatter_body(axis_name, S, block,
-                    dyn_src, dyn_dst, dyn_mask, out_degree, d, t, sl, rl):
+                    dyn_src, dyn_dst, dyn_mask, out_degree, in_degree,
+                    d, t, sl, rl):
     """Clear matching dynamic edges on the owning shard; psum the removal
     verdicts so the sender's shard can decrement its out-degrees."""
     my = jax.lax.axis_index(axis_name)
@@ -502,7 +558,9 @@ def _unscatter_body(axis_name, S, block,
     sender_mine = ((d - t) % S == my) & (d < S)
     bb = jnp.where(sender_mine, sl, block)
     od = out_degree[0].at[bb].add(-removed, mode="drop")
-    return dm[None], od[None]
+    ii = jnp.where(mine, rl, block)
+    ideg = in_degree[0].at[ii].add(-removed, mode="drop")
+    return dm[None], od[None], ideg[None]
 
 
 @functools.lru_cache(maxsize=64)
@@ -511,8 +569,8 @@ def _unscatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     spec = P(axis_name)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec,) * 4 + (P(),) * 4,
-        out_specs=(spec,) * 2,
+        in_specs=(spec,) * 5 + (P(),) * 4,
+        out_specs=(spec,) * 3,
     )
     return jax.jit(fn)
 
@@ -537,11 +595,14 @@ def disconnect(sg: ShardedGraph, senders, receivers, *,
     sl = (s % B).astype(np.int32)
     rl = (r % B).astype(np.int32)
     dp, tp, slp, rlp = _pad_queries(S, d, t, sl, rl)
-    dyn_mask, out_degree = _unscatter_fn(mesh, mesh.axis_names[0], S, B)(
-        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree,
+    dyn_mask, out_degree, in_degree = _unscatter_fn(
+        mesh, mesh.axis_names[0], S, B
+    )(
+        sg.dyn_src, sg.dyn_dst, sg.dyn_mask, sg.out_degree, sg.in_degree,
         jnp.asarray(dp), jnp.asarray(tp), jnp.asarray(slp), jnp.asarray(rlp),
     )
-    return dataclasses.replace(sg, dyn_mask=dyn_mask, out_degree=out_degree)
+    return dataclasses.replace(sg, dyn_mask=dyn_mask, out_degree=out_degree,
+                               in_degree=in_degree)
 
 
 # --------------------------------------------------------------- ring pass
@@ -794,6 +855,139 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
         "coverage": coverage,
         "messages": accum.value((hi, lo)),
     }
+
+
+# ------------------------------------------------------------------- gossip
+
+
+def _ring_rounds_gossip(axis_name, S, block, exact_rng,
+                        neighbors, neighbors_mask, node_mask,
+                        values0, round_keys, alpha, rounds):
+    """Per-shard body: ``rounds`` push-pull gossip rounds (models/gossip.py).
+
+    Each node samples one incoming neighbor — the k-th VALID slot of its
+    (liveness-re-masked) table row, matching the engine's draw — and pulls
+    that neighbor's value over the ring: at ring step ``t`` the resident
+    value block belongs to shard ``(my - t) mod S``, and each node whose
+    partner lives there grabs its value — every node matches exactly one
+    step, so the accumulated sum IS the pulled value. ``exact_rng=True``
+    reproduces the engine's full-population draw bit-for-bit (verification
+    mode, O(N) per shard).
+    """
+    nbrs = neighbors[0]  # [B, W] global ids
+    nmask = neighbors_mask[0]
+    nm = node_mask[0]
+    my = jax.lax.axis_index(axis_name)
+    count = jnp.sum(nmask, axis=1)
+    has_neighbor = (count > 0) & nm
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(nm.astype(jnp.int32)), axis_name), 1
+    )
+    csum = jnp.cumsum(nmask, axis=1)
+
+    def draw_u(key):
+        if exact_rng:
+            full = jax.random.randint(key, (S * block,), 0,
+                                      jnp.int32(2**31 - 1))
+            return jax.lax.dynamic_slice(full, (my * block,), (block,))
+        return jax.random.randint(jax.random.fold_in(key, my), (block,),
+                                  0, jnp.int32(2**31 - 1))
+
+    def one_round(values, rkey):
+        key = jax.random.wrap_key_data(rkey)
+        k = draw_u(key) % jnp.maximum(count, 1)
+        slot = jnp.argmax((csum == (k + 1)[:, None]) & nmask, axis=1)
+        partner = jnp.take_along_axis(nbrs, slot[:, None], axis=1)[:, 0]
+        p_shard = partner // block
+        p_local = partner % block
+
+        acc0 = jax.lax.pcast(
+            jnp.zeros((block,), values.dtype), (axis_name,), to="varying"
+        )
+
+        def ring_step(rc, t):
+            rot, acc = rc
+            resident = (my - t) % S
+            acc = acc + jnp.where(p_shard == resident, rot[p_local], 0.0)
+            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
+            return (rot, acc), None
+
+        if S > 1:
+            (rot, pulled), _ = jax.lax.scan(
+                ring_step, (values, acc0), jnp.arange(S - 1)
+            )
+        else:
+            rot, pulled = values, acc0
+        resident = (my - (S - 1)) % S
+        pulled = pulled + jnp.where(p_shard == resident, rot[p_local], 0.0)
+
+        mixed = (1.0 - alpha) * values + alpha * pulled
+        values = jnp.where(has_neighbor, mixed, values)
+
+        masked = values * nm
+        mean = jax.lax.psum(jnp.sum(masked), axis_name) / n_live
+        var = jax.lax.psum(
+            jnp.sum(jnp.where(nm, (values - mean) ** 2, 0.0)), axis_name
+        ) / n_live
+        stats = {
+            "messages": 2 * jax.lax.psum(
+                jnp.sum(has_neighbor.astype(jnp.int32)), axis_name
+            ),
+            "variance": var,
+            "mean": mean,
+        }
+        return values, stats
+
+    values, stats = jax.lax.scan(one_round, values0[0], round_keys)
+    return values[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+               exact_rng: bool):
+    body = functools.partial(_ring_rounds_gossip, axis_name, S, block,
+                             exact_rng)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh,
+        in_specs=(spec,) * 4 + (P(), P()),
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
+           rounds: int, axis_name: str = DEFAULT_AXIS,
+           exact_rng: bool = False):
+    """Run ``rounds`` of push-pull gossip averaging (models/gossip.py) on
+    the sharded graph — randomized consensus, the second protocol family
+    reference users build on ``node_message`` [ref: README.md:20].
+
+    Returns ``(values [S, block] f32, stats dict of [rounds] arrays)``. The
+    init draw and per-round key schedule match ``engine.run``'s, so with
+    ``exact_rng=True`` and ``S*block == n_pad`` the values are bit-identical
+    to the single-device engine (tests/test_sharded.py).
+    """
+    if sg.neighbors is None:
+        raise ValueError(
+            "sharded gossip needs a partner table: shard a graph built "
+            "with a neighbor table (from_edges build_neighbor_table=True)"
+        )
+    S, block = sg.n_shards, sg.block
+    # Gossip.init parity: values = normal(key, (n_pad,)) * node_mask. The
+    # sharded layout may pad beyond n_pad; extra rows are dead (masked).
+    vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
+    values0 = vals.reshape(S, block) * sg.node_mask
+    round_keys = jax.random.key_data(
+        jax.random.split(jax.random.fold_in(key, 1), rounds)
+    )
+    fn = _gossip_fn(mesh, axis_name, S, block, rounds, bool(exact_rng))
+    values, stats = fn(
+        sg.neighbors, sg.neighbors_mask, sg.node_mask, values0,
+        round_keys, jnp.float32(protocol.alpha),
+    )
+    return values, stats
 
 
 # ---------------------------------------------------------------------- SIR

@@ -321,6 +321,98 @@ class TestShardedChurn:
         ).all()
 
 
+class TestShardedGossip:
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_matches_single_device(self, n_shards):
+        from p2pnetwork_tpu.models import Gossip
+
+        # 1024 = 8 * 128: S*block == n_pad, so exact_rng reproduces the
+        # engine's init draw and slot draws bit-for-bit.
+        g = G.barabasi_albert(1024, 3, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        proto = Gossip(alpha=0.5)
+        rounds = 6
+
+        vals_sh, stats_sh = sharded.gossip(
+            sg, mesh, proto, jax.random.key(5), rounds, exact_rng=True
+        )
+        ref_state, ref_stats = engine.run(g, proto, jax.random.key(5), rounds)
+        np.testing.assert_array_equal(
+            np.asarray(vals_sh).reshape(-1)[: g.n_nodes_padded],
+            np.asarray(ref_state.values),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stats_sh["messages"]), np.asarray(ref_stats["messages"])
+        )
+        for k in ("variance", "mean"):
+            np.testing.assert_allclose(
+                np.asarray(stats_sh[k]), np.asarray(ref_stats[k]),
+                rtol=1e-4, atol=1e-6,
+            )
+
+    def test_under_failures_matches_single_device(self):
+        from p2pnetwork_tpu.models import Gossip
+        from p2pnetwork_tpu.sim import failures
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=2)
+        mesh = M.ring_mesh(8)
+        key = jax.random.key(9)
+        sg = sharded.random_node_failures(sharded.shard_graph(g, mesh), key, 0.25)
+        gf = failures.random_node_failures(g, key, 0.25)
+        np.testing.assert_array_equal(
+            np.asarray(sg.in_degree).reshape(-1), np.asarray(gf.in_degree)
+        )
+        vals_sh, _ = sharded.gossip(
+            sg, mesh, Gossip(alpha=0.5), jax.random.key(1), 5, exact_rng=True
+        )
+        ref_state, _ = engine.run(gf, Gossip(alpha=0.5), jax.random.key(1), 5)
+        np.testing.assert_array_equal(
+            np.asarray(vals_sh).reshape(-1), np.asarray(ref_state.values)
+        )
+
+    def test_after_connect_matches_single_device(self):
+        # Regression: connect bumps in_degree but not the stored table; the
+        # old min(in_degree, width) sampling window then hit padding slots
+        # (node id 0) after a runtime connect. Sampling the k-th VALID slot
+        # keeps both paths exact and garbage-free.
+        from p2pnetwork_tpu.models import Gossip
+        from p2pnetwork_tpu.sim import topology
+
+        g = G.barabasi_albert(1024, 3, seed=0)
+        mesh = M.ring_mesh(8)
+        sg = sharded.with_capacity(sharded.shard_graph(g, mesh), 8)
+        sg = sharded.connect(sg, [10], [900])
+        gc = topology.connect(topology.with_capacity(g, extra_edges=8), [10], [900])
+        vals_sh, _ = sharded.gossip(
+            sg, mesh, Gossip(alpha=0.5), jax.random.key(3), 5, exact_rng=True
+        )
+        ref_state, _ = engine.run(gc, Gossip(alpha=0.5), jax.random.key(3), 5)
+        np.testing.assert_array_equal(
+            np.asarray(vals_sh).reshape(-1), np.asarray(ref_state.values)
+        )
+
+    def test_scalable_rng_converges(self):
+        from p2pnetwork_tpu.models import Gossip
+
+        g = G.barabasi_albert(1024, 4, seed=1)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        _, stats = sharded.gossip(sg, mesh, Gossip(alpha=0.5),
+                                  jax.random.key(0), 40)
+        var = np.asarray(stats["variance"])
+        assert var[-1] < var[0] / 100  # consensus forming
+
+    def test_requires_neighbor_table(self):
+        from p2pnetwork_tpu.models import Gossip
+
+        g = G.ring(256, build_neighbor_table=False)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        with pytest.raises(ValueError, match="neighbor table"):
+            sharded.gossip(sg, mesh, Gossip(), jax.random.key(0), 2)
+
+
 class TestShardedCoverage:
     def test_until_coverage_matches_engine(self):
         g = G.watts_strogatz(512, 6, 0.2, seed=0)

@@ -171,6 +171,32 @@ def test_with_capacity_extra_nodes():
     out = np.asarray(segment.propagate_or(g3, sig, "segment"))
     assert out[0]
 
+def test_gossip_after_connect_samples_only_stored_neighbors():
+    # Regression: a runtime connect bumps in_degree past the stored table
+    # row; the old prefix-window sampling then drew padding slots (node id
+    # 0 garbage). Partner draws must stay within the valid table entries.
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from p2pnetwork_tpu.models import Gossip
+
+    # Directed: node 2's only stored in-neighbor is node 1.
+    g = G.from_edges([1], [2], 8)
+    g = topology.with_capacity(g, extra_edges=8)
+    g = topology.connect(g, [3], [2], undirected=False)  # dynamic in-edge
+    assert int(np.asarray(g.in_degree)[2]) == 2  # table row still width 1
+    proto = Gossip(alpha=0.5)
+    values = jnp.zeros(g.n_nodes_padded).at[1].set(10.0).at[3].set(99.0)
+    from p2pnetwork_tpu.models.gossip import GossipState
+
+    for seed in range(5):
+        st, _ = proto.step(g, GossipState(values=values), jax.random.key(seed))
+        # Node 2 pulls from its stored neighbor (1), never the dynamic
+        # link's endpoint (3) and never padding garbage (node 0).
+        assert float(np.asarray(st.values)[2]) == 5.0  # 0.5*0 + 0.5*10
+
+
 def test_edge_exists_probe_matches_brute():
     # The searchsorted window probe must agree with the O(B*E) broadcast
     # compare it replaced, on a degree-skewed graph (BA), for a batch mixing
