@@ -860,7 +860,7 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
 # ------------------------------------------------------------------- gossip
 
 
-def _ring_rounds_gossip(axis_name, S, block, exact_rng,
+def _ring_rounds_gossip(axis_name, S, block, rng,
                         neighbors, neighbors_mask, node_mask,
                         values0, round_keys, alpha, rounds):
     """Per-shard body: ``rounds`` push-pull gossip rounds (models/gossip.py).
@@ -884,14 +884,12 @@ def _ring_rounds_gossip(axis_name, S, block, exact_rng,
         jax.lax.psum(jnp.sum(nm.astype(jnp.int32)), axis_name), 1
     )
     csum = jnp.cumsum(nmask, axis=1)
-
-    def draw_u(key):
-        if exact_rng:
-            full = jax.random.randint(key, (S * block,), 0,
-                                      jnp.int32(2**31 - 1))
-            return jax.lax.dynamic_slice(full, (my * block,), (block,))
-        return jax.random.randint(jax.random.fold_in(key, my), (block,),
-                                  0, jnp.int32(2**31 - 1))
+    draw_u = _make_draw(
+        axis_name, S, block, rng, my,
+        sample=lambda k, shape: jax.random.randint(
+            k, shape, 0, jnp.int32(2**31 - 1)
+        ),
+    )
 
     def one_round(values, rkey):
         key = jax.random.wrap_key_data(rkey)
@@ -944,9 +942,8 @@ def _ring_rounds_gossip(axis_name, S, block, exact_rng,
 
 @functools.lru_cache(maxsize=64)
 def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-               exact_rng: bool):
-    body = functools.partial(_ring_rounds_gossip, axis_name, S, block,
-                             exact_rng)
+               rng: str):
+    body = functools.partial(_ring_rounds_gossip, axis_name, S, block, rng)
     spec = P(axis_name)
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
@@ -959,7 +956,7 @@ def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
            rounds: int, axis_name: str = DEFAULT_AXIS,
-           exact_rng: bool = False):
+           exact_rng: bool = False, rng: Optional[str] = None):
     """Run ``rounds`` of push-pull gossip averaging (models/gossip.py) on
     the sharded graph — randomized consensus, the second protocol family
     reference users build on ``node_message`` [ref: README.md:20].
@@ -982,7 +979,8 @@ def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
     round_keys = jax.random.key_data(
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
-    fn = _gossip_fn(mesh, axis_name, S, block, rounds, bool(exact_rng))
+    fn = _gossip_fn(mesh, axis_name, S, block, rounds,
+                    _resolve_rng(sg, exact_rng, rng))
     values, stats = fn(
         sg.neighbors, sg.neighbors_mask, sg.node_mask, values0,
         round_keys, jnp.float32(protocol.alpha),
@@ -993,19 +991,71 @@ def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
 # ---------------------------------------------------------------------- SIR
 
 
-def _ring_rounds_sir(axis_name, S, block, exact_rng,
+#: Node tile size for the shard-count-invariant scalable RNG. One PRNG key
+#: per 128-node tile, derived from the GLOBAL tile index — each shard only
+#: generates its own tiles (O(block) work), and the draw stream does not
+#: depend on how many shards the population is split across.
+RNG_TILE = 128
+
+
+def _make_draw(axis_name, S, block, rng, my, sample=None):
+    """Per-shard random-draw function for the chosen RNG mode.
+
+    - ``"exact"``: draw the full population on every shard, slice own block
+      — O(N)/shard, bit-identical to the single-device engine (oracle mode).
+    - ``"tile"`` (scalable default): one key per global 128-node tile —
+      O(block)/shard AND invariant across shard counts, so results have a
+      cross-shard-count regression oracle. Requires ``block % 128 == 0``
+      (callers fall back to ``"fold"`` otherwise).
+    - ``"fold"``: fold the shard index into the key — cheapest, but results
+      change with the mesh size.
+
+    ``sample(key, shape)`` defaults to a [0, 1) uniform draw.
+    """
+    if sample is None:
+        sample = lambda k, shape: jax.random.uniform(k, shape)  # noqa: E731
+    if rng == "tile" and block % RNG_TILE != 0:  # pragma: no cover
+        raise ValueError("tile RNG requires block % 128 == 0")
+
+    def draw(key):
+        if rng == "exact":
+            full = sample(key, (S * block,))
+            return jax.lax.dynamic_slice(full, (my * block,), (block,))
+        if rng == "tile":
+            tiles = block // RNG_TILE
+            base = my * tiles
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(key, base + i)
+            )(jnp.arange(tiles))
+            return jax.vmap(
+                lambda k: sample(k, (RNG_TILE,))
+            )(keys).reshape(block)
+        return sample(jax.random.fold_in(key, my), (block,))
+
+    return draw
+
+
+def _resolve_rng(sg: ShardedGraph, exact_rng: bool, rng: Optional[str]) -> str:
+    if exact_rng:
+        return "exact"
+    if rng is not None:
+        if rng not in ("exact", "tile", "fold"):
+            raise ValueError(
+                f"rng must be 'exact', 'tile' or 'fold', got {rng!r}"
+            )
+        return rng
+    return "tile" if sg.block % RNG_TILE == 0 else "fold"
+
+
+def _ring_rounds_sir(axis_name, S, block, rng,
                      bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                      node_mask, out_degree,
                      status0, round_keys, one_minus_beta, gamma, rounds):
     """Per-shard body: ``rounds`` SIR rounds, infection pressure via a ring
     sum pass. ``round_keys`` is replicated raw key data [rounds, ...];
     ``beta``/``gamma`` are replicated scalars (runtime operands, so a
-    parameter sweep does not recompile per value).
-
-    ``exact_rng=True`` draws the full population's uniforms on every shard
-    and slices out this shard's block — O(N) per shard, but bit-identical to
-    the single-device engine (verification mode). ``exact_rng=False`` folds
-    the shard index into the key — O(block), the scalable default.
+    parameter sweep does not recompile per value). ``rng`` selects the
+    uniform-draw scheme — see :func:`_make_draw`.
     """
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
 
@@ -1019,12 +1069,7 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
     )
     my = jax.lax.axis_index(axis_name)
-
-    def draw(key, shape_full):
-        if exact_rng:
-            full = jax.random.uniform(key, (shape_full,))
-            return jax.lax.dynamic_slice(full, (my * block,), (block,))
-        return jax.random.uniform(jax.random.fold_in(key, my), (block,))
+    draw = _make_draw(axis_name, S, block, rng, my)
 
     def one_round(status, rkey):
         key = jax.random.wrap_key_data(rkey)
@@ -1044,8 +1089,8 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
         # one_minus_beta arrives precomputed in f64 then cast, matching the
         # engine's `jnp.power(1.0 - beta, ...)` constant bit-for-bit.
         p_infect = 1.0 - jnp.power(one_minus_beta, pressure)
-        newly_infected = susceptible & (draw(k_inf, S * block) < p_infect)
-        recovers = infected & (draw(k_rec, S * block) < gamma)
+        newly_infected = susceptible & (draw(k_inf) < p_infect)
+        recovers = infected & (draw(k_rec) < gamma)
 
         status = jnp.where(newly_infected, INFECTED, status)
         status = jnp.where(recovers, RECOVERED, status)
@@ -1070,8 +1115,8 @@ def _ring_rounds_sir(axis_name, S, block, exact_rng,
 
 @functools.lru_cache(maxsize=64)
 def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-            exact_rng: bool):
-    body = functools.partial(_ring_rounds_sir, axis_name, S, block, exact_rng)
+            rng: str):
+    body = functools.partial(_ring_rounds_sir, axis_name, S, block, rng)
     spec = P(axis_name)
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
@@ -1083,13 +1128,17 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 
 def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
-        axis_name: str = DEFAULT_AXIS, exact_rng: bool = False):
+        axis_name: str = DEFAULT_AXIS, exact_rng: bool = False,
+        rng: Optional[str] = None):
     """Run ``rounds`` of SIR (models/sir.py) on the sharded graph.
 
     Returns ``(status [S, block] i32, stats dict of [rounds] arrays)``. The
     key schedule matches ``engine.run``'s, so with ``exact_rng=True`` and a
     node count divisible by the shard count this is bit-identical to the
-    single-device engine (tests/test_sharded.py).
+    single-device engine (tests/test_sharded.py). The scalable default is
+    ``rng="tile"`` — O(block) draws that are INVARIANT across shard counts
+    (the same run on 1, 2, or 8 shards gives the same epidemic), falling
+    back to ``"fold"`` when the block size is not tile-aligned.
     """
     S, block = sg.n_shards, sg.block
     source = protocol.source
@@ -1101,7 +1150,8 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
     round_keys = jax.random.key_data(
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
-    fn = _sir_fn(mesh, axis_name, S, block, rounds, bool(exact_rng))
+    fn = _sir_fn(mesh, axis_name, S, block, rounds,
+                 _resolve_rng(sg, exact_rng, rng))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     status, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,

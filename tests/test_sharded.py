@@ -111,6 +111,64 @@ def test_sharded_sir_matches_single_device(n_shards):
         )
 
 
+class TestTileRNG:
+    """The scalable default RNG must be invariant across shard counts —
+    the regression oracle the fold_in-per-shard mode lacked: the SAME
+    population run on 1, 2, 4, or 8 shards gives the SAME epidemic."""
+
+    def test_sir_invariant_across_shard_counts(self):
+        from p2pnetwork_tpu.models import SIR
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0)
+        proto = SIR(beta=0.4, gamma=0.15, source=3, method="segment")
+        results = {}
+        for n_shards in (1, 2, 4, 8):
+            mesh = M.ring_mesh(n_shards)
+            sg = sharded.shard_graph(g, mesh)
+            assert sg.block % sharded.RNG_TILE == 0
+            status, stats = sharded.sir(sg, mesh, proto, jax.random.key(7), 8)
+            results[n_shards] = (
+                np.asarray(status).reshape(-1),
+                np.asarray(stats["coverage"]),
+            )
+        for n_shards in (2, 4, 8):
+            np.testing.assert_array_equal(
+                results[n_shards][0], results[1][0], err_msg=f"S={n_shards}"
+            )
+            np.testing.assert_array_equal(results[n_shards][1], results[1][1])
+
+    def test_gossip_invariant_across_shard_counts(self):
+        from p2pnetwork_tpu.models import Gossip
+
+        g = G.barabasi_albert(1024, 3, seed=1)
+        vals = {}
+        for n_shards in (1, 8):
+            mesh = M.ring_mesh(n_shards)
+            sg = sharded.shard_graph(g, mesh)
+            v, _ = sharded.gossip(sg, mesh, Gossip(alpha=0.5),
+                                  jax.random.key(2), 6)
+            vals[n_shards] = np.asarray(v).reshape(-1)
+        np.testing.assert_array_equal(vals[8], vals[1])
+
+    def test_fold_fallback_for_unaligned_blocks(self):
+        from p2pnetwork_tpu.models import SIR
+
+        g = G.watts_strogatz(640, 6, 0.2, seed=0)  # block 80: not tile-able
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        assert sg.block % sharded.RNG_TILE != 0  # pin: fold path exercised
+        assert sharded._resolve_rng(sg, False, None) == "fold"
+        with pytest.raises(ValueError, match="rng must be"):
+            sharded._resolve_rng(sg, False, "Tile")
+        status, stats = sharded.sir(
+            sg, mesh, SIR(beta=0.5, gamma=0.1, source=0), jax.random.key(0), 10
+        )
+        total = (np.asarray(stats["s_frac"]) + np.asarray(stats["i_frac"])
+                 + np.asarray(stats["r_frac"]))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-6)
+        assert float(np.asarray(stats["coverage"])[-1]) > 0.3
+
+
 def test_sharded_sir_scalable_rng_is_plausible():
     # The fold_in-per-shard default is not bit-identical to the engine but
     # must still produce a real epidemic: infection spreads beyond the
