@@ -1,10 +1,15 @@
 """North-star benchmark (BASELINE.json): 1M-node Watts–Strogatz single-source
 flood to 99% coverage, one chip, whole run device-side (lax.while_loop — zero
-host round-trips per round).
+host round-trips per round), plus the 10M-node scale config.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-``value`` is the wall-clock seconds of the best aggregation path;
-``vs_baseline`` is (1 s north-star target) / value, so > 1 beats the target.
+``value`` is the wall-clock seconds of the best aggregation path at 1M;
+``vs_baseline`` is (1 s north-star target) / value, so > 1 beats the target;
+``scale_10M`` carries the 10M-node result (driver-verified scale row).
+
+Every stage is wrapped: any failure — graph build included — emits an
+error-carrying JSON record instead of dying with no evidence, and a 10M
+failure cannot sink the 1M result.
 
 Reference anchor: the reference implementation moves one message per peer per
 10 ms poll tick per Python thread [ref: p2pnetwork/nodeconnection.py:220];
@@ -13,10 +18,18 @@ simulating this workload there would take hours — it publishes no numbers
 """
 
 import json
+import os
 import sys
 import time
+import traceback
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
 
 
 def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int = 5):
@@ -27,13 +40,13 @@ def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int 
     key = jax.random.key(0)
 
     def once():
+        # run_until_coverage itself blocks on a real device->host transfer
+        # of the packed run summary (engine._unpack_summary) — the sync
+        # that keeps these timings honest on tunneled backends, where
+        # jax.block_until_ready can return before execution finishes.
         state, out = engine.run_until_coverage(
             graph, protocol, key, coverage_target=target, max_rounds=max_rounds
         )
-        # Synchronize via a real host transfer: on tunneled backends
-        # jax.block_until_ready can return before execution finishes, which
-        # would make these timings dispatch-only fiction.
-        out["rounds"] = int(out["rounds"])
         return out
 
     out = once()  # compile + warm up
@@ -45,45 +58,38 @@ def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int 
     return min(times), out
 
 
-def main():
-    n = 1_000_000
-    k = 10  # 10M directed edges
-    target = 0.99
-    t_build0 = time.perf_counter()
+def bench_1m(record):
     from p2pnetwork_tpu.sim import graph as G
 
+    n, k, target = 1_000_000, 10, 0.99
+    t_build0 = time.perf_counter()
     g = G.watts_strogatz(n, k, 0.1, seed=0, blocked=True, hybrid=True)
     build_s = time.perf_counter() - t_build0
 
-    platform = jax.devices()[0].platform
     methods = ["pallas", "hybrid"]
     results = {}
     for m in methods:
         try:
             secs, out = time_flood(g, m, target=target, max_rounds=64)
             results[m] = (secs, out)
-            print(f"# {m}: {secs*1000:.1f} ms, rounds={int(out['rounds'])}, "
+            print(f"# 1M {m}: {secs*1000:.1f} ms, rounds={int(out['rounds'])}, "
                   f"coverage={float(out['coverage']):.4f}, "
-                  f"messages={int(out['messages'])}", file=sys.stderr)
+                  f"messages={int(out['messages'])}", file=sys.stderr, flush=True)
         except Exception as e:  # a path failing must not sink the bench
-            print(f"# {m}: failed: {type(e).__name__}: {e}", file=sys.stderr)
+            print(f"# 1M {m}: failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
 
     if not results:
-        print(json.dumps({"metric": "1M-node flood to 99% coverage",
-                          "value": None, "unit": "s", "vs_baseline": 0.0,
-                          "error": "all methods failed"}))
-        return 1
+        raise RuntimeError("all 1M aggregation methods failed")
 
     best_method = min(results, key=lambda m: results[m][0])
     secs, out = results[best_method]
     msgs = int(out["messages"])
-    record = {
-        "metric": "1M-node WS flood to 99% coverage (single chip)",
+    record.update({
         "value": round(secs, 6),
-        "unit": "s",
         "vs_baseline": round(1.0 / secs, 3),  # north-star target: 1 s
         "method": best_method,
-        "platform": platform,
+        "platform": jax.devices()[0].platform,
         "rounds": int(out["rounds"]),
         "coverage": round(float(out["coverage"]), 5),
         "messages": msgs,
@@ -91,7 +97,56 @@ def main():
         "graph_build_s": round(build_s, 2),
         "n_nodes": n,
         "n_edges": g.n_edges,
+    })
+
+
+def bench_10m():
+    """The scale row: 10M nodes / ~100M directed edges on ONE chip."""
+    from p2pnetwork_tpu.sim import graph as G
+
+    n = 10_000_000
+    t_build0 = time.perf_counter()
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, hybrid=True,
+                         build_neighbor_table=False)
+    build_s = time.perf_counter() - t_build0
+    print(f"# 10M graph built in {build_s:.1f}s ({g.n_edges} edges)",
+          file=sys.stderr, flush=True)
+    secs, out = time_flood(g, "hybrid", target=0.99, max_rounds=64, reps=3)
+    msgs = int(out["messages"])
+    print(f"# 10M hybrid: {secs:.3f} s, rounds={int(out['rounds'])}, "
+          f"coverage={float(out['coverage']):.4f}, messages={msgs}",
+          file=sys.stderr, flush=True)
+    return {
+        "value_s": round(secs, 4),
+        "rounds": int(out["rounds"]),
+        "coverage": round(float(out["coverage"]), 5),
+        "messages": msgs,
+        "msgs_per_sec_per_chip": round(msgs / secs, 1),
+        "graph_build_s": round(build_s, 1),
+        "n_nodes": n,
+        "n_edges": g.n_edges,
     }
+
+
+def main():
+    record = {
+        "metric": "1M-node WS flood to 99% coverage (single chip)",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        bench_1m(record)
+    except Exception as e:
+        record["error"] = f"{type(e).__name__}: {e}"
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps(record))
+        return 1
+    try:
+        record["scale_10M"] = bench_10m()
+    except Exception as e:  # the scale row must not sink the 1M result
+        record["scale_10M"] = {"error": f"{type(e).__name__}: {e}"}
+        traceback.print_exc(file=sys.stderr)
     print(json.dumps(record))
     return 0
 

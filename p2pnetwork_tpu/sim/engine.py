@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.utils import accum
@@ -57,18 +58,20 @@ def run_until_coverage(
     """Run until ``stats['coverage'] >= coverage_target`` (or max_rounds).
 
     Device-side early exit via ``lax.while_loop`` — the whole
-    run-to-99%-coverage measurement executes as one XLA program with zero
-    host synchronization per round. Returns (final_state, dict with
-    ``rounds``, ``coverage``, ``messages`` totals; ``messages`` is an exact
-    Python int — see :func:`run_until_coverage_from`).
+    run-to-99%-coverage measurement executes as one XLA program (init
+    included) with zero host synchronization per round. Returns
+    (final_state, dict with ``rounds``, ``coverage``, ``messages`` totals;
+    ``messages`` is an exact Python int — see
+    :func:`run_until_coverage_from`).
 
     Requires the protocol's stats to include ``coverage`` and ``messages``
     (e.g. models.flood.Flood).
     """
-    return run_until_coverage_from(
-        graph, protocol, protocol.init(graph, key), key,
+    state, packed = _coverage_with_init(
+        graph, protocol, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
     )
+    return state, _unpack_summary(packed)
 
 
 def run_until_coverage_from(
@@ -89,28 +92,36 @@ def run_until_coverage_from(
     ``messages`` in the returned dict is an exact Python int: the loop
     accumulates device-side in a two-limb (hi, lo) counter (utils/accum.py)
     so totals past 2^31 — routine at 10M-node scale — do not wrap int32.
+    The whole summary (rounds, coverage, both limbs) comes back in ONE
+    packed transfer — on tunneled backends every extra round trip is
+    milliseconds.
     """
-    state, rounds, coverage, hi, lo = _coverage_loop(
+    state, packed = _coverage_loop(
         graph, protocol, state0, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
     )
-    return state, {
-        "rounds": rounds,
-        "coverage": coverage,
-        "messages": accum.value((hi, lo)),
-    }
+    return state, _unpack_summary(packed)
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
-def _coverage_loop(
-    graph: Graph,
-    protocol,
-    state0,
-    key: jax.Array,
-    *,
-    coverage_target: float,
-    max_rounds: int,
-):
+def _pack_summary(rounds, coverage, hi, lo):
+    """[rounds, coverage-bits, hi, lo-bits] as one i32[4] — a single
+    device->host transfer carries the whole run summary."""
+    return jnp.stack([
+        rounds,
+        jax.lax.bitcast_convert_type(coverage, jnp.int32),
+        hi,
+        jax.lax.bitcast_convert_type(lo, jnp.int32),
+    ])
+
+
+def _unpack_summary(packed) -> Dict[str, Any]:
+    arr = np.asarray(packed)
+    coverage = float(arr[1:2].view(np.float32)[0])
+    messages = (int(arr[2]) << 32) + int(arr[3:4].view(np.uint32)[0])
+    return {"rounds": int(arr[0]), "coverage": coverage, "messages": messages}
+
+
+def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
     def cond(carry):
         _, _, rounds, coverage, _, _ = carry
         return (coverage < coverage_target) & (rounds < max_rounds)
@@ -129,4 +140,19 @@ def _coverage_loop(
     )
     init = (state0, key, jnp.int32(0), cov0, *accum.zero())
     state, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
-    return state, rounds, coverage, hi, lo
+    return state, _pack_summary(rounds, coverage, hi, lo)
+
+
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
+def _coverage_with_init(graph, protocol, key, *, coverage_target, max_rounds):
+    """init + loop in one XLA program (the fresh-run entry pays zero eager
+    dispatches — protocol.init's scatter and the seed coverage all trace)."""
+    return _coverage_body(graph, protocol, protocol.init(graph, key), key,
+                          coverage_target, max_rounds)
+
+
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
+def _coverage_loop(graph, protocol, state0, key, *, coverage_target,
+                   max_rounds):
+    return _coverage_body(graph, protocol, state0, key, coverage_target,
+                          max_rounds)
