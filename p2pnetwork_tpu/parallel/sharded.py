@@ -206,9 +206,13 @@ def with_capacity(sg: ShardedGraph, extra_edges: int) -> ShardedGraph:
     existing region preserves every runtime link."""
     K = _round_up(max(extra_edges, 1), 8)
     S = sg.n_shards
+    # Commit the region to the mesh up front — uncommitted/single-device
+    # arrays mixed with sharded operands are rejected under shard_map.
+    shard = NamedSharding(_mesh_of(sg), P(_mesh_of(sg).axis_names[0]))
     if sg.dyn_src is not None:
         grow = K
-        pad = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, grow)))  # noqa: E731
+        pad = lambda x: jax.device_put(  # noqa: E731
+            jnp.pad(x, ((0, 0), (0, 0), (0, grow))), shard)
         return dataclasses.replace(
             sg,
             dyn_src=pad(sg.dyn_src),
@@ -217,9 +221,9 @@ def with_capacity(sg: ShardedGraph, extra_edges: int) -> ShardedGraph:
         )
     return dataclasses.replace(
         sg,
-        dyn_src=jnp.zeros((S, S, K), jnp.int32),
-        dyn_dst=jnp.zeros((S, S, K), jnp.int32),
-        dyn_mask=jnp.zeros((S, S, K), bool),
+        dyn_src=jax.device_put(jnp.zeros((S, S, K), jnp.int32), shard),
+        dyn_dst=jax.device_put(jnp.zeros((S, S, K), jnp.int32), shard),
+        dyn_mask=jax.device_put(jnp.zeros((S, S, K), bool), shard),
     )
 
 
@@ -605,6 +609,80 @@ def disconnect(sg: ShardedGraph, senders, receivers, *,
                                in_degree=in_degree)
 
 
+def init_state(sg: ShardedGraph, protocol, key: jax.Array):
+    """The sharded initial state for a protocol — what ``protocol.init``
+    produces on the engine path, laid out ``[S, block]``. Flood ->
+    ``(seen, frontier)``; SIR -> ``status``; Gossip -> ``values``."""
+    from p2pnetwork_tpu.models.flood import Flood
+    from p2pnetwork_tpu.models.gossip import Gossip
+    from p2pnetwork_tpu.models.sir import SIR
+
+    S, block = sg.n_shards, sg.block
+    if isinstance(protocol, Flood):
+        seed = _flood_seed(sg, protocol.source)
+        return (seed, seed)
+    if isinstance(protocol, SIR):
+        source = protocol.source
+        return (
+            jnp.zeros((S, block), dtype=jnp.int32)
+            .at[source // block, source % block].set(1)
+        ) * sg.node_mask
+    if isinstance(protocol, Gossip):
+        vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
+        return vals.reshape(S, block) * sg.node_mask
+    raise ValueError(
+        f"the sharded path implements Flood, SIR and Gossip; got "
+        f"{type(protocol).__name__} — run it on the single-device engine "
+        f"or add a ring body for it"
+    )
+
+
+def topology_state(sg: ShardedGraph) -> dict:
+    """The sharded graph's runtime-mutable leaves as a checkpointable
+    pytree — the multi-chip mirror of sim/checkpoint.topology_state. Leaves
+    keep their shardings, so ``sim.checkpoint.save_orbax`` writes each
+    process's shards in parallel and a restore lands them back on the mesh.
+    """
+    ts = {
+        "bkt_mask": sg.bkt_mask,
+        "node_mask": sg.node_mask,
+        "out_degree": sg.out_degree,
+        "in_degree": sg.in_degree,
+    }
+    if sg.dyn_src is not None:
+        ts["dyn_src"] = sg.dyn_src
+        ts["dyn_dst"] = sg.dyn_dst
+        ts["dyn_mask"] = sg.dyn_mask
+    if sg.neighbors_mask is not None:
+        ts["neighbors_mask"] = sg.neighbors_mask
+    return ts
+
+
+def apply_topology_state(sg: ShardedGraph, ts: dict) -> ShardedGraph:
+    """Re-apply a :func:`topology_state` onto a structurally-equal sharded
+    graph (same shard count, capacity, and neighbor table presence)."""
+    expected = set(topology_state(sg).keys())
+    if expected != set(ts.keys()):
+        raise ValueError(
+            f"sharded topology state keys mismatch: checkpoint has "
+            f"{sorted(ts.keys())}, graph expects {sorted(expected)} — shard "
+            f"the same construction (capacity, neighbor table) it came from"
+        )
+    for name in expected:
+        saved, cur = np.shape(ts[name]), tuple(getattr(sg, name).shape)
+        if tuple(saved) != cur:
+            raise ValueError(
+                f"sharded topology state mismatch for {name!r}: saved shape "
+                f"{tuple(saved)}, graph has {cur}"
+            )
+    # Place every restored leaf on the graph's mesh explicitly: a leaf that
+    # came back host-side (npz) or committed to one device would otherwise
+    # be rejected when mixed with sharded operands under shard_map.
+    shard = NamedSharding(_mesh_of(sg), P(_mesh_of(sg).axis_names[0]))
+    kw = {k: jax.device_put(jnp.asarray(v), shard) for k, v in ts.items()}
+    return dataclasses.replace(sg, **kw)
+
+
 # --------------------------------------------------------------- ring pass
 
 
@@ -749,22 +827,33 @@ def _flood_seed(sg: ShardedGraph, source: int):
 
 
 def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
-          axis_name: str = DEFAULT_AXIS):
+          axis_name: str = DEFAULT_AXIS, state0=None,
+          return_state: bool = False):
     """Run ``rounds`` of single-source flood on the sharded graph.
 
     Returns ``(seen [S, block] bool, stats dict of [rounds] arrays)`` — the
     sharded equivalent of ``engine.run(graph, Flood(source), ...)``, and
     bit-identical to it (tests/test_sharded.py), including under runtime
     failures and connects.
+
+    Resume path (the mesh-backed JaxSimNode's stepper): pass ``state0 =
+    (seen, frontier)`` to continue a run (``source`` is then ignored) and
+    ``return_state=True`` to get ``((seen, frontier), stats)`` back.
     """
+    from p2pnetwork_tpu.models.flood import Flood
+
     S, block = sg.n_shards, sg.block
-    seen0 = _flood_seed(sg, source)
+    if state0 is None:
+        state0 = init_state(sg, Flood(source=source), None)
+    seen0, frontier0 = state0
     fn = _flood_fn(mesh, axis_name, S, block, rounds)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     seen, frontier, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        sg.node_mask, sg.out_degree, seen0, seen0,
+        sg.node_mask, sg.out_degree, seen0, frontier0,
     )
+    if return_state:
+        return (seen, frontier), stats
     return seen, stats
 
 
@@ -812,8 +901,10 @@ def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
         jnp.sum((seen0_b & node_mask_b).astype(jnp.int32)), axis_name
     )
     init = (seen0_b, frontier0[0], jnp.int32(0), covered0, *accum.zero())
-    seen, _, rounds, covered, hi, lo = jax.lax.while_loop(cond, body, init)
-    return seen[None], rounds, covered / n_live, hi, lo
+    seen, frontier, rounds, covered, hi, lo = jax.lax.while_loop(
+        cond, body, init
+    )
+    return seen[None], frontier[None], rounds, covered / n_live, hi, lo
 
 
 @functools.lru_cache(maxsize=64)
@@ -825,7 +916,7 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh,
         in_specs=(P(),) + (spec,) * 10,
-        out_specs=(spec, P(), P(), P(), P()),
+        out_specs=(spec, spec, P(), P(), P(), P()),
     )
     return jax.jit(fn)
 
@@ -833,28 +924,39 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                          coverage_target: float = 0.99,
                          max_rounds: int = 1024,
-                         axis_name: str = DEFAULT_AXIS):
+                         axis_name: str = DEFAULT_AXIS,
+                         state0=None, return_state: bool = False):
     """Flood until coverage of the LIVE population reaches the target —
     the north-star run-to-99% measurement (engine.run_until_coverage), on
     the multi-chip path. One XLA program, zero host round-trips per round.
 
     Returns ``(seen [S, block] bool, dict(rounds, coverage, messages))``
-    with ``messages`` an exact Python int.
+    with ``messages`` an exact Python int. Resume path (same contract as
+    :func:`flood`): pass ``state0 = (seen, frontier)`` to continue a run
+    (``source`` is then ignored) and ``return_state=True`` to get the full
+    ``((seen, frontier), dict)`` back.
     """
+    from p2pnetwork_tpu.models.flood import Flood
+
     S, block = sg.n_shards, sg.block
-    seen0 = _flood_seed(sg, source)
+    if state0 is None:
+        state0 = init_state(sg, Flood(source=source), None)
+    seen0, frontier0 = state0
     fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
-    seen, rounds, coverage, hi, lo = fn(
+    seen, frontier, rounds, coverage, hi, lo = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        sg.node_mask, sg.out_degree, seen0, seen0,
+        sg.node_mask, sg.out_degree, seen0, frontier0,
     )
-    return seen, {
+    out = {
         "rounds": rounds,
         "coverage": coverage,
         "messages": accum.value((hi, lo)),
     }
+    if return_state:
+        return (seen, frontier), out
+    return seen, out
 
 
 # ------------------------------------------------------------------- gossip
@@ -956,7 +1058,8 @@ def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
            rounds: int, axis_name: str = DEFAULT_AXIS,
-           exact_rng: bool = False, rng: Optional[str] = None):
+           exact_rng: bool = False, rng: Optional[str] = None,
+           values0=None):
     """Run ``rounds`` of push-pull gossip averaging (models/gossip.py) on
     the sharded graph — randomized consensus, the second protocol family
     reference users build on ``node_message`` [ref: README.md:20].
@@ -972,10 +1075,8 @@ def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
             "with a neighbor table (from_edges build_neighbor_table=True)"
         )
     S, block = sg.n_shards, sg.block
-    # Gossip.init parity: values = normal(key, (n_pad,)) * node_mask. The
-    # sharded layout may pad beyond n_pad; extra rows are dead (masked).
-    vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
-    values0 = vals.reshape(S, block) * sg.node_mask
+    if values0 is None:
+        values0 = init_state(sg, protocol, key)
     round_keys = jax.random.key_data(
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
@@ -1129,7 +1230,7 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
         axis_name: str = DEFAULT_AXIS, exact_rng: bool = False,
-        rng: Optional[str] = None):
+        rng: Optional[str] = None, status0=None):
     """Run ``rounds`` of SIR (models/sir.py) on the sharded graph.
 
     Returns ``(status [S, block] i32, stats dict of [rounds] arrays)``. The
@@ -1141,11 +1242,8 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
     back to ``"fold"`` when the block size is not tile-aligned.
     """
     S, block = sg.n_shards, sg.block
-    source = protocol.source
-    status0 = (
-        jnp.zeros((S, block), dtype=jnp.int32)
-        .at[source // block, source % block].set(1)
-    ) * sg.node_mask  # dead source seeds nothing (SIR.init parity)
+    if status0 is None:
+        status0 = init_state(sg, protocol, key)
     # engine.run's schedule: one subkey per round off fold_in(key, 1).
     round_keys = jax.random.key_data(
         jax.random.split(jax.random.fold_in(key, 1), rounds)

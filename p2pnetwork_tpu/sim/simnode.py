@@ -76,6 +76,12 @@ class JaxSimNode(Node):
         stats = node.run_rounds(10)   # 10 batched propagation rounds
         node.stop(); node.join()
 
+    Pass ``mesh=jax.make_mesh(...)`` (or ``parallel.mesh.ring_mesh()``) to
+    run the population on the MULTI-CHIP backend: same events, same
+    stepping/churn/checkpoint methods, with the graph partitioned over the
+    device ring (parallel/sharded.py) — the reference's whole API surface
+    at the scale one chip cannot hold.
+
     Each completed round fires ``node_message`` with
     ``{"sim_round": r, **round_stats}``. ``sim_message_count`` accumulates
     the simulated message volume — the population-scale analog of
@@ -85,6 +91,7 @@ class JaxSimNode(Node):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  graph: Optional[Graph] = None, protocol=None, seed: int = 0,
+                 mesh=None, dynamic_edges: int = 0, rng: Optional[str] = None,
                  **node_kwargs):
         super().__init__(host, port, **node_kwargs)
         self.sim_graph: Optional[Graph] = None
@@ -93,19 +100,51 @@ class JaxSimNode(Node):
         self.sim_round = 0
         self.sim_message_count = 0
         self.sim_peer: Optional[SimPeer] = None
+        self.sim_mesh = None
+        self.sim_sharded = None
+        self._sim_rng: Optional[str] = None
         self._sim_key: Optional[jax.Array] = None
         self._churn_count = 0
         if graph is not None and protocol is not None:
-            self.attach_simulation(graph, protocol, seed=seed)
+            self.attach_simulation(graph, protocol, seed=seed, mesh=mesh,
+                                   dynamic_edges=dynamic_edges, rng=rng)
 
     # ------------------------------------------------------------- plumbing
 
-    def attach_simulation(self, graph: Graph, protocol, seed: int = 0) -> None:
-        """Attach (or replace) the simulated population."""
+    def attach_simulation(self, graph: Graph, protocol, seed: int = 0,
+                          mesh=None, dynamic_edges: int = 0,
+                          rng: Optional[str] = None) -> None:
+        """Attach (or replace) the simulated population.
+
+        ``mesh`` switches the node onto the multi-chip backend
+        (parallel/sharded.py): the population is partitioned over the
+        device ring and every stepping, churn, and checkpoint operation
+        below drives the sharded representation — same Node event surface,
+        same semantics, proven bit-exact against the engine in
+        tests/test_sharded.py. On that backend ``sim_graph`` remains the
+        PRISTINE attach-time construction (the seed for re-shards and
+        checkpoint templates); the live topology is ``sim_sharded``, and
+        backend-agnostic introspection goes through ``sim_node_alive``.
+        ``dynamic_edges`` reserves runtime link capacity on the sharded
+        graph; ``rng`` picks the sharded RNG mode ('exact' | 'tile' |
+        'fold', default tile when aligned).
+        """
         self.sim_graph = graph
         self.sim_protocol = protocol
         self._sim_key = jax.random.key(seed)
-        self.sim_state = protocol.init(graph, self._sim_key)
+        self.sim_mesh = mesh
+        self._sim_rng = rng
+        if mesh is not None:
+            from p2pnetwork_tpu.parallel import sharded
+
+            sg = sharded.shard_graph(graph, mesh)
+            if dynamic_edges:
+                sg = sharded.with_capacity(sg, dynamic_edges)
+            self.sim_sharded = sg
+            self.sim_state = sharded.init_state(sg, protocol, self._sim_key)
+        else:
+            self.sim_sharded = None
+            self.sim_state = protocol.init(graph, self._sim_key)
         self.sim_round = 0
         self.sim_message_count = 0
         self._churn_count = 0
@@ -113,13 +152,49 @@ class JaxSimNode(Node):
         self.debug_print(
             f"attach_simulation: {graph.n_nodes} nodes / {graph.n_edges} edges, "
             f"protocol {type(protocol).__name__}"
+            + (f", {mesh.devices.size}-device mesh" if mesh is not None else "")
         )
 
     def _require_sim(self):
         if self.sim_graph is None:
             raise RuntimeError("JaxSimNode: no simulation attached; call attach_simulation()")
 
+    @property
+    def sim_node_alive(self):
+        """Liveness of the simulated population (bool, one entry per padded
+        node) from whichever backend is active. On the mesh backend the
+        live topology is ``sim_sharded`` — ``sim_graph`` stays the pristine
+        attach-time construction (it seeds re-shards and checkpoint
+        templates), so topology introspection must go through this
+        property, not ``sim_graph.node_mask``."""
+        self._require_sim()
+        if self.sim_mesh is not None:
+            return np.asarray(self.sim_sharded.node_mask).reshape(-1)
+        return np.asarray(self.sim_graph.node_mask)
+
     # ------------------------------------------------------------- stepping
+
+    def _run_rounds_sharded(self, rounds: int, seg_key):
+        """Dispatch a run_rounds segment onto the sharded backend."""
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.models.gossip import Gossip
+        from p2pnetwork_tpu.models.sir import SIR
+        from p2pnetwork_tpu.parallel import sharded
+
+        sg, mesh, proto = self.sim_sharded, self.sim_mesh, self.sim_protocol
+        if isinstance(proto, Flood):
+            return sharded.flood(sg, mesh, proto.source, rounds,
+                                 state0=self.sim_state, return_state=True)
+        if isinstance(proto, SIR):
+            return sharded.sir(sg, mesh, proto, seg_key, rounds,
+                               rng=self._sim_rng, status0=self.sim_state)
+        if isinstance(proto, Gossip):
+            return sharded.gossip(sg, mesh, proto, seg_key, rounds,
+                                  rng=self._sim_rng, values0=self.sim_state)
+        raise ValueError(
+            f"the sharded backend implements Flood, SIR and Gossip; got "
+            f"{type(proto).__name__}"
+        )
 
     def run_rounds(self, rounds: int) -> dict:
         """Advance the population ``rounds`` synchronous rounds.
@@ -130,9 +205,13 @@ class JaxSimNode(Node):
         self._require_sim()
         # Per-segment key: deterministic in (seed, segment start).
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
-        self.sim_state, stats = engine.run_from(
-            self.sim_graph, self.sim_protocol, self.sim_state, seg_key, rounds
-        )
+        if self.sim_mesh is not None:
+            self.sim_state, stats = self._run_rounds_sharded(rounds, seg_key)
+        else:
+            self.sim_state, stats = engine.run_from(
+                self.sim_graph, self.sim_protocol, self.sim_state, seg_key,
+                rounds,
+            )
         host_stats = {k: np.asarray(v) for k, v in stats.items()}
         for r in range(rounds):
             round_stats = {k: host_stats[k][r].item() for k in host_stats}
@@ -145,13 +224,30 @@ class JaxSimNode(Node):
     def run_until_coverage(self, coverage_target: float = 0.99,
                            max_rounds: int = 1024) -> dict:
         """Device-side run-to-coverage continuing from the current state
-        (no per-round events; one summary ``node_message`` at the end)."""
+        (no per-round events; one summary ``node_message`` at the end).
+        On the mesh backend this is the multi-chip while_loop
+        (sharded.flood_until_coverage; Flood only)."""
         self._require_sim()
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
-        self.sim_state, out = engine.run_until_coverage_from(
-            self.sim_graph, self.sim_protocol, self.sim_state, seg_key,
-            coverage_target=coverage_target, max_rounds=max_rounds,
-        )
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.models.flood import Flood
+            from p2pnetwork_tpu.parallel import sharded
+
+            if not isinstance(self.sim_protocol, Flood):
+                raise ValueError(
+                    "run_until_coverage on the sharded backend implements "
+                    "Flood; run SIR-to-coverage on the single-device engine"
+                )
+            self.sim_state, out = sharded.flood_until_coverage(
+                self.sim_sharded, self.sim_mesh, self.sim_protocol.source,
+                coverage_target=coverage_target, max_rounds=max_rounds,
+                state0=self.sim_state, return_state=True,
+            )
+        else:
+            self.sim_state, out = engine.run_until_coverage_from(
+                self.sim_graph, self.sim_protocol, self.sim_state, seg_key,
+                coverage_target=coverage_target, max_rounds=max_rounds,
+            )
         summary = {k: np.asarray(v).item() for k, v in out.items()}
         self.sim_round += int(summary["rounds"])
         self.sim_message_count += int(summary["messages"])
@@ -164,18 +260,26 @@ class JaxSimNode(Node):
         """Population topology changes surface through ``node_message``
         (like round stats) — SimPeer is not in the socket registries, so
         the inbound/outbound disconnect dispatcher correctly ignores it."""
-        alive = int(np.asarray(self.sim_graph.node_mask.sum()))
+        mask = (self.sim_sharded.node_mask if self.sim_mesh is not None
+                else self.sim_graph.node_mask)
+        alive = int(np.asarray(mask.sum()))
         self.node_message(
             self.sim_peer, {"sim_topology": change, "alive_nodes": alive}
         )
 
     def fail_sim_nodes(self, node_ids) -> None:
-        """Fail-stop simulated peers (sim/failures.py) — the population
-        analog of peers dropping [ref: node.py:307-319]."""
+        """Fail-stop simulated peers (sim/failures.py, or the sharded
+        mirror on the mesh backend) — the population analog of peers
+        dropping [ref: node.py:307-319]."""
         self._require_sim()
-        from p2pnetwork_tpu.sim import failures
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.parallel import sharded
 
-        self.sim_graph = failures.fail_nodes(self.sim_graph, node_ids)
+            self.sim_sharded = sharded.fail_nodes(self.sim_sharded, node_ids)
+        else:
+            from p2pnetwork_tpu.sim import failures
+
+            self.sim_graph = failures.fail_nodes(self.sim_graph, node_ids)
         self._sim_topology_event("fail_nodes")
 
     def inject_sim_churn(self, frac: float, seed: Optional[int] = None) -> None:
@@ -187,8 +291,6 @@ class JaxSimNode(Node):
         ``seed`` only to reproduce one specific churn event.
         """
         self._require_sim()
-        from p2pnetwork_tpu.sim import failures
-
         if seed is not None:
             key = jax.random.key(seed)
         else:
@@ -196,17 +298,36 @@ class JaxSimNode(Node):
             key = jax.random.fold_in(
                 jax.random.fold_in(self._sim_key, 0x0C0C), self._churn_count
             )
-        self.sim_graph = failures.random_node_failures(self.sim_graph, key, frac)
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.parallel import sharded
+
+            self.sim_sharded = sharded.random_node_failures(
+                self.sim_sharded, key, frac
+            )
+        else:
+            from p2pnetwork_tpu.sim import failures
+
+            self.sim_graph = failures.random_node_failures(
+                self.sim_graph, key, frac
+            )
         self._sim_topology_event("churn")
 
     def connect_sim_nodes(self, senders, receivers) -> None:
-        """Add links between simulated peers at runtime (sim/topology.py;
-        the population analog of ``connect_with_node`` [ref: node.py:122]).
-        The graph needs dynamic capacity (``topology.with_capacity``)."""
+        """Add links between simulated peers at runtime (sim/topology.py,
+        or the sharded mirror; the population analog of
+        ``connect_with_node`` [ref: node.py:122]). Needs dynamic capacity
+        (``topology.with_capacity`` / ``dynamic_edges=`` at attach)."""
         self._require_sim()
-        from p2pnetwork_tpu.sim import topology
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.parallel import sharded
 
-        self.sim_graph = topology.connect(self.sim_graph, senders, receivers)
+            self.sim_sharded = sharded.connect(
+                self.sim_sharded, senders, receivers
+            )
+        else:
+            from p2pnetwork_tpu.sim import topology
+
+            self.sim_graph = topology.connect(self.sim_graph, senders, receivers)
         self._sim_topology_event("connect")
 
     # ----------------------------------------------------------- checkpoint
@@ -221,11 +342,18 @@ class JaxSimNode(Node):
         self._require_sim()
         payload = {
             "protocol": self.sim_state,
-            "topology": ckpt.topology_state(self.sim_graph),
+            "topology": self._topology_state(),
             "churn_count": np.int64(self._churn_count),
         }
         ckpt.save(path, payload, self._sim_key, self.sim_round,
                   self.sim_message_count)
+
+    def _topology_state(self):
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.parallel import sharded
+
+            return sharded.topology_state(self.sim_sharded)
+        return ckpt.topology_state(self.sim_graph)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore a checkpoint taken from a node with the same (pristine)
@@ -237,19 +365,46 @@ class JaxSimNode(Node):
         churn counter is restored, so the next ``inject_sim_churn()`` draws
         fresh randomness instead of replaying pre-checkpoint draws."""
         self._require_sim()
-        proto_template = self.sim_protocol.init(self.sim_graph, jax.random.key(0))
-        payload, key, rnd, msgs = ckpt.load_node_payload(
-            path, self.sim_graph, proto_template
-        )
-        # Validate everything (including topology shapes) BEFORE mutating
-        # the node — a rejected load must leave it untouched, not holding a
-        # foreign protocol state against its own graph.
-        new_graph = ckpt.apply_topology_state(self.sim_graph, payload["topology"])
-        # Device-put the protocol leaves (npz gives numpy): raw numpy would
-        # re-pay host->device transfer on every subsequent jit dispatch.
-        self.sim_state = jax.tree.map(jax.numpy.asarray, payload["protocol"])
+        if self.sim_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from p2pnetwork_tpu.parallel import sharded
+
+            template = {
+                "protocol": sharded.init_state(
+                    self.sim_sharded, self.sim_protocol, jax.random.key(0)
+                ),
+                "topology": sharded.topology_state(self.sim_sharded),
+                "churn_count": np.int64(0),
+            }
+            payload, key, rnd, msgs = ckpt.load(path, template)
+            new_sharded = sharded.apply_topology_state(
+                self.sim_sharded, payload["topology"]
+            )
+            shard = NamedSharding(self.sim_mesh,
+                                  P(self.sim_mesh.axis_names[0]))
+            self.sim_state = jax.tree.map(
+                lambda x: jax.device_put(jax.numpy.asarray(x), shard),
+                payload["protocol"],
+            )
+            self.sim_sharded = new_sharded
+        else:
+            proto_template = self.sim_protocol.init(self.sim_graph,
+                                                    jax.random.key(0))
+            payload, key, rnd, msgs = ckpt.load_node_payload(
+                path, self.sim_graph, proto_template
+            )
+            # Validate everything (including topology shapes) BEFORE
+            # mutating the node — a rejected load must leave it untouched,
+            # not holding a foreign protocol state against its own graph.
+            new_graph = ckpt.apply_topology_state(self.sim_graph,
+                                                  payload["topology"])
+            # Device-put the protocol leaves (npz gives numpy): raw numpy
+            # would re-pay host->device transfer on every jit dispatch.
+            self.sim_state = jax.tree.map(jax.numpy.asarray,
+                                          payload["protocol"])
+            self.sim_graph = new_graph
         self._sim_key = key
         self.sim_round = rnd
         self.sim_message_count = msgs
-        self.sim_graph = new_graph
         self._churn_count = int(payload["churn_count"])

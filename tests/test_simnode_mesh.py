@@ -1,0 +1,155 @@
+"""JaxSimNode on the multi-chip (mesh) backend.
+
+The same Node event surface — run_rounds, run_until_coverage, failures,
+churn, runtime links, checkpoint/restore — driving the sharded
+representation (parallel/sharded.py), parity-tested against the
+single-device node on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import SIR, Flood, Gossip  # noqa: E402
+from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+from p2pnetwork_tpu.sim import topology  # noqa: E402
+from p2pnetwork_tpu.sim.simnode import JaxSimNode  # noqa: E402
+from tests.helpers import EventRecorder  # noqa: E402
+
+
+def _graph():
+    # 1024 = 8 * 128: exact-RNG and churn draws align with the engine.
+    return G.watts_strogatz(1024, 6, 0.2, seed=0)
+
+
+class TestMeshBackedNode:
+    def test_flood_matches_single_device_node(self):
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=Flood(source=0), seed=3)
+        b = JaxSimNode(graph=g, protocol=Flood(source=0), seed=3,
+                       mesh=M.ring_mesh(8))
+        a.run_rounds(3)
+        a.run_rounds(2)
+        b.run_rounds(3)
+        b.run_rounds(2)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state[0]).reshape(-1),
+            np.asarray(a.sim_state.seen),
+        )
+        assert a.sim_message_count == b.sim_message_count
+        assert a.sim_round == b.sim_round == 5
+
+    def test_sir_exact_rng_matches_single_device_node(self):
+        g = _graph()
+        proto = SIR(beta=0.4, gamma=0.15, source=3, method="segment")
+        a = JaxSimNode(graph=g, protocol=proto, seed=7)
+        b = JaxSimNode(graph=g, protocol=proto, seed=7,
+                       mesh=M.ring_mesh(8), rng="exact")
+        a.run_rounds(4)
+        a.run_rounds(4)
+        b.run_rounds(4)
+        b.run_rounds(4)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state).reshape(-1), np.asarray(a.sim_state.status)
+        )
+        assert a.sim_message_count == b.sim_message_count
+
+    def test_gossip_exact_rng_matches_single_device_node(self):
+        g = G.barabasi_albert(1024, 3, seed=1)
+        a = JaxSimNode(graph=g, protocol=Gossip(alpha=0.5), seed=2)
+        b = JaxSimNode(graph=g, protocol=Gossip(alpha=0.5), seed=2,
+                       mesh=M.ring_mesh(8), rng="exact")
+        a.run_rounds(5)
+        b.run_rounds(5)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state).reshape(-1), np.asarray(a.sim_state.values)
+        )
+
+    def test_churn_and_events_match(self):
+        g = _graph()
+        rec = EventRecorder()
+        a = JaxSimNode(graph=topology.with_capacity(g, extra_edges=16),
+                       protocol=Flood(source=0), seed=0)
+        b = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0,
+                       mesh=M.ring_mesh(8), dynamic_edges=8, callback=rec)
+        a.fail_sim_nodes([5, 500])
+        b.fail_sim_nodes([5, 500])
+        a.inject_sim_churn(0.1)
+        b.inject_sim_churn(0.1)  # same key schedule -> same failure set
+        a.connect_sim_nodes([2], [900])
+        b.connect_sim_nodes([2], [900])
+        # Backend-agnostic topology introspection: sim_node_alive reads the
+        # ACTIVE backend (on the mesh, sim_graph stays pristine by design).
+        np.testing.assert_array_equal(b.sim_node_alive, a.sim_node_alive)
+        assert a.sim_node_alive.sum() == b.sim_node_alive.sum() < 1024
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_sharded.out_degree).reshape(-1),
+            np.asarray(a.sim_graph.out_degree),
+        )
+        a.run_rounds(6)
+        b.run_rounds(6)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state[0]).reshape(-1), np.asarray(a.sim_state.seen)
+        )
+        topo_events = [d for d in rec.data_for("node_message")
+                       if isinstance(d, dict) and "sim_topology" in d]
+        assert [e["sim_topology"] for e in topo_events] == [
+            "fail_nodes", "churn", "connect"
+        ]
+        assert topo_events[0]["alive_nodes"] == 1022
+
+    def test_run_until_coverage_matches(self):
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0)
+        b = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0,
+                       mesh=M.ring_mesh(8))
+        a.run_rounds(2)
+        b.run_rounds(2)
+        out_a = a.run_until_coverage(0.99)
+        out_b = b.run_until_coverage(0.99)
+        assert out_a["rounds"] == out_b["rounds"]
+        assert out_a["messages"] == out_b["messages"]
+        assert a.sim_round == b.sim_round
+
+    def test_run_until_coverage_sir_rejected(self):
+        b = JaxSimNode(graph=_graph(), protocol=SIR(), seed=0,
+                       mesh=M.ring_mesh(4))
+        with pytest.raises(ValueError, match="Flood"):
+            b.run_until_coverage(0.5)
+
+    def test_checkpoint_roundtrip_with_churned_topology(self, tmp_path):
+        g = _graph()
+        mesh = M.ring_mesh(8)
+        proto = SIR(beta=0.5, gamma=0.2, source=0)
+        path = str(tmp_path / "mesh_node.npz")
+        a = JaxSimNode(graph=g, protocol=proto, seed=9, mesh=mesh,
+                       dynamic_edges=8, rng="exact")
+        a.run_rounds(3)
+        a.fail_sim_nodes([11, 400])
+        a.inject_sim_churn(0.05)
+        a.connect_sim_nodes([1], [700])
+        a.run_rounds(2)
+        a.save_checkpoint(path)
+        a.run_rounds(4)
+
+        b = JaxSimNode(graph=g, protocol=proto, seed=9, mesh=mesh,
+                       dynamic_edges=8, rng="exact")
+        b.load_checkpoint(path)
+        assert b.sim_round == 5
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_sharded.node_mask),
+            np.asarray(a.sim_sharded.node_mask),
+        )
+        b.run_rounds(4)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state), np.asarray(a.sim_state)
+        )
+        # Next churn draws identically (counter restored).
+        a.inject_sim_churn(0.05)
+        b.inject_sim_churn(0.05)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_sharded.node_mask),
+            np.asarray(a.sim_sharded.node_mask),
+        )
