@@ -14,6 +14,18 @@ Layouts: every per-node array is sharded on its leading (node) axis, every
 per-edge array on its edge axis, the neighbor table on rows. The blocked /
 hybrid representations are layout-specialized for the single-chip kernels
 and are dropped here (use method="segment" or "gather").
+
+Communication evidence (tests/test_auto_comm.py inspects the compiled
+HLO): for segment-method Flood/SIR on an 8-device mesh, every collective
+GSPMD inserts is node-extent — the bool frontier (N bytes) for flood, the
+f32 pressure signal (4N bytes) for SIR, plus scalar stats all-reduces —
+and edge-extent arrays are never moved. That is the bandwidth-sane
+partitioning (per-round cross-shard volume on the order of the node
+state, like the explicit ring path, delivered as compiler-placed
+collectives instead of S ppermute hops). The tests bound every
+collective's payload to node extent — including variadic combined and
+async forms — so a compiler or layout change that regresses to
+edge-extent traffic fails loudly.
 """
 
 from __future__ import annotations

@@ -1,0 +1,106 @@
+"""Communication behavior of the GSPMD auto path (parallel/auto.py).
+
+The auto idiom hands partitioning to the compiler, so its bandwidth story
+needs EVIDENCE, not hope: the worry is the compiler deciding to all-gather
+edge-extent arrays (E entries) every round instead of just the node-extent
+frontier (N bools — an order of magnitude smaller at avg degree ~10).
+These tests compile the auto-sharded program on the real 8-device mesh and
+inspect the HLO's collectives: every collective's payload must be
+node-extent, never edge-extent, and collectives must exist at all (the
+program is genuinely partitioned, not silently replicated).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import SIR, Flood  # noqa: E402
+from p2pnetwork_tpu.parallel import auto  # noqa: E402
+from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+                "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+                "u64": 8}
+
+# Matches the full (possibly tuple/variadic) result type of a collective —
+# XLA's collective combiner fuses ops into variadic forms like
+#   (s32[], s32[], f32[4096]) all-reduce(...)
+# and async pairs use the -start suffix; both must stay visible here or an
+# edge-extent payload could hide inside a fused/async op.
+_LINE = re.compile(
+    r"=\s+(.+?)\s+"
+    r"(all-gather|all-reduce|all-to-all|collective-permute|reduce-scatter)"
+    r"(?:-start)?\("
+)
+_SHAPE = re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def _collectives(hlo_text):
+    """[(op, dtype, shape, bytes)] — one entry per tensor component of
+    every collective in the module, tuple results flattened."""
+    out = []
+    for type_str, op in _LINE.findall(hlo_text):
+        for dtype, shape in _SHAPE.findall(type_str):
+            if dtype not in _DTYPE_BYTES:
+                continue  # e.g. token types
+            dims = [int(d) for d in shape.split(",") if d] or [1]
+            out.append((op, dtype, tuple(dims),
+                        int(np.prod(dims)) * _DTYPE_BYTES[dtype]))
+    return out
+
+
+def test_parser_sees_variadic_and_async_collectives():
+    # Regression: the first parser missed tuple-shaped (combined)
+    # collectives entirely — the exact form XLA's combiner emits.
+    hlo = """
+      %ar = (s32[], s32[], f32[4096]{0}) all-reduce(%a, %b, %c), to_apply=%add
+      %ag = pred[4096]{0} all-gather(%x), channel_id=1
+      %rs = f32[512]{0} reduce-scatter(%y), channel_id=2
+      %ags = (f32[1024]{0}, f32[1024]{0}) all-gather-start(%z), channel_id=3
+    """
+    colls = _collectives(hlo)
+    ops = [c[0] for c in colls]
+    assert ops.count("all-reduce") == 3  # tuple flattened
+    assert "reduce-scatter" in ops and ops.count("all-gather") == 3
+    assert max(c[3] for c in colls) == 4096 * 4
+
+
+@pytest.mark.parametrize("protocol", [
+    Flood(source=0, method="segment"),
+    SIR(beta=0.3, gamma=0.1, method="segment"),
+])
+def test_auto_collectives_are_node_extent_only(protocol):
+    g = G.watts_strogatz(4096, 6, 0.2, seed=0)
+    gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+    hlo = engine.run.lower(gs, protocol, jax.random.key(0), 5).compile().as_text()
+    colls = _collectives(hlo)
+    # Partitioned for real: cross-shard edges force at least one collective.
+    assert colls, "no collectives found — program was not partitioned"
+    node_extent_bytes = g.n_nodes_padded * 4
+    edge_extent_bytes = g.n_edges_padded * 4
+    assert edge_extent_bytes > 4 * node_extent_bytes  # the test has teeth
+    for op, dtype, shape, nbytes in colls:
+        assert nbytes <= node_extent_bytes, (
+            f"{op} moves {nbytes} bytes ({dtype}{list(shape)}) — "
+            f"edge-extent traffic; the auto path would not be "
+            f"bandwidth-sane at scale"
+        )
+
+
+def test_auto_flood_gathers_frontier_not_edges():
+    # The specific expected shape: ONE pred[N] all-gather (the frontier)
+    # inside the round loop, nothing larger.
+    g = G.watts_strogatz(4096, 6, 0.2, seed=0)
+    gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+    hlo = engine.run.lower(
+        gs, Flood(source=0, method="segment"), jax.random.key(0), 5
+    ).compile().as_text()
+    gathers = [c for c in _collectives(hlo) if c[0] == "all-gather"]
+    assert gathers
+    for op, dtype, shape, nbytes in gathers:
+        assert dtype == "pred" and nbytes <= g.n_nodes_padded
