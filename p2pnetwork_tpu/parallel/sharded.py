@@ -1148,14 +1148,14 @@ def _resolve_rng(sg: ShardedGraph, exact_rng: bool, rng: Optional[str]) -> str:
     return "tile" if sg.block % RNG_TILE == 0 else "fold"
 
 
-def _ring_rounds_sir(axis_name, S, block, rng,
-                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                     node_mask, out_degree,
-                     status0, round_keys, one_minus_beta, gamma, rounds):
-    """Per-shard body: ``rounds`` SIR rounds, infection pressure via a ring
-    sum pass. ``round_keys`` is replicated raw key data [rounds, ...];
+def _make_sir_round(axis_name, S, block, rng,
+                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                    node_mask, out_degree, one_minus_beta, gamma):
+    """Build the per-shard SIR round closure (shared by the fixed-rounds
+    scan and the run-to-coverage while_loop): ``one_round(status, key) ->
+    (status, stats)`` with infection pressure via a ring sum pass.
     ``beta``/``gamma`` are replicated scalars (runtime operands, so a
-    parameter sweep does not recompile per value). ``rng`` selects the
+    parameter sweep does not recompile per value); ``rng`` selects the
     uniform-draw scheme — see :func:`_make_draw`.
     """
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
@@ -1172,8 +1172,7 @@ def _ring_rounds_sir(axis_name, S, block, rng,
     my = jax.lax.axis_index(axis_name)
     draw = _make_draw(axis_name, S, block, rng, my)
 
-    def one_round(status, rkey):
-        key = jax.random.wrap_key_data(rkey)
+    def one_round(status, key):
         k_inf, k_rec = jax.random.split(key)
         infected = (status == INFECTED) & node_mask_b
         susceptible = (status == SUSCEPTIBLE) & node_mask_b
@@ -1210,8 +1209,116 @@ def _ring_rounds_sir(axis_name, S, block, rng,
         }
         return status, stats
 
-    status, stats = jax.lax.scan(one_round, status0[0], round_keys)
+    return one_round
+
+
+def _ring_rounds_sir(axis_name, S, block, rng,
+                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                     node_mask, out_degree,
+                     status0, round_keys, one_minus_beta, gamma, rounds):
+    """Per-shard body: ``rounds`` SIR rounds (scan over replicated raw key
+    data, engine.run key-schedule parity)."""
+    one_round = _make_sir_round(
+        axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
+        dyn_src, dyn_dst, dyn_mask, node_mask, out_degree,
+        one_minus_beta, gamma,
+    )
+
+    def body(status, rkey):
+        return one_round(status, jax.random.wrap_key_data(rkey))
+
+    status, stats = jax.lax.scan(body, status0[0], round_keys)
     return status[None], stats
+
+
+def _ring_coverage_sir(axis_name, S, block, rng, coverage_target, max_rounds,
+                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                       node_mask, out_degree,
+                       status0, key_data, one_minus_beta, gamma):
+    """Per-shard body: SIR until ever-infected coverage reaches the target
+    (engine.run_until_coverage's key schedule: split the carried key each
+    round). Messages accumulate in the two-limb counter."""
+    one_round = _make_sir_round(
+        axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
+        dyn_src, dyn_dst, dyn_mask, node_mask, out_degree,
+        one_minus_beta, gamma,
+    )
+
+    def cond(carry):
+        _, _, rounds, coverage, _, _ = carry
+        return (coverage < coverage_target) & (rounds < max_rounds)
+
+    def body(carry):
+        status, kd, rounds, _, hi, lo = carry
+        k, sub = jax.random.split(jax.random.wrap_key_data(kd))
+        status, stats = one_round(status, sub)
+        hi, lo = accum.add((hi, lo), stats["messages"])
+        return (status, jax.random.key_data(k), rounds + 1,
+                stats["coverage"], hi, lo)
+
+    from p2pnetwork_tpu.models.sir import SUSCEPTIBLE
+
+    node_mask_b = node_mask[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+    cov0 = jax.lax.psum(
+        jnp.sum(((status0[0] != SUSCEPTIBLE) & node_mask_b).astype(jnp.int32)),
+        axis_name,
+    ) / n_live
+    init = (status0[0], key_data, jnp.int32(0), cov0, *accum.zero())
+    status, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
+    return status[None], rounds, coverage, hi, lo
+
+
+@functools.lru_cache(maxsize=64)
+def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                max_rounds: int, rng: str):
+    body = functools.partial(_ring_coverage_sir, axis_name, S, block, rng)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh,
+        in_specs=(P(),) + (spec,) * 9 + (P(), P(), P()),
+        out_specs=(spec, P(), P(), P(), P()),
+    )
+    return jax.jit(fn)
+
+
+def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
+                       key: jax.Array, *,
+                       coverage_target: float = 0.99,
+                       max_rounds: int = 1024,
+                       axis_name: str = DEFAULT_AXIS,
+                       exact_rng: bool = False, rng: Optional[str] = None,
+                       status0=None):
+    """Run SIR until the ever-infected coverage of the LIVE population
+    reaches the target — engine.run_until_coverage's measurement for the
+    epidemic protocol, on the multi-chip path. Same key schedule as the
+    engine loop (split the carried key per round), so ``exact_rng=True``
+    with ``S*block == n_pad`` is bit-identical to it.
+
+    Returns ``(status [S, block] i32, dict(rounds, coverage, messages))``
+    with ``messages`` an exact Python int.
+    """
+    S, block = sg.n_shards, sg.block
+    if status0 is None:
+        status0 = init_state(sg, protocol, key)
+    fn = _sir_cov_fn(mesh, axis_name, S, block, max_rounds,
+                     _resolve_rng(sg, exact_rng, rng))
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    status, rounds, coverage, hi, lo = fn(
+        jnp.float32(coverage_target),
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        sg.node_mask, sg.out_degree, status0,
+        jax.random.key_data(key),
+        jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
+    )
+    return status, {
+        "rounds": rounds,
+        "coverage": coverage,
+        "messages": accum.value((hi, lo)),
+    }
 
 
 @functools.lru_cache(maxsize=64)

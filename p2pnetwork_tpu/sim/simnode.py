@@ -226,23 +226,32 @@ class JaxSimNode(Node):
         """Device-side run-to-coverage continuing from the current state
         (no per-round events; one summary ``node_message`` at the end).
         On the mesh backend this is the multi-chip while_loop
-        (sharded.flood_until_coverage; Flood only)."""
+        (sharded.flood_until_coverage / sharded.sir_until_coverage)."""
         self._require_sim()
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
         if self.sim_mesh is not None:
             from p2pnetwork_tpu.models.flood import Flood
+            from p2pnetwork_tpu.models.sir import SIR
             from p2pnetwork_tpu.parallel import sharded
 
-            if not isinstance(self.sim_protocol, Flood):
+            if isinstance(self.sim_protocol, Flood):
+                self.sim_state, out = sharded.flood_until_coverage(
+                    self.sim_sharded, self.sim_mesh, self.sim_protocol.source,
+                    coverage_target=coverage_target, max_rounds=max_rounds,
+                    state0=self.sim_state, return_state=True,
+                )
+            elif isinstance(self.sim_protocol, SIR):
+                self.sim_state, out = sharded.sir_until_coverage(
+                    self.sim_sharded, self.sim_mesh, self.sim_protocol,
+                    seg_key, coverage_target=coverage_target,
+                    max_rounds=max_rounds, rng=self._sim_rng,
+                    status0=self.sim_state,
+                )
+            else:
                 raise ValueError(
                     "run_until_coverage on the sharded backend implements "
-                    "Flood; run SIR-to-coverage on the single-device engine"
+                    "Flood and SIR; the protocol must expose a coverage stat"
                 )
-            self.sim_state, out = sharded.flood_until_coverage(
-                self.sim_sharded, self.sim_mesh, self.sim_protocol.source,
-                coverage_target=coverage_target, max_rounds=max_rounds,
-                state0=self.sim_state, return_state=True,
-            )
         else:
             self.sim_state, out = engine.run_until_coverage_from(
                 self.sim_graph, self.sim_protocol, self.sim_state, seg_key,

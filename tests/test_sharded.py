@@ -544,6 +544,27 @@ class TestShardedCoverage:
         assert int(np.asarray(out["rounds"])) == int(np.asarray(ref_out["rounds"]))
         assert out["messages"] == ref_out["messages"]
 
+    def test_sir_until_coverage_matches_engine(self):
+        from p2pnetwork_tpu.models import SIR
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        proto = SIR(beta=0.5, gamma=0.1, source=0, method="segment")
+        status, out = sharded.sir_until_coverage(
+            sg, mesh, proto, jax.random.key(9), coverage_target=0.8,
+            max_rounds=64, exact_rng=True,
+        )
+        ref_state, ref_out = engine.run_until_coverage(
+            g, proto, jax.random.key(9), coverage_target=0.8, max_rounds=64
+        )
+        assert int(np.asarray(out["rounds"])) == int(np.asarray(ref_out["rounds"]))
+        assert out["messages"] == ref_out["messages"]
+        np.testing.assert_array_equal(
+            np.asarray(status).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.status)[: g.n_nodes],
+        )
+
     def test_max_rounds_cap(self):
         g = G.ring(256)  # diameter 128: can't reach 99% in 3 rounds
         mesh = M.ring_mesh(4)

@@ -113,10 +113,28 @@ class TestMeshBackedNode:
         assert out_a["messages"] == out_b["messages"]
         assert a.sim_round == b.sim_round
 
-    def test_run_until_coverage_sir_rejected(self):
-        b = JaxSimNode(graph=_graph(), protocol=SIR(), seed=0,
-                       mesh=M.ring_mesh(4))
-        with pytest.raises(ValueError, match="Flood"):
+    def test_run_until_coverage_sir_matches(self):
+        g = _graph()
+        proto = SIR(beta=0.5, gamma=0.1, source=0, method="segment")
+        a = JaxSimNode(graph=g, protocol=proto, seed=5)
+        b = JaxSimNode(graph=g, protocol=proto, seed=5,
+                       mesh=M.ring_mesh(8), rng="exact")
+        a.run_rounds(2)
+        b.run_rounds(2)
+        out_a = a.run_until_coverage(0.7, max_rounds=64)
+        out_b = b.run_until_coverage(0.7, max_rounds=64)
+        assert out_a["rounds"] == out_b["rounds"]
+        assert out_a["messages"] == out_b["messages"]
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state).reshape(-1), np.asarray(a.sim_state.status)
+        )
+
+    def test_run_until_coverage_gossip_rejected(self):
+        from p2pnetwork_tpu.models import Gossip
+
+        b = JaxSimNode(graph=G.barabasi_albert(1024, 3, seed=0),
+                       protocol=Gossip(), seed=0, mesh=M.ring_mesh(4))
+        with pytest.raises(ValueError, match="Flood and SIR"):
             b.run_until_coverage(0.5)
 
     def test_checkpoint_roundtrip_with_churned_topology(self, tmp_path):
