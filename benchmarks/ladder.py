@@ -225,6 +225,37 @@ def bench_gossip_sharded():
     })
 
 
+def bench_flood_sharded_ring():
+    """1M flood-to-99% on the explicit ring path (every available device;
+    one chip measures ring overhead vs the single-chip hybrid entry) —
+    segment reductions vs the MXU bucket layout."""
+    import numpy as np
+
+    from p2pnetwork_tpu.parallel import mesh as M
+    from p2pnetwork_tpu.parallel import sharded
+    from p2pnetwork_tpu.sim import graph as G
+
+    mesh = M.ring_mesh()
+    g = G.watts_strogatz(1_000_000, 10, 0.1, seed=0,
+                         build_neighbor_table=False)
+    results = {}
+    for mxu in (False, True):
+        sg = sharded.shard_graph(g, mesh, mxu=mxu)
+        seen, out = sharded.flood_until_coverage(sg, mesh, source=0)  # warm
+        t0 = time.perf_counter()
+        seen, out = sharded.flood_until_coverage(sg, mesh, source=0)
+        _ = out["messages"]  # blocking summary transfer
+        results["mxu" if mxu else "segment"] = time.perf_counter() - t0
+    emit({
+        "config": f"1M WS flood, ring-sharded ({mesh.devices.size} dev)",
+        "value": round(results["mxu"], 4),
+        "unit": "s to 99% coverage (MXU buckets)",
+        "segment_s": round(results["segment"], 4),
+        "mxu_speedup": round(results["segment"] / results["mxu"], 2),
+        "rounds": int(np.asarray(out["rounds"])),
+    })
+
+
 def bench_churn_connect():
     """Runtime connect cost vs graph size: the membership probe is a
     searchsorted window scan (sim/topology.py), so a connect batch should
@@ -272,6 +303,7 @@ def main():
     bench_gossip_sharded()
     bench_sir_1m()
     bench_churn_connect()
+    bench_flood_sharded_ring()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
     if args.full:
         bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)")

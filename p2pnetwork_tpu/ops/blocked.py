@@ -90,6 +90,24 @@ def build_blocked_from_arrays(
     )
 
 
+def onehot_apply(contrib: jax.Array, local_dst: jax.Array, block: int,
+                 out_len: int) -> jax.Array:
+    """The one-hot-matmul core: reduce ``contrib [NB, W]`` into its
+    destinations — ``out[v] = sum_w contrib[nb, w] * (local_dst == v%block)``
+    — as one batched einsum (MXU work, no scatter). f32 accumulation;
+    bf16 ``contrib`` is exact for 0/1 payloads. Shared by the single-chip
+    blocked path and the sharded ring's MXU buckets (parallel/sharded.py).
+    """
+    onehot = (
+        local_dst[:, :, None]
+        == jnp.arange(block, dtype=jnp.int32)[None, None, :]
+    ).astype(contrib.dtype)  # [NB, W, B]
+    out = jnp.einsum(
+        "nw,nwb->nb", contrib, onehot, preferred_element_type=jnp.float32
+    )
+    return out.reshape(-1)[:out_len]
+
+
 def propagate_sum_blocked(blocked: BlockedEdges, signal: jax.Array,
                           node_mask: jax.Array) -> jax.Array:
     """Per-node sum over incoming edges via batched one-hot matmul.
@@ -97,14 +115,8 @@ def propagate_sum_blocked(blocked: BlockedEdges, signal: jax.Array,
     ``signal`` f32[N_pad] -> f32[N_pad]; all MXU, no scatter.
     """
     contrib = signal[blocked.src] * blocked.mask.astype(signal.dtype)  # [NB, W]
-    onehot = (
-        blocked.local_dst[:, :, None]
-        == jnp.arange(blocked.block, dtype=jnp.int32)[None, None, :]
-    ).astype(signal.dtype)  # [NB, W, B]
-    out = jnp.einsum(
-        "nw,nwb->nb", contrib, onehot, preferred_element_type=jnp.float32
-    )
-    out = out.reshape(-1)[: node_mask.shape[0]]
+    out = onehot_apply(contrib, blocked.local_dst, blocked.block,
+                       node_mask.shape[0])
     return out * node_mask.astype(signal.dtype)
 
 

@@ -88,6 +88,14 @@ class ShardedGraph:
     # re-masked by liveness, like the single-device table.
     neighbors: Optional[jax.Array] = None  # i32[S, B, W]
     neighbors_mask: Optional[jax.Array] = None  # bool[S, B, W]
+    # MXU bucket layout (shard_graph(..., mxu=True)): each static bucket's
+    # edges regrouped by 128-destination block (ops/blocked.py scheme), so
+    # the ring pass applies buckets as batched one-hot matmuls instead of
+    # segment reductions — XLA's TPU scatter lowering is the ring path's
+    # bottleneck. ``mxu_dst`` is the destination index WITHIN its 128-block.
+    mxu_src: Optional[jax.Array] = None  # i32[S, S, NB, W]
+    mxu_dst: Optional[jax.Array] = None  # i32[S, S, NB, W]
+    mxu_mask: Optional[jax.Array] = None  # bool[S, S, NB, W]
 
     @property
     def n_nodes_padded(self) -> int:
@@ -112,8 +120,21 @@ def _dyn_or_empty(sg: ShardedGraph):
     )
 
 
+def _mxu_or_empty(sg: ShardedGraph):
+    """The MXU bucket triple, or zero-width placeholders (W == 0 selects
+    the segment static group at trace time)."""
+    if sg.mxu_src is not None:
+        return sg.mxu_src, sg.mxu_dst, sg.mxu_mask
+    S = sg.n_shards
+    return (
+        jnp.zeros((S, S, 1, 0), jnp.int32),
+        jnp.zeros((S, S, 1, 0), jnp.int32),
+        jnp.zeros((S, S, 1, 0), bool),
+    )
+
+
 def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
-                edge_pad_multiple: int = 128) -> ShardedGraph:
+                edge_pad_multiple: int = 128, mxu: bool = False) -> ShardedGraph:
     """Partition ``graph`` for ``mesh`` (host-side; one-off setup).
 
     Nodes are split into ``S`` contiguous blocks. Every active edge lands in
@@ -124,6 +145,11 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     A graph carrying live dynamic edges (sim/topology.py) is sharded
     losslessly: its runtime links are folded into the static buckets (this
     IS the documented consolidation path — re-shard when churn accumulates).
+
+    ``mxu=True`` additionally builds the per-bucket one-hot-matmul layout
+    (see ``ShardedGraph.mxu_src``) — on TPU the ring pass then runs on the
+    MXU instead of XLA's scatter lowering of segment reductions (~2x per
+    chip at 1M nodes; measured in benchmarks/ladder.py).
     """
     S = mesh.shape[axis_name]
     emask = np.asarray(graph.edge_mask)
@@ -164,6 +190,34 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
             bkt_dst[d, t, :n] = receivers[lo:hi] % block
             bkt_mask[d, t, :n] = True
 
+    mxu_src = mxu_dst = mxu_mask = None
+    if mxu:
+        from p2pnetwork_tpu.ops.blocked import (NODE_BLOCK,
+                                                build_blocked_from_arrays)
+
+        per_bucket = []
+        for d in range(S):
+            for t in range(S):
+                b = d * S + t
+                lo_, hi_ = offsets[b], offsets[b + 1]
+                per_bucket.append(build_blocked_from_arrays(
+                    (senders[lo_:hi_] % block).astype(np.int32),
+                    (receivers[lo_:hi_] % block).astype(np.int32),
+                    block, NODE_BLOCK,
+                ))
+        nb = max(be.src.shape[0] for be in per_bucket)
+        w = max(be.width for be in per_bucket)
+        mxu_src = np.zeros((S, S, nb, w), np.int32)
+        mxu_dst = np.zeros((S, S, nb, w), np.int32)
+        mxu_mask = np.zeros((S, S, nb, w), bool)
+        for d in range(S):
+            for t in range(S):
+                be = per_bucket[d * S + t]
+                r, c = be.src.shape
+                mxu_src[d, t, :r, :c] = np.asarray(be.src)
+                mxu_dst[d, t, :r, :c] = np.asarray(be.local_dst)
+                mxu_mask[d, t, :r, :c] = np.asarray(be.mask)
+
     pad_n = S * block - graph.n_nodes_padded
     node_mask = np.pad(np.asarray(graph.node_mask), (0, pad_n))
     out_degree = np.pad(np.asarray(graph.out_degree), (0, pad_n))
@@ -193,6 +247,9 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         neighbors_mask=None if neighbors_mask is None else dev(
             neighbors_mask.reshape(S, block, -1)
         ),
+        mxu_src=None if mxu_src is None else dev(mxu_src),
+        mxu_dst=None if mxu_dst is None else dev(mxu_dst),
+        mxu_mask=None if mxu_mask is None else dev(mxu_mask),
     )
 
 
@@ -239,6 +296,7 @@ def _mesh_of(sg: ShardedGraph) -> Mesh:
 
 def _remask_body(axis_name, S, block,
                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                 mxu_src, mxu_dst, mxu_mask,
                  neighbors, neighbors_mask, node_mask, alive):
     """Per-shard liveness re-mask: an edge survives iff both endpoints do.
 
@@ -296,6 +354,24 @@ def _remask_body(axis_name, S, block,
     else:
         out_degree = cnt[0]
 
+    # MXU bucket re-mask (mirrors sim/failures._remask_blocked): sources by
+    # ring-step liveness, destinations by the local NODE_BLOCK layout.
+    if mxu_src.shape[-1] > 0:
+        from p2pnetwork_tpu.ops.blocked import NODE_BLOCK
+
+        _, nb, w = mxu_src.shape[1:]
+        src_alive = jnp.take_along_axis(
+            masks_by_t, mxu_src[0].reshape(S, nb * w), axis=1
+        ).reshape(S, nb, w)
+        gd = jnp.minimum(
+            jnp.arange(nb, dtype=jnp.int32)[None, :, None] * NODE_BLOCK
+            + mxu_dst[0],
+            block - 1,
+        )
+        mxu_mask_b = mxu_mask[0] & src_alive & nm[gd]
+    else:
+        mxu_mask_b = mxu_mask[0]
+
     # Partner-table re-mask (mirrors sim/failures.py's
     # `neighbor_mask & node_mask[:, None] & node_mask[neighbors]`): the
     # neighbor ids are global, so their liveness comes from the collected
@@ -309,8 +385,8 @@ def _remask_body(axis_name, S, block,
         nbr_mask = neighbors_mask[0] & nm[:, None] & nbr_alive
     else:
         nbr_mask = neighbors_mask[0]
-    return (bkt_mask_b[None], dyn_mask_b[None], nm[None], out_degree[None],
-            in_degree[None], nbr_mask[None])
+    return (bkt_mask_b[None], dyn_mask_b[None], mxu_mask_b[None], nm[None],
+            out_degree[None], in_degree[None], nbr_mask[None])
 
 
 @functools.lru_cache(maxsize=64)
@@ -319,8 +395,8 @@ def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     spec = P(axis_name)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec,) * 10,
-        out_specs=(spec,) * 6,
+        in_specs=(spec,) * 13,
+        out_specs=(spec,) * 7,
     )
     return jax.jit(fn)
 
@@ -337,16 +413,18 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
     alive = jnp.asarray(alive).reshape(sg.n_shards, sg.block)
     mesh = _mesh_of(sg)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     if sg.neighbors is not None:
         neighbors, neighbors_mask = sg.neighbors, sg.neighbors_mask
     else:
         neighbors = jnp.zeros((sg.n_shards, sg.block, 0), jnp.int32)
         neighbors_mask = jnp.zeros((sg.n_shards, sg.block, 0), bool)
     fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block)
-    bkt_mask, dyn_mask, node_mask, out_degree, in_degree, nbr_mask = fn(
+    (bkt_mask, dyn_mask, mxu_mask, node_mask, out_degree, in_degree,
+     nbr_mask) = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, neighbors, neighbors_mask,
-        sg.node_mask, alive,
+        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        neighbors, neighbors_mask, sg.node_mask, alive,
     )
     return dataclasses.replace(
         sg,
@@ -356,6 +434,7 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
         in_degree=in_degree,
         dyn_mask=dyn_mask if sg.dyn_mask is not None else None,
         neighbors_mask=nbr_mask if sg.neighbors_mask is not None else None,
+        mxu_mask=mxu_mask if sg.mxu_mask is not None else None,
     )
 
 
@@ -655,6 +734,8 @@ def topology_state(sg: ShardedGraph) -> dict:
         ts["dyn_mask"] = sg.dyn_mask
     if sg.neighbors_mask is not None:
         ts["neighbors_mask"] = sg.neighbors_mask
+    if sg.mxu_mask is not None:
+        ts["mxu_mask"] = sg.mxu_mask
     return ts
 
 
@@ -693,26 +774,30 @@ def _ring_perm(S: int):
 
 
 def _ring_pass(axis_name, S, frontier, groups, acc0, combine):
-    """One full ring rotation. ``groups`` is a sequence of
-    ``(src [S, W], dst [S, W], mask [S, W], apply_fn)`` bucket groups —
-    static (dst-sorted) and dynamic (unsorted) edges ride the same
-    rotation; at step ``t`` each group's bucket ``t`` consumes the resident
-    block, folding results with ``combine``.
+    """One full ring rotation. ``groups`` is a sequence of ``(apply_fn,
+    *arrays)`` bucket groups, every array carrying a leading ring-step axis
+    ``[S, ...]`` — static (dst-sorted segment or MXU-blocked) and dynamic
+    (unsorted) edges ride the same rotation; at step ``t`` each group's
+    bucket ``t`` consumes the resident block, folding results with
+    ``combine``.
 
     The last bucket is peeled out of the scan: after it is applied there is
     nothing left to rotate, so running its ppermute would be one wasted ICI
-    collective per pass. Zero-width groups (unused dynamic capacity) are
-    skipped at trace time.
+    collective per pass. Zero-width groups (unused dynamic capacity,
+    absent MXU layout) are skipped at trace time.
     """
-    groups = [g for g in groups if g[0].shape[-1] > 0]
+    groups = [g for g in groups if g[1].shape[-1] > 0]
+    meta = []
     arrays = []
-    for src, dst, m, _ in groups:
-        arrays += [src, dst, m]
+    for fn, *arrs in groups:
+        meta.append((fn, len(arrs)))
+        arrays += arrs
 
-    def apply_all(acc, rot, bkt_arrays):
-        for gi, (_, _, _, fn) in enumerate(groups):
-            bs, bd, bm = bkt_arrays[3 * gi: 3 * gi + 3]
-            acc = combine(acc, fn(rot, bs, bd, bm))
+    def apply_all(acc, rot, xs):
+        i = 0
+        for fn, n in meta:
+            acc = combine(acc, fn(rot, *xs[i: i + n]))
+            i += n
         return acc
 
     def ring_step(rc, bkt_arrays):
@@ -752,18 +837,44 @@ def _bucket_sum(block, sorted_dst=True):
     return apply
 
 
-def _groups_or(block, buckets, dyn_buckets):
-    return [
-        (*buckets, _bucket_or(block, sorted_dst=True)),
-        (*dyn_buckets, _bucket_or(block, sorted_dst=False)),
-    ]
+def _bucket_or_mxu(block):
+    """Bucket OR via the shared one-hot-matmul core (ops/blocked.py) —
+    bf16 inputs are exact on 0/1 contributions, accumulation is f32."""
+    from p2pnetwork_tpu.ops.blocked import NODE_BLOCK, onehot_apply
+
+    def apply(rot, src, dst, m):  # [NB, W] each
+        contrib = (rot[src] & m).astype(jnp.bfloat16)
+        return onehot_apply(contrib, dst, NODE_BLOCK, block) > 0
+
+    return apply
 
 
-def _groups_sum(block, buckets, dyn_buckets):
-    return [
-        (*buckets, _bucket_sum(block, sorted_dst=True)),
-        (*dyn_buckets, _bucket_sum(block, sorted_dst=False)),
-    ]
+def _bucket_sum_mxu(block):
+    from p2pnetwork_tpu.ops.blocked import NODE_BLOCK, onehot_apply
+
+    def apply(rot, src, dst, m):  # rot f32[B]; src/dst i32[NB, W]
+        contrib = (rot[src] * m).astype(jnp.bfloat16)  # 0/1 exact in bf16
+        return onehot_apply(contrib, dst, NODE_BLOCK, block)
+
+    return apply
+
+
+def _groups_or(block, buckets, dyn_buckets, mxu_buckets):
+    static = (
+        (_bucket_or_mxu(block), *mxu_buckets)
+        if mxu_buckets[0].shape[-1] > 0
+        else (_bucket_or(block, sorted_dst=True), *buckets)
+    )
+    return [static, (_bucket_or(block, sorted_dst=False), *dyn_buckets)]
+
+
+def _groups_sum(block, buckets, dyn_buckets, mxu_buckets):
+    static = (
+        (_bucket_sum_mxu(block), *mxu_buckets)
+        if mxu_buckets[0].shape[-1] > 0
+        else (_bucket_sum(block, sorted_dst=True), *buckets)
+    )
+    return [static, (_bucket_sum(block, sorted_dst=False), *dyn_buckets)]
 
 
 # -------------------------------------------------------------------- flood
@@ -771,12 +882,14 @@ def _groups_sum(block, buckets, dyn_buckets):
 
 def _ring_rounds_or(axis_name, S, block,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                    mxu_src, mxu_dst, mxu_mask,
                     node_mask, out_degree, seen0, frontier0, rounds):
     """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
     full ring pass. All blocks carry a leading length-1 shard axis."""
     groups = _groups_or(
         block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator, like models/flood.py — under failures the
@@ -813,7 +926,7 @@ def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int):
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
-        in_specs=(spec,) * 10,
+        in_specs=(spec,) * 13,
         out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)
@@ -848,8 +961,10 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
     seen0, frontier0 = state0
     fn = _flood_fn(mesh, axis_name, S, block, rounds)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     seen, frontier, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask,
         sg.node_mask, sg.out_degree, seen0, frontier0,
     )
     if return_state:
@@ -862,6 +977,7 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
 
 def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                      mxu_src, mxu_dst, mxu_mask,
                       node_mask, out_degree, seen0, frontier0):
     """Per-shard body: flood until the psum'd live coverage reaches the
     target — the device-side early-exit ``lax.while_loop`` of
@@ -872,6 +988,7 @@ def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
     groups = _groups_or(
         block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     n_live = jnp.maximum(
@@ -915,7 +1032,7 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     fn = jax.shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh,
-        in_specs=(P(),) + (spec,) * 10,
+        in_specs=(P(),) + (spec,) * 13,
         out_specs=(spec, spec, P(), P(), P(), P()),
     )
     return jax.jit(fn)
@@ -944,9 +1061,11 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     seen0, frontier0 = state0
     fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     seen, frontier, rounds, coverage, hi, lo = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask,
         sg.node_mask, sg.out_degree, seen0, frontier0,
     )
     out = {
@@ -1150,6 +1269,7 @@ def _resolve_rng(sg: ShardedGraph, exact_rng: bool, rng: Optional[str]) -> str:
 
 def _make_sir_round(axis_name, S, block, rng,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                    mxu_src, mxu_dst, mxu_mask,
                     node_mask, out_degree, one_minus_beta, gamma):
     """Build the per-shard SIR round closure (shared by the fixed-rounds
     scan and the run-to-coverage while_loop): ``one_round(status, key) ->
@@ -1163,6 +1283,7 @@ def _make_sir_round(axis_name, S, block, rng,
     groups = _groups_sum(
         block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator (models/sir.py parity under failures).
@@ -1214,14 +1335,15 @@ def _make_sir_round(axis_name, S, block, rng,
 
 def _ring_rounds_sir(axis_name, S, block, rng,
                      bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                     mxu_src, mxu_dst, mxu_mask,
                      node_mask, out_degree,
                      status0, round_keys, one_minus_beta, gamma, rounds):
     """Per-shard body: ``rounds`` SIR rounds (scan over replicated raw key
     data, engine.run key-schedule parity)."""
     one_round = _make_sir_round(
         axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, node_mask, out_degree,
-        one_minus_beta, gamma,
+        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        node_mask, out_degree, one_minus_beta, gamma,
     )
 
     def body(status, rkey):
@@ -1233,6 +1355,7 @@ def _ring_rounds_sir(axis_name, S, block, rng,
 
 def _ring_coverage_sir(axis_name, S, block, rng, coverage_target, max_rounds,
                        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                       mxu_src, mxu_dst, mxu_mask,
                        node_mask, out_degree,
                        status0, key_data, one_minus_beta, gamma):
     """Per-shard body: SIR until ever-infected coverage reaches the target
@@ -1240,8 +1363,8 @@ def _ring_coverage_sir(axis_name, S, block, rng, coverage_target, max_rounds,
     round). Messages accumulate in the two-limb counter."""
     one_round = _make_sir_round(
         axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, node_mask, out_degree,
-        one_minus_beta, gamma,
+        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        node_mask, out_degree, one_minus_beta, gamma,
     )
 
     def cond(carry):
@@ -1279,7 +1402,7 @@ def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     fn = jax.shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh,
-        in_specs=(P(),) + (spec,) * 9 + (P(), P(), P()),
+        in_specs=(P(),) + (spec,) * 12 + (P(), P(), P()),
         out_specs=(spec, P(), P(), P(), P()),
     )
     return jax.jit(fn)
@@ -1307,9 +1430,11 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     fn = _sir_cov_fn(mesh, axis_name, S, block, max_rounds,
                      _resolve_rng(sg, exact_rng, rng))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, rounds, coverage, hi, lo = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask,
         sg.node_mask, sg.out_degree, status0,
         jax.random.key_data(key),
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
@@ -1329,7 +1454,7 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
-        in_specs=(spec,) * 9 + (P(), P(), P()),
+        in_specs=(spec,) * 12 + (P(), P(), P()),
         out_specs=(spec, P()),
     )
     return jax.jit(fn)
@@ -1358,8 +1483,10 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
     fn = _sir_fn(mesh, axis_name, S, block, rounds,
                  _resolve_rng(sg, exact_rng, rng))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask,
         sg.node_mask, sg.out_degree,
         status0, round_keys,
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
