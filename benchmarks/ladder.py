@@ -239,19 +239,23 @@ def bench_flood_sharded_ring():
     g = G.watts_strogatz(1_000_000, 10, 0.1, seed=0,
                          build_neighbor_table=False)
     results = {}
-    for mxu in (False, True):
-        sg = sharded.shard_graph(g, mesh, mxu=mxu)
+    for label, kw in (("segment", {}), ("mxu", dict(mxu=True)),
+                      ("hybrid", dict(hybrid=True))):
+        sg = sharded.shard_graph(g, mesh, **kw)
         seen, out = sharded.flood_until_coverage(sg, mesh, source=0)  # warm
         t0 = time.perf_counter()
         seen, out = sharded.flood_until_coverage(sg, mesh, source=0)
         _ = out["messages"]  # blocking summary transfer
-        results["mxu" if mxu else "segment"] = time.perf_counter() - t0
+        results[label] = time.perf_counter() - t0
     emit({
         "config": f"1M WS flood, ring-sharded ({mesh.devices.size} dev)",
-        "value": round(results["mxu"], 4),
-        "unit": "s to 99% coverage (MXU buckets)",
+        "value": round(results["hybrid"], 4),
+        "unit": "s to 99% coverage (ring-decomposed diagonals + MXU remainder)",
         "segment_s": round(results["segment"], 4),
-        "mxu_speedup": round(results["segment"] / results["mxu"], 2),
+        "mxu_s": round(results["mxu"], 4),
+        "hybrid_speedup_vs_segment": round(
+            results["segment"] / results["hybrid"], 2
+        ),
         "rounds": int(np.asarray(out["rounds"])),
     })
 

@@ -59,11 +59,12 @@ def build_blocked(graph: Graph, block: int = NODE_BLOCK) -> BlockedEdges:
     return build_blocked_from_arrays(senders, receivers, graph.n_nodes_padded, block)
 
 
-def build_blocked_from_arrays(
+def build_blocked_arrays_np(
     senders: np.ndarray, receivers: np.ndarray, n_pad: int, block: int = NODE_BLOCK
-) -> BlockedEdges:
-    """Blocked representation from host edge arrays (``receivers`` sorted
-    non-decreasing; any subset of a graph's active edges qualifies)."""
+):
+    """The blocked layout as HOST arrays ``(src, local_dst, mask)`` —
+    callers that repack many layouts (the sharded ring builds one per
+    bucket) stay in numpy instead of paying a device round trip each."""
     nb = _round_up(n_pad, block) // block
 
     blk = receivers // block
@@ -81,7 +82,15 @@ def build_blocked_from_arrays(
     take = np.minimum(take, max(e - 1, 0))
     src = np.where(mask, src_pool[take], 0).astype(np.int32)
     local_dst = np.where(mask, dst_pool[take] % block, 0).astype(np.int32)
+    return src, local_dst, mask
 
+
+def build_blocked_from_arrays(
+    senders: np.ndarray, receivers: np.ndarray, n_pad: int, block: int = NODE_BLOCK
+) -> BlockedEdges:
+    """Blocked representation from host edge arrays (``receivers`` sorted
+    non-decreasing; any subset of a graph's active edges qualifies)."""
+    src, local_dst, mask = build_blocked_arrays_np(senders, receivers, n_pad, block)
     return BlockedEdges(
         src=jnp.asarray(src),
         local_dst=jnp.asarray(local_dst),
@@ -95,8 +104,7 @@ def onehot_apply(contrib: jax.Array, local_dst: jax.Array, block: int,
     """The one-hot-matmul core: reduce ``contrib [NB, W]`` into its
     destinations — ``out[v] = sum_w contrib[nb, w] * (local_dst == v%block)``
     — as one batched einsum (MXU work, no scatter). f32 accumulation;
-    bf16 ``contrib`` is exact for 0/1 payloads. Shared by the single-chip
-    blocked path and the sharded ring's MXU buckets (parallel/sharded.py).
+    bf16 ``contrib`` is exact for 0/1 payloads.
     """
     onehot = (
         local_dst[:, :, None]

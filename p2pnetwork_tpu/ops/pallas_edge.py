@@ -65,12 +65,9 @@ def _is_cpu() -> bool:
     return jax.default_backend() == "cpu"
 
 
-@functools.partial(
-    jax.jit, static_argnames=("block", "tile_w", "interpret", "exact")
-)
-def segment_sum_pallas(contrib: jax.Array, local_dst: jax.Array,
-                       block: int = 128, tile_w: int = TILE_W,
-                       interpret: bool | None = None, exact: bool = True):
+def segment_sum_pallas_impl(contrib: jax.Array, local_dst: jax.Array,
+                            block: int = 128, tile_w: int = TILE_W,
+                            interpret: bool | None = None, exact: bool = True):
     """Blocked segment sum: ``out[n, b] = sum_w contrib[n, w] * (dst[n, w] == b)``.
 
     ``contrib`` f32[NB, W] (masked slots must be 0), ``local_dst`` i32[NB, W]
@@ -114,6 +111,17 @@ def segment_sum_pallas(contrib: jax.Array, local_dst: jax.Array,
         interpret=interpret,
     )(contrib, local_dst)
     return out[:nb]
+
+
+#: Jitted entry for eager callers. In-jit callers — notably the sharded
+#: ring's bucket apply, which runs inside a shard_map body with
+#: check_vma=False — use ``segment_sum_pallas_impl`` directly: a nested
+#: jit inside a vma-typed shard_map trips a lowering-cache bug in current
+#: JAX, which is also why those shard_maps disable vma checking.
+segment_sum_pallas = jax.jit(
+    segment_sum_pallas_impl,
+    static_argnames=("block", "tile_w", "interpret", "exact"),
+)
 
 
 def propagate_sum_pallas(blocked: BlockedEdges, signal: jax.Array,

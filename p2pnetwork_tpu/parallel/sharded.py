@@ -93,9 +93,24 @@ class ShardedGraph:
     # the ring pass applies buckets as batched one-hot matmuls instead of
     # segment reductions — XLA's TPU scatter lowering is the ring path's
     # bottleneck. ``mxu_dst`` is the destination index WITHIN its 128-block.
+    # Under ``hybrid=True`` these hold only the non-diagonal REMAINDER.
     mxu_src: Optional[jax.Array] = None  # i32[S, S, NB, W]
     mxu_dst: Optional[jax.Array] = None  # i32[S, S, NB, W]
     mxu_mask: Optional[jax.Array] = None  # bool[S, S, NB, W]
+    # Ring-decomposed circular diagonals (shard_graph(..., hybrid=True)):
+    # a global diagonal ``u = (v + off) mod n`` splits into at most two
+    # STATIC (ring_step, local_shift) pieces — identical on every shard —
+    # with per-shard validity masks. Applying a piece is one static
+    # ``jnp.roll`` of the resident block plus a mask: pure VPU traffic,
+    # the sharded mirror of ops/diag.py's gather-free fast path.
+    diag_masks: Optional[jax.Array] = None  # bool[S, P, B]
+    diag_pieces: Tuple[Tuple[int, int], ...] = dataclasses.field(
+        default=(), metadata=dict(static=True)
+    )  # ((ring_step, local_shift), ...) per mask row
+    #: Destination-block width of the MXU layout (512 cuts Poisson padding
+    #: waste vs 128 at the cost of a wider one-hot, like ops/diag.py).
+    mxu_block: int = dataclasses.field(default=128,
+                                       metadata=dict(static=True))
 
     @property
     def n_nodes_padded(self) -> int:
@@ -120,6 +135,14 @@ def _dyn_or_empty(sg: ShardedGraph):
     )
 
 
+def _diag_masks_or_empty(sg: ShardedGraph):
+    """The diagonal piece masks, or a zero-piece placeholder (``P == 0``
+    pairs with the empty static ``diag_pieces`` tuple)."""
+    if sg.diag_masks is not None:
+        return sg.diag_masks
+    return jnp.zeros((sg.n_shards, 0, sg.block), bool)
+
+
 def _mxu_or_empty(sg: ShardedGraph):
     """The MXU bucket triple, or zero-width placeholders (W == 0 selects
     the segment static group at trace time)."""
@@ -133,8 +156,76 @@ def _mxu_or_empty(sg: ShardedGraph):
     )
 
 
+def _extract_ring_diagonals(senders, receivers, n, S, block, max_diags,
+                            min_count):
+    """Select dominant circular diagonals and decompose each into static
+    ring pieces (host-side; see ShardedGraph.diag_pieces).
+
+    Returns ``(pieces, masks [S, P, block], diag_sel)`` where ``diag_sel``
+    flags the edges covered (the rest go to the bucket remainder). Edges
+    whose signed offset wraps the real-node boundary (``v + off_s`` outside
+    ``[0, n)``) stay in the remainder — only the uniform no-wrap body of a
+    diagonal has the shard-invariant piece structure.
+    """
+    diag_sel = np.zeros(senders.shape[0], dtype=bool)
+    if min_count is None:
+        min_count = max(n // 256, 128)
+    off = (senders.astype(np.int64) - receivers.astype(np.int64)) % n
+    pieces = []
+    mask_rows = []
+    if off.size:
+        counts = np.bincount(off)
+        ok = counts >= min_count
+        ok[0] = False
+        cand = np.flatnonzero(ok)
+        kept = [int(o) for o in
+                cand[np.argsort(counts[cand])[::-1]][:max_diags]]
+        by_off = np.argsort(off, kind="stable")
+        lo = np.searchsorted(off[by_off], kept)
+        hi = np.searchsorted(off[by_off], kept, side="right")
+        for i, o in enumerate(kept):
+            sel = by_off[lo[i]:hi[i]]
+            # One mask slot per receiver: duplicate (offset, receiver)
+            # pairs beyond the first stay in the remainder (sum parity).
+            _, first = np.unique(receivers[sel], return_index=True)
+            sel = sel[first]
+            off_s = o if o <= n // 2 else o - n
+            v = receivers[sel].astype(np.int64)
+            nowrap = (v + off_s >= 0) & (v + off_s < n)
+            sel = sel[nowrap]
+            if not sel.size:
+                continue
+            diag_sel[sel] = True
+            dmask = np.zeros(S * block, dtype=bool)
+            dmask[receivers[sel]] = True
+            dmask = dmask.reshape(S, block)
+            q, r = divmod(off_s, block)  # floor division: r in [0, block)
+            j = np.arange(block)
+            piece_a = dmask & (j + r < block)[None, :]
+            piece_b = dmask & (j + r >= block)[None, :]
+            t_a = (-q) % S
+            t_b = (-q - 1) % S
+            if S == 1 or t_a == t_b:
+                if piece_a.any() or piece_b.any():
+                    pieces.append((t_a, int(r)))
+                    mask_rows.append(dmask)
+            else:
+                if piece_a.any():
+                    pieces.append((t_a, int(r)))
+                    mask_rows.append(piece_a)
+                if piece_b.any():
+                    pieces.append((t_b, int(r)))
+                    mask_rows.append(piece_b)
+    if not pieces:
+        return (), None, diag_sel
+    masks = np.stack(mask_rows, axis=1)  # [S, P, block]
+    return tuple(pieces), masks, diag_sel
+
+
 def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
-                edge_pad_multiple: int = 128, mxu: bool = False) -> ShardedGraph:
+                edge_pad_multiple: int = 128, mxu: bool = False,
+                hybrid: bool = False, max_diags: int = 64,
+                min_count: Optional[int] = None) -> ShardedGraph:
     """Partition ``graph`` for ``mesh`` (host-side; one-off setup).
 
     Nodes are split into ``S`` contiguous blocks. Every active edge lands in
@@ -161,62 +252,78 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         receivers = np.concatenate([receivers, np.asarray(graph.dyn_receivers)[dmask]])
 
     block = _round_up(graph.n_nodes_padded, S) // S
-    src_shard = senders // block
-    dst_shard = receivers // block
-    step = (dst_shard - src_shard) % S
 
-    # Bucket sizes -> common padded width.
-    flat = dst_shard * S + step
-    counts = np.bincount(flat, minlength=S * S)
-    e_bkt = _round_up(max(int(counts.max()), 1), edge_pad_multiple)
+    # Diagonal extraction must precede bucketing (the selection indexes the
+    # unsorted edge arrays); the covered edges leave the APPLIED remainder
+    # but stay in the bkt_* truth arrays below (degrees, probe, remask).
+    diag_pieces: Tuple[Tuple[int, int], ...] = ()
+    diag_masks = None
+    if hybrid:
+        diag_pieces, diag_masks, diag_sel = _extract_ring_diagonals(
+            senders, receivers, graph.n_nodes, S, block, max_diags, min_count
+        )
+        mxu = True  # the remainder rides the MXU buckets
+    else:
+        diag_sel = np.zeros(senders.shape[0], dtype=bool)
 
+    def _bucketize(s_arr, r_arr):
+        """Sort edges by (bucket, local dst); return sorted arrays + bucket
+        offsets (bucket = dst_shard * S + ring_step)."""
+        flat = (r_arr // block) * S + ((r_arr // block) - (s_arr // block)) % S
+        order = np.lexsort((r_arr, flat))
+        s_arr, r_arr, flat = s_arr[order], r_arr[order], flat[order]
+        offs = np.zeros(S * S + 1, dtype=np.int64)
+        np.cumsum(np.bincount(flat, minlength=S * S), out=offs[1:])
+        return s_arr, r_arr, offs
+
+    senders_b, receivers_b, offsets = _bucketize(senders, receivers)
+    e_bkt = _round_up(
+        max(int(np.diff(offsets).max()), 1), edge_pad_multiple
+    )
     bkt_src = np.zeros((S, S, e_bkt), dtype=np.int32)
     # Pad destinations with block-1 so each bucket stays dst-sorted — the
     # segment reductions in the ring body promise indices_are_sorted=True.
     bkt_dst = np.full((S, S, e_bkt), block - 1, dtype=np.int32)
     bkt_mask = np.zeros((S, S, e_bkt), dtype=bool)
-
-    # Sort edges by (bucket, local dst) so each bucket is dst-sorted.
-    order = np.lexsort((receivers, flat))
-    senders, receivers, flat = senders[order], receivers[order], flat[order]
-    offsets = np.zeros(S * S + 1, dtype=np.int64)
-    np.cumsum(np.bincount(flat, minlength=S * S), out=offsets[1:])
     for d in range(S):
         for t in range(S):
             b = d * S + t
             lo, hi = offsets[b], offsets[b + 1]
-            n = hi - lo
-            bkt_src[d, t, :n] = senders[lo:hi] % block
-            bkt_dst[d, t, :n] = receivers[lo:hi] % block
-            bkt_mask[d, t, :n] = True
+            cnt = hi - lo
+            bkt_src[d, t, :cnt] = senders_b[lo:hi] % block
+            bkt_dst[d, t, :cnt] = receivers_b[lo:hi] % block
+            bkt_mask[d, t, :cnt] = True
 
     mxu_src = mxu_dst = mxu_mask = None
+    mxu_block = 512  # ops/diag.py's remainder block: less padding waste
     if mxu:
-        from p2pnetwork_tpu.ops.blocked import (NODE_BLOCK,
-                                                build_blocked_from_arrays)
+        from p2pnetwork_tpu.ops.blocked import build_blocked_arrays_np
 
+        rem_s, rem_r, rem_offs = _bucketize(
+            senders[~diag_sel], receivers[~diag_sel]
+        )
         per_bucket = []
         for d in range(S):
             for t in range(S):
                 b = d * S + t
-                lo_, hi_ = offsets[b], offsets[b + 1]
-                per_bucket.append(build_blocked_from_arrays(
-                    (senders[lo_:hi_] % block).astype(np.int32),
-                    (receivers[lo_:hi_] % block).astype(np.int32),
-                    block, NODE_BLOCK,
+                lo_, hi_ = rem_offs[b], rem_offs[b + 1]
+                per_bucket.append(build_blocked_arrays_np(
+                    (rem_s[lo_:hi_] % block).astype(np.int32),
+                    (rem_r[lo_:hi_] % block).astype(np.int32),
+                    block, mxu_block,
                 ))
-        nb = max(be.src.shape[0] for be in per_bucket)
-        w = max(be.width for be in per_bucket)
+        nb = max(bs.shape[0] for bs, _, _ in per_bucket)
+        w = max(bs.shape[1] for bs, _, _ in per_bucket)
         mxu_src = np.zeros((S, S, nb, w), np.int32)
         mxu_dst = np.zeros((S, S, nb, w), np.int32)
         mxu_mask = np.zeros((S, S, nb, w), bool)
         for d in range(S):
             for t in range(S):
-                be = per_bucket[d * S + t]
-                r, c = be.src.shape
-                mxu_src[d, t, :r, :c] = np.asarray(be.src)
-                mxu_dst[d, t, :r, :c] = np.asarray(be.local_dst)
-                mxu_mask[d, t, :r, :c] = np.asarray(be.mask)
+                bs, bd, bm = per_bucket[d * S + t]
+                r, c = bs.shape
+                mxu_src[d, t, :r, :c] = bs
+                mxu_dst[d, t, :r, :c] = bd
+                mxu_mask[d, t, :r, :c] = bm
 
     pad_n = S * block - graph.n_nodes_padded
     node_mask = np.pad(np.asarray(graph.node_mask), (0, pad_n))
@@ -250,6 +357,9 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         mxu_src=None if mxu_src is None else dev(mxu_src),
         mxu_dst=None if mxu_dst is None else dev(mxu_dst),
         mxu_mask=None if mxu_mask is None else dev(mxu_mask),
+        diag_masks=None if diag_masks is None else dev(diag_masks),
+        diag_pieces=diag_pieces,
+        mxu_block=mxu_block,
     )
 
 
@@ -294,9 +404,9 @@ def _mesh_of(sg: ShardedGraph) -> Mesh:
     return mesh
 
 
-def _remask_body(axis_name, S, block,
+def _remask_body(axis_name, S, block, pieces, mxu_block,
                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                 mxu_src, mxu_dst, mxu_mask,
+                 mxu_src, mxu_dst, mxu_mask, diag_masks,
                  neighbors, neighbors_mask, node_mask, alive):
     """Per-shard liveness re-mask: an edge survives iff both endpoints do.
 
@@ -355,22 +465,31 @@ def _remask_body(axis_name, S, block,
         out_degree = cnt[0]
 
     # MXU bucket re-mask (mirrors sim/failures._remask_blocked): sources by
-    # ring-step liveness, destinations by the local NODE_BLOCK layout.
+    # ring-step liveness, destinations by the local mxu_block layout.
     if mxu_src.shape[-1] > 0:
-        from p2pnetwork_tpu.ops.blocked import NODE_BLOCK
-
         _, nb, w = mxu_src.shape[1:]
         src_alive = jnp.take_along_axis(
             masks_by_t, mxu_src[0].reshape(S, nb * w), axis=1
         ).reshape(S, nb, w)
         gd = jnp.minimum(
-            jnp.arange(nb, dtype=jnp.int32)[None, :, None] * NODE_BLOCK
+            jnp.arange(nb, dtype=jnp.int32)[None, :, None] * mxu_block
             + mxu_dst[0],
             block - 1,
         )
         mxu_mask_b = mxu_mask[0] & src_alive & nm[gd]
     else:
         mxu_mask_b = mxu_mask[0]
+
+    # Diagonal-piece re-mask: a piece edge u -> v needs v alive (nm) and
+    # u alive — u sits at local (j + r) % B of the block resident at the
+    # piece's ring step, i.e. the same static roll the apply uses.
+    if pieces:
+        dm = diag_masks[0]
+        rows = [dm[pi] & nm & jnp.roll(masks_by_t[tp], -r)
+                for pi, (tp, r) in enumerate(pieces)]
+        diag_masks_b = jnp.stack(rows, axis=0)
+    else:
+        diag_masks_b = diag_masks[0]
 
     # Partner-table re-mask (mirrors sim/failures.py's
     # `neighbor_mask & node_mask[:, None] & node_mask[neighbors]`): the
@@ -385,18 +504,21 @@ def _remask_body(axis_name, S, block,
         nbr_mask = neighbors_mask[0] & nm[:, None] & nbr_alive
     else:
         nbr_mask = neighbors_mask[0]
-    return (bkt_mask_b[None], dyn_mask_b[None], mxu_mask_b[None], nm[None],
-            out_degree[None], in_degree[None], nbr_mask[None])
+    return (bkt_mask_b[None], dyn_mask_b[None], mxu_mask_b[None],
+            diag_masks_b[None], nm[None], out_degree[None], in_degree[None],
+            nbr_mask[None])
 
 
 @functools.lru_cache(maxsize=64)
-def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int):
-    body = functools.partial(_remask_body, axis_name, S, block)
+def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int, pieces=(),
+               mxu_block: int = 128):
+    body = functools.partial(_remask_body, axis_name, S, block, pieces,
+                             mxu_block)
     spec = P(axis_name)
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec,) * 13,
-        out_specs=(spec,) * 7,
+        in_specs=(spec,) * 14,
+        out_specs=(spec,) * 8,
     )
     return jax.jit(fn)
 
@@ -419,11 +541,13 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
     else:
         neighbors = jnp.zeros((sg.n_shards, sg.block, 0), jnp.int32)
         neighbors_mask = jnp.zeros((sg.n_shards, sg.block, 0), bool)
-    fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block)
-    (bkt_mask, dyn_mask, mxu_mask, node_mask, out_degree, in_degree,
-     nbr_mask) = fn(
+    fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block,
+                    sg.diag_pieces, sg.mxu_block)
+    (bkt_mask, dyn_mask, mxu_mask, diag_masks, node_mask, out_degree,
+     in_degree, nbr_mask) = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
         dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        _diag_masks_or_empty(sg),
         neighbors, neighbors_mask, sg.node_mask, alive,
     )
     return dataclasses.replace(
@@ -435,6 +559,7 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
         dyn_mask=dyn_mask if sg.dyn_mask is not None else None,
         neighbors_mask=nbr_mask if sg.neighbors_mask is not None else None,
         mxu_mask=mxu_mask if sg.mxu_mask is not None else None,
+        diag_masks=diag_masks if sg.diag_masks is not None else None,
     )
 
 
@@ -736,6 +861,8 @@ def topology_state(sg: ShardedGraph) -> dict:
         ts["neighbors_mask"] = sg.neighbors_mask
     if sg.mxu_mask is not None:
         ts["mxu_mask"] = sg.mxu_mask
+    if sg.diag_masks is not None:
+        ts["diag_masks"] = sg.diag_masks
     return ts
 
 
@@ -773,7 +900,34 @@ def _ring_perm(S: int):
     return [(i, (i + 1) % S) for i in range(S)]
 
 
-def _ring_pass(axis_name, S, frontier, groups, acc0, combine):
+def _ring_pass_unrolled(axis_name, S, rot, groups, diag, acc0, combine):
+    """Unrolled ring rotation (used when diagonal pieces are present: each
+    piece applies at a STATIC step with a STATIC shift, which a lax.scan
+    body cannot express). S is small; the unroll is the same structure the
+    single-chip hybrid uses for its diagonal stack."""
+    pieces, masks, apply_diag = diag
+    acc = acc0
+    for t in range(S):
+        for fn, *arrs in groups:
+            acc = combine(acc, fn(rot, *(a[t] for a in arrs)))
+        for pi, (tp, r) in enumerate(pieces):
+            if tp == t:
+                acc = combine(acc, apply_diag(rot, r, masks[pi]))
+        if t < S - 1:
+            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
+    return acc
+
+
+def _diag_or_piece(rot, r, mask):
+    """out[j] |= rot[(j + r) % B] & mask[j] — a static circular shift."""
+    return jnp.roll(rot, -r) & mask
+
+
+def _diag_sum_piece(rot, r, mask):
+    return jnp.roll(rot, -r) * mask
+
+
+def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None):
     """One full ring rotation. ``groups`` is a sequence of ``(apply_fn,
     *arrays)`` bucket groups, every array carrying a leading ring-step axis
     ``[S, ...]`` — static (dst-sorted segment or MXU-blocked) and dynamic
@@ -787,6 +941,9 @@ def _ring_pass(axis_name, S, frontier, groups, acc0, combine):
     absent MXU layout) are skipped at trace time.
     """
     groups = [g for g in groups if g[1].shape[-1] > 0]
+    if diag is not None and diag[0]:
+        return _ring_pass_unrolled(axis_name, S, frontier, groups, diag,
+                                   acc0, combine)
     meta = []
     arrays = []
     for fn, *arrs in groups:
@@ -837,40 +994,43 @@ def _bucket_sum(block, sorted_dst=True):
     return apply
 
 
-def _bucket_or_mxu(block):
-    """Bucket OR via the shared one-hot-matmul core (ops/blocked.py) —
-    bf16 inputs are exact on 0/1 contributions, accumulation is f32."""
-    from p2pnetwork_tpu.ops.blocked import NODE_BLOCK, onehot_apply
+def _bucket_or_mxu(block, mxu_block):
+    """Bucket OR via the fused Pallas one-hot-matmul kernel
+    (ops/pallas_edge.py — the one-hot never touches HBM); 0/1
+    contributions are exact in the single-pass MXU mode."""
+    from p2pnetwork_tpu.ops.pallas_edge import segment_sum_pallas_impl
 
     def apply(rot, src, dst, m):  # [NB, W] each
-        contrib = (rot[src] & m).astype(jnp.bfloat16)
-        return onehot_apply(contrib, dst, NODE_BLOCK, block) > 0
+        contrib = (rot[src] & m).astype(jnp.float32)
+        out = segment_sum_pallas_impl(contrib, dst, mxu_block, exact=False)
+        return out.reshape(-1)[:block] > 0
 
     return apply
 
 
-def _bucket_sum_mxu(block):
-    from p2pnetwork_tpu.ops.blocked import NODE_BLOCK, onehot_apply
+def _bucket_sum_mxu(block, mxu_block):
+    from p2pnetwork_tpu.ops.pallas_edge import segment_sum_pallas_impl
 
     def apply(rot, src, dst, m):  # rot f32[B]; src/dst i32[NB, W]
-        contrib = (rot[src] * m).astype(jnp.bfloat16)  # 0/1 exact in bf16
-        return onehot_apply(contrib, dst, NODE_BLOCK, block)
+        contrib = rot[src] * m  # 0/1 pressure: exact in single-pass mode
+        out = segment_sum_pallas_impl(contrib, dst, mxu_block, exact=False)
+        return out.reshape(-1)[:block]
 
     return apply
 
 
-def _groups_or(block, buckets, dyn_buckets, mxu_buckets):
+def _groups_or(block, mxu_block, buckets, dyn_buckets, mxu_buckets):
     static = (
-        (_bucket_or_mxu(block), *mxu_buckets)
+        (_bucket_or_mxu(block, mxu_block), *mxu_buckets)
         if mxu_buckets[0].shape[-1] > 0
         else (_bucket_or(block, sorted_dst=True), *buckets)
     )
     return [static, (_bucket_or(block, sorted_dst=False), *dyn_buckets)]
 
 
-def _groups_sum(block, buckets, dyn_buckets, mxu_buckets):
+def _groups_sum(block, mxu_block, buckets, dyn_buckets, mxu_buckets):
     static = (
-        (_bucket_sum_mxu(block), *mxu_buckets)
+        (_bucket_sum_mxu(block, mxu_block), *mxu_buckets)
         if mxu_buckets[0].shape[-1] > 0
         else (_bucket_sum(block, sorted_dst=True), *buckets)
     )
@@ -880,17 +1040,18 @@ def _groups_sum(block, buckets, dyn_buckets, mxu_buckets):
 # -------------------------------------------------------------------- flood
 
 
-def _ring_rounds_or(axis_name, S, block,
+def _ring_rounds_or(axis_name, S, block, pieces, mxu_block,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                    mxu_src, mxu_dst, mxu_mask,
+                    mxu_src, mxu_dst, mxu_mask, diag_masks,
                     node_mask, out_degree, seen0, frontier0, rounds):
     """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
     full ring pass. All blocks carry a leading length-1 shard axis."""
     groups = _groups_or(
-        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
         (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
+    diag = (pieces, diag_masks[0], _diag_or_piece)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator, like models/flood.py — under failures the
     # coverage must be of SURVIVORS, or dead-but-seen nodes push it past 1.
@@ -901,7 +1062,8 @@ def _ring_rounds_or(axis_name, S, block,
     def one_round(carry, _):
         seen, frontier = carry  # [block] bool each
         delivered = _ring_pass(axis_name, S, frontier, groups,
-                               jnp.zeros_like(seen), jnp.logical_or)
+                               jnp.zeros_like(seen), jnp.logical_or,
+                               diag=diag)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
@@ -919,14 +1081,19 @@ def _ring_rounds_or(axis_name, S, block,
 
 
 @functools.lru_cache(maxsize=64)
-def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int):
+def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+              pieces=(), mxu_block: int = 128):
     """Build (and cache) the compiled sharded flood program for this shape."""
-    body = functools.partial(_ring_rounds_or, axis_name, S, block)
+    body = functools.partial(_ring_rounds_or, axis_name, S, block, pieces,
+                             mxu_block)
     spec = P(axis_name)
+    # check_vma=False: the body may invoke the Pallas bucket kernel, whose
+    # vma-typed lowering trips a cache bug in current JAX (see
+    # ops/pallas_edge.py); scoped to the ring-body programs only.
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
-        mesh=mesh,
-        in_specs=(spec,) * 13,
+        mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 14,
         out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)
@@ -959,12 +1126,13 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
     if state0 is None:
         state0 = init_state(sg, Flood(source=source), None)
     seen0, frontier0 = state0
-    fn = _flood_fn(mesh, axis_name, S, block, rounds)
+    fn = _flood_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
+                   sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     seen, frontier, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        mxu_src, mxu_dst, mxu_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree, seen0, frontier0,
     )
     if return_state:
@@ -975,9 +1143,11 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
 # --------------------------------------------------- flood-to-coverage
 
 
-def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
+def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
+                      coverage_target,
+                      max_rounds,
                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                      mxu_src, mxu_dst, mxu_mask,
+                      mxu_src, mxu_dst, mxu_mask, diag_masks,
                       node_mask, out_degree, seen0, frontier0):
     """Per-shard body: flood until the psum'd live coverage reaches the
     target — the device-side early-exit ``lax.while_loop`` of
@@ -986,10 +1156,11 @@ def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
     by construction. Messages accumulate in the two-limb counter
     (utils/accum.py) — multi-chip totals wrap int32 even sooner."""
     groups = _groups_or(
-        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
         (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
+    diag = (pieces, diag_masks[0], _diag_or_piece)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     n_live = jnp.maximum(
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
@@ -1002,7 +1173,8 @@ def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
     def body(carry):
         seen, frontier, rounds, _, hi, lo = carry
         delivered = _ring_pass(axis_name, S, frontier, groups,
-                               jnp.zeros_like(seen), jnp.logical_or)
+                               jnp.zeros_like(seen), jnp.logical_or,
+                               diag=diag)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
@@ -1026,13 +1198,15 @@ def _ring_coverage_or(axis_name, S, block, coverage_target, max_rounds,
 
 @functools.lru_cache(maxsize=64)
 def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                  max_rounds: int):
-    body = functools.partial(_ring_coverage_or, axis_name, S, block)
+                  max_rounds: int, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_coverage_or, axis_name, S, block, pieces,
+                             mxu_block)
     spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factory.
     fn = jax.shard_map(
         lambda target, *args: body(target, max_rounds, *args),
-        mesh=mesh,
-        in_specs=(P(),) + (spec,) * 13,
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 14,
         out_specs=(spec, spec, P(), P(), P(), P()),
     )
     return jax.jit(fn)
@@ -1059,13 +1233,14 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     if state0 is None:
         state0 = init_state(sg, Flood(source=source), None)
     seen0, frontier0 = state0
-    fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds)
+    fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                       sg.diag_pieces, sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     seen, frontier, rounds, coverage, hi, lo = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        mxu_src, mxu_dst, mxu_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree, seen0, frontier0,
     )
     out = {
@@ -1120,6 +1295,9 @@ def _ring_rounds_gossip(axis_name, S, block, rng,
         p_shard = partner // block
         p_local = partner % block
 
+        # pcast: a fresh constant is shard-invariant by type; the ring
+        # fold adds shard-varying blocks into it, so the accumulator must
+        # be marked varying up front (scan carries demand matching vma).
         acc0 = jax.lax.pcast(
             jnp.zeros((block,), values.dtype), (axis_name,), to="varying"
         )
@@ -1267,9 +1445,9 @@ def _resolve_rng(sg: ShardedGraph, exact_rng: bool, rng: Optional[str]) -> str:
     return "tile" if sg.block % RNG_TILE == 0 else "fold"
 
 
-def _make_sir_round(axis_name, S, block, rng,
+def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                    mxu_src, mxu_dst, mxu_mask,
+                    mxu_src, mxu_dst, mxu_mask, diag_masks,
                     node_mask, out_degree, one_minus_beta, gamma):
     """Build the per-shard SIR round closure (shared by the fixed-rounds
     scan and the run-to-coverage while_loop): ``one_round(status, key) ->
@@ -1281,10 +1459,11 @@ def _make_sir_round(axis_name, S, block, rng,
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
 
     groups = _groups_sum(
-        block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
         (dyn_src[0], dyn_dst[0], dyn_mask[0]),
         (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
+    diag = (pieces, diag_masks[0], _diag_sum_piece)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator (models/sir.py parity under failures).
     n_live = jnp.maximum(
@@ -1298,14 +1477,10 @@ def _make_sir_round(axis_name, S, block, rng,
         infected = (status == INFECTED) & node_mask_b
         susceptible = (status == SUSCEPTIBLE) & node_mask_b
 
-        # pcast: a fresh constant is shard-invariant by type; the ring pass
-        # folds shard-varying blocks into it, so the accumulator must be
-        # marked varying up front (scan carries demand matching vma types).
-        acc0 = jax.lax.pcast(
-            jnp.zeros((block,), jnp.float32), (axis_name,), to="varying"
-        )
+        acc0 = jnp.zeros((block,), jnp.float32)
         pressure = _ring_pass(
             axis_name, S, infected.astype(jnp.float32), groups, acc0, jnp.add,
+            diag=diag,
         )
         # one_minus_beta arrives precomputed in f64 then cast, matching the
         # engine's `jnp.power(1.0 - beta, ...)` constant bit-for-bit.
@@ -1333,16 +1508,17 @@ def _make_sir_round(axis_name, S, block, rng,
     return one_round
 
 
-def _ring_rounds_sir(axis_name, S, block, rng,
+def _ring_rounds_sir(axis_name, S, block, rng, pieces, mxu_block,
                      bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                     mxu_src, mxu_dst, mxu_mask,
+                     mxu_src, mxu_dst, mxu_mask, diag_masks,
                      node_mask, out_degree,
                      status0, round_keys, one_minus_beta, gamma, rounds):
     """Per-shard body: ``rounds`` SIR rounds (scan over replicated raw key
     data, engine.run key-schedule parity)."""
     one_round = _make_sir_round(
-        axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        axis_name, S, block, rng, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask,
+        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, one_minus_beta, gamma,
     )
 
@@ -1353,17 +1529,19 @@ def _ring_rounds_sir(axis_name, S, block, rng,
     return status[None], stats
 
 
-def _ring_coverage_sir(axis_name, S, block, rng, coverage_target, max_rounds,
+def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block,
+                       coverage_target, max_rounds,
                        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                       mxu_src, mxu_dst, mxu_mask,
+                       mxu_src, mxu_dst, mxu_mask, diag_masks,
                        node_mask, out_degree,
                        status0, key_data, one_minus_beta, gamma):
     """Per-shard body: SIR until ever-infected coverage reaches the target
     (engine.run_until_coverage's key schedule: split the carried key each
     round). Messages accumulate in the two-limb counter."""
     one_round = _make_sir_round(
-        axis_name, S, block, rng, bkt_src, bkt_dst, bkt_mask,
-        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+        axis_name, S, block, rng, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask,
+        dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, one_minus_beta, gamma,
     )
 
@@ -1396,13 +1574,15 @@ def _ring_coverage_sir(axis_name, S, block, rng, coverage_target, max_rounds,
 
 @functools.lru_cache(maxsize=64)
 def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                max_rounds: int, rng: str):
-    body = functools.partial(_ring_coverage_sir, axis_name, S, block, rng)
+                max_rounds: int, rng: str, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_coverage_sir, axis_name, S, block, rng,
+                             pieces, mxu_block)
     spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factory.
     fn = jax.shard_map(
         lambda target, *args: body(target, max_rounds, *args),
-        mesh=mesh,
-        in_specs=(P(),) + (spec,) * 12 + (P(), P(), P()),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 13 + (P(), P(), P()),
         out_specs=(spec, P(), P(), P(), P()),
     )
     return jax.jit(fn)
@@ -1428,13 +1608,14 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     if status0 is None:
         status0 = init_state(sg, protocol, key)
     fn = _sir_cov_fn(mesh, axis_name, S, block, max_rounds,
-                     _resolve_rng(sg, exact_rng, rng))
+                     _resolve_rng(sg, exact_rng, rng), sg.diag_pieces,
+                     sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, rounds, coverage, hi, lo = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        mxu_src, mxu_dst, mxu_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree, status0,
         jax.random.key_data(key),
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
@@ -1448,13 +1629,17 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
 
 @functools.lru_cache(maxsize=64)
 def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-            rng: str):
-    body = functools.partial(_ring_rounds_sir, axis_name, S, block, rng)
+            rng: str, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_rounds_sir, axis_name, S, block, rng,
+                             pieces, mxu_block)
     spec = P(axis_name)
+    # check_vma=False: the body may invoke the Pallas bucket kernel, whose
+    # vma-typed lowering trips a cache bug in current JAX (see
+    # ops/pallas_edge.py); scoped to the ring-body programs only.
     fn = jax.shard_map(
         lambda *args: body(*args, rounds=rounds),
-        mesh=mesh,
-        in_specs=(spec,) * 12 + (P(), P(), P()),
+        mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 13 + (P(), P(), P()),
         out_specs=(spec, P()),
     )
     return jax.jit(fn)
@@ -1481,12 +1666,13 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
     fn = _sir_fn(mesh, axis_name, S, block, rounds,
-                 _resolve_rng(sg, exact_rng, rng))
+                 _resolve_rng(sg, exact_rng, rng), sg.diag_pieces,
+                 sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, stats = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        mxu_src, mxu_dst, mxu_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree,
         status0, round_keys,
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
