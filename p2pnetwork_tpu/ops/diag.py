@@ -86,6 +86,60 @@ def build_hybrid(
     )
 
 
+def select_diagonals(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    n: int,
+    max_diags: int = 64,
+    min_count: Optional[int] = None,
+):
+    """Pick the dominant circular offsets of an edge list (host-side).
+
+    Returns ``(kept_offsets, per_offset_sel, diag_sel)``: the chosen
+    offsets (by descending edge count), for each one the indices of its
+    covered edges — deduplicated to ONE edge per receiver, so sums count
+    every edge instance exactly once — and the overall covered bitmap.
+    Shared by the single-chip hybrid build and the sharded ring's
+    decomposition (parallel/sharded.py), so selection tuning cannot
+    silently diverge the two paths. Edges touching padded ids (``>= n``,
+    possible when folded-in dynamic links involve spare nodes) are never
+    candidates — their offsets-mod-n would alias real diagonals.
+    """
+    if min_count is None:
+        min_count = max(n // 256, 128)
+    diag_sel = np.zeros(senders.shape[0], dtype=bool)
+    senders = senders.astype(np.int64)
+    receivers = receivers.astype(np.int64)
+    real = np.flatnonzero((senders < n) & (receivers < n))
+    kept: list = []
+    per_sel: list = []
+    if real.size:
+        off = (senders[real] - receivers[real]) % n  # in [0, n)
+        counts = np.bincount(off)
+        # Filter (self-loops, below-threshold) BEFORE truncating to
+        # max_diags — a frequent self-loop offset ranking in the top
+        # max_diags must not displace a qualifying real diagonal into the
+        # per-edge remainder. Vectorized: `counts` has up to n entries.
+        ok = counts >= min_count
+        ok[0] = False
+        cand = np.flatnonzero(ok)
+        kept = [int(o) for o in cand[np.argsort(counts[cand])[::-1]][:max_diags]]
+        # One sort pass gives every diagonal's edge set as a contiguous
+        # slice (instead of a full O(E) scan per kept offset).
+        by_off = np.argsort(off, kind="stable")
+        lo = np.searchsorted(off[by_off], kept)
+        hi = np.searchsorted(off[by_off], kept, side="right")
+        for d, o in enumerate(kept):
+            sel = real[by_off[lo[d]:hi[d]]]
+            # A mask slot holds ONE edge; duplicate (offset, receiver)
+            # pairs beyond the first stay in the remainder.
+            _, first = np.unique(receivers[sel], return_index=True)
+            sel = sel[first]
+            per_sel.append(sel)
+            diag_sel[sel] = True
+    return kept, per_sel, diag_sel
+
+
 def build_hybrid_from_arrays(
     senders: np.ndarray,
     receivers: np.ndarray,
@@ -102,40 +156,16 @@ def build_hybrid_from_arrays(
     senders = senders.astype(np.int64)
     receivers = receivers.astype(np.int64)
 
-    if min_count is None:
-        min_count = max(n // 256, 128)
-
-    off = (senders - receivers) % n  # in [0, n)
+    kept, per_sel, diag_sel = select_diagonals(
+        senders, receivers, n, max_diags, min_count
+    )
     offsets: Tuple[int, ...] = ()
-    diag_sel = np.zeros(senders.shape[0], dtype=bool)
     masks = np.zeros((0, n), dtype=bool)
-    if off.size:
-        counts = np.bincount(off)
-        # Filter (self-loops, below-threshold) BEFORE truncating to
-        # max_diags — a frequent self-loop offset ranking in the top
-        # max_diags must not displace a qualifying real diagonal into the
-        # per-edge remainder. Vectorized: `counts` has up to n entries.
-        ok = counts >= min_count
-        ok[0] = False
-        cand = np.flatnonzero(ok)
-        kept = [int(o) for o in cand[np.argsort(counts[cand])[::-1]][:max_diags]]
-        if kept:
-            offsets = tuple(kept)
-            masks = np.zeros((len(kept), n), dtype=bool)
-            # One sort pass gives every diagonal's edge set as a contiguous
-            # slice (instead of a full O(E) scan per kept offset).
-            by_off = np.argsort(off, kind="stable")
-            lo = np.searchsorted(off[by_off], kept)
-            hi = np.searchsorted(off[by_off], kept, side="right")
-            for d, o in enumerate(kept):
-                sel = by_off[lo[d]:hi[d]]
-                # A mask slot holds ONE edge; duplicate (offset, receiver)
-                # pairs beyond the first stay in the remainder so sums count
-                # every edge instance exactly once.
-                _, first = np.unique(receivers[sel], return_index=True)
-                sel = sel[first]
-                masks[d, receivers[sel]] = True
-                diag_sel[sel] = True
+    if kept:
+        offsets = tuple(kept)
+        masks = np.zeros((len(kept), n), dtype=bool)
+        for d, sel in enumerate(per_sel):
+            masks[d, receivers[sel]] = True
 
     rem_s = senders[~diag_sel].astype(np.int32)
     rem_r = receivers[~diag_sel].astype(np.int32)

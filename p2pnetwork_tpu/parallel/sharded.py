@@ -167,55 +167,42 @@ def _extract_ring_diagonals(senders, receivers, n, S, block, max_diags,
     ``[0, n)``) stay in the remainder — only the uniform no-wrap body of a
     diagonal has the shard-invariant piece structure.
     """
-    diag_sel = np.zeros(senders.shape[0], dtype=bool)
-    if min_count is None:
-        min_count = max(n // 256, 128)
-    off = (senders.astype(np.int64) - receivers.astype(np.int64)) % n
+    from p2pnetwork_tpu.ops.diag import select_diagonals
+
+    kept, per_sel, diag_sel = select_diagonals(
+        senders, receivers, n, max_diags, min_count
+    )
     pieces = []
     mask_rows = []
-    if off.size:
-        counts = np.bincount(off)
-        ok = counts >= min_count
-        ok[0] = False
-        cand = np.flatnonzero(ok)
-        kept = [int(o) for o in
-                cand[np.argsort(counts[cand])[::-1]][:max_diags]]
-        by_off = np.argsort(off, kind="stable")
-        lo = np.searchsorted(off[by_off], kept)
-        hi = np.searchsorted(off[by_off], kept, side="right")
-        for i, o in enumerate(kept):
-            sel = by_off[lo[i]:hi[i]]
-            # One mask slot per receiver: duplicate (offset, receiver)
-            # pairs beyond the first stay in the remainder (sum parity).
-            _, first = np.unique(receivers[sel], return_index=True)
-            sel = sel[first]
-            off_s = o if o <= n // 2 else o - n
-            v = receivers[sel].astype(np.int64)
-            nowrap = (v + off_s >= 0) & (v + off_s < n)
-            sel = sel[nowrap]
-            if not sel.size:
-                continue
-            diag_sel[sel] = True
-            dmask = np.zeros(S * block, dtype=bool)
-            dmask[receivers[sel]] = True
-            dmask = dmask.reshape(S, block)
-            q, r = divmod(off_s, block)  # floor division: r in [0, block)
-            j = np.arange(block)
-            piece_a = dmask & (j + r < block)[None, :]
-            piece_b = dmask & (j + r >= block)[None, :]
-            t_a = (-q) % S
-            t_b = (-q - 1) % S
-            if S == 1 or t_a == t_b:
-                if piece_a.any() or piece_b.any():
-                    pieces.append((t_a, int(r)))
-                    mask_rows.append(dmask)
-            else:
-                if piece_a.any():
-                    pieces.append((t_a, int(r)))
-                    mask_rows.append(piece_a)
-                if piece_b.any():
-                    pieces.append((t_b, int(r)))
-                    mask_rows.append(piece_b)
+    for o, sel in zip(kept, per_sel):
+        off_s = o if o <= n // 2 else o - n
+        v = receivers[sel].astype(np.int64)
+        nowrap = (v + off_s >= 0) & (v + off_s < n)
+        dropped = sel[~nowrap]
+        diag_sel[dropped] = False  # wrap edges ride the remainder
+        sel = sel[nowrap]
+        if not sel.size:
+            continue
+        dmask = np.zeros(S * block, dtype=bool)
+        dmask[receivers[sel]] = True
+        dmask = dmask.reshape(S, block)
+        q, r = divmod(off_s, block)  # floor division: r in [0, block)
+        j = np.arange(block)
+        piece_a = dmask & (j + r < block)[None, :]
+        piece_b = dmask & (j + r >= block)[None, :]
+        t_a = (-q) % S
+        t_b = (-q - 1) % S
+        if S == 1 or t_a == t_b:
+            if piece_a.any() or piece_b.any():
+                pieces.append((t_a, int(r)))
+                mask_rows.append(dmask)
+        else:
+            if piece_a.any():
+                pieces.append((t_a, int(r)))
+                mask_rows.append(piece_a)
+            if piece_b.any():
+                pieces.append((t_b, int(r)))
+                mask_rows.append(piece_b)
     if not pieces:
         return (), None, diag_sel
     masks = np.stack(mask_rows, axis=1)  # [S, P, block]
@@ -267,16 +254,19 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         diag_sel = np.zeros(senders.shape[0], dtype=bool)
 
     def _bucketize(s_arr, r_arr):
-        """Sort edges by (bucket, local dst); return sorted arrays + bucket
-        offsets (bucket = dst_shard * S + ring_step)."""
+        """Sort edges by (bucket, local dst); return sorted arrays, bucket
+        offsets (bucket = dst_shard * S + ring_step), sorted bucket ids,
+        and the sort order."""
         flat = (r_arr // block) * S + ((r_arr // block) - (s_arr // block)) % S
         order = np.lexsort((r_arr, flat))
         s_arr, r_arr, flat = s_arr[order], r_arr[order], flat[order]
         offs = np.zeros(S * S + 1, dtype=np.int64)
         np.cumsum(np.bincount(flat, minlength=S * S), out=offs[1:])
-        return s_arr, r_arr, offs
+        return s_arr, r_arr, offs, flat, order
 
-    senders_b, receivers_b, offsets = _bucketize(senders, receivers)
+    senders_b, receivers_b, offsets, flat_b, order_b = _bucketize(
+        senders, receivers
+    )
     e_bkt = _round_up(
         max(int(np.diff(offsets).max()), 1), edge_pad_multiple
     )
@@ -299,9 +289,12 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     if mxu:
         from p2pnetwork_tpu.ops.blocked import build_blocked_arrays_np
 
-        rem_s, rem_r, rem_offs = _bucketize(
-            senders[~diag_sel], receivers[~diag_sel]
-        )
+        # A subset of the already-bucket-sorted arrays stays sorted — no
+        # second O(E log E) lexsort for the remainder.
+        ks = ~diag_sel[order_b]
+        rem_s, rem_r = senders_b[ks], receivers_b[ks]
+        rem_offs = np.zeros(S * S + 1, dtype=np.int64)
+        np.cumsum(np.bincount(flat_b[ks], minlength=S * S), out=rem_offs[1:])
         per_bucket = []
         for d in range(S):
             for t in range(S):

@@ -92,7 +92,7 @@ class JaxSimNode(Node):
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  graph: Optional[Graph] = None, protocol=None, seed: int = 0,
                  mesh=None, dynamic_edges: int = 0, rng: Optional[str] = None,
-                 **node_kwargs):
+                 layout: str = "hybrid", **node_kwargs):
         super().__init__(host, port, **node_kwargs)
         self.sim_graph: Optional[Graph] = None
         self.sim_protocol = None
@@ -107,13 +107,15 @@ class JaxSimNode(Node):
         self._churn_count = 0
         if graph is not None and protocol is not None:
             self.attach_simulation(graph, protocol, seed=seed, mesh=mesh,
-                                   dynamic_edges=dynamic_edges, rng=rng)
+                                   dynamic_edges=dynamic_edges, rng=rng,
+                                   layout=layout)
 
     # ------------------------------------------------------------- plumbing
 
     def attach_simulation(self, graph: Graph, protocol, seed: int = 0,
                           mesh=None, dynamic_edges: int = 0,
-                          rng: Optional[str] = None) -> None:
+                          rng: Optional[str] = None,
+                          layout: str = "hybrid") -> None:
         """Attach (or replace) the simulated population.
 
         ``mesh`` switches the node onto the multi-chip backend
@@ -127,8 +129,18 @@ class JaxSimNode(Node):
         backend-agnostic introspection goes through ``sim_node_alive``.
         ``dynamic_edges`` reserves runtime link capacity on the sharded
         graph; ``rng`` picks the sharded RNG mode ('exact' | 'tile' |
-        'fold', default tile when aligned).
+        'fold', default tile when aligned); ``layout`` picks the sharded
+        edge layout — 'hybrid' (ring-decomposed diagonals + MXU remainder,
+        the fast default), 'mxu', or 'segment' (BENCH.md has the measured
+        ladder). All layouts are bit-exact.
         """
+        if layout not in ("hybrid", "mxu", "segment"):
+            # Validate regardless of backend: a typo'd layout must not be
+            # silently accepted just because no mesh is attached yet.
+            raise ValueError(
+                f"layout must be 'hybrid', 'mxu' or 'segment', got "
+                f"{layout!r}"
+            )
         self.sim_graph = graph
         self.sim_protocol = protocol
         self._sim_key = jax.random.key(seed)
@@ -137,7 +149,8 @@ class JaxSimNode(Node):
         if mesh is not None:
             from p2pnetwork_tpu.parallel import sharded
 
-            sg = sharded.shard_graph(graph, mesh)
+            sg = sharded.shard_graph(graph, mesh, mxu=layout == "mxu",
+                                     hybrid=layout == "hybrid")
             if dynamic_edges:
                 sg = sharded.with_capacity(sg, dynamic_edges)
             self.sim_sharded = sg

@@ -515,6 +515,38 @@ class TestHybridSharded:
         assert int(np.asarray(out["rounds"])) == int(np.asarray(refo["rounds"]))
         assert out["messages"] == refo["messages"]
 
+    def test_consolidated_padded_node_edges_stay_in_remainder(self):
+        # Regression: a dynamic edge from a joined SPARE node (id >= n)
+        # folded in at re-shard has an offset-mod-n that can alias a real
+        # diagonal; extraction marking it diag-covered both dropped the
+        # real message and delivered a phantom one. Padded-endpoint edges
+        # must never be diagonal candidates.
+        from p2pnetwork_tpu.sim import topology
+
+        # A ring MISSING the directed edge 8->7, so the offset-1 diagonal
+        # slot at receiver 7 is vacant. Spare node 520's link 520->7 has
+        # offset (520 - 7) mod 512 == 1: without the padded-sender
+        # exclusion it fills that vacant slot — delivering a phantom 8->7
+        # and dropping the real 520->7.
+        base = np.arange(512, dtype=np.int32)
+        src = np.concatenate([base, (base + 1) % 512])
+        dst = np.concatenate([(base + 1) % 512, base])
+        keep = ~((src == 8) & (dst == 7))
+        g = G.from_edges(src[keep], dst[keep], 512)
+        g = topology.with_capacity(g, extra_nodes=128, extra_edges=8)
+        g = topology.join_node(g, 520, [7])
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, hybrid=True, min_count=64)
+        assert len(sg.diag_pieces) > 0
+        rounds = 2
+        seen, _ = sharded.flood(sg, mesh, source=520, rounds=rounds)
+        ref, _ = engine.run(g, Flood(source=520), jax.random.key(0), rounds)
+        np.testing.assert_array_equal(
+            np.asarray(seen).reshape(-1)[: g.n_nodes_padded],
+            np.asarray(ref.seen),
+        )
+        assert np.asarray(seen).reshape(-1)[7]  # the 520->7 link delivered
+
     def test_checkpoint_carries_diag_masks(self):
         g = G.ring(512)
         mesh = M.ring_mesh(4)
