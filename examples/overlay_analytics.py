@@ -1,0 +1,63 @@
+"""Sim backend demo: overlay-health analytics as compiled protocols.
+
+Three questions reference users answer by hand-instrumenting callbacks
+[ref: README.md:20] — who matters (PageRank), how far is everyone
+(HopDistance / BFS), what's the network-wide average (PushSum) — each runs
+here as a batched protocol over the whole population in one compiled scan.
+Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from p2pnetwork_tpu.models import HopDistance, PageRank, PushSum
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim import graph as G
+
+
+def main():
+    n = 50_000
+    print(f"building {n}-node Barabasi-Albert overlay ...")
+    g = G.barabasi_albert(n, 4, seed=0)
+    print(f"  {g.n_edges} directed edges")
+
+    # Who matters: PageRank power iteration to a tight residual.
+    t0 = time.perf_counter()
+    state, stats = engine.run(g, PageRank(damping=0.85), jax.random.key(0), 40)
+    ranks = np.asarray(state.ranks)[:n]
+    dt = time.perf_counter() - t0
+    top = np.argsort(ranks)[::-1][:5]
+    print(f"PageRank (40 rounds, {dt*1000:.0f} ms incl. compile): "
+          f"residual {float(np.asarray(stats['residual'])[-1]):.2e}")
+    print("  top-5 hubs:", ", ".join(f"node {i} ({ranks[i]:.2e})" for i in top))
+
+    # How far is everyone: BFS hop layers from node 0.
+    state, out = engine.run_until_coverage(
+        g, HopDistance(source=0), jax.random.key(0), coverage_target=1.0,
+        max_rounds=64,
+    )
+    dist = np.asarray(state.dist)[:n]
+    reached = dist >= 0
+    print(f"HopDistance: {int(out['rounds'])} rounds, "
+          f"{reached.mean()*100:.1f}% reachable, "
+          f"eccentricity {dist.max()}, mean hops {dist[reached].mean():.2f}")
+
+    # What's the average: push-sum consensus (every node converges on the
+    # network-wide mean with no coordinator).
+    proto = PushSum()
+    st0 = proto.init(g, jax.random.key(1))
+    true_mean = float(np.asarray(st0.s)[:n].mean())
+    state, stats = engine.run(g, proto, jax.random.key(1), 60)
+    est = np.asarray(proto.estimate(g, state))[:n]
+    print(f"PushSum: true mean {true_mean:+.5f}, "
+          f"estimates [{est.min():+.5f}, {est.max():+.5f}] after 60 rounds "
+          f"(variance {float(np.asarray(stats['variance'])[-1]):.2e})")
+
+
+if __name__ == "__main__":
+    main()

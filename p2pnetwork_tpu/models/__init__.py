@@ -8,6 +8,9 @@ TPU-native forms of the protocols its users write by hand, all behind one
 from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
+from p2pnetwork_tpu.models.hopdist import HopDistance, HopDistanceState
+from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
+from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
 
 __all__ = [
@@ -16,6 +19,12 @@ __all__ = [
     "FloodState",
     "Gossip",
     "GossipState",
+    "HopDistance",
+    "HopDistanceState",
+    "PageRank",
+    "PageRankState",
+    "PushSum",
+    "PushSumState",
     "SIR",
     "SIRState",
 ]

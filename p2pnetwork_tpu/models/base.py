@@ -34,3 +34,17 @@ class Protocol(TypingProtocol):
     def init(self, graph: Graph, key: jax.Array) -> State: ...
 
     def step(self, graph: Graph, state: State, key: jax.Array) -> Tuple[State, Stats]: ...
+
+
+def validate_source(graph: Graph, source: int) -> None:
+    """Reject a source index outside the padded id space (the jit scatter
+    would silently clamp it to the last padded index, which the node mask
+    then zeroes — a run that spins to max_rounds at coverage 0 with no
+    error). Ids in ``[n_nodes, n_nodes_padded)`` are allowed: joined spare
+    nodes (sim/topology.py) live there, and dead ids are already zeroed by
+    the ``& node_mask`` every seed applies."""
+    if not 0 <= source < graph.n_nodes_padded:
+        raise ValueError(
+            f"source {source} out of range for padded id space "
+            f"[0, {graph.n_nodes_padded})"
+        )

@@ -17,6 +17,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from p2pnetwork_tpu.models import base
 from p2pnetwork_tpu.ops import segment
 from p2pnetwork_tpu.sim.graph import Graph
 
@@ -38,6 +39,7 @@ class Flood:
     method: str = "auto"  # aggregation lowering, see ops/segment.py
 
     def init(self, graph: Graph, key: jax.Array) -> FloodState:
+        base.validate_source(graph, self.source)
         seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
         seed = seed & graph.node_mask
         return FloodState(seen=seed, frontier=seed)

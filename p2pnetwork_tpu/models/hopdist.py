@@ -1,0 +1,73 @@
+"""Hop-distance (BFS layers) from a source node.
+
+The discovery measurement behind every overlay-health question reference
+users answer by hand-instrumenting ``node_message`` hops [ref:
+README.md:20]: how many forwarding steps does a message need to reach each
+peer? One synchronous round is the same masked frontier-OR as flooding
+(``propagate_or``, the batched form of the reference's per-edge send loop
+[ref: p2pnetwork/node.py:110-112]); nodes record the round number at which
+the wave first reaches them. The final state is the exact BFS hop count
+per node (-1 for unreachable), so eccentricity / diameter / reachability
+drop out as device-side reductions.
+
+Deterministic — no RNG consumed; exposes ``coverage`` + ``messages`` stats,
+so :func:`p2pnetwork_tpu.sim.engine.run_until_coverage` runs it to any
+reach fraction with the device-side early-exit loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HopDistanceState:
+    dist: jax.Array  # i32[N_pad] — BFS hops from source, -1 = not reached
+    frontier: jax.Array  # bool[N_pad] — nodes first reached last round
+    round: jax.Array  # i32[] — rounds executed so far
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class HopDistance:
+    """Single-source BFS hop counts. ``source`` is the seed node index."""
+
+    source: int = 0
+    method: str = "auto"  # aggregation lowering, see ops/segment.py
+
+    def init(self, graph: Graph, key: jax.Array) -> HopDistanceState:
+        base.validate_source(graph, self.source)
+        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
+        seed = seed & graph.node_mask
+        dist = jnp.where(seed, 0, -1).astype(jnp.int32)
+        return HopDistanceState(dist=dist, frontier=seed,
+                                round=jnp.int32(0))
+
+    def coverage(self, graph: Graph, state: HopDistanceState) -> jax.Array:
+        """Reached fraction of live nodes (run_until_coverage resume seed)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum((state.dist >= 0) & graph.node_mask) / n_real
+
+    def step(self, graph: Graph, state: HopDistanceState, key: jax.Array):
+        delivered = segment.propagate_or(graph, state.frontier, self.method)
+        new = delivered & (state.dist < 0) & graph.node_mask
+        rnd = state.round + 1
+        dist = jnp.where(new, rnd, state.dist)
+        reached = (dist >= 0) & graph.node_mask
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            "messages": segment.frontier_messages(graph, state.frontier),
+            "coverage": jnp.sum(reached) / n_real,
+            "frontier": jnp.sum(new),
+            # Farthest hop seen so far — the source's eccentricity once the
+            # wave dies out (frontier == 0).
+            "max_dist": jnp.max(dist),
+        }
+        return HopDistanceState(dist=dist, frontier=new, round=rnd), stats

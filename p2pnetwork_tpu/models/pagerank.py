@@ -1,0 +1,69 @@
+"""PageRank power iteration over the peer graph.
+
+The "who matters in this overlay" analysis reference users would run
+offline on a dump of ``all_nodes`` [ref: p2pnetwork/node.py:75-78]; here it
+is just another protocol behind the models/base.py seam — per-round state
+is the rank vector, and one synchronous round is one ``propagate_sum`` of
+``rank / out_degree`` over the edge set (the batched replacement for the
+reference's per-edge send loop [ref: p2pnetwork/node.py:110-112]).
+
+Damped formulation with dangling-mass redistribution over LIVE nodes:
+
+    r'[v] = (1-d)/N + d * ( sum_{u->v} r[u]/deg_out[u]  +  dangling/N )
+
+where ``dangling`` is the rank mass held by live nodes with no outgoing
+edges (isolated nodes, or nodes whose every link failed — sim/failures.py).
+``sum(r) == 1`` holds at every round, and the iteration is a deterministic
+pure function of the graph — no RNG consumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PageRankState:
+    ranks: jax.Array  # f32[N_pad] — sums to 1 over live nodes
+    residual: jax.Array  # f32[] — L1 change of the last round
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class PageRank:
+    damping: float = 0.85
+    method: str = "auto"  # aggregation lowering, see ops/segment.py
+
+    def init(self, graph: Graph, key: jax.Array) -> PageRankState:
+        mask_f = graph.node_mask.astype(jnp.float32)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1).astype(jnp.float32)
+        return PageRankState(ranks=mask_f / n_real,
+                             residual=jnp.float32(jnp.inf))
+
+    def step(self, graph: Graph, state: PageRankState, key: jax.Array):
+        mask = graph.node_mask
+        mask_f = mask.astype(jnp.float32)
+        n_real = jnp.maximum(jnp.sum(mask), 1).astype(jnp.float32)
+        deg = graph.out_degree.astype(jnp.float32)
+        contrib = jnp.where(mask & (graph.out_degree > 0),
+                            state.ranks / jnp.maximum(deg, 1.0), 0.0)
+        pulled = segment.propagate_sum(graph, contrib, self.method)
+        dangling = jnp.sum(jnp.where(mask & (graph.out_degree == 0),
+                                     state.ranks, 0.0))
+        ranks = ((1.0 - self.damping) / n_real
+                 + self.damping * (pulled + dangling / n_real)) * mask_f
+        residual = jnp.sum(jnp.abs(ranks - state.ranks))
+        stats = {
+            # Every live node with outgoing links ships one share per edge.
+            "messages": segment.frontier_messages(graph, mask),
+            "residual": residual,
+            "rank_total": jnp.sum(ranks),
+            "rank_max": jnp.max(ranks),
+        }
+        return PageRankState(ranks=ranks, residual=residual), stats

@@ -17,6 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from p2pnetwork_tpu.models import base
 from p2pnetwork_tpu.ops import segment
 from p2pnetwork_tpu.sim.graph import Graph
 
@@ -39,6 +40,7 @@ class SIR:
     method: str = "auto"
 
     def init(self, graph: Graph, key: jax.Array) -> SIRState:
+        base.validate_source(graph, self.source)
         status = jnp.zeros(graph.n_nodes_padded, dtype=jnp.int32)
         status = status.at[self.source].set(INFECTED)
         return SIRState(status=status * graph.node_mask)

@@ -1,0 +1,179 @@
+"""PushSum / PageRank / HopDistance tests: numpy oracles + invariants.
+
+Same philosophy as the rest of the suite (SURVEY.md section 4): the
+reference's socket tests assert on counts after sleeps; here every run is a
+pure function of (graph, key), so assertions are exact — conservation laws
+hold to rounding, and independent numpy re-implementations must agree."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import Flood, HopDistance, PageRank, PushSum  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _edges(g):
+    """Active (sender, receiver) pairs of a Graph, as numpy arrays."""
+    m = np.asarray(g.edge_mask)
+    return np.asarray(g.senders)[m], np.asarray(g.receivers)[m]
+
+
+class TestPushSum:
+    def test_mass_conservation(self):
+        g = G.barabasi_albert(300, 3, seed=0)
+        proto = PushSum()
+        key = jax.random.key(1)
+        s0 = np.asarray(proto.init(g, key).s).sum()
+        _, stats = engine.run(g, proto, key, 30)
+        s_tot = np.asarray(stats["s_total"])
+        w_tot = np.asarray(stats["w_total"])
+        np.testing.assert_allclose(s_tot, s0, rtol=1e-4)
+        np.testing.assert_allclose(w_tot, g.n_nodes, rtol=1e-5)
+
+    def test_converges_to_true_mean(self):
+        g = G.watts_strogatz(400, 6, 0.1, seed=2)
+        proto = PushSum()
+        key = jax.random.key(3)
+        state0 = proto.init(g, key)
+        true_mean = np.asarray(state0.s)[: g.n_nodes].mean()
+        # Diffusive mixing: the estimate spread shrinks by the spectral gap
+        # per round; this graph needs ~200 rounds to reach 1e-3 (verified
+        # against the float64 oracle).
+        state, stats = engine.run(g, proto, key, 250)
+        est = np.asarray(proto.estimate(g, state))[: g.n_nodes]
+        np.testing.assert_allclose(est, true_mean, atol=1e-3)
+        assert np.asarray(stats["variance"])[-1] < 1e-6
+
+    def test_matches_numpy_oracle(self):
+        g = G.erdos_renyi(64, 0.1, seed=4)
+        proto = PushSum()
+        key = jax.random.key(5)
+        state = proto.init(g, key)
+        s = np.asarray(state.s)[: g.n_nodes].astype(np.float64)
+        w = np.asarray(state.w)[: g.n_nodes].astype(np.float64)
+        snd, rcv = _edges(g)
+        out_deg = np.bincount(snd, minlength=g.n_nodes)
+        for _ in range(10):
+            share_s = s / (out_deg + 1.0)
+            share_w = w / (out_deg + 1.0)
+            s = share_s + np.bincount(rcv, share_s[snd], minlength=g.n_nodes)
+            w = share_w + np.bincount(rcv, share_w[snd], minlength=g.n_nodes)
+        got, _ = engine.run(g, proto, key, 10)
+        np.testing.assert_allclose(np.asarray(got.s)[: g.n_nodes], s, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(got.w)[: g.n_nodes], w, rtol=1e-4)
+
+    def test_sink_keeps_mass(self):
+        # 1 -> 0: node 1 has an outgoing edge; node 0 is a sink (out_deg 0).
+        g = G.from_edges([1], [0], 2)
+        proto = PushSum()
+        key = jax.random.key(6)
+        state, _ = engine.run(g, proto, key, 5)
+        s_tot0 = np.asarray(proto.init(g, key).s).sum()
+        np.testing.assert_allclose(np.asarray(state.s).sum(), s_tot0, rtol=1e-5)
+
+    def test_conservation_under_failures(self):
+        g = failures.fail_nodes(G.watts_strogatz(200, 4, 0.1, seed=7), [3, 50])
+        proto = PushSum()
+        key = jax.random.key(8)
+        s0 = np.asarray(proto.init(g, key).s).sum()
+        _, stats = engine.run(g, proto, key, 20)
+        np.testing.assert_allclose(np.asarray(stats["s_total"])[-1], s0,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats["w_total"])[-1], 198,
+                                   rtol=1e-5)
+
+
+class TestPageRank:
+    def test_matches_numpy_power_iteration(self):
+        g = G.barabasi_albert(128, 3, seed=0)
+        proto = PageRank(damping=0.85)
+        n = g.n_nodes
+        snd, rcv = _edges(g)
+        out_deg = np.bincount(snd, minlength=n)
+        r = np.full(n, 1.0 / n)
+        for _ in range(25):
+            contrib = np.where(out_deg > 0, r / np.maximum(out_deg, 1), 0.0)
+            pulled = np.bincount(rcv, contrib[snd], minlength=n)
+            dangling = r[out_deg == 0].sum()
+            r = (1 - 0.85) / n + 0.85 * (pulled + dangling / n)
+        state, _ = engine.run(g, proto, jax.random.key(0), 25)
+        np.testing.assert_allclose(np.asarray(state.ranks)[:n], r, atol=1e-5)
+
+    def test_ranks_sum_to_one_and_converge(self):
+        g = G.watts_strogatz(500, 6, 0.1, seed=1)
+        _, stats = engine.run(g, PageRank(), jax.random.key(0), 40)
+        np.testing.assert_allclose(np.asarray(stats["rank_total"]), 1.0,
+                                   atol=1e-4)
+        res = np.asarray(stats["residual"])
+        assert res[-1] < 1e-5 and res[-1] < res[0]
+
+    def test_uniform_on_ring(self):
+        # Symmetric ring: every node is equivalent -> uniform ranks.
+        g = G.ring(64)
+        state, _ = engine.run(g, PageRank(), jax.random.key(0), 30)
+        np.testing.assert_allclose(np.asarray(state.ranks)[:64], 1 / 64,
+                                   atol=1e-6)
+
+    def test_dead_nodes_hold_no_rank(self):
+        g = failures.fail_nodes(G.barabasi_albert(100, 3, seed=2), [1, 7])
+        state, stats = engine.run(g, PageRank(), jax.random.key(0), 20)
+        ranks = np.asarray(state.ranks)
+        assert ranks[1] == 0.0 and ranks[7] == 0.0
+        np.testing.assert_allclose(np.asarray(stats["rank_total"])[-1], 1.0,
+                                   atol=1e-4)
+
+
+class TestHopDistance:
+    def _bfs(self, g, source):
+        from collections import deque
+
+        snd, rcv = _edges(g)
+        adj = [[] for _ in range(g.n_nodes)]
+        for u, v in zip(snd, rcv):
+            adj[int(u)].append(int(v))
+        dist = [-1] * g.n_nodes
+        dist[source] = 0
+        q = deque([source])
+        while q:
+            u = q.popleft()
+            for v in adj[u]:
+                if dist[v] < 0:
+                    dist[v] = dist[u] + 1
+                    q.append(v)
+        return np.array(dist)
+
+    def test_matches_bfs_oracle(self):
+        g = G.watts_strogatz(300, 4, 0.1, seed=0)
+        state, _ = engine.run(g, HopDistance(source=5), jax.random.key(0), 40)
+        np.testing.assert_array_equal(np.asarray(state.dist)[: g.n_nodes],
+                                      self._bfs(g, 5))
+
+    def test_unreachable_stay_minus_one(self):
+        # Two components: {0,1} and {2,3}; 4 isolated.
+        g = G.from_edges([0, 2], [1, 3], 5)
+        state, _ = engine.run(g, HopDistance(source=0), jax.random.key(0), 10)
+        dist = np.asarray(state.dist)
+        assert dist[0] == 0 and dist[1] == 1
+        assert dist[2] == -1 and dist[3] == -1 and dist[4] == -1
+
+    def test_coverage_loop_and_flood_agreement(self):
+        # The BFS wave IS the flood wave: identical rounds-to-coverage, and
+        # max_dist equals the round count.
+        g = G.watts_strogatz(1000, 6, 0.1, seed=1)
+        _, out_h = engine.run_until_coverage(g, HopDistance(source=0),
+                                             jax.random.key(0),
+                                             coverage_target=0.99)
+        _, out_f = engine.run_until_coverage(g, Flood(source=0),
+                                             jax.random.key(0),
+                                             coverage_target=0.99)
+        assert out_h["rounds"] == out_f["rounds"]
+        assert out_h["messages"] == out_f["messages"]
+
+    def test_eccentricity_on_ring(self):
+        g = G.ring(32)  # symmetric ring: eccentricity = 16
+        state, stats = engine.run(g, HopDistance(source=0), jax.random.key(0), 20)
+        assert np.asarray(state.dist)[:32].max() == 16
+        assert np.asarray(stats["max_dist"])[-1] == 16
