@@ -809,9 +809,12 @@ def disconnect(sg: ShardedGraph, senders, receivers, *,
 def init_state(sg: ShardedGraph, protocol, key: jax.Array):
     """The sharded initial state for a protocol — what ``protocol.init``
     produces on the engine path, laid out ``[S, block]``. Flood ->
-    ``(seen, frontier)``; SIR -> ``status``; Gossip -> ``values``."""
+    ``(seen, frontier)``; SIR -> ``status``; Gossip -> ``values``;
+    PageRank -> ``ranks``; PushSum -> ``(s, w)``."""
     from p2pnetwork_tpu.models.flood import Flood
     from p2pnetwork_tpu.models.gossip import Gossip
+    from p2pnetwork_tpu.models.pagerank import PageRank
+    from p2pnetwork_tpu.models.pushsum import PushSum
     from p2pnetwork_tpu.models.sir import SIR
 
     S, block = sg.n_shards, sg.block
@@ -827,10 +830,18 @@ def init_state(sg: ShardedGraph, protocol, key: jax.Array):
     if isinstance(protocol, Gossip):
         vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
         return vals.reshape(S, block) * sg.node_mask
+    if isinstance(protocol, PageRank):
+        mask_f = sg.node_mask.astype(jnp.float32)
+        return mask_f / jnp.maximum(jnp.sum(mask_f), 1.0)
+    if isinstance(protocol, PushSum):
+        vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
+        mask_f = sg.node_mask.astype(jnp.float32)
+        return (vals.reshape(S, block) * mask_f, mask_f)
     raise ValueError(
-        f"the sharded path implements Flood, SIR and Gossip; got "
-        f"{type(protocol).__name__} — run it on the single-device engine "
-        f"or add a ring body for it"
+        f"the sharded path implements Flood, SIR, Gossip, PageRank and "
+        f"PushSum; got {type(protocol).__name__} — run it on the "
+        f"single-device engine, or write its round body around "
+        f"sharded.propagate"
     )
 
 
@@ -1671,3 +1682,264 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
     )
     return status, stats
+
+
+# ------------------------------------------- generic value propagation
+
+
+def _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+                   bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                   mxu_src, mxu_dst, mxu_mask, diag_masks):
+    """Build ``pass_(x) -> f32[block]``: one full ring rotation summing a
+    per-node value over every incoming edge — the sharded mirror of
+    ops/segment.propagate_sum. All bucket arrays arrive with their leading
+    length-1 shard axis already peeled (``arr[0]``)."""
+    groups = _groups_sum(
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
+    )
+    diag = (pieces, diag_masks[0], _diag_sum_piece)
+
+    def pass_(x):
+        return _ring_pass(axis_name, S, x, groups,
+                          jnp.zeros((block,), x.dtype), jnp.add, diag=diag)
+
+    return pass_
+
+
+def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
+                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                    mxu_src, mxu_dst, mxu_mask, diag_masks,
+                    node_mask, signal):
+    node_mask_b = node_mask[0]
+    if op == "or":
+        groups = _groups_or(
+            block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+            (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+            (mxu_src[0], mxu_dst[0], mxu_mask[0]),
+        )
+        out = _ring_pass(axis_name, S, signal[0], groups,
+                         jnp.zeros((block,), bool), jnp.logical_or,
+                         diag=(pieces, diag_masks[0], _diag_or_piece))
+        return (out & node_mask_b)[None]
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks)
+    out = pass_(signal[0])
+    return (out * node_mask_b.astype(out.dtype))[None]
+
+
+@functools.lru_cache(maxsize=64)
+def _propagate_fn(mesh: Mesh, axis_name: str, S: int, block: int, op: str,
+                  pieces=(), mxu_block: int = 128):
+    body = functools.partial(_propagate_body, axis_name, S, block, pieces,
+                             mxu_block, op)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(body, mesh=mesh, check_vma=False,
+                       in_specs=(spec,) * 12, out_specs=spec)
+    return jax.jit(fn)
+
+
+def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
+              op: str = "sum", axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """One aggregation pass over every edge of the sharded graph: the
+    multi-chip mirror of ``ops.segment.propagate_or`` / ``propagate_sum``,
+    and the extension seam for protocols the library does not ship — the
+    reference's users write their own protocol logic [ref: README.md:20];
+    here they write a per-round function of elementwise updates around this
+    call and it runs at ring-sharded scale.
+
+    ``signal`` is ``[S, block]`` (bool for ``op="or"``, float for
+    ``op="sum"``); returns the per-node aggregate in the same layout, masked
+    to live nodes. Static + dynamic (runtime-connected) edges and the
+    ring-decomposed diagonals all contribute, exactly as in the shipped
+    protocol bodies.
+    """
+    if op not in ("or", "sum"):
+        raise ValueError(f"op must be 'or' or 'sum', got {op!r}")
+    fn = _propagate_fn(mesh, axis_name, sg.n_shards, sg.block, op,
+                       sg.diag_pieces, sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    return fn(sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+              dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask,
+              _diag_masks_or_empty(sg), sg.node_mask, signal)
+
+
+# ---------------------------------------------------- pagerank / pushsum
+
+
+def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks,
+                          node_mask, out_degree,
+                          ranks0, damping, one_minus_damping, rounds):
+    """Per-shard body: ``rounds`` damped power-iteration rounds
+    (models/pagerank.py arithmetic, edge sums over the ring). ``damping``
+    rides as a replicated runtime operand so a damping sweep does not
+    recompile; ``one_minus_damping`` arrives precomputed in f64 then cast,
+    matching the engine's constant folding."""
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b = node_mask[0]
+    mask_f = node_mask_b.astype(jnp.float32)
+    deg = out_degree[0]
+    deg_f = deg.astype(jnp.float32)
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    ).astype(jnp.float32)
+    msgs = jax.lax.psum(
+        jnp.sum(jnp.where(node_mask_b, deg, 0)), axis_name
+    )
+
+    def one_round(ranks, _):
+        contrib = jnp.where(node_mask_b & (deg > 0),
+                            ranks / jnp.maximum(deg_f, 1.0), 0.0)
+        pulled = pass_(contrib)
+        dangling = jax.lax.psum(
+            jnp.sum(jnp.where(node_mask_b & (deg == 0), ranks, 0.0)),
+            axis_name,
+        )
+        new = (one_minus_damping / n_live
+               + damping * (pulled + dangling / n_live)) * mask_f
+        stats = {
+            "messages": msgs,
+            "residual": jax.lax.psum(jnp.sum(jnp.abs(new - ranks)), axis_name),
+            "rank_total": jax.lax.psum(jnp.sum(new), axis_name),
+            "rank_max": jax.lax.pmax(jnp.max(new), axis_name),
+        }
+        return new, stats
+
+    ranks, stats = jax.lax.scan(one_round, ranks0[0], None, length=rounds)
+    return ranks[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _pagerank_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+                 pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_rounds_pagerank, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 13 + (P(), P()),
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def pagerank(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
+             axis_name: str = DEFAULT_AXIS, ranks0=None):
+    """Run ``rounds`` of PageRank power iteration (models/pagerank.py) on
+    the sharded graph. Deterministic — no RNG. Returns ``(ranks [S, block]
+    f32, stats dict of [rounds] arrays)``; agrees with the single-device
+    engine to f32 summation-order tolerance (edge sums accumulate in
+    bucket/ring order here, receiver order there)."""
+    S, block = sg.n_shards, sg.block
+    if ranks0 is None:
+        ranks0 = init_state(sg, protocol, None)
+    fn = _pagerank_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
+                      sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    return fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, ranks0,
+        jnp.float32(protocol.damping), jnp.float32(1.0 - protocol.damping),
+    )
+
+
+def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
+                         bkt_src, bkt_dst, bkt_mask,
+                         dyn_src, dyn_dst, dyn_mask,
+                         mxu_src, mxu_dst, mxu_mask, diag_masks,
+                         node_mask, out_degree, s0, w0, rounds):
+    """Per-shard body: ``rounds`` push-sum rounds (models/pushsum.py
+    arithmetic — mass split over out-edges, two ring sums per round)."""
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b = node_mask[0]
+    mask_f = node_mask_b.astype(jnp.float32)
+    deg = out_degree[0]
+    shares = 1.0 / (deg.astype(jnp.float32) + 1.0)
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+    msgs = jax.lax.psum(
+        jnp.sum(jnp.where(node_mask_b, deg, 0)), axis_name
+    )
+
+    def one_round(carry, _):
+        s, w = carry
+        s_share = s * shares
+        w_share = w * shares
+        s = (s_share + pass_(s_share)) * mask_f
+        w = (w_share + pass_(w_share)) * mask_f
+        est = jnp.where(w > 0, s / jnp.maximum(w, 1e-30), 0.0)
+        mean = jax.lax.psum(jnp.sum(est * mask_f), axis_name) / n_live
+        var = jax.lax.psum(
+            jnp.sum(jnp.where(node_mask_b, (est - mean) ** 2, 0.0)), axis_name
+        ) / n_live
+        stats = {
+            "messages": msgs,
+            "s_total": jax.lax.psum(jnp.sum(s), axis_name),
+            "w_total": jax.lax.psum(jnp.sum(w), axis_name),
+            "variance": var,
+            "mean": mean,
+        }
+        return (s, w), stats
+
+    (s, w), stats = jax.lax.scan(one_round, (s0[0], w0[0]), None,
+                                 length=rounds)
+    return s[None], w[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _pushsum_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+                pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_rounds_pushsum, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 14,
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
+            rounds: int, axis_name: str = DEFAULT_AXIS, state0=None):
+    """Run ``rounds`` of push-sum consensus (models/pushsum.py) on the
+    sharded graph. ``key`` seeds the initial values exactly as the engine
+    path does (Gossip-init parity); pass ``state0 = (s, w)`` to continue a
+    run instead. Returns ``((s, w) [S, block] f32 each, stats dict)``;
+    the conservation invariants (sum(s) fixed, sum(w) == live count) hold
+    here too, to f32 summation order."""
+    S, block = sg.n_shards, sg.block
+    if state0 is None:
+        state0 = init_state(sg, protocol, key)
+    s0, w0 = state0
+    fn = _pushsum_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
+                     sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    s, w, stats = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, s0, w0,
+    )
+    return (s, w), stats

@@ -191,6 +191,8 @@ class JaxSimNode(Node):
         """Dispatch a run_rounds segment onto the sharded backend."""
         from p2pnetwork_tpu.models.flood import Flood
         from p2pnetwork_tpu.models.gossip import Gossip
+        from p2pnetwork_tpu.models.pagerank import PageRank
+        from p2pnetwork_tpu.models.pushsum import PushSum
         from p2pnetwork_tpu.models.sir import SIR
         from p2pnetwork_tpu.parallel import sharded
 
@@ -204,9 +206,15 @@ class JaxSimNode(Node):
         if isinstance(proto, Gossip):
             return sharded.gossip(sg, mesh, proto, seg_key, rounds,
                                   rng=self._sim_rng, values0=self.sim_state)
+        if isinstance(proto, PageRank):
+            return sharded.pagerank(sg, mesh, proto, rounds,
+                                    ranks0=self.sim_state)
+        if isinstance(proto, PushSum):
+            return sharded.pushsum(sg, mesh, proto, seg_key, rounds,
+                                   state0=self.sim_state)
         raise ValueError(
-            f"the sharded backend implements Flood, SIR and Gossip; got "
-            f"{type(proto).__name__}"
+            f"the sharded backend implements Flood, SIR, Gossip, PageRank "
+            f"and PushSum; got {type(proto).__name__}"
         )
 
     def run_rounds(self, rounds: int) -> dict:

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
 
 from p2pnetwork_tpu.models import Flood  # noqa: E402
 from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
@@ -795,3 +796,145 @@ class TestAutoSharding:
         assert len(gs.node_mask.sharding.device_set) == 8
         assert len(gs.senders.sharding.device_set) == 8
         assert gs.blocked is None and gs.hybrid is None
+
+
+class TestShardedValueProtocols:
+    """PageRank / PushSum on the ring, and the generic propagate seam.
+
+    Edge sums accumulate in bucket/ring order here vs receiver order on the
+    engine, so value parity is to f32 tolerance (unlike the bit-exact OR
+    and integer-sum protocols)."""
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_pagerank_matches_single_device(self, n_shards):
+        from p2pnetwork_tpu.models import PageRank
+
+        g = G.barabasi_albert(1024, 3, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        proto = PageRank(damping=0.85)
+        rounds = 20
+
+        ranks_sh, stats_sh = sharded.pagerank(sg, mesh, proto, rounds)
+        ref_state, ref_stats = engine.run(g, proto, jax.random.key(0), rounds)
+        np.testing.assert_allclose(
+            np.asarray(ranks_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.ranks)[: g.n_nodes],
+            rtol=1e-4, atol=1e-9,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(stats_sh["messages"]), np.asarray(ref_stats["messages"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats_sh["rank_total"]), 1.0, atol=1e-4
+        )
+
+    def test_pagerank_under_churn_matches_single_device(self):
+        from p2pnetwork_tpu.models import PageRank
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=2)
+        mesh = M.ring_mesh(8)
+        sg = sharded.with_capacity(
+            sharded.fail_nodes(sharded.shard_graph(g, mesh), [7, 500]), 8
+        )
+        sg = sharded.connect(sg, [10], [900])
+        gc = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [7, 500]),
+                                   extra_edges=8),
+            [10], [900],
+        )
+        ranks_sh, _ = sharded.pagerank(sg, mesh, PageRank(), 10)
+        ref_state, _ = engine.run(gc, PageRank(), jax.random.key(0), 10)
+        np.testing.assert_allclose(
+            np.asarray(ranks_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.ranks)[: g.n_nodes],
+            rtol=1e-4, atol=1e-9,
+        )
+        assert np.asarray(ranks_sh).reshape(-1)[7] == 0.0
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_pushsum_matches_single_device(self, n_shards):
+        from p2pnetwork_tpu.models import PushSum
+
+        # 1024 = 8 * 128: S*block == n_pad, so the init draw matches the
+        # engine's bit-for-bit (Gossip-init parity).
+        g = G.barabasi_albert(1024, 3, seed=1)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        proto = PushSum()
+        key = jax.random.key(5)
+        rounds = 10
+
+        (s_sh, w_sh), stats_sh = sharded.pushsum(sg, mesh, proto, key, rounds)
+        ref_state, ref_stats = engine.run(g, proto, key, rounds)
+        np.testing.assert_allclose(
+            np.asarray(s_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.s)[: g.n_nodes], rtol=1e-4, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(w_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.w)[: g.n_nodes], rtol=1e-4, atol=1e-7,
+        )
+        # Conservation on the sharded path: sum(w) == live count.
+        np.testing.assert_allclose(
+            np.asarray(stats_sh["w_total"]), g.n_nodes, rtol=1e-5
+        )
+
+    def test_pushsum_conservation_under_failures(self):
+        from p2pnetwork_tpu.models import PushSum
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=3)
+        mesh = M.ring_mesh(8)
+        sg = sharded.fail_nodes(sharded.shard_graph(g, mesh), [3, 900])
+        key = jax.random.key(7)
+        (s_sh, w_sh), stats_sh = sharded.pushsum(sg, mesh, PushSum(), key, 15)
+        s0 = np.asarray(sharded.init_state(sg, PushSum(), key)[0]).sum()
+        np.testing.assert_allclose(np.asarray(stats_sh["s_total"])[-1], s0,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(stats_sh["w_total"])[-1], 1022,
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("op", ["or", "sum"])
+    @pytest.mark.parametrize("hybrid", [False, True])
+    def test_generic_propagate_matches_segment(self, op, hybrid):
+        from p2pnetwork_tpu.ops import segment
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=4)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh, hybrid=hybrid, min_count=32)
+        S, block = sg.n_shards, sg.block
+        key = jax.random.key(11)
+        if op == "or":
+            sig = jax.random.bernoulli(key, 0.1, (S * block,))
+            ref = segment.propagate_or(g, sig[: g.n_nodes_padded], "segment")
+        else:
+            sig = jax.random.normal(key, (S * block,), dtype=jnp.float32)
+            ref = segment.propagate_sum(g, sig[: g.n_nodes_padded], "segment")
+        out = sharded.propagate(sg, mesh, sig.reshape(S, block), op=op)
+        flat = np.asarray(out).reshape(-1)[: g.n_nodes]
+        want = np.asarray(ref)[: g.n_nodes]
+        if op == "or":
+            np.testing.assert_array_equal(flat, want)
+        else:
+            np.testing.assert_allclose(flat, want, rtol=1e-4, atol=1e-6)
+
+    def test_generic_propagate_sees_dynamic_edges(self):
+        from p2pnetwork_tpu.ops import segment
+        from p2pnetwork_tpu.sim import topology
+
+        g = G.ring(512)
+        mesh = M.ring_mesh(4)
+        sg = sharded.connect(
+            sharded.with_capacity(sharded.shard_graph(g, mesh), 8),
+            [100], [400],
+        )
+        gc = topology.connect(topology.with_capacity(g, extra_edges=8),
+                              [100], [400])
+        sig = jnp.zeros(sg.n_nodes_padded, dtype=bool).at[100].set(True)
+        out = sharded.propagate(sg, mesh,
+                                sig.reshape(sg.n_shards, sg.block), op="or")
+        ref = segment.propagate_or(gc, sig[: gc.n_nodes_padded])
+        np.testing.assert_array_equal(
+            np.asarray(out).reshape(-1)[:512], np.asarray(ref)[:512]
+        )

@@ -171,3 +171,42 @@ class TestMeshBackedNode:
             np.asarray(b.sim_sharded.node_mask),
             np.asarray(a.sim_sharded.node_mask),
         )
+
+
+class TestMeshBackedValueProtocols:
+    def test_pagerank_matches_single_device_node(self):
+        from p2pnetwork_tpu.models import PageRank
+
+        g = G.barabasi_albert(1024, 3, seed=2)
+        a = JaxSimNode(graph=g, protocol=PageRank(), seed=5)
+        b = JaxSimNode(graph=g, protocol=PageRank(), seed=5,
+                       mesh=M.ring_mesh(8))
+        a.run_rounds(6)
+        a.run_rounds(4)
+        b.run_rounds(6)
+        b.run_rounds(4)
+        np.testing.assert_allclose(
+            np.asarray(b.sim_state).reshape(-1),
+            np.asarray(a.sim_state.ranks),
+            rtol=1e-4, atol=1e-9,
+        )
+        assert a.sim_round == b.sim_round == 10
+
+    def test_pushsum_matches_single_device_node(self):
+        from p2pnetwork_tpu.models import PushSum
+
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=PushSum(), seed=11)
+        b = JaxSimNode(graph=g, protocol=PushSum(), seed=11,
+                       mesh=M.ring_mesh(8))
+        a.run_rounds(5)
+        b.run_rounds(5)
+        np.testing.assert_allclose(
+            np.asarray(b.sim_state[0]).reshape(-1),
+            np.asarray(a.sim_state.s), rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(b.sim_state[1]).reshape(-1),
+            np.asarray(a.sim_state.w), rtol=1e-4, atol=1e-6,
+        )
+        assert a.sim_message_count == b.sim_message_count
