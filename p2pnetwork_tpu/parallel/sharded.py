@@ -1197,7 +1197,12 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
     seen, frontier, rounds, covered, hi, lo = jax.lax.while_loop(
         cond, body, init
     )
-    return seen[None], frontier[None], rounds, covered / n_live, hi, lo
+    # One packed i32[4] (replicated) carries the whole summary back — the
+    # engine's single-transfer trick; four separate scalars cost four
+    # device->host round trips on tunneled backends.
+    return seen[None], frontier[None], accum.pack_summary(
+        rounds, covered / n_live, (hi, lo)
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -1211,7 +1216,7 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 14,
-        out_specs=(spec, spec, P(), P(), P(), P()),
+        out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)
 
@@ -1241,17 +1246,13 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                        sg.diag_pieces, sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
-    seen, frontier, rounds, coverage, hi, lo = fn(
+    seen, frontier, packed = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree, seen0, frontier0,
     )
-    out = {
-        "rounds": rounds,
-        "coverage": coverage,
-        "messages": accum.value((hi, lo)),
-    }
+    out = accum.unpack_summary(packed)
     if return_state:
         return (seen, frontier), out
     return seen, out
@@ -1462,12 +1463,10 @@ def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
     """
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
 
-    groups = _groups_sum(
-        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
-        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
-        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
-    )
-    diag = (pieces, diag_masks[0], _diag_sum_piece)
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator (models/sir.py parity under failures).
     n_live = jnp.maximum(
@@ -1481,11 +1480,7 @@ def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
         infected = (status == INFECTED) & node_mask_b
         susceptible = (status == SUSCEPTIBLE) & node_mask_b
 
-        acc0 = jnp.zeros((block,), jnp.float32)
-        pressure = _ring_pass(
-            axis_name, S, infected.astype(jnp.float32), groups, acc0, jnp.add,
-            diag=diag,
-        )
+        pressure = pass_(infected.astype(jnp.float32))
         # one_minus_beta arrives precomputed in f64 then cast, matching the
         # engine's `jnp.power(1.0 - beta, ...)` constant bit-for-bit.
         p_infect = 1.0 - jnp.power(one_minus_beta, pressure)
@@ -1573,7 +1568,8 @@ def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block,
     ) / n_live
     init = (status0[0], key_data, jnp.int32(0), cov0, *accum.zero())
     status, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
-    return status[None], rounds, coverage, hi, lo
+    # Single-transfer summary, like the flood coverage body.
+    return status[None], accum.pack_summary(rounds, coverage, (hi, lo))
 
 
 @functools.lru_cache(maxsize=64)
@@ -1587,7 +1583,7 @@ def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 13 + (P(), P(), P()),
-        out_specs=(spec, P(), P(), P(), P()),
+        out_specs=(spec, P()),
     )
     return jax.jit(fn)
 
@@ -1616,7 +1612,7 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
                      sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
-    status, rounds, coverage, hi, lo = fn(
+    status, packed = fn(
         jnp.float32(coverage_target),
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
@@ -1624,11 +1620,7 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
         jax.random.key_data(key),
         jnp.float32(1.0 - protocol.beta), jnp.float32(protocol.gamma),
     )
-    return status, {
-        "rounds": rounds,
-        "coverage": coverage,
-        "messages": accum.value((hi, lo)),
-    }
+    return status, accum.unpack_summary(packed)
 
 
 @functools.lru_cache(maxsize=64)

@@ -10,11 +10,9 @@ host round-trips (the north-star benchmark loop).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.utils import accum
@@ -103,22 +101,9 @@ def run_until_coverage_from(
     return state, _unpack_summary(packed)
 
 
-def _pack_summary(rounds, coverage, hi, lo):
-    """[rounds, coverage-bits, hi, lo-bits] as one i32[4] — a single
-    device->host transfer carries the whole run summary."""
-    return jnp.stack([
-        rounds,
-        jax.lax.bitcast_convert_type(coverage, jnp.int32),
-        hi,
-        jax.lax.bitcast_convert_type(lo, jnp.int32),
-    ])
-
-
-def _unpack_summary(packed) -> Dict[str, Any]:
-    arr = np.asarray(packed)
-    coverage = float(arr[1:2].view(np.float32)[0])
-    messages = (int(arr[2]) << 32) + int(arr[3:4].view(np.uint32)[0])
-    return {"rounds": int(arr[0]), "coverage": coverage, "messages": messages}
+# One-transfer run summaries, shared with the sharded coverage loops.
+_pack_summary = accum.pack_summary
+_unpack_summary = accum.unpack_summary
 
 
 def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
@@ -140,7 +125,7 @@ def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
     )
     init = (state0, key, jnp.int32(0), cov0, *accum.zero())
     state, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
-    return state, _pack_summary(rounds, coverage, hi, lo)
+    return state, _pack_summary(rounds, coverage, (hi, lo))
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))

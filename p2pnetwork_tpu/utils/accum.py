@@ -47,3 +47,25 @@ def value(acc: Acc) -> int:
     """Combine to an exact Python int (host-side; forces a transfer)."""
     hi, lo = acc
     return (int(np.asarray(hi)) << 32) + int(np.uint32(np.asarray(lo)))
+
+
+def pack_summary(rounds: jax.Array, coverage: jax.Array, acc: Acc) -> jax.Array:
+    """[rounds, coverage-bits, hi, lo-bits] as one i32[4] — a single
+    device->host transfer carries a whole run summary (on tunneled
+    backends every extra round trip is milliseconds). Shared by the
+    engine's and the sharded path's run-to-coverage loops."""
+    hi, lo = acc
+    return jnp.stack([
+        rounds,
+        jax.lax.bitcast_convert_type(coverage, jnp.int32),
+        hi,
+        jax.lax.bitcast_convert_type(lo, jnp.int32),
+    ])
+
+
+def unpack_summary(packed) -> dict:
+    """Host-side inverse of :func:`pack_summary` (forces the transfer)."""
+    arr = np.asarray(packed)
+    coverage = float(arr[1:2].view(np.float32)[0])
+    messages = (int(arr[2]) << 32) + int(arr[3:4].view(np.uint32)[0])
+    return {"rounds": int(arr[0]), "coverage": coverage, "messages": messages}
