@@ -810,9 +810,11 @@ def init_state(sg: ShardedGraph, protocol, key: jax.Array):
     """The sharded initial state for a protocol — what ``protocol.init``
     produces on the engine path, laid out ``[S, block]``. Flood ->
     ``(seen, frontier)``; SIR -> ``status``; Gossip -> ``values``;
-    PageRank -> ``ranks``; PushSum -> ``(s, w)``."""
+    HopDistance -> ``(dist, frontier, round)``; PageRank -> ``ranks``;
+    PushSum -> ``(s, w)``."""
     from p2pnetwork_tpu.models.flood import Flood
     from p2pnetwork_tpu.models.gossip import Gossip
+    from p2pnetwork_tpu.models.hopdist import HopDistance
     from p2pnetwork_tpu.models.pagerank import PageRank
     from p2pnetwork_tpu.models.pushsum import PushSum
     from p2pnetwork_tpu.models.sir import SIR
@@ -830,6 +832,10 @@ def init_state(sg: ShardedGraph, protocol, key: jax.Array):
     if isinstance(protocol, Gossip):
         vals = jax.random.normal(key, (sg.n_nodes_padded,), dtype=jnp.float32)
         return vals.reshape(S, block) * sg.node_mask
+    if isinstance(protocol, HopDistance):
+        seed = _flood_seed(sg, protocol.source)
+        dist = jnp.where(seed, 0, -1).astype(jnp.int32)
+        return (dist, seed, jnp.int32(0))
     if isinstance(protocol, PageRank):
         mask_f = sg.node_mask.astype(jnp.float32)
         return mask_f / jnp.maximum(jnp.sum(mask_f), 1.0)
@@ -838,9 +844,9 @@ def init_state(sg: ShardedGraph, protocol, key: jax.Array):
         mask_f = sg.node_mask.astype(jnp.float32)
         return (vals.reshape(S, block) * mask_f, mask_f)
     raise ValueError(
-        f"the sharded path implements Flood, SIR, Gossip, PageRank and "
-        f"PushSum; got {type(protocol).__name__} — run it on the "
-        f"single-device engine, or write its round body around "
+        f"the sharded path implements Flood, SIR, Gossip, HopDistance, "
+        f"PageRank and PushSum; got {type(protocol).__name__} — run it on "
+        f"the single-device engine, or write its round body around "
         f"sharded.propagate"
     )
 
@@ -1050,12 +1056,10 @@ def _ring_rounds_or(axis_name, S, block, pieces, mxu_block,
                     node_mask, out_degree, seen0, frontier0, rounds):
     """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
     full ring pass. All blocks carry a leading length-1 shard axis."""
-    groups = _groups_or(
-        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
-        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
-        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
-    )
-    diag = (pieces, diag_masks[0], _diag_or_piece)
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     # Live-count denominator, like models/flood.py — under failures the
     # coverage must be of SURVIVORS, or dead-but-seen nodes push it past 1.
@@ -1065,9 +1069,7 @@ def _ring_rounds_or(axis_name, S, block, pieces, mxu_block,
 
     def one_round(carry, _):
         seen, frontier = carry  # [block] bool each
-        delivered = _ring_pass(axis_name, S, frontier, groups,
-                               jnp.zeros_like(seen), jnp.logical_or,
-                               diag=diag)
+        delivered = pass_(frontier)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
@@ -1159,12 +1161,10 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
     identical on every shard, so the loop condition is replicated-consistent
     by construction. Messages accumulate in the two-limb counter
     (utils/accum.py) — multi-chip totals wrap int32 even sooner."""
-    groups = _groups_or(
-        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
-        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
-        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
-    )
-    diag = (pieces, diag_masks[0], _diag_or_piece)
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks)
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     n_live = jnp.maximum(
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
@@ -1176,9 +1176,7 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
 
     def body(carry):
         seen, frontier, rounds, _, hi, lo = carry
-        delivered = _ring_pass(axis_name, S, frontier, groups,
-                               jnp.zeros_like(seen), jnp.logical_or,
-                               diag=diag)
+        delivered = pass_(frontier)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         msgs = jax.lax.psum(
@@ -1706,15 +1704,11 @@ def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
                     node_mask, signal):
     node_mask_b = node_mask[0]
     if op == "or":
-        groups = _groups_or(
-            block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
-            (dyn_src[0], dyn_dst[0], dyn_mask[0]),
-            (mxu_src[0], mxu_dst[0], mxu_mask[0]),
-        )
-        out = _ring_pass(axis_name, S, signal[0], groups,
-                         jnp.zeros((block,), bool), jnp.logical_or,
-                         diag=(pieces, diag_masks[0], _diag_or_piece))
-        return (out & node_mask_b)[None]
+        pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                              bkt_src, bkt_dst, bkt_mask,
+                              dyn_src, dyn_dst, dyn_mask,
+                              mxu_src, mxu_dst, mxu_mask, diag_masks)
+        return (pass_(signal[0]) & node_mask_b)[None]
     pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
@@ -1935,3 +1929,237 @@ def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
         sg.node_mask, sg.out_degree, s0, w0,
     )
     return (s, w), stats
+
+
+# ------------------------------------------------------------ hop distance
+
+
+def _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                  mxu_src, mxu_dst, mxu_mask, diag_masks):
+    """Build ``pass_(frontier) -> bool[block]``: one ring rotation OR-ing a
+    boolean signal over every incoming edge (the OR twin of
+    :func:`_make_sum_pass`, shared by the hop-distance body)."""
+    groups = _groups_or(
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
+    )
+    diag = (pieces, diag_masks[0], _diag_or_piece)
+
+    def pass_(frontier):
+        return _ring_pass(axis_name, S, frontier, groups,
+                          jnp.zeros((block,), bool), jnp.logical_or,
+                          diag=diag)
+
+    return pass_
+
+
+def _make_hopdist_round(axis_name, S, block, pieces, mxu_block,
+                        bkt_src, bkt_dst, bkt_mask,
+                        dyn_src, dyn_dst, dyn_mask,
+                        mxu_src, mxu_dst, mxu_mask, diag_masks,
+                        node_mask, out_degree):
+    """Per-shard BFS round closure (models/hopdist.py arithmetic): the wave
+    is the flood wave; nodes record the first round that reaches them."""
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def one_round(dist, frontier, rnd):
+        delivered = pass_(frontier)
+        new = delivered & (dist < 0) & node_mask_b
+        rnd = rnd + 1
+        dist = jnp.where(new, rnd, dist)
+        reached = (dist >= 0) & node_mask_b
+        stats = {
+            "messages": jax.lax.psum(
+                jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+            ),
+            "coverage": jax.lax.psum(
+                jnp.sum(reached.astype(jnp.int32)), axis_name
+            ) / n_live,
+            "frontier": jax.lax.psum(jnp.sum(new.astype(jnp.int32)),
+                                     axis_name),
+            "max_dist": jax.lax.pmax(jnp.max(dist), axis_name),
+        }
+        return dist, new, rnd, stats
+
+    return one_round
+
+
+def _ring_rounds_hopdist(axis_name, S, block, pieces, mxu_block,
+                         bkt_src, bkt_dst, bkt_mask,
+                         dyn_src, dyn_dst, dyn_mask,
+                         mxu_src, mxu_dst, mxu_mask, diag_masks,
+                         node_mask, out_degree,
+                         dist0, frontier0, round0, rounds):
+    one_round = _make_hopdist_round(
+        axis_name, S, block, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
+    )
+
+    def body(carry, _):
+        dist, frontier, rnd = carry
+        dist, frontier, rnd, stats = one_round(dist, frontier, rnd)
+        return (dist, frontier, rnd), stats
+
+    (dist, frontier, rnd), stats = jax.lax.scan(
+        body, (dist0[0], frontier0[0], round0), None, length=rounds
+    )
+    return dist[None], frontier[None], rnd, stats
+
+
+@functools.lru_cache(maxsize=64)
+def _hopdist_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
+                pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_rounds_hopdist, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda *args: body(*args, rounds=rounds),
+        mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 14 + (P(),),
+        out_specs=(spec, spec, P(), P()),
+    )
+    return jax.jit(fn)
+
+
+def hopdist(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
+            axis_name: str = DEFAULT_AXIS, state0=None):
+    """Run ``rounds`` of BFS hop-distance (models/hopdist.py) on the sharded
+    graph. Deterministic; integer state, so parity with the single-device
+    engine is bit-exact. Returns ``((dist, frontier, round), stats)`` with
+    ``dist [S, block] i32`` (-1 = unreached)."""
+    S, block = sg.n_shards, sg.block
+    if state0 is None:
+        state0 = init_state(sg, protocol, None)
+    dist0, frontier0, round0 = state0
+    fn = _hopdist_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
+                     sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    dist, frontier, rnd, stats = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, dist0, frontier0, round0,
+    )
+    return (dist, frontier, rnd), stats
+
+
+def _ring_coverage_hopdist(axis_name, S, block, pieces, mxu_block,
+                           coverage_target, max_rounds,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks,
+                           node_mask, out_degree, dist0, frontier0, round0):
+    """Per-shard body: BFS until coverage reaches the target OR the wave
+    dies out (frontier empty) — whichever first — as one while_loop with
+    the packed single-transfer summary. Lean: only the collectives the
+    loop consumes (messages, live frontier count, covered count) run per
+    round; eccentricity is a single reduction after the loop."""
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def cond(carry):
+        _, _, rnd, alive, covered, _, _ = carry
+        return ((alive > 0) & (rnd - round0 < max_rounds)
+                & (covered / n_live < coverage_target))
+
+    def body(carry):
+        dist, frontier, rnd, _, covered, hi, lo = carry
+        msgs = jax.lax.psum(
+            jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+        )
+        hi, lo = accum.add((hi, lo), msgs)
+        delivered = pass_(frontier)
+        new = delivered & (dist < 0) & node_mask_b
+        rnd = rnd + 1
+        dist = jnp.where(new, rnd, dist)
+        alive = jax.lax.psum(jnp.sum(new.astype(jnp.int32)), axis_name)
+        return dist, new, rnd, alive, covered + alive, hi, lo
+
+    covered0 = jax.lax.psum(
+        jnp.sum(((dist0[0] >= 0) & node_mask_b).astype(jnp.int32)), axis_name
+    )
+    alive0 = jax.lax.psum(jnp.sum(frontier0[0].astype(jnp.int32)), axis_name)
+    init = (dist0[0], frontier0[0], round0, alive0, covered0, *accum.zero())
+    dist, frontier, rnd, _, covered, hi, lo = jax.lax.while_loop(
+        cond, body, init
+    )
+    return dist[None], frontier[None], accum.pack_summary(
+        rnd - round0, covered / n_live, (hi, lo)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _hopdist_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                    max_rounds: int, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_coverage_hopdist, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 14 + (P(),),
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
+                           coverage_target: float = 0.99,
+                           max_rounds: int = 1024,
+                           axis_name: str = DEFAULT_AXIS, state0=None):
+    """BFS until the reached fraction of the LIVE population hits the
+    target — engine.run_until_coverage's measurement for HopDistance,
+    multi-chip — with an extra early exit the engine loop lacks: if the
+    wave dies out first (unreachable remainder), the loop stops instead of
+    spinning to ``max_rounds``. Returns ``((dist, frontier, round),
+    dict(rounds, coverage, messages))``."""
+    S, block = sg.n_shards, sg.block
+    if state0 is None:
+        state0 = init_state(sg, protocol, None)
+    dist0, frontier0, round0 = state0
+    fn = _hopdist_cov_fn(mesh, axis_name, S, block, max_rounds,
+                         sg.diag_pieces, sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    dist, frontier, packed = fn(
+        jnp.float32(coverage_target),
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, dist0, frontier0, round0,
+    )
+    out = accum.unpack_summary(packed)
+    rnd = round0 + out["rounds"]
+    return (dist, frontier, rnd), out
+
+
+def hopdist_until_done(sg: ShardedGraph, mesh: Mesh, protocol, *,
+                       max_rounds: int = 1024,
+                       axis_name: str = DEFAULT_AXIS, state0=None):
+    """BFS until the wave dies out (or ``max_rounds``): the complete
+    single-source reachability / eccentricity measurement — the
+    coverage loop with an unreachable target, so only frontier death
+    stops it. ``rounds`` includes the final round that observes the
+    emptied frontier (one past the last delivery); the max over ``dist``
+    is the source's eccentricity."""
+    return hopdist_until_coverage(
+        sg, mesh, protocol, coverage_target=2.0, max_rounds=max_rounds,
+        axis_name=axis_name, state0=state0,
+    )

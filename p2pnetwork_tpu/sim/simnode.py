@@ -191,6 +191,7 @@ class JaxSimNode(Node):
         """Dispatch a run_rounds segment onto the sharded backend."""
         from p2pnetwork_tpu.models.flood import Flood
         from p2pnetwork_tpu.models.gossip import Gossip
+        from p2pnetwork_tpu.models.hopdist import HopDistance
         from p2pnetwork_tpu.models.pagerank import PageRank
         from p2pnetwork_tpu.models.pushsum import PushSum
         from p2pnetwork_tpu.models.sir import SIR
@@ -206,6 +207,9 @@ class JaxSimNode(Node):
         if isinstance(proto, Gossip):
             return sharded.gossip(sg, mesh, proto, seg_key, rounds,
                                   rng=self._sim_rng, values0=self.sim_state)
+        if isinstance(proto, HopDistance):
+            return sharded.hopdist(sg, mesh, proto, rounds,
+                                   state0=self.sim_state)
         if isinstance(proto, PageRank):
             return sharded.pagerank(sg, mesh, proto, rounds,
                                     ranks0=self.sim_state)
@@ -213,8 +217,8 @@ class JaxSimNode(Node):
             return sharded.pushsum(sg, mesh, proto, seg_key, rounds,
                                    state0=self.sim_state)
         raise ValueError(
-            f"the sharded backend implements Flood, SIR, Gossip, PageRank "
-            f"and PushSum; got {type(proto).__name__}"
+            f"the sharded backend implements Flood, SIR, Gossip, "
+            f"HopDistance, PageRank and PushSum; got {type(proto).__name__}"
         )
 
     def run_rounds(self, rounds: int) -> dict:
@@ -252,6 +256,7 @@ class JaxSimNode(Node):
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
         if self.sim_mesh is not None:
             from p2pnetwork_tpu.models.flood import Flood
+            from p2pnetwork_tpu.models.hopdist import HopDistance
             from p2pnetwork_tpu.models.sir import SIR
             from p2pnetwork_tpu.parallel import sharded
 
@@ -260,6 +265,12 @@ class JaxSimNode(Node):
                     self.sim_sharded, self.sim_mesh, self.sim_protocol.source,
                     coverage_target=coverage_target, max_rounds=max_rounds,
                     state0=self.sim_state, return_state=True,
+                )
+            elif isinstance(self.sim_protocol, HopDistance):
+                self.sim_state, out = sharded.hopdist_until_coverage(
+                    self.sim_sharded, self.sim_mesh, self.sim_protocol,
+                    coverage_target=coverage_target, max_rounds=max_rounds,
+                    state0=self.sim_state,
                 )
             elif isinstance(self.sim_protocol, SIR):
                 self.sim_state, out = sharded.sir_until_coverage(
@@ -271,7 +282,8 @@ class JaxSimNode(Node):
             else:
                 raise ValueError(
                     "run_until_coverage on the sharded backend implements "
-                    "Flood and SIR; the protocol must expose a coverage stat"
+                    "Flood, SIR and HopDistance; the protocol must expose "
+                    "a coverage stat"
                 )
         else:
             self.sim_state, out = engine.run_until_coverage_from(
@@ -413,8 +425,14 @@ class JaxSimNode(Node):
             )
             shard = NamedSharding(self.sim_mesh,
                                   P(self.sim_mesh.axis_names[0]))
+            replicated = NamedSharding(self.sim_mesh, P())
+            # Scalar leaves (HopDistance's round counter) are replicated —
+            # a rank-1 spec on a 0-d array is invalid.
             self.sim_state = jax.tree.map(
-                lambda x: jax.device_put(jax.numpy.asarray(x), shard),
+                lambda x: jax.device_put(
+                    jax.numpy.asarray(x),
+                    shard if jax.numpy.asarray(x).ndim >= 1 else replicated,
+                ),
                 payload["protocol"],
             )
             self.sim_sharded = new_sharded

@@ -938,3 +938,76 @@ class TestShardedValueProtocols:
         np.testing.assert_array_equal(
             np.asarray(out).reshape(-1)[:512], np.asarray(ref)[:512]
         )
+
+
+class TestShardedHopDistance:
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_matches_single_device(self, n_shards):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        proto = HopDistance(source=5)
+        rounds = 6
+
+        (dist_sh, _, rnd), stats_sh = sharded.hopdist(sg, mesh, proto, rounds)
+        ref_state, ref_stats = engine.run(g, proto, jax.random.key(0), rounds)
+        np.testing.assert_array_equal(
+            np.asarray(dist_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.dist)[: g.n_nodes],
+        )
+        assert int(np.asarray(rnd)) == rounds
+        for k in ("messages", "frontier", "max_dist"):
+            np.testing.assert_array_equal(
+                np.asarray(stats_sh[k]), np.asarray(ref_stats[k])
+            )
+
+    def test_until_done_full_bfs(self):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.ring(256)  # eccentricity 128, wave dies at round 128
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        (dist, frontier, rnd), out = sharded.hopdist_until_done(
+            sg, mesh, HopDistance(source=0)
+        )
+        dist_flat = np.asarray(dist).reshape(-1)[:256]
+        ref = np.minimum(np.arange(256), 256 - np.arange(256))
+        np.testing.assert_array_equal(dist_flat, ref)
+        # 128 delivery rounds + the final round that proves the frontier
+        # died (frontier-based termination observes emptiness one round
+        # after the last delivery); eccentricity is max(dist) = 128.
+        assert out["rounds"] == 129
+        assert out["coverage"] == 1.0
+        assert not np.asarray(frontier).any()
+        # Resume from the finished state: zero further rounds.
+        (_, _, _), out2 = sharded.hopdist_until_done(
+            sg, mesh, HopDistance(source=0),
+            state0=(dist, frontier, jnp.int32(int(np.asarray(rnd)))),
+        )
+        assert out2["rounds"] == 0
+
+    def test_under_churn_matches_single_device(self):
+        from p2pnetwork_tpu.models import HopDistance
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=2)
+        mesh = M.ring_mesh(8)
+        sg = sharded.with_capacity(
+            sharded.fail_nodes(sharded.shard_graph(g, mesh), [9, 700]), 8
+        )
+        sg = sharded.connect(sg, [11], [901])
+        gc = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [9, 700]),
+                                   extra_edges=8),
+            [11], [901],
+        )
+        (dist_sh, _, _), _ = sharded.hopdist(sg, mesh, HopDistance(source=0), 8)
+        ref_state, _ = engine.run(gc, HopDistance(source=0),
+                                  jax.random.key(0), 8)
+        np.testing.assert_array_equal(
+            np.asarray(dist_sh).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.dist)[: g.n_nodes],
+        )
+        assert np.asarray(dist_sh).reshape(-1)[9] == -1

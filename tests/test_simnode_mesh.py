@@ -134,7 +134,7 @@ class TestMeshBackedNode:
 
         b = JaxSimNode(graph=G.barabasi_albert(1024, 3, seed=0),
                        protocol=Gossip(), seed=0, mesh=M.ring_mesh(4))
-        with pytest.raises(ValueError, match="Flood and SIR"):
+        with pytest.raises(ValueError, match="coverage stat"):
             b.run_until_coverage(0.5)
 
     def test_checkpoint_roundtrip_with_churned_topology(self, tmp_path):
@@ -210,3 +210,47 @@ class TestMeshBackedValueProtocols:
             np.asarray(a.sim_state.w), rtol=1e-4, atol=1e-6,
         )
         assert a.sim_message_count == b.sim_message_count
+
+    def test_hopdist_matches_single_device_node(self):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=HopDistance(source=0), seed=1)
+        b = JaxSimNode(graph=g, protocol=HopDistance(source=0), seed=1,
+                       mesh=M.ring_mesh(8))
+        a.run_rounds(4)
+        b.run_rounds(4)
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state[0]).reshape(-1),
+            np.asarray(a.sim_state.dist),
+        )
+        assert a.sim_message_count == b.sim_message_count
+
+    def test_hopdist_coverage_and_checkpoint_roundtrip(self, tmp_path):
+        # The scalar round leaf in HopDistance's state must replicate, not
+        # take the rank-1 shard spec (regression: load_checkpoint crashed
+        # on 0-d leaves); and run_until_coverage must ride the sharded
+        # BFS loop with engine-identical rounds when coverage binds.
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = _graph()
+        proto = HopDistance(source=0)
+        a = JaxSimNode(graph=g, protocol=proto, seed=2)
+        b = JaxSimNode(graph=g, protocol=proto, seed=2, mesh=M.ring_mesh(8))
+        out_a = a.run_until_coverage(0.99)
+        out_b = b.run_until_coverage(0.99)
+        assert out_a["rounds"] == out_b["rounds"]
+        assert out_a["messages"] == out_b["messages"]
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state[0]).reshape(-1),
+            np.asarray(a.sim_state.dist),
+        )
+
+        path = str(tmp_path / "hopdist_mesh.npz")
+        b.save_checkpoint(path)
+        c = JaxSimNode(graph=g, protocol=proto, seed=2, mesh=M.ring_mesh(8))
+        c.load_checkpoint(path)
+        np.testing.assert_array_equal(
+            np.asarray(c.sim_state[0]), np.asarray(b.sim_state[0])
+        )
+        assert int(np.asarray(c.sim_state[2])) == int(np.asarray(b.sim_state[2]))
