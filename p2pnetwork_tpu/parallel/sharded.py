@@ -1698,6 +1698,28 @@ def _make_sum_pass(axis_name, S, block, pieces, mxu_block,
     return pass_
 
 
+def _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                  mxu_src, mxu_dst, mxu_mask, diag_masks):
+    """Build ``pass_(frontier) -> bool[block]``: one ring rotation OR-ing a
+    boolean signal over every incoming edge — the OR twin of
+    :func:`_make_sum_pass`, shared by the flood bodies, the coverage
+    loops, :func:`propagate` and the BFS hop-distance bodies."""
+    groups = _groups_or(
+        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
+        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
+    )
+    diag = (pieces, diag_masks[0], _diag_or_piece)
+
+    def pass_(frontier):
+        return _ring_pass(axis_name, S, frontier, groups,
+                          jnp.zeros((block,), bool), jnp.logical_or,
+                          diag=diag)
+
+    return pass_
+
+
 def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -1932,27 +1954,6 @@ def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
 
 
 # ------------------------------------------------------------ hop distance
-
-
-def _make_or_pass(axis_name, S, block, pieces, mxu_block,
-                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
-                  mxu_src, mxu_dst, mxu_mask, diag_masks):
-    """Build ``pass_(frontier) -> bool[block]``: one ring rotation OR-ing a
-    boolean signal over every incoming edge (the OR twin of
-    :func:`_make_sum_pass`, shared by the hop-distance body)."""
-    groups = _groups_or(
-        block, mxu_block, (bkt_src[0], bkt_dst[0], bkt_mask[0]),
-        (dyn_src[0], dyn_dst[0], dyn_mask[0]),
-        (mxu_src[0], mxu_dst[0], mxu_mask[0]),
-    )
-    diag = (pieces, diag_masks[0], _diag_or_piece)
-
-    def pass_(frontier):
-        return _ring_pass(axis_name, S, frontier, groups,
-                          jnp.zeros((block,), bool), jnp.logical_or,
-                          diag=diag)
-
-    return pass_
 
 
 def _make_hopdist_round(axis_name, S, block, pieces, mxu_block,

@@ -426,15 +426,15 @@ class JaxSimNode(Node):
             shard = NamedSharding(self.sim_mesh,
                                   P(self.sim_mesh.axis_names[0]))
             replicated = NamedSharding(self.sim_mesh, P())
-            # Scalar leaves (HopDistance's round counter) are replicated —
-            # a rank-1 spec on a 0-d array is invalid.
-            self.sim_state = jax.tree.map(
-                lambda x: jax.device_put(
-                    jax.numpy.asarray(x),
-                    shard if jax.numpy.asarray(x).ndim >= 1 else replicated,
-                ),
-                payload["protocol"],
-            )
+
+            def put(x):
+                # Scalar leaves (HopDistance's round counter) replicate —
+                # a rank-1 spec on a 0-d array is invalid.
+                arr = jax.numpy.asarray(x)
+                return jax.device_put(arr,
+                                      shard if arr.ndim >= 1 else replicated)
+
+            self.sim_state = jax.tree.map(put, payload["protocol"])
             self.sim_sharded = new_sharded
         else:
             proto_template = self.sim_protocol.init(self.sim_graph,
