@@ -1,0 +1,108 @@
+"""Write your own protocol — single-device AND multi-chip, no library changes.
+
+The reference deliberately ships no protocol: users implement flooding /
+discovery / aggregation themselves in ``node_message`` overrides
+[ref: README.md:20]. This framework keeps that identity at TPU scale. A
+protocol here is two pure jittable functions behind the models/base.py
+seam; this example builds one the library does NOT ship — **decaying
+heat diffusion** (each node keeps half its heat and spreads the rest
+equally over its out-edges; heat injected at one node, total heat
+conserved) — and runs it two ways:
+
+1. against the single-device engine (``engine.run``), like any shipped
+   protocol;
+2. as a round function written around :func:`sharded.propagate` — the
+   generic one-pass edge aggregation of the ring path — jitted over an
+   8-device mesh, with results parity-checked against (1).
+
+Run: ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+python examples/custom_protocol.py`` (or on real chips unchanged).
+"""
+
+import dataclasses
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.parallel import mesh as M
+from p2pnetwork_tpu.parallel import sharded
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim import graph as G
+
+
+# ----------------------------------------------------- the custom protocol
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HeatState:
+    heat: jax.Array  # f32[N_pad]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class HeatDiffusion:
+    """Keep ``retain`` of your heat, spread the rest over out-edges."""
+
+    source: int = 0
+    retain: float = 0.5
+
+    def init(self, graph, key):
+        heat = jnp.zeros(graph.n_nodes_padded, dtype=jnp.float32)
+        return HeatState(heat=heat.at[self.source].set(1.0))
+
+    def step(self, graph, state, key):
+        deg = graph.out_degree.astype(jnp.float32)
+        spread = jnp.where(deg > 0, (1.0 - self.retain) / jnp.maximum(deg, 1.0),
+                           0.0)
+        kept = jnp.where(deg > 0, self.retain, 1.0) * state.heat
+        heat = kept + segment.propagate_sum(graph, state.heat * spread)
+        stats = {
+            "messages": segment.frontier_messages(graph, state.heat > 0),
+            "heat_total": jnp.sum(heat),
+            "heat_max": jnp.max(heat),
+        }
+        return HeatState(heat=heat), stats
+
+
+def main():
+    n = 8192
+    g = G.watts_strogatz(n, 6, 0.1, seed=0)
+    rounds = 20
+    proto = HeatDiffusion(source=7)
+
+    # 1) Single-device engine — the protocol seam, like any shipped model.
+    state, stats = engine.run(g, proto, jax.random.key(0), rounds)
+    heat_ref = np.asarray(state.heat)[:n]
+    print(f"engine: total heat {float(np.asarray(stats['heat_total'])[-1]):.6f} "
+          f"(conserved), hottest node {heat_ref.argmax()} "
+          f"({heat_ref.max():.4f})")
+
+    # 2) Multi-chip: the same round, written around sharded.propagate.
+    mesh = M.ring_mesh(min(8, len(jax.devices())))
+    sg = sharded.shard_graph(g, mesh)
+    S, block = sg.n_shards, sg.block
+    deg = sg.out_degree.astype(jnp.float32)
+    spread = jnp.where(deg > 0, (1.0 - proto.retain) / jnp.maximum(deg, 1.0),
+                       0.0)
+    keep = jnp.where(deg > 0, proto.retain, 1.0)
+
+    heat = jnp.zeros((S, block), jnp.float32).at[
+        proto.source // block, proto.source % block].set(1.0)
+    for _ in range(rounds):
+        heat = keep * heat + sharded.propagate(sg, mesh, heat * spread,
+                                               op="sum")
+    heat_sh = np.asarray(heat).reshape(-1)[:n]
+
+    err = np.abs(heat_sh - heat_ref).max()
+    assert err < 1e-6, f"sharded diverged from engine: {err}"
+    print(f"sharded ({S} devices): bit-compatible with the engine "
+          f"(max |diff| {err:.2e}) — same protocol, zero library changes")
+
+
+if __name__ == "__main__":
+    main()
