@@ -33,10 +33,17 @@ import jax  # noqa: E402
 
 
 def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int = 5):
+    from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood
     from p2pnetwork_tpu.models.flood import Flood
     from p2pnetwork_tpu.sim import engine
 
-    protocol = Flood(source=0, method=method)
+    if method.startswith("adaptive"):
+        # "adaptive-<k>": frontier-sparse rounds under k, dense hybrid above
+        # (models/adaptive_flood.py) — bit-identical results to Flood.
+        k = int(method.split("-")[1])
+        protocol = AdaptiveFlood(source=0, method="hybrid", k=k)
+    else:
+        protocol = Flood(source=0, method=method)
     key = jax.random.key(0)
 
     def once():
@@ -63,10 +70,11 @@ def bench_1m(record):
 
     n, k, target = 1_000_000, 10, 0.99
     t_build0 = time.perf_counter()
-    g = G.watts_strogatz(n, k, 0.1, seed=0, blocked=True, hybrid=True)
+    g = G.watts_strogatz(n, k, 0.1, seed=0, blocked=True, hybrid=True,
+                         source_csr=True)
     build_s = time.perf_counter() - t_build0
 
-    methods = ["pallas", "hybrid"]
+    methods = ["pallas", "hybrid", "adaptive-1024"]
     results = {}
     for m in methods:
         try:
@@ -107,17 +115,19 @@ def bench_10m():
     n = 10_000_000
     t_build0 = time.perf_counter()
     g = G.watts_strogatz(n, 10, 0.1, seed=0, hybrid=True,
-                         build_neighbor_table=False)
+                         build_neighbor_table=False, source_csr=True)
     build_s = time.perf_counter() - t_build0
     print(f"# 10M graph built in {build_s:.1f}s ({g.n_edges} edges)",
           file=sys.stderr, flush=True)
-    secs, out = time_flood(g, "hybrid", target=0.99, max_rounds=64, reps=3)
+    secs, out = time_flood(g, "adaptive-2048", target=0.99, max_rounds=64,
+                           reps=3)
     msgs = int(out["messages"])
-    print(f"# 10M hybrid: {secs:.3f} s, rounds={int(out['rounds'])}, "
+    print(f"# 10M adaptive-2048: {secs:.3f} s, rounds={int(out['rounds'])}, "
           f"coverage={float(out['coverage']):.4f}, messages={msgs}",
           file=sys.stderr, flush=True)
     return {
         "value_s": round(secs, 4),
+        "method": "adaptive-2048",
         "rounds": int(out["rounds"]),
         "coverage": round(float(out["coverage"]), 5),
         "messages": msgs,

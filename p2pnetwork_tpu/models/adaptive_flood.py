@@ -1,0 +1,188 @@
+"""Frontier-adaptive flood: sparse rounds when the wave is small.
+
+Direction-optimized traversal, TPU-style. The dense flood round
+(models/flood.py) costs the same whether one node broadcast or half the
+population did — its remainder gather touches every edge slot at XLA's
+~8 cycles/element floor (BENCH.md "gather floor"). But a flood's life is
+asymmetric: the first rounds move a handful of messages, the last rounds a
+trickle, and only the middle saturates the graph. The reference pays this
+shape in its own coin — one Python ``send`` per edge per 10 ms poll tick
+[ref: p2pnetwork/node.py:110-112, nodeconnection.py:220]; here we pay it
+in wasted gather cycles.
+
+``AdaptiveFlood`` keeps TWO round implementations behind one
+``lax.cond``, chosen per round by the live frontier count:
+
+- **sparse** (``count <= k``): the frontier lives as an index list
+  ``[k]``. One round gathers the ≤ ``k * max_out_span`` out-edge slots
+  through the graph's source-CSR view (graph.py ``src_eid``/
+  ``src_offsets``), re-checks runtime edge liveness through
+  ``edge_mask``, folds in the dynamic (runtime-connected) edge region,
+  dedups new receivers with a scatter-min claim pass, and scatter-marks
+  them seen — O(k·W) work instead of O(E).
+- **dense** (``count > k``): exactly models/flood.py's masked OR round
+  (same ``method`` lowerings). When the wave shrinks back under ``k``,
+  the branch pays one ``nonzero`` compaction to re-enter sparse mode.
+
+State is a strict superset of FloodState (``seen``/``frontier`` bools
+plus the index list and its count). Results are
+bit-identical to ``Flood`` — same seen sets, same per-round message and
+coverage stats (tests/test_adaptive_flood.py asserts this through dense,
+sparse, and both transition directions, under failures and runtime
+connects).
+
+Requires a graph built with ``source_csr=True`` (or
+``with_source_csr()``). Degree-skewed graphs bound the slot width by
+their largest out-degree: a Barabási–Albert hub makes ``k * max_out_span``
+rival the edge count, so this protocol targets the quasi-regular
+topologies (WS lattices, rings, ER) where the benchmark family lives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AdaptiveFloodState:
+    seen: jax.Array  # bool[N_pad]
+    frontier: jax.Array  # bool[N_pad] — nodes that first saw it last round
+    fidx: jax.Array  # i32[k] — frontier as indices (valid iff fcount <= k)
+    fcount: jax.Array  # i32[] — live frontier size (always exact)
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class AdaptiveFlood:
+    """Single-source flood with frontier-sparse small rounds.
+
+    ``k`` is the sparse-mode capacity (index-list width, a compile-time
+    shape); ``method`` picks the dense round's aggregation lowering."""
+
+    source: int = 0
+    method: str = "auto"
+    k: int = 1024
+
+    def init(self, graph: Graph, key: jax.Array) -> AdaptiveFloodState:
+        base.validate_source(graph, self.source)
+        if graph.src_eid is None:
+            raise ValueError(
+                "AdaptiveFlood requires a source-CSR graph — build with "
+                "from_edges(source_csr=True) or graph.with_source_csr()"
+            )
+        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
+        seed = seed & graph.node_mask
+        fidx = jnp.full(self.k, graph.n_nodes_padded - 1, dtype=jnp.int32)
+        fidx = fidx.at[0].set(self.source)
+        count = jnp.sum(seed).astype(jnp.int32)
+        return AdaptiveFloodState(seen=seed, frontier=seed, fidx=fidx,
+                                  fcount=count)
+
+    def coverage(self, graph: Graph, state: AdaptiveFloodState) -> jax.Array:
+        """Live-node coverage (Flood.coverage parity)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum(state.seen & graph.node_mask) / n_real
+
+    # ------------------------------------------------------------- rounds
+
+    def _sparse_round(self, graph: Graph, state: AdaptiveFloodState):
+        k, w = self.k, max(graph.max_out_span, 1)
+        n_pad = graph.n_nodes_padded
+        pad_node = n_pad - 1
+
+        fvalid = jnp.arange(k) < state.fcount
+        f = jnp.where(fvalid, state.fidx, pad_node)
+        base_off = graph.src_offsets[f]  # [k]
+        row_len = graph.src_offsets[f + 1] - base_off  # [k] build-time extent
+        slot = base_off[:, None] + jnp.arange(w)[None, :]  # [k, w]
+        svalid = (jnp.arange(w)[None, :] < row_len[:, None]) & fvalid[:, None]
+        eid = graph.src_eid[jnp.where(svalid, slot, graph.n_edges_padded - 1)]
+        # Runtime liveness re-check: failed edges (sim/failures.py) stay in
+        # the build-time CSR rows but are masked here.
+        evalid = svalid & graph.edge_mask[eid]
+        cand = jnp.where(evalid, graph.receivers[eid], pad_node).reshape(-1)
+        fresh = (evalid.reshape(-1) & ~state.seen[cand]
+                 & graph.node_mask[cand])
+
+        # Dynamic (runtime-connected) out-edges ride along: the region is a
+        # small unsorted COO block, scanned whole.
+        if graph.dyn_senders is not None:
+            dsend = state.frontier[graph.dyn_senders] & graph.dyn_mask
+            dcand = jnp.where(dsend, graph.dyn_receivers, pad_node)
+            dfresh = (dsend & ~state.seen[dcand] & graph.node_mask[dcand])
+            cand = jnp.concatenate([cand, dcand])
+            fresh = jnp.concatenate([fresh, dfresh])
+
+        # First-claim dedup: every fresh slot claims its candidate with its
+        # position; winners are the slots that hold the minimum claim, so
+        # each newly-seen node appears in the next frontier exactly once.
+        order = jnp.arange(cand.shape[0], dtype=jnp.int32)
+        big = jnp.int32(2**31 - 1)
+        claim = jnp.where(fresh, order, big)
+        scratch = jnp.full(n_pad, big, dtype=jnp.int32).at[cand].min(
+            claim, mode="drop"
+        )
+        winner = fresh & (scratch[cand] == order)
+        new_count = jnp.sum(winner).astype(jnp.int32)
+
+        seen = state.seen.at[jnp.where(fresh, cand, n_pad)].set(
+            True, mode="drop"
+        )
+        frontier = (
+            jnp.zeros(n_pad, dtype=bool)
+            .at[jnp.where(winner, cand, n_pad)].set(True, mode="drop")
+        )
+        # Next index list: compact the winners (O(k·w) cumsum, not O(N)).
+        # Overflow past k only happens when new_count > k — dense mode
+        # takes over and the truncated list is never read.
+        pos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
+        fidx = jnp.where(jnp.arange(k) < new_count, cand[pos], pad_node)
+
+        msgs = jnp.sum(jnp.where(fvalid, graph.out_degree[f], 0))
+        return AdaptiveFloodState(seen=seen, frontier=frontier, fidx=fidx,
+                                  fcount=new_count), msgs
+
+    def _dense_round(self, graph: Graph, state: AdaptiveFloodState):
+        delivered = segment.propagate_or(graph, state.frontier, self.method)
+        new = delivered & ~state.seen & graph.node_mask
+        seen = state.seen | new
+        new_count = jnp.sum(new).astype(jnp.int32)
+
+        # Re-enter sparse mode: pay the O(N) compaction only on the round
+        # that crosses back under k (lax.cond executes one branch).
+        def compact(n):
+            return jnp.nonzero(
+                n, size=self.k, fill_value=graph.n_nodes_padded - 1
+            )[0].astype(jnp.int32)
+
+        fidx = jax.lax.cond(
+            new_count <= self.k, compact, lambda n: state.fidx, new
+        )
+        msgs = segment.frontier_messages(graph, state.frontier)
+        return AdaptiveFloodState(seen=seen, frontier=new, fidx=fidx,
+                                  fcount=new_count), msgs
+
+    def step(self, graph: Graph, state: AdaptiveFloodState, key: jax.Array):
+        new_state, msgs = jax.lax.cond(
+            state.fcount <= self.k,
+            lambda s: self._sparse_round(graph, s),
+            lambda s: self._dense_round(graph, s),
+            state,
+        )
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            "messages": msgs,
+            # Masked recompute, not an incremental counter — a fused AND +
+            # reduce is nearly free, and it stays exact across mid-run
+            # node failures (models/flood.py parity).
+            "coverage": jnp.sum(new_state.seen & graph.node_mask) / n_real,
+            "frontier": new_state.fcount,
+        }
+        return new_state, stats

@@ -109,6 +109,18 @@ class Graph:
     dyn_senders: Optional[jax.Array] = None  # i32[K]
     dyn_receivers: Optional[jax.Array] = None  # i32[K]
     dyn_mask: Optional[jax.Array] = None  # bool[K]
+    # Source-CSR (out-edge) view for frontier-sparse traversal
+    # (models/adaptive_flood.py): edge ids permuted sender-sorted —
+    # ``src_eid[src_offsets[v] : src_offsets[v+1]]`` are node ``v``'s
+    # out-edges as indices into senders/receivers/edge_mask. Row extents
+    # are BUILD-time; runtime edge liveness is re-checked through
+    # ``edge_mask[src_eid[...]]``, so failures need no rebuild. Attach via
+    # ``from_edges(source_csr=True)`` or :meth:`with_source_csr`.
+    src_eid: Optional[jax.Array] = None  # i32[E_pad]
+    src_offsets: Optional[jax.Array] = None  # i32[N_pad + 1]
+    #: Widest build-time out-edge row (static slot width for the sparse
+    #: frontier gather), 0 when no CSR is attached.
+    max_out_span: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def n_nodes_padded(self) -> int:
@@ -129,6 +141,21 @@ class Graph:
 
         return dataclasses.replace(self, blocked=build_blocked(self, block))
 
+    def with_source_csr(self) -> "Graph":
+        """Return a copy carrying the source-CSR out-edge view used by the
+        frontier-sparse rounds of models/adaptive_flood.py. Pulls the edge
+        arrays to host; prefer ``from_edges(source_csr=True)`` at
+        construction for large graphs."""
+        senders = np.asarray(self.senders)
+        emask = np.asarray(self.edge_mask)
+        eid, offsets, span = _build_source_csr(
+            senders, emask, self.n_nodes_padded, self.n_edges_padded
+        )
+        return dataclasses.replace(
+            self, src_eid=jnp.asarray(eid), src_offsets=jnp.asarray(offsets),
+            max_out_span=span,
+        )
+
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
@@ -139,6 +166,29 @@ class Graph:
         return dataclasses.replace(
             self, hybrid=build_hybrid(self, block, max_diags)
         )
+
+
+def _build_source_csr(senders: np.ndarray, edge_mask: np.ndarray,
+                      n_pad: int, e_pad: int):
+    """Sender-sorted edge-id permutation + row offsets (host-side).
+
+    Only edges active in ``edge_mask`` enter rows; padding slots of
+    ``src_eid`` point at ``e_pad - 1`` (a masked edge), so an out-of-row
+    gather can never alias a live edge."""
+    from p2pnetwork_tpu import native
+
+    active = np.flatnonzero(edge_mask).astype(np.int32)
+    # Radix sort (native/graphcore.cpp, numpy fallback) — the same sorter
+    # the receiver sort uses; np.argsort doubles the host cost at 100M
+    # edges.
+    _, sorted_eids = native.sort_pairs(senders[active], active)
+    eid = np.full(e_pad, e_pad - 1, dtype=np.int32)
+    eid[: active.size] = sorted_eids
+    counts = np.bincount(senders[active], minlength=n_pad).astype(np.int32)
+    offsets = np.zeros(n_pad + 1, dtype=np.int32)
+    np.cumsum(counts, out=offsets[1:])
+    span = int(counts.max()) if active.size else 0
+    return eid, offsets, span
 
 
 def from_edges(
@@ -152,6 +202,7 @@ def from_edges(
     max_degree: Optional[int] = None,
     blocked: bool = False,
     hybrid: bool = False,
+    source_csr: bool = False,
 ) -> Graph:
     """Build a :class:`Graph` from host-side edge arrays.
 
@@ -250,6 +301,15 @@ def from_edges(
 
         hybrid_rep = build_hybrid_from_arrays(senders, receivers, n_nodes, n_pad)
 
+    src_eid = src_offsets = None
+    max_out_span = 0
+    if source_csr:
+        src_eid, src_offsets, max_out_span = _build_source_csr(
+            s, emask, n_pad, e_pad
+        )
+        src_eid = jnp.asarray(src_eid)
+        src_offsets = jnp.asarray(src_offsets)
+
     return Graph(
         senders=jnp.asarray(s),
         receivers=jnp.asarray(r),
@@ -265,6 +325,9 @@ def from_edges(
         max_in_span=max_in_span,
         blocked=blocked_rep,
         hybrid=hybrid_rep,
+        src_eid=src_eid,
+        src_offsets=src_offsets,
+        max_out_span=max_out_span,
     )
 
 

@@ -57,6 +57,12 @@ def with_capacity(graph: Graph, extra_edges: int = 0,
                 "blocked/hybrid layouts: build those after growing, or "
                 "pass capacity to the generator instead"
             )
+        src_offsets = g.src_offsets
+        if src_offsets is not None:
+            # Grown nodes have empty build-time out-rows: extend the offset
+            # array with its end value, preserving the i32[N_pad + 1]
+            # invariant (models/adaptive_flood.py reads offsets[v+1]).
+            src_offsets = jnp.pad(src_offsets, (0, grow), mode="edge")
         g = dataclasses.replace(
             g,
             node_mask=pad1(g.node_mask, False),
@@ -64,6 +70,7 @@ def with_capacity(graph: Graph, extra_edges: int = 0,
             out_degree=pad1(g.out_degree),
             neighbors=neighbors,
             neighbor_mask=neighbor_mask,
+            src_offsets=src_offsets,
         )
     if extra_edges:
         k = _round_up(extra_edges, 128)

@@ -1,0 +1,127 @@
+"""AdaptiveFlood vs Flood: bit-identical results through every regime.
+
+The adaptive protocol must be indistinguishable from the dense one — same
+seen sets, same per-round messages / coverage / frontier stats, same
+rounds-to-coverage — across sparse-only runs, dense crossings in both
+directions, failures, runtime connects, and resume."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import AdaptiveFlood, Flood  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _assert_matches(g, adaptive, rounds, source=0):
+    key = jax.random.key(0)
+    st_a, stats_a = engine.run(g, adaptive, key, rounds)
+    st_f, stats_f = engine.run(g, Flood(source=source), key, rounds)
+    np.testing.assert_array_equal(np.asarray(st_a.seen), np.asarray(st_f.seen))
+    np.testing.assert_array_equal(np.asarray(st_a.frontier),
+                                  np.asarray(st_f.frontier))
+    np.testing.assert_array_equal(np.asarray(stats_a["messages"]),
+                                  np.asarray(stats_f["messages"]))
+    np.testing.assert_array_equal(np.asarray(stats_a["frontier"]),
+                                  np.asarray(stats_f["frontier"]))
+    np.testing.assert_allclose(np.asarray(stats_a["coverage"]),
+                               np.asarray(stats_f["coverage"]), rtol=1e-6)
+    return st_a
+
+
+class TestAdaptiveFloodParity:
+    def test_sparse_only_run(self):
+        # k large enough that every round stays sparse.
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0, source_csr=True)
+        _assert_matches(g, AdaptiveFlood(source=0, k=2048), rounds=8)
+
+    def test_crosses_into_dense_and_back(self):
+        # Small k: rounds 1-2 sparse, the middle dense, the tail sparse.
+        g = G.watts_strogatz(4096, 6, 0.1, seed=1, source_csr=True)
+        _assert_matches(g, AdaptiveFlood(source=7, k=64), rounds=12, source=7)
+
+    def test_always_dense(self):
+        # k=1 below even the seed round after one step: dense path all the
+        # way, exercising the compaction-on-reentry cond never firing.
+        g = G.watts_strogatz(2048, 6, 0.1, seed=2, source_csr=True)
+        _assert_matches(g, AdaptiveFlood(source=0, k=1), rounds=8)
+
+    @pytest.mark.parametrize("make", [
+        lambda: G.erdos_renyi(700, 0.01, seed=3, source_csr=True),
+        lambda: G.ring(512, source_csr=True),
+        lambda: G.barabasi_albert(500, 3, seed=4, source_csr=True),
+    ])
+    def test_other_topologies(self, make):
+        _assert_matches(make(), AdaptiveFlood(source=0, k=128), rounds=10)
+
+    def test_under_failures(self):
+        g = failures.fail_nodes(
+            G.watts_strogatz(2048, 6, 0.1, seed=5, source_csr=True), [3, 500]
+        )
+        _assert_matches(g, AdaptiveFlood(source=0, k=64), rounds=10)
+
+    def test_under_edge_failures(self):
+        # CSR rows are build-time; dead edges must be filtered at gather.
+        g = G.watts_strogatz(1024, 6, 0.1, seed=6, source_csr=True)
+        g = failures.random_edge_failures(g, jax.random.key(1), 0.3)
+        _assert_matches(g, AdaptiveFlood(source=0, k=64), rounds=10)
+
+    def test_with_runtime_connects(self):
+        # A dynamic link out of the wave's path must carry in sparse mode.
+        g = G.ring(1024, source_csr=True)
+        g = topology.connect(topology.with_capacity(g, extra_edges=8),
+                             [2], [900])
+        _assert_matches(g, AdaptiveFlood(source=0, k=64), rounds=12)
+
+    def test_run_until_coverage_matches(self):
+        g = G.watts_strogatz(8192, 8, 0.1, seed=7, source_csr=True)
+        _, out_a = engine.run_until_coverage(
+            g, AdaptiveFlood(source=0, k=256), jax.random.key(0),
+            coverage_target=0.99,
+        )
+        _, out_f = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0), coverage_target=0.99,
+        )
+        assert out_a["rounds"] == out_f["rounds"]
+        assert out_a["messages"] == out_f["messages"]
+        assert out_a["coverage"] == pytest.approx(out_f["coverage"], rel=1e-6)
+
+    def test_resume_midway(self):
+        g = G.watts_strogatz(2048, 6, 0.1, seed=8, source_csr=True)
+        proto = AdaptiveFlood(source=0, k=64)
+        key = jax.random.key(0)
+        st, _ = engine.run(g, proto, key, 4)
+        st, _ = engine.run_from(g, proto, st, key, 4)
+        ref, _ = engine.run(g, Flood(source=0), key, 8)
+        np.testing.assert_array_equal(np.asarray(st.seen),
+                                      np.asarray(ref.seen))
+
+    def test_requires_source_csr(self):
+        g = G.ring(256)
+        with pytest.raises(ValueError, match="source-CSR"):
+            AdaptiveFlood(source=0).init(g, jax.random.key(0))
+
+
+class TestAdaptiveFloodGrownNodes:
+    def test_joined_spare_node_joins_the_wave(self):
+        # with_capacity(extra_nodes) must keep src_offsets at i32[N_pad+1];
+        # a joined spare node has an empty build-time CSR row and reaches
+        # the wave purely through the dynamic edge region.
+        g = G.ring(250, source_csr=True)
+        g = topology.with_capacity(g, extra_edges=16, extra_nodes=10)
+        assert g.src_offsets.shape[0] == g.n_nodes_padded + 1
+        spare = 300
+        g = topology.join_node(g, spare, [5])
+        ga = topology.join_node(
+            topology.with_capacity(G.ring(250), extra_edges=16,
+                                   extra_nodes=10),
+            spare, [5],
+        )
+        key = jax.random.key(0)
+        st_a, _ = engine.run(g, AdaptiveFlood(source=0, k=32), key, 8)
+        st_f, _ = engine.run(ga, Flood(source=0), key, 8)
+        np.testing.assert_array_equal(np.asarray(st_a.seen),
+                                      np.asarray(st_f.seen))
+        assert np.asarray(st_a.seen)[spare]  # the joined node got the wave
