@@ -5,7 +5,12 @@ The reference ships no protocols — users implement flooding/gossip/etc. in
 TPU-native forms of the protocols its users write by hand, all behind one
 ``Protocol`` seam (models/base.py)."""
 
-from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood, AdaptiveFloodState
+from p2pnetwork_tpu.models.adaptive_flood import (
+    AdaptiveFlood,
+    AdaptiveFloodState,
+    AdaptiveHopDistance,
+    AdaptiveHopDistanceState,
+)
 from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
@@ -18,6 +23,8 @@ __all__ = [
     "Protocol",
     "AdaptiveFlood",
     "AdaptiveFloodState",
+    "AdaptiveHopDistance",
+    "AdaptiveHopDistanceState",
     "Flood",
     "FloodState",
     "Gossip",

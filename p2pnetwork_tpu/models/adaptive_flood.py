@@ -71,17 +71,8 @@ class AdaptiveFlood:
     k: int = 1024
 
     def init(self, graph: Graph, key: jax.Array) -> AdaptiveFloodState:
-        base.validate_source(graph, self.source)
-        if graph.src_eid is None:
-            raise ValueError(
-                "AdaptiveFlood requires a source-CSR graph — build with "
-                "from_edges(source_csr=True) or graph.with_source_csr()"
-            )
-        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
-        seed = seed & graph.node_mask
-        fidx = jnp.full(self.k, graph.n_nodes_padded - 1, dtype=jnp.int32)
-        fidx = fidx.at[0].set(self.source)
-        count = jnp.sum(seed).astype(jnp.int32)
+        seed, fidx, count = _wave_seed(graph, self.source, self.k,
+                                       "AdaptiveFlood")
         return AdaptiveFloodState(seen=seed, frontier=seed, fidx=fidx,
                                   fcount=count)
 
@@ -90,99 +81,183 @@ class AdaptiveFlood:
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         return jnp.sum(state.seen & graph.node_mask) / n_real
 
-    # ------------------------------------------------------------- rounds
-
-    def _sparse_round(self, graph: Graph, state: AdaptiveFloodState):
-        k, w = self.k, max(graph.max_out_span, 1)
-        n_pad = graph.n_nodes_padded
-        pad_node = n_pad - 1
-
-        fvalid = jnp.arange(k) < state.fcount
-        f = jnp.where(fvalid, state.fidx, pad_node)
-        base_off = graph.src_offsets[f]  # [k]
-        row_len = graph.src_offsets[f + 1] - base_off  # [k] build-time extent
-        slot = base_off[:, None] + jnp.arange(w)[None, :]  # [k, w]
-        svalid = (jnp.arange(w)[None, :] < row_len[:, None]) & fvalid[:, None]
-        eid = graph.src_eid[jnp.where(svalid, slot, graph.n_edges_padded - 1)]
-        # Runtime liveness re-check: failed edges (sim/failures.py) stay in
-        # the build-time CSR rows but are masked here.
-        evalid = svalid & graph.edge_mask[eid]
-        cand = jnp.where(evalid, graph.receivers[eid], pad_node).reshape(-1)
-        fresh = (evalid.reshape(-1) & ~state.seen[cand]
-                 & graph.node_mask[cand])
-
-        # Dynamic (runtime-connected) out-edges ride along: the region is a
-        # small unsorted COO block, scanned whole.
-        if graph.dyn_senders is not None:
-            dsend = state.frontier[graph.dyn_senders] & graph.dyn_mask
-            dcand = jnp.where(dsend, graph.dyn_receivers, pad_node)
-            dfresh = (dsend & ~state.seen[dcand] & graph.node_mask[dcand])
-            cand = jnp.concatenate([cand, dcand])
-            fresh = jnp.concatenate([fresh, dfresh])
-
-        # First-claim dedup: every fresh slot claims its candidate with its
-        # position; winners are the slots that hold the minimum claim, so
-        # each newly-seen node appears in the next frontier exactly once.
-        order = jnp.arange(cand.shape[0], dtype=jnp.int32)
-        big = jnp.int32(2**31 - 1)
-        claim = jnp.where(fresh, order, big)
-        scratch = jnp.full(n_pad, big, dtype=jnp.int32).at[cand].min(
-            claim, mode="drop"
-        )
-        winner = fresh & (scratch[cand] == order)
-        new_count = jnp.sum(winner).astype(jnp.int32)
-
-        seen = state.seen.at[jnp.where(fresh, cand, n_pad)].set(
-            True, mode="drop"
-        )
-        frontier = (
-            jnp.zeros(n_pad, dtype=bool)
-            .at[jnp.where(winner, cand, n_pad)].set(True, mode="drop")
-        )
-        # Next index list: compact the winners (O(k·w) cumsum, not O(N)).
-        # Overflow past k only happens when new_count > k — dense mode
-        # takes over and the truncated list is never read.
-        pos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
-        fidx = jnp.where(jnp.arange(k) < new_count, cand[pos], pad_node)
-
-        msgs = jnp.sum(jnp.where(fvalid, graph.out_degree[f], 0))
-        return AdaptiveFloodState(seen=seen, frontier=frontier, fidx=fidx,
-                                  fcount=new_count), msgs
-
-    def _dense_round(self, graph: Graph, state: AdaptiveFloodState):
-        delivered = segment.propagate_or(graph, state.frontier, self.method)
-        new = delivered & ~state.seen & graph.node_mask
-        seen = state.seen | new
-        new_count = jnp.sum(new).astype(jnp.int32)
-
-        # Re-enter sparse mode: pay the O(N) compaction only on the round
-        # that crosses back under k (lax.cond executes one branch).
-        def compact(n):
-            return jnp.nonzero(
-                n, size=self.k, fill_value=graph.n_nodes_padded - 1
-            )[0].astype(jnp.int32)
-
-        fidx = jax.lax.cond(
-            new_count <= self.k, compact, lambda n: state.fidx, new
-        )
-        msgs = segment.frontier_messages(graph, state.frontier)
-        return AdaptiveFloodState(seen=seen, frontier=new, fidx=fidx,
-                                  fcount=new_count), msgs
-
     def step(self, graph: Graph, state: AdaptiveFloodState, key: jax.Array):
-        new_state, msgs = jax.lax.cond(
-            state.fcount <= self.k,
-            lambda s: self._sparse_round(graph, s),
-            lambda s: self._dense_round(graph, s),
-            state,
+        seen, frontier, fidx, fcount, msgs = _wave_step(
+            graph, self.k, self.method,
+            state.seen, state.frontier, state.fidx, state.fcount,
         )
+        new_state = AdaptiveFloodState(seen=seen, frontier=frontier,
+                                       fidx=fidx, fcount=fcount)
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         stats = {
             "messages": msgs,
             # Masked recompute, not an incremental counter — a fused AND +
             # reduce is nearly free, and it stays exact across mid-run
             # node failures (models/flood.py parity).
-            "coverage": jnp.sum(new_state.seen & graph.node_mask) / n_real,
-            "frontier": new_state.fcount,
+            "coverage": jnp.sum(seen & graph.node_mask) / n_real,
+            "frontier": fcount,
         }
         return new_state, stats
+
+
+# --------------------------------------------------- shared wave rounds
+
+
+def _sparse_wave_round(graph: Graph, k: int, seen, frontier, fidx, fcount):
+    """One frontier-sparse wave round: O(k·max_out_span) work via the
+    source-CSR view. Returns ``(seen, frontier, fidx, new_count, msgs)``."""
+    w = max(graph.max_out_span, 1)
+    n_pad = graph.n_nodes_padded
+    pad_node = n_pad - 1
+
+    fvalid = jnp.arange(k) < fcount
+    f = jnp.where(fvalid, fidx, pad_node)
+    base_off = graph.src_offsets[f]  # [k]
+    row_len = graph.src_offsets[f + 1] - base_off  # [k] build-time extent
+    slot = base_off[:, None] + jnp.arange(w)[None, :]  # [k, w]
+    svalid = (jnp.arange(w)[None, :] < row_len[:, None]) & fvalid[:, None]
+    eid = graph.src_eid[jnp.where(svalid, slot, graph.n_edges_padded - 1)]
+    # Runtime liveness re-check: failed edges (sim/failures.py) stay in
+    # the build-time CSR rows but are masked here.
+    evalid = svalid & graph.edge_mask[eid]
+    cand = jnp.where(evalid, graph.receivers[eid], pad_node).reshape(-1)
+    fresh = evalid.reshape(-1) & ~seen[cand] & graph.node_mask[cand]
+
+    # Dynamic (runtime-connected) out-edges ride along: the region is a
+    # small unsorted COO block, scanned whole.
+    if graph.dyn_senders is not None:
+        dsend = frontier[graph.dyn_senders] & graph.dyn_mask
+        dcand = jnp.where(dsend, graph.dyn_receivers, pad_node)
+        dfresh = dsend & ~seen[dcand] & graph.node_mask[dcand]
+        cand = jnp.concatenate([cand, dcand])
+        fresh = jnp.concatenate([fresh, dfresh])
+
+    # First-claim dedup: every fresh slot claims its candidate with its
+    # position; winners are the slots that hold the minimum claim, so
+    # each newly-seen node appears in the next frontier exactly once.
+    order = jnp.arange(cand.shape[0], dtype=jnp.int32)
+    big = jnp.int32(2**31 - 1)
+    claim = jnp.where(fresh, order, big)
+    scratch = jnp.full(n_pad, big, dtype=jnp.int32).at[cand].min(
+        claim, mode="drop"
+    )
+    winner = fresh & (scratch[cand] == order)
+    new_count = jnp.sum(winner).astype(jnp.int32)
+
+    seen = seen.at[jnp.where(fresh, cand, n_pad)].set(True, mode="drop")
+    new_frontier = (
+        jnp.zeros(n_pad, dtype=bool)
+        .at[jnp.where(winner, cand, n_pad)].set(True, mode="drop")
+    )
+    # Next index list: compact the winners (O(k·w) cumsum, not O(N)).
+    # Overflow past k only happens when new_count > k — dense mode
+    # takes over and the truncated list is never read.
+    pos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
+    fidx = jnp.where(jnp.arange(k) < new_count, cand[pos], pad_node)
+
+    msgs = jnp.sum(jnp.where(fvalid, graph.out_degree[f], 0))
+    return seen, new_frontier, fidx, new_count, msgs
+
+
+def _dense_wave_round(graph: Graph, k: int, method: str, seen, frontier,
+                      fidx):
+    """One dense wave round (models/flood.py's masked OR), maintaining the
+    sparse index list on the crossing back under ``k``."""
+    delivered = segment.propagate_or(graph, frontier, method)
+    new = delivered & ~seen & graph.node_mask
+    seen = seen | new
+    new_count = jnp.sum(new).astype(jnp.int32)
+
+    # Re-enter sparse mode: pay the O(N) compaction only on the round
+    # that crosses back under k (lax.cond executes one branch).
+    def compact(n):
+        return jnp.nonzero(
+            n, size=k, fill_value=graph.n_nodes_padded - 1
+        )[0].astype(jnp.int32)
+
+    fidx = jax.lax.cond(new_count <= k, compact, lambda n: fidx, new)
+    msgs = segment.frontier_messages(graph, frontier)
+    return seen, new, fidx, new_count, msgs
+
+
+def _wave_seed(graph: Graph, source: int, k: int, proto_name: str):
+    """Validated seed shared by the adaptive protocols: the source's
+    one-hot (masked by liveness), the fidx sentinel list, and the count."""
+    base.validate_source(graph, source)
+    if graph.src_eid is None:
+        raise ValueError(
+            f"{proto_name} requires a source-CSR graph — build with "
+            f"from_edges(source_csr=True) or graph.with_source_csr()"
+        )
+    seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[source].set(True)
+    seed = seed & graph.node_mask
+    fidx = jnp.full(k, graph.n_nodes_padded - 1, dtype=jnp.int32)
+    fidx = fidx.at[0].set(source)
+    return seed, fidx, jnp.sum(seed).astype(jnp.int32)
+
+
+def _wave_step(graph: Graph, k: int, method: str, seen, frontier, fidx,
+               fcount):
+    """Adaptive wave round: lax.cond picks sparse vs dense by the live
+    frontier count. Shared by AdaptiveFlood and AdaptiveHopDistance."""
+    return jax.lax.cond(
+        fcount <= k,
+        lambda s, f, i: _sparse_wave_round(graph, k, s, f, i, fcount),
+        lambda s, f, i: _dense_wave_round(graph, k, method, s, f, i),
+        seen, frontier, fidx,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AdaptiveHopDistanceState:
+    dist: jax.Array  # i32[N_pad] — BFS hops from source, -1 = not reached
+    frontier: jax.Array  # bool[N_pad]
+    fidx: jax.Array  # i32[k]
+    fcount: jax.Array  # i32[]
+    round: jax.Array  # i32[]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class AdaptiveHopDistance:
+    """BFS hop distances with frontier-sparse small rounds — the adaptive
+    twin of models/hopdist.py (the wave IS the flood wave; nodes record the
+    first round that reaches them), bit-identical to it round for round."""
+
+    source: int = 0
+    method: str = "auto"
+    k: int = 1024
+
+    def init(self, graph: Graph, key: jax.Array) -> AdaptiveHopDistanceState:
+        seed, fidx, count = _wave_seed(graph, self.source, self.k,
+                                       "AdaptiveHopDistance")
+        return AdaptiveHopDistanceState(
+            dist=jnp.where(seed, 0, -1).astype(jnp.int32), frontier=seed,
+            fidx=fidx, fcount=count, round=jnp.int32(0),
+        )
+
+    def coverage(self, graph: Graph, state) -> jax.Array:
+        """Reached fraction of live nodes (hopdist.py parity)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum((state.dist >= 0) & graph.node_mask) / n_real
+
+    def step(self, graph: Graph, state: AdaptiveHopDistanceState,
+             key: jax.Array):
+        seen = state.dist >= 0
+        seen2, frontier, fidx, fcount, msgs = _wave_step(
+            graph, self.k, self.method,
+            seen, state.frontier, state.fidx, state.fcount,
+        )
+        rnd = state.round + 1
+        dist = jnp.where(frontier, rnd, state.dist)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        reached = (dist >= 0) & graph.node_mask
+        stats = {
+            "messages": msgs,
+            "coverage": jnp.sum(reached) / n_real,
+            "frontier": fcount,
+            "max_dist": jnp.max(dist),
+        }
+        return AdaptiveHopDistanceState(dist=dist, frontier=frontier,
+                                        fidx=fidx, fcount=fcount,
+                                        round=rnd), stats

@@ -172,9 +172,11 @@ def _build_source_csr(senders: np.ndarray, edge_mask: np.ndarray,
                       n_pad: int, e_pad: int):
     """Sender-sorted edge-id permutation + row offsets (host-side).
 
-    Only edges active in ``edge_mask`` enter rows; padding slots of
-    ``src_eid`` point at ``e_pad - 1`` (a masked edge), so an out-of-row
-    gather can never alias a live edge."""
+    Only edges active in ``edge_mask`` enter rows. Padding slots of
+    ``src_eid`` hold ``e_pad - 1`` merely to stay in bounds — that edge CAN
+    be live (whenever the edge count is an exact pad multiple), so
+    consumers must mask out-of-row slots themselves before trusting the
+    gathered edge (models/adaptive_flood.py's ``svalid``)."""
     from p2pnetwork_tpu import native
 
     active = np.flatnonzero(edge_mask).astype(np.int32)

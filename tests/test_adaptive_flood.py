@@ -125,3 +125,49 @@ class TestAdaptiveFloodGrownNodes:
         np.testing.assert_array_equal(np.asarray(st_a.seen),
                                       np.asarray(st_f.seen))
         assert np.asarray(st_a.seen)[spare]  # the joined node got the wave
+
+
+class TestAdaptiveHopDistance:
+    def test_matches_hopdist_through_crossings(self):
+        from p2pnetwork_tpu.models import AdaptiveHopDistance, HopDistance
+
+        g = G.watts_strogatz(4096, 6, 0.1, seed=9, source_csr=True)
+        key = jax.random.key(0)
+        st_a, stats_a = engine.run(g, AdaptiveHopDistance(source=3, k=64),
+                                   key, 12)
+        st_h, stats_h = engine.run(g, HopDistance(source=3), key, 12)
+        np.testing.assert_array_equal(np.asarray(st_a.dist),
+                                      np.asarray(st_h.dist))
+        for k in ("messages", "frontier", "max_dist"):
+            np.testing.assert_array_equal(np.asarray(stats_a[k]),
+                                          np.asarray(stats_h[k]))
+
+    def test_coverage_loop_matches(self):
+        from p2pnetwork_tpu.models import AdaptiveHopDistance, HopDistance
+
+        g = G.watts_strogatz(8192, 8, 0.1, seed=10, source_csr=True)
+        _, out_a = engine.run_until_coverage(
+            g, AdaptiveHopDistance(source=0, k=256), jax.random.key(0),
+            coverage_target=0.99,
+        )
+        _, out_h = engine.run_until_coverage(
+            g, HopDistance(source=0), jax.random.key(0), coverage_target=0.99,
+        )
+        assert out_a["rounds"] == out_h["rounds"]
+        assert out_a["messages"] == out_h["messages"]
+
+    def test_under_churn(self):
+        from p2pnetwork_tpu.models import AdaptiveHopDistance, HopDistance
+
+        g = G.ring(1024, source_csr=True)
+        g = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [7]),
+                                   extra_edges=8),
+            [2], [900],
+        )
+        st_a, _ = engine.run(g, AdaptiveHopDistance(source=0, k=32),
+                             jax.random.key(0), 20)
+        st_h, _ = engine.run(g, HopDistance(source=0), jax.random.key(0), 20)
+        np.testing.assert_array_equal(np.asarray(st_a.dist),
+                                      np.asarray(st_h.dist))
+        assert np.asarray(st_a.dist)[7] == -1
