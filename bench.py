@@ -138,6 +138,31 @@ def bench_10m():
     }
 
 
+def _backend_alive(timeout_s: int = 240):
+    """Probe JAX backend init in a CHILD process. A wedged device tunnel
+    hangs PJRT client creation while holding the GIL, so no in-process
+    watchdog (signal.alarm included — verified) can fire; probing in a
+    subprocess turns an unbounded hang into a bounded, reportable error.
+    Returns None when healthy, else an error string."""
+    import subprocess
+
+    probe = (
+        "import sys; sys.path.insert(0, {!r}); "
+        "from p2pnetwork_tpu.utils.jax_env import apply_platform_env; "
+        "apply_platform_env(); import jax; "
+        "print(jax.devices())".format(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"JAX backend init hung for {timeout_s}s "
+                f"(device tunnel wedged?)")
+    if r.returncode != 0:
+        return "backend probe failed: " + r.stderr.strip()[-300:]
+    return None
+
+
 def main():
     record = {
         "metric": "1M-node WS flood to 99% coverage (single chip)",
@@ -145,6 +170,12 @@ def main():
         "unit": "s",
         "vs_baseline": 0.0,
     }
+    err = _backend_alive()
+    if err is not None:
+        record["error"] = err
+        print(f"# {err}", file=sys.stderr, flush=True)
+        print(json.dumps(record))
+        return 1
     try:
         bench_1m(record)
     except Exception as e:
