@@ -111,6 +111,19 @@ class ShardedGraph:
     #: waste vs 128 at the cost of a wider one-hot, like ops/diag.py).
     mxu_block: int = dataclasses.field(default=128,
                                        metadata=dict(static=True))
+    # Per-shard sender-CSR view for frontier-sparse traversal
+    # (shard_graph(source_csr=True)): for this shard's edges (dst-owned),
+    # positions into the FLATTENED bucket arrays (``ring_step * E_bkt +
+    # slot``) grouped by GLOBAL sender id — ``csr_pos[d,
+    # csr_offsets[d, u] : csr_offsets[d, u + 1]]`` are sender ``u``'s edges
+    # into shard ``d``. Gathering bkt_mask/bkt_dst through these positions
+    # inherits liveness re-masks and disconnects with no rebuild. Row
+    # extents are build-time; out-of-row slots must be masked by the
+    # consumer (padding entries stay in bounds but can alias live slots).
+    csr_pos: Optional[jax.Array] = None  # i32[S, E_s]
+    csr_offsets: Optional[jax.Array] = None  # i32[S, S*block + 1]
+    #: Widest per-(sender, dst-shard) build-time row, 0 without the view.
+    csr_span: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def n_nodes_padded(self) -> int:
@@ -212,7 +225,8 @@ def _extract_ring_diagonals(senders, receivers, n, S, block, max_diags,
 def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
                 edge_pad_multiple: int = 128, mxu: bool = False,
                 hybrid: bool = False, max_diags: int = 64,
-                min_count: Optional[int] = None) -> ShardedGraph:
+                min_count: Optional[int] = None,
+                source_csr: bool = False) -> ShardedGraph:
     """Partition ``graph`` for ``mesh`` (host-side; one-off setup).
 
     Nodes are split into ``S`` contiguous blocks. Every active edge lands in
@@ -228,6 +242,11 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
     (see ``ShardedGraph.mxu_src``) — on TPU the ring pass then runs on the
     MXU instead of XLA's scatter lowering of segment reductions (~2x per
     chip at 1M nodes; measured in benchmarks/ladder.py).
+
+    ``source_csr=True`` additionally builds the per-shard sender-CSR view
+    (``csr_pos``/``csr_offsets``) that the frontier-adaptive coverage loop
+    gathers small frontiers through (see :func:`flood_until_coverage`'s
+    ``adaptive_k``).
     """
     S = mesh.shape[axis_name]
     emask = np.asarray(graph.edge_mask)
@@ -318,6 +337,35 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
                 mxu_dst[d, t, :r, :c] = bd
                 mxu_mask[d, t, :r, :c] = bm
 
+    csr_pos = csr_offsets = None
+    csr_span = 0
+    if source_csr:
+        from p2pnetwork_tpu import native
+
+        n_g = S * block
+        rows_pos = []
+        counts = np.zeros((S, n_g), dtype=np.int64)
+        for d in range(S):
+            # This shard's live bucket slots, flattened (t * e_bkt + slot),
+            # keyed by the GLOBAL sender id reconstructed from the ring
+            # step: step t holds senders of shard (d - t) mod S.
+            t_idx, slot_idx = np.nonzero(bkt_mask[d])
+            g_send = (
+                ((d - t_idx) % S) * block + bkt_src[d, t_idx, slot_idx]
+            ).astype(np.int32)
+            pos = (t_idx * e_bkt + slot_idx).astype(np.int32)
+            _, pos_sorted = native.sort_pairs(g_send, pos)
+            rows_pos.append(pos_sorted)
+            counts[d] = np.bincount(g_send, minlength=n_g)
+        e_s = _round_up(max(max(p.size for p in rows_pos), 1),
+                        edge_pad_multiple)
+        csr_pos = np.zeros((S, e_s), dtype=np.int32)
+        for d in range(S):
+            csr_pos[d, : rows_pos[d].size] = rows_pos[d]
+        csr_offsets = np.zeros((S, n_g + 1), dtype=np.int32)
+        np.cumsum(counts, axis=1, out=csr_offsets[:, 1:])
+        csr_span = int(counts.max()) if counts.size else 0
+
     pad_n = S * block - graph.n_nodes_padded
     node_mask = np.pad(np.asarray(graph.node_mask), (0, pad_n))
     out_degree = np.pad(np.asarray(graph.out_degree), (0, pad_n))
@@ -353,6 +401,9 @@ def shard_graph(graph: Graph, mesh: Mesh, axis_name: str = DEFAULT_AXIS,
         diag_masks=None if diag_masks is None else dev(diag_masks),
         diag_pieces=diag_pieces,
         mxu_block=mxu_block,
+        csr_pos=None if csr_pos is None else dev(csr_pos),
+        csr_offsets=None if csr_offsets is None else dev(csr_offsets),
+        csr_span=csr_span,
     )
 
 
@@ -1223,10 +1274,19 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                          coverage_target: float = 0.99,
                          max_rounds: int = 1024,
                          axis_name: str = DEFAULT_AXIS,
-                         state0=None, return_state: bool = False):
+                         state0=None, return_state: bool = False,
+                         adaptive_k: int = 0):
     """Flood until coverage of the LIVE population reaches the target —
     the north-star run-to-99% measurement (engine.run_until_coverage), on
     the multi-chip path. One XLA program, zero host round-trips per round.
+
+    ``adaptive_k > 0`` (requires ``shard_graph(source_csr=True)``) runs
+    rounds whose global frontier fits ``adaptive_k`` nodes through the
+    frontier-sparse path: the frontier rides as a replicated index list
+    and each shard gathers only its edges from those senders — O(k·span)
+    work plus one tiny all-gather instead of the full ring pass. Results
+    are bit-identical to the dense loop (the multi-chip mirror of
+    models/adaptive_flood.py).
 
     Returns ``(seen [S, block] bool, dict(rounds, coverage, messages))``
     with ``messages`` an exact Python int. Resume path (same contract as
@@ -1240,16 +1300,33 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     if state0 is None:
         state0 = init_state(sg, Flood(source=source), None)
     seen0, frontier0 = state0
-    fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
-                       sg.diag_pieces, sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
-    seen, frontier, packed = fn(
-        jnp.float32(coverage_target),
+    common = (
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
-        sg.node_mask, sg.out_degree, seen0, frontier0,
+        sg.node_mask, sg.out_degree,
     )
+    if adaptive_k > 0:
+        if sg.csr_pos is None:
+            raise ValueError(
+                "adaptive_k requires a sender-CSR sharded graph — build "
+                "with shard_graph(source_csr=True)"
+            )
+        fn = _flood_adaptive_cov_fn(
+            mesh, axis_name, S, block, max_rounds, adaptive_k,
+            max(sg.csr_span, 1), sg.diag_pieces, sg.mxu_block,
+        )
+        seen, frontier, packed = fn(
+            jnp.float32(coverage_target), *common,
+            sg.csr_pos, sg.csr_offsets, seen0, frontier0,
+        )
+    else:
+        fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                           sg.diag_pieces, sg.mxu_block)
+        seen, frontier, packed = fn(
+            jnp.float32(coverage_target), *common, seen0, frontier0,
+        )
     out = accum.unpack_summary(packed)
     if return_state:
         return (seen, frontier), out
@@ -2164,3 +2241,187 @@ def hopdist_until_done(sg: ShardedGraph, mesh: Mesh, protocol, *,
         sg, mesh, protocol, coverage_target=2.0, max_rounds=max_rounds,
         axis_name=axis_name, state0=state0,
     )
+
+
+# ----------------------------------------- frontier-adaptive coverage loop
+
+
+def _pack_global_frontier(axis_name, S, k, local_ids, local_count, pad_id):
+    """Combine per-shard winner lists into one REPLICATED global frontier
+    list: all-gather the (tiny) per-shard [k] lists + counts, then every
+    shard deterministically packs them at running offsets — identical
+    output everywhere, so the list can drive replicated control flow.
+    Truncation past ``k`` is benign: the total then exceeds ``k`` and the
+    next round runs dense, never reading the list."""
+    lists = jax.lax.all_gather(local_ids, axis_name)  # [S, k]
+    counts = jax.lax.all_gather(local_count, axis_name)  # [S]
+    offs = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    out = jnp.full(k, pad_id, dtype=jnp.int32)
+    idx = jnp.arange(k, dtype=jnp.int32)
+    for s in range(S):
+        tpos = offs[s] + idx
+        valid = (idx < counts[s]) & (tpos < k)
+        out = out.at[jnp.where(valid, tpos, k)].set(
+            jnp.where(valid, lists[s], pad_id), mode="drop"
+        )
+    return out, jnp.sum(counts).astype(jnp.int32)
+
+
+def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
+                          coverage_target, max_rounds,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks,
+                          node_mask, out_degree, csr_pos, csr_offsets,
+                          seen0, frontier0):
+    """Per-shard body: run-to-coverage flood where rounds with a global
+    frontier of at most ``k`` nodes skip the ring entirely — the frontier
+    rides as a replicated index list, each shard gathers only ITS edges
+    from those senders through the sender-CSR view (O(k·span) work and one
+    tiny all-gather, instead of O(E/S) bucket work and S ppermute hops).
+    The multi-chip mirror of models/adaptive_flood.py; results stay
+    bit-identical to the dense loop."""
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b, out_degree_b = node_mask[0], out_degree[0]
+    csr_pos_b, csr_offsets_b = csr_pos[0], csr_offsets[0]
+    flat_mask = bkt_mask[0].reshape(-1)
+    flat_dst = bkt_dst[0].reshape(-1)
+    dyn_src_b, dyn_dst_b, dyn_mask_b = dyn_src[0], dyn_dst[0], dyn_mask[0]
+    has_dyn = dyn_src_b.shape[-1] > 0
+    n_g = S * block
+    pad_id = n_g - 1
+    my = jax.lax.axis_index(axis_name)
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+    idx_k = jnp.arange(k, dtype=jnp.int32)
+
+    def my_new_ids(new_local_mask, local_count):
+        """This shard's new nodes as global ids, [k]-padded."""
+        lpos = jnp.nonzero(new_local_mask, size=k, fill_value=block - 1)[0]
+        return jnp.where(idx_k < local_count,
+                         my * block + lpos.astype(jnp.int32), pad_id)
+
+    def sparse_round(seen, frontier, F, fcount):
+        msgs = jax.lax.psum(
+            jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+        )
+        fvalid = idx_k < fcount
+        f = jnp.where(fvalid, F, pad_id)
+        base = csr_offsets_b[f]
+        ln = csr_offsets_b[f + 1] - base
+        slot = base[:, None] + jnp.arange(span)[None, :]
+        svalid = (jnp.arange(span)[None, :] < ln[:, None]) & fvalid[:, None]
+        pos = csr_pos_b[jnp.where(svalid, slot, 0)]
+        evalid = (svalid & flat_mask[pos]).reshape(-1)
+        cand = jnp.where(evalid, flat_dst[pos].reshape(-1), block - 1)
+        fresh = evalid & ~seen[cand] & node_mask_b[cand]
+        if has_dyn:
+            # Dynamic out-edges: reconstruct the global sender from the
+            # ring step, membership-test against the frontier list. The
+            # -1 sentinel (never a node id) keeps padded F entries from
+            # matching a live spare node.
+            t_i = jnp.arange(S, dtype=jnp.int32)[:, None]
+            g_send = ((my - t_i) % S) * block + dyn_src_b
+            probe = jnp.where(fvalid, F, -1)
+            member = jnp.any(
+                g_send[..., None] == probe[None, None, :], axis=-1
+            ) & dyn_mask_b
+            dcand = jnp.where(member, dyn_dst_b, block - 1).reshape(-1)
+            dfresh = (member.reshape(-1) & ~seen[dcand]
+                      & node_mask_b[dcand])
+            cand = jnp.concatenate([cand, dcand])
+            fresh = jnp.concatenate([fresh, dfresh])
+        # First-claim dedup onto this shard's node block (each shard owns
+        # its receivers, so dedup is purely local).
+        order = jnp.arange(cand.shape[0], dtype=jnp.int32)
+        big = jnp.int32(2**31 - 1)
+        claim = jnp.where(fresh, order, big)
+        scratch = jnp.full(block, big, dtype=jnp.int32).at[cand].min(claim)
+        winner = fresh & (scratch[cand] == order)
+        local_count = jnp.sum(winner).astype(jnp.int32)
+        seen = seen.at[jnp.where(fresh, cand, block)].set(True, mode="drop")
+        frontier = (
+            jnp.zeros(block, dtype=bool)
+            .at[jnp.where(winner, cand, block)].set(True, mode="drop")
+        )
+        wpos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
+        local_ids = jnp.where(idx_k < local_count,
+                              my * block + cand[wpos], pad_id)
+        F, fcount = _pack_global_frontier(axis_name, S, k, local_ids,
+                                          local_count, pad_id)
+        return seen, frontier, F, fcount, msgs
+
+    def dense_round(seen, frontier, F, fcount):
+        msgs = jax.lax.psum(
+            jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
+        )
+        delivered = pass_(frontier)
+        new = delivered & ~seen & node_mask_b
+        seen = seen | new
+        local_count = jnp.sum(new).astype(jnp.int32)
+        fcount = jax.lax.psum(local_count, axis_name)
+
+        def compact(_):
+            return _pack_global_frontier(
+                axis_name, S, k, my_new_ids(new, local_count), local_count,
+                pad_id,
+            )[0]
+
+        F = jax.lax.cond(fcount <= k, compact, lambda _: F, None)
+        return seen, new, F, fcount, msgs
+
+    def cond(carry):
+        _, _, _, _, rounds, covered, _, _ = carry
+        return (covered / n_live < coverage_target) & (rounds < max_rounds)
+
+    def body(carry):
+        seen, frontier, F, fcount, rounds, _, hi, lo = carry
+        seen, frontier, F, fcount, msgs = jax.lax.cond(
+            fcount <= k, sparse_round, dense_round,
+            seen, frontier, F, fcount,
+        )
+        hi, lo = accum.add((hi, lo), msgs)
+        covered = jax.lax.psum(
+            jnp.sum((seen & node_mask_b).astype(jnp.int32)), axis_name
+        )
+        return seen, frontier, F, fcount, rounds + 1, covered, hi, lo
+
+    seen_b, frontier_b = seen0[0], frontier0[0]
+    count0 = jnp.sum(frontier_b).astype(jnp.int32)
+    F0, fcount0 = _pack_global_frontier(
+        axis_name, S, k, my_new_ids(frontier_b, count0), count0, pad_id
+    )
+    covered0 = jax.lax.psum(
+        jnp.sum((seen_b & node_mask_b).astype(jnp.int32)), axis_name
+    )
+    init = (seen_b, frontier_b, F0, fcount0, jnp.int32(0), covered0,
+            *accum.zero())
+    seen, frontier, _, _, rounds, covered, hi, lo = jax.lax.while_loop(
+        cond, body, init
+    )
+    return seen[None], frontier[None], accum.pack_summary(
+        rounds, covered / n_live, (hi, lo)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _flood_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                           max_rounds: int, k: int, span: int, pieces=(),
+                           mxu_block: int = 128):
+    body = functools.partial(_ring_adaptive_cov_or, axis_name, S, block,
+                             pieces, mxu_block, k, span)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 16,
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)

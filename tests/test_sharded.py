@@ -1011,3 +1011,110 @@ class TestShardedHopDistance:
             np.asarray(ref_state.dist)[: g.n_nodes],
         )
         assert np.asarray(dist_sh).reshape(-1)[9] == -1
+
+
+class TestShardedAdaptiveFlood:
+    """Frontier-adaptive run-to-coverage on the ring: bit-identical to the
+    dense sharded loop and the single-device engine through sparse-only,
+    crossing, and churned regimes."""
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    @pytest.mark.parametrize("k", [16, 256])
+    def test_matches_dense_loop_and_engine(self, n_shards, k):
+        from p2pnetwork_tpu.models import Flood
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.99, adaptive_k=k
+        )
+        seen_d, out_d = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.99
+        )
+        np.testing.assert_array_equal(np.asarray(seen_a), np.asarray(seen_d))
+        assert out_a == out_d
+        _, ref = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0), coverage_target=0.99
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+
+    def test_hybrid_layout_and_churn(self):
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=1)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh, hybrid=True, min_count=32,
+                                 source_csr=True)
+        sg = sharded.with_capacity(sharded.fail_nodes(sg, [3, 700]), 8)
+        sg = sharded.connect(sg, [2], [900])
+        gc = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [3, 700]),
+                                   extra_edges=8),
+            [2], [900],
+        )
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.95, adaptive_k=64
+        )
+        _, ref = engine.run_until_coverage(
+            gc, Flood(source=0), jax.random.key(0), coverage_target=0.95
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+        assert not np.asarray(seen_a).reshape(-1)[3]
+
+    def test_dynamic_link_carries_in_sparse_mode(self):
+        # On a ring with k large enough to stay sparse the whole run, a
+        # runtime link must jump the wave across the ring.
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.sim import topology
+
+        g = G.ring(512)
+        mesh = M.ring_mesh(4)
+        sg = sharded.connect(
+            sharded.with_capacity(
+                sharded.shard_graph(g, mesh, source_csr=True), 8
+            ),
+            [100], [400],
+        )
+        gc = topology.connect(topology.with_capacity(g, extra_edges=8),
+                              [100], [400])
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.5, adaptive_k=1024,
+            max_rounds=200,
+        )
+        _, ref = engine.run_until_coverage(
+            gc, Flood(source=0), jax.random.key(0), coverage_target=0.5,
+            max_rounds=200,
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+
+    def test_requires_csr(self):
+        g = G.ring(256)
+        mesh = M.ring_mesh(2)
+        sg = sharded.shard_graph(g, mesh)
+        with pytest.raises(ValueError, match="source_csr"):
+            sharded.flood_until_coverage(sg, mesh, source=0, adaptive_k=32)
+
+    def test_resume_state_roundtrip(self):
+        from p2pnetwork_tpu.models import Flood
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=2)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        state, out1 = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.3, adaptive_k=64,
+            return_state=True,
+        )
+        state, out2 = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.99, adaptive_k=64,
+            state0=state, return_state=True,
+        )
+        _, ref = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0), coverage_target=0.99
+        )
+        assert out1["rounds"] + out2["rounds"] == ref["rounds"]
+        assert out1["messages"] + out2["messages"] == ref["messages"]
