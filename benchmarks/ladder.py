@@ -285,22 +285,31 @@ def bench_flood_sharded_ring():
     g = G.watts_strogatz(1_000_000, 10, 0.1, seed=0,
                          build_neighbor_table=False)
     results = {}
-    for label, kw in (("segment", {}), ("mxu", dict(mxu=True)),
-                      ("hybrid", dict(hybrid=True))):
+    for label, kw, call_kw in (
+        ("segment", {}, {}),
+        ("mxu", dict(mxu=True), {}),
+        ("hybrid", dict(hybrid=True), {}),
+        ("adaptive", dict(hybrid=True, source_csr=True),
+         dict(adaptive_k=1024)),
+    ):
         sg = sharded.shard_graph(g, mesh, **kw)
-        seen, out = sharded.flood_until_coverage(sg, mesh, source=0)  # warm
+        seen, out = sharded.flood_until_coverage(sg, mesh, source=0,
+                                                 **call_kw)  # warm
         t0 = time.perf_counter()
-        seen, out = sharded.flood_until_coverage(sg, mesh, source=0)
+        seen, out = sharded.flood_until_coverage(sg, mesh, source=0,
+                                                 **call_kw)
         _ = out["messages"]  # blocking summary transfer
         results[label] = time.perf_counter() - t0
     emit({
         "config": f"1M WS flood, ring-sharded ({mesh.devices.size} dev)",
-        "value": round(results["hybrid"], 4),
-        "unit": "s to 99% coverage (ring-decomposed diagonals + MXU remainder)",
+        "value": round(results["adaptive"], 4),
+        "unit": "s to 99% coverage (hybrid layout + frontier-adaptive "
+                "rounds)",
         "segment_s": round(results["segment"], 4),
         "mxu_s": round(results["mxu"], 4),
-        "hybrid_speedup_vs_segment": round(
-            results["segment"] / results["hybrid"], 2
+        "hybrid_s": round(results["hybrid"], 4),
+        "adaptive_speedup_vs_segment": round(
+            results["segment"] / results["adaptive"], 2
         ),
         "rounds": int(np.asarray(out["rounds"])),
     })

@@ -1857,14 +1857,14 @@ def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
 # ---------------------------------------------------- pagerank / pushsum
 
 
-def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
-                          bkt_src, bkt_dst, bkt_mask,
-                          dyn_src, dyn_dst, dyn_mask,
-                          mxu_src, mxu_dst, mxu_mask, diag_masks,
-                          node_mask, out_degree,
-                          ranks0, damping, one_minus_damping, rounds):
-    """Per-shard body: ``rounds`` damped power-iteration rounds
-    (models/pagerank.py arithmetic, edge sums over the ring). ``damping``
+def _make_pagerank_round(axis_name, S, block, pieces, mxu_block,
+                         bkt_src, bkt_dst, bkt_mask,
+                         dyn_src, dyn_dst, dyn_mask,
+                         mxu_src, mxu_dst, mxu_mask, diag_masks,
+                         node_mask, out_degree, damping, one_minus_damping):
+    """Build the per-shard power-iteration round closure
+    (models/pagerank.py arithmetic, edge sums over the ring), shared by
+    the fixed-rounds scan and the run-to-residual while_loop. ``damping``
     rides as a replicated runtime operand so a damping sweep does not
     recompile; ``one_minus_damping`` arrives precomputed in f64 then cast,
     matching the engine's constant folding."""
@@ -1883,7 +1883,7 @@ def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
         jnp.sum(jnp.where(node_mask_b, deg, 0)), axis_name
     )
 
-    def one_round(ranks, _):
+    def one_round(ranks):
         contrib = jnp.where(node_mask_b & (deg > 0),
                             ranks / jnp.maximum(deg_f, 1.0), 0.0)
         pulled = pass_(contrib)
@@ -1901,7 +1901,24 @@ def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
         }
         return new, stats
 
-    ranks, stats = jax.lax.scan(one_round, ranks0[0], None, length=rounds)
+    return one_round
+
+
+def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks,
+                          node_mask, out_degree,
+                          ranks0, damping, one_minus_damping, rounds):
+    """Per-shard body: ``rounds`` damped power-iteration rounds."""
+    one_round = _make_pagerank_round(
+        axis_name, S, block, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, diag_masks,
+        node_mask, out_degree, damping, one_minus_damping,
+    )
+    ranks, stats = jax.lax.scan(lambda r, _: one_round(r), ranks0[0], None,
+                                length=rounds)
     return ranks[None], stats
 
 
@@ -1941,6 +1958,81 @@ def pagerank(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
         sg.node_mask, sg.out_degree, ranks0,
         jnp.float32(protocol.damping), jnp.float32(1.0 - protocol.damping),
     )
+
+
+def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
+                            tol, max_rounds,
+                            bkt_src, bkt_dst, bkt_mask,
+                            dyn_src, dyn_dst, dyn_mask,
+                            mxu_src, mxu_dst, mxu_mask, diag_masks,
+                            node_mask, out_degree,
+                            ranks0, damping, one_minus_damping):
+    """Per-shard body: power iteration until the L1 residual drops below
+    ``tol`` — engine.run_until_converged's measurement on the multi-chip
+    path, with the packed single-transfer summary."""
+    one_round = _make_pagerank_round(
+        axis_name, S, block, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, diag_masks,
+        node_mask, out_degree, damping, one_minus_damping,
+    )
+
+    def cond(carry):
+        _, rounds, residual, _, _ = carry
+        return (residual >= tol) & (rounds < max_rounds)
+
+    def body(carry):
+        ranks, rounds, _, hi, lo = carry
+        ranks, stats = one_round(ranks)
+        hi, lo = accum.add((hi, lo), stats["messages"])
+        return ranks, rounds + 1, stats["residual"], hi, lo
+
+    init = (ranks0[0], jnp.int32(0), jnp.float32(jnp.inf), *accum.zero())
+    ranks, rounds, residual, hi, lo = jax.lax.while_loop(cond, body, init)
+    return ranks[None], accum.pack_summary(rounds, residual, (hi, lo))
+
+
+@functools.lru_cache(maxsize=64)
+def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                          max_rounds: int, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_residual_pagerank, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda tol, *args: body(tol, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 13 + (P(), P()),
+        out_specs=(spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
+                            tol: float = 1e-6, max_rounds: int = 1024,
+                            axis_name: str = DEFAULT_AXIS, ranks0=None):
+    """Run PageRank until the L1 residual drops below ``tol`` — the
+    convergence measurement (engine.run_until_converged with
+    stat="residual"), multi-chip, as one device-side while_loop. Returns
+    ``(ranks [S, block] f32, dict(rounds, value, messages))`` with
+    ``value`` the final residual and ``messages`` an exact Python int."""
+    S, block = sg.n_shards, sg.block
+    if ranks0 is None:
+        ranks0 = init_state(sg, protocol, None)
+    fn = _pagerank_residual_fn(mesh, axis_name, S, block, max_rounds,
+                               sg.diag_pieces, sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    ranks, packed = fn(
+        jnp.float32(tol),
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, ranks0,
+        jnp.float32(protocol.damping), jnp.float32(1.0 - protocol.damping),
+    )
+    out = accum.unpack_summary(packed)
+    out["value"] = out.pop("coverage")
+    return ranks, out
 
 
 def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,

@@ -106,26 +106,85 @@ _pack_summary = accum.pack_summary
 _unpack_summary = accum.unpack_summary
 
 
-def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
+def run_until_converged(
+    graph: Graph,
+    protocol,
+    key: jax.Array,
+    *,
+    stat: str,
+    threshold: float,
+    max_rounds: int = 1024,
+    state0=None,
+):
+    """Run until the scalar ``stats[stat]`` drops BELOW ``threshold`` — the
+    run-to-coverage loop's sibling for convergence-style protocols
+    (PageRank to a residual, PushSum/Gossip to a variance), as one
+    device-side ``lax.while_loop`` with the packed single-transfer summary.
+
+    Returns ``(state, dict(rounds, value, messages))`` where ``value`` is
+    the stat after the final round (inf if zero rounds ran) and
+    ``messages`` an exact Python int. Pass ``state0`` to resume.
+
+    Thresholds have an f32 floor: an L1 residual summed over N ranks
+    bottoms out around N * eps * scale (measured ~1.4e-8 at 50K nodes), so
+    an unreachable threshold runs to ``max_rounds`` — size it to the
+    population, or watch ``value`` in the summary."""
+    state, packed = _converged_loop(
+        graph, protocol, state0, key, stat=stat, threshold=threshold,
+        max_rounds=max_rounds,
+    )
+    out = _unpack_summary(packed)
+    out["value"] = out.pop("coverage")  # pack_summary's f32 slot, reused
+    return state, out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("protocol", "stat", "max_rounds"))
+def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
+                    max_rounds):
+    if state0 is None:
+        state0 = protocol.init(graph, key)
+    return _stat_while(
+        graph, protocol, state0, key, stat=stat,
+        keep_going=lambda v, r: (v >= threshold) & (r < max_rounds),
+        value0=jnp.float32(jnp.inf),
+    )
+
+
+def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0):
+    """The shared device-side early-exit loop: run protocol rounds while
+    ``keep_going(stats[stat], rounds)`` holds, accumulating messages in the
+    two-limb counter and returning the packed one-transfer summary. Both
+    run-to-coverage and run-to-convergence are this loop with a different
+    predicate and seed value."""
+
     def cond(carry):
-        _, _, rounds, coverage, _, _ = carry
-        return (coverage < coverage_target) & (rounds < max_rounds)
+        _, _, rounds, value, _, _ = carry
+        return keep_going(value, rounds)
 
     def body(carry):
         state, k, rounds, _, hi, lo = carry
         k, sub = jax.random.split(k)
         state, stats = protocol.step(graph, state, sub)
         hi, lo = accum.add((hi, lo), stats["messages"])
-        return (state, k, rounds + 1, stats["coverage"], hi, lo)
+        return (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo)
 
+    init = (state0, key, jnp.int32(0), value0, *accum.zero())
+    state, _, rounds, value, hi, lo = jax.lax.while_loop(cond, body, init)
+    return state, _pack_summary(rounds, value, (hi, lo))
+
+
+def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
     cov0 = (
         jnp.float32(protocol.coverage(graph, state0))
         if hasattr(protocol, "coverage")
         else jnp.float32(0.0)
     )
-    init = (state0, key, jnp.int32(0), cov0, *accum.zero())
-    state, _, rounds, coverage, hi, lo = jax.lax.while_loop(cond, body, init)
-    return state, _pack_summary(rounds, coverage, (hi, lo))
+    return _stat_while(
+        graph, protocol, state0, key, stat="coverage",
+        keep_going=lambda v, r: (v < coverage_target) & (r < max_rounds),
+        value0=cov0,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))

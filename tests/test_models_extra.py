@@ -177,3 +177,40 @@ class TestHopDistance:
         state, stats = engine.run(g, HopDistance(source=0), jax.random.key(0), 20)
         assert np.asarray(state.dist)[:32].max() == 16
         assert np.asarray(stats["max_dist"])[-1] == 16
+
+
+class TestRunUntilConverged:
+    def test_pagerank_to_residual(self):
+        g = G.barabasi_albert(500, 3, seed=0)
+        state, out = engine.run_until_converged(
+            g, PageRank(), jax.random.key(0), stat="residual",
+            threshold=1e-6,
+        )
+        assert out["value"] < 1e-6
+        assert 0 < out["rounds"] < 200
+        # The loop stopped exactly when the fixed-rounds run would have.
+        _, stats = engine.run(g, PageRank(), jax.random.key(0), out["rounds"])
+        res = np.asarray(stats["residual"])
+        assert res[-1] < 1e-6 and (res[:-1] >= 1e-6).all()
+        np.testing.assert_allclose(out["value"], res[-1], rtol=1e-6)
+        assert out["messages"] == int(np.asarray(stats["messages"]).sum())
+
+    def test_pushsum_to_variance(self):
+        g = G.watts_strogatz(512, 8, 0.1, seed=1)
+        proto = PushSum()
+        state, out = engine.run_until_converged(
+            g, proto, jax.random.key(2), stat="variance", threshold=1e-9,
+        )
+        assert out["value"] < 1e-9
+        est = np.asarray(proto.estimate(g, state))[: g.n_nodes]
+        true_mean = np.asarray(proto.init(g, jax.random.key(2)).s)[
+            : g.n_nodes].mean()
+        np.testing.assert_allclose(est, true_mean, atol=1e-3)
+
+    def test_max_rounds_cap(self):
+        g = G.ring(128)
+        _, out = engine.run_until_converged(
+            g, PageRank(), jax.random.key(0), stat="residual",
+            threshold=0.0, max_rounds=7,
+        )
+        assert out["rounds"] == 7

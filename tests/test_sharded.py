@@ -1118,3 +1118,52 @@ class TestShardedAdaptiveFlood:
         )
         assert out1["rounds"] + out2["rounds"] == ref["rounds"]
         assert out1["messages"] + out2["messages"] == ref["messages"]
+
+
+class TestShardedPageRankResidual:
+    @pytest.mark.parametrize("n_shards", [1, 8])
+    def test_matches_engine_loop(self, n_shards):
+        from p2pnetwork_tpu.models import PageRank
+
+        g = G.barabasi_albert(1024, 3, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        ranks, out = sharded.pagerank_until_residual(
+            sg, mesh, PageRank(), tol=1e-5
+        )
+        _, ref = engine.run_until_converged(
+            g, PageRank(), jax.random.key(0), stat="residual",
+            threshold=1e-5,
+        )
+        # f32 summation order differs (ring vs receiver order), so the
+        # loop may exit one round apart right at the threshold; rank
+        # values agree to tolerance either way.
+        assert abs(out["rounds"] - ref["rounds"]) <= 1
+        assert out["value"] < 1e-5
+        ref_state, _ = engine.run(g, PageRank(), jax.random.key(0),
+                                  out["rounds"])
+        np.testing.assert_allclose(
+            np.asarray(ranks).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_state.ranks)[: g.n_nodes],
+            rtol=1e-4, atol=1e-9,
+        )
+
+    def test_under_churn(self):
+        from p2pnetwork_tpu.models import PageRank
+        from p2pnetwork_tpu.sim import failures
+
+        g = G.watts_strogatz(1024, 6, 0.1, seed=1)
+        mesh = M.ring_mesh(8)
+        sg = sharded.fail_nodes(sharded.shard_graph(g, mesh), [5, 600])
+        gf = failures.fail_nodes(g, [5, 600])
+        ranks, out = sharded.pagerank_until_residual(
+            sg, mesh, PageRank(), tol=1e-5
+        )
+        assert out["value"] < 1e-5
+        assert np.asarray(ranks).reshape(-1)[5] == 0.0
+        ref_ranks = engine.run(gf, PageRank(), jax.random.key(0),
+                               out["rounds"])[0].ranks
+        np.testing.assert_allclose(
+            np.asarray(ranks).reshape(-1)[: g.n_nodes],
+            np.asarray(ref_ranks)[: g.n_nodes], rtol=1e-4, atol=1e-9,
+        )
