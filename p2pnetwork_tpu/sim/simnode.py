@@ -246,6 +246,15 @@ class JaxSimNode(Node):
             self.node_message(self.sim_peer, {"sim_round": self.sim_round, **round_stats})
         return host_stats
 
+    def _finish_run(self, out: dict) -> dict:
+        """Shared tail of the run-to-* loops: host summary, round/message
+        accounting, and the single summary ``node_message`` event."""
+        summary = {k: np.asarray(v).item() for k, v in out.items()}
+        self.sim_round += int(summary["rounds"])
+        self.sim_message_count += int(summary["messages"])
+        self.node_message(self.sim_peer, {"sim_run": True, **summary})
+        return summary
+
     def run_until_coverage(self, coverage_target: float = 0.99,
                            max_rounds: int = 1024) -> dict:
         """Device-side run-to-coverage continuing from the current state
@@ -290,11 +299,40 @@ class JaxSimNode(Node):
                 self.sim_graph, self.sim_protocol, self.sim_state, seg_key,
                 coverage_target=coverage_target, max_rounds=max_rounds,
             )
-        summary = {k: np.asarray(v).item() for k, v in out.items()}
-        self.sim_round += int(summary["rounds"])
-        self.sim_message_count += int(summary["messages"])
-        self.node_message(self.sim_peer, {"sim_run": True, **summary})
-        return summary
+        return self._finish_run(out)
+
+    def run_until_converged(self, stat: str, threshold: float,
+                            max_rounds: int = 1024) -> dict:
+        """Device-side run-to-convergence continuing from the current state
+        (engine.run_until_converged): advance until ``stats[stat]`` drops
+        below ``threshold`` — PageRank to a residual, PushSum/Gossip to a
+        variance. On the mesh backend, PageRank rides the multi-chip
+        residual loop (sharded.pagerank_until_residual)."""
+        self._require_sim()
+        seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
+        if self.sim_mesh is not None:
+            from p2pnetwork_tpu.models.pagerank import PageRank
+            from p2pnetwork_tpu.parallel import sharded
+
+            if not (isinstance(self.sim_protocol, PageRank)
+                    and stat == "residual"):
+                raise ValueError(
+                    "run_until_converged on the sharded backend implements "
+                    "PageRank with stat='residual'; run other protocols on "
+                    "the single-device backend or step them with run_rounds"
+                )
+            self.sim_state, out = sharded.pagerank_until_residual(
+                self.sim_sharded, self.sim_mesh, self.sim_protocol,
+                tol=threshold, max_rounds=max_rounds,
+                ranks0=self.sim_state,
+            )
+        else:
+            self.sim_state, out = engine.run_until_converged(
+                self.sim_graph, self.sim_protocol, seg_key, stat=stat,
+                threshold=threshold, max_rounds=max_rounds,
+                state0=self.sim_state,
+            )
+        return self._finish_run(out)
 
     # ------------------------------------------------------------- topology
 

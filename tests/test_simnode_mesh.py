@@ -254,3 +254,20 @@ class TestMeshBackedValueProtocols:
             np.asarray(c.sim_state[0]), np.asarray(b.sim_state[0])
         )
         assert int(np.asarray(c.sim_state[2])) == int(np.asarray(b.sim_state[2]))
+
+    def test_pagerank_run_until_converged(self):
+        from p2pnetwork_tpu.models import PageRank
+
+        g = G.barabasi_albert(1024, 3, seed=3)
+        a = JaxSimNode(graph=g, protocol=PageRank(), seed=1)
+        b = JaxSimNode(graph=g, protocol=PageRank(), seed=1,
+                       mesh=M.ring_mesh(8))
+        out_a = a.run_until_converged("residual", 1e-5)
+        out_b = b.run_until_converged("residual", 1e-5)
+        assert out_a["value"] < 1e-5 and out_b["value"] < 1e-5
+        assert abs(out_a["rounds"] - out_b["rounds"]) <= 1
+        assert a.sim_round == out_a["rounds"]
+        with pytest.raises(ValueError, match="sharded backend"):
+            JaxSimNode(graph=g, protocol=PageRank(), seed=1,
+                       mesh=M.ring_mesh(4)).run_until_converged("rank_max",
+                                                                0.5)
