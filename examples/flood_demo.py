@@ -21,7 +21,7 @@ from p2pnetwork_tpu.sim import graph as G
 def main():
     n = 100_000
     print(f"building {n}-node Watts-Strogatz graph ...")
-    g = G.watts_strogatz(n, 10, 0.1, seed=0)
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, source_csr=True)
     print(f"  {g.n_edges} directed edges")
 
     protocol = Flood(source=0)
@@ -44,6 +44,25 @@ def main():
     print(f"  first run (with compile): {first*1000:.1f} ms")
     print(f"  steady state:             {steady*1000:.1f} ms "
           f"({int(out['messages'])/steady/1e6:.1f}M msgs/sec)")
+
+    # The frontier-adaptive variant: bit-identical results, small rounds
+    # as O(k x degree) index-list traversal (models/adaptive_flood.py).
+    from p2pnetwork_tpu.models import AdaptiveFlood
+
+    adaptive = AdaptiveFlood(source=0, k=1024)
+    state_a, out_a = engine.run_until_coverage(
+        g, adaptive, jax.random.key(0), coverage_target=0.99, max_rounds=64
+    )
+    jax.block_until_ready(state_a.seen)
+    t0 = time.perf_counter()
+    state_a, out_a = engine.run_until_coverage(
+        g, adaptive, jax.random.key(0), coverage_target=0.99, max_rounds=64
+    )
+    jax.block_until_ready(state_a.seen)
+    adaptive_s = time.perf_counter() - t0
+    assert out_a == out, "adaptive flood must match the dense run exactly"
+    print(f"  adaptive (k=1024):        {adaptive_s*1000:.1f} ms "
+          f"— identical rounds/messages/coverage")
 
 
 if __name__ == "__main__":

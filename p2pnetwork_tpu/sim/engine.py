@@ -129,6 +129,20 @@ def run_until_converged(
     bottoms out around N * eps * scale (measured ~1.4e-8 at 50K nodes), so
     an unreachable threshold runs to ``max_rounds`` — size it to the
     population, or watch ``value`` in the summary."""
+    # Validate the stat name by abstract tracing (no device work): a typo
+    # must be a clear ValueError, not a KeyError from inside the jitted
+    # loop.
+    stats_shapes = jax.eval_shape(
+        lambda g, k, s0: protocol.step(
+            g, protocol.init(g, k) if s0 is None else s0, k
+        )[1],
+        graph, key, state0,
+    )
+    if stat not in stats_shapes:
+        raise ValueError(
+            f"{type(protocol).__name__} exposes stats "
+            f"{sorted(stats_shapes)}; got stat={stat!r}"
+        )
     state, packed = _converged_loop(
         graph, protocol, state0, key, stat=stat, threshold=threshold,
         max_rounds=max_rounds,

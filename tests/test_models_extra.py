@@ -214,3 +214,9 @@ class TestRunUntilConverged:
             threshold=0.0, max_rounds=7,
         )
         assert out["rounds"] == 7
+
+    def test_unknown_stat_is_a_clear_error(self):
+        g = G.ring(128)
+        with pytest.raises(ValueError, match="exposes stats"):
+            engine.run_until_converged(g, PageRank(), jax.random.key(0),
+                                       stat="residul", threshold=1e-6)
