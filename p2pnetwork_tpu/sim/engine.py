@@ -65,6 +65,7 @@ def run_until_coverage(
     Requires the protocol's stats to include ``coverage`` and ``messages``
     (e.g. models.flood.Flood).
     """
+    _require_stats(graph, protocol, None, key, ("coverage", "messages"))
     state, packed = _coverage_with_init(
         graph, protocol, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
@@ -94,6 +95,7 @@ def run_until_coverage_from(
     packed transfer — on tunneled backends every extra round trip is
     milliseconds.
     """
+    _require_stats(graph, protocol, state0, key, ("coverage", "messages"))
     state, packed = _coverage_loop(
         graph, protocol, state0, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
@@ -129,20 +131,7 @@ def run_until_converged(
     bottoms out around N * eps * scale (measured ~1.4e-8 at 50K nodes), so
     an unreachable threshold runs to ``max_rounds`` — size it to the
     population, or watch ``value`` in the summary."""
-    # Validate the stat name by abstract tracing (no device work): a typo
-    # must be a clear ValueError, not a KeyError from inside the jitted
-    # loop.
-    stats_shapes = jax.eval_shape(
-        lambda g, k, s0: protocol.step(
-            g, protocol.init(g, k) if s0 is None else s0, k
-        )[1],
-        graph, key, state0,
-    )
-    if stat not in stats_shapes:
-        raise ValueError(
-            f"{type(protocol).__name__} exposes stats "
-            f"{sorted(stats_shapes)}; got stat={stat!r}"
-        )
+    _require_stats(graph, protocol, state0, key, (stat, "messages"))
     state, packed = _converged_loop(
         graph, protocol, state0, key, stat=stat, threshold=threshold,
         max_rounds=max_rounds,
@@ -163,6 +152,35 @@ def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
         keep_going=lambda v, r: (v >= threshold) & (r < max_rounds),
         value0=jnp.float32(jnp.inf),
     )
+
+
+#: Memoized stats-key sets per (protocol, graph structure) — the abstract
+#: trace of init+step runs once, not per call (the run-to-* entry points
+#: sit on paths budgeted in milliseconds).
+_stats_keys_cache: dict = {}
+
+
+def _require_stats(graph, protocol, state0, key, required) -> None:
+    """Check the protocol's stats dict exposes ``required`` keys, by
+    abstract tracing (no device work) — a typo'd or missing stat must be a
+    clear ValueError up front, not a KeyError from inside the jitted
+    loop."""
+    cache_key = (protocol, jax.tree_util.tree_structure(graph))
+    keys = _stats_keys_cache.get(cache_key)
+    if keys is None:
+        shapes = jax.eval_shape(
+            lambda g, k, s0: protocol.step(
+                g, protocol.init(g, k) if s0 is None else s0, k
+            )[1],
+            graph, key, state0,
+        )
+        keys = _stats_keys_cache[cache_key] = frozenset(shapes)
+    missing = [r for r in required if r not in keys]
+    if missing:
+        raise ValueError(
+            f"{type(protocol).__name__} exposes stats {sorted(keys)}; "
+            f"this loop needs {sorted(missing)}"
+        )
 
 
 def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0):

@@ -171,3 +171,29 @@ class TestAdaptiveHopDistance:
         np.testing.assert_array_equal(np.asarray(st_a.dist),
                                       np.asarray(st_h.dist))
         assert np.asarray(st_a.dist)[7] == -1
+
+
+class TestAdaptiveFloodEdgeCases:
+    def test_edgeless_graph(self):
+        # No edges at all: the wave dies at the seed; coverage never moves.
+        g = G.from_edges([], [], 64).with_source_csr()
+        st, stats = engine.run(g, AdaptiveFlood(source=3, k=16),
+                               jax.random.key(0), 4)
+        assert np.asarray(st.seen).sum() == 1
+        np.testing.assert_array_equal(np.asarray(stats["messages"]),
+                                      [0, 0, 0, 0])
+
+    def test_isolated_source(self):
+        g = G.from_edges([0, 1], [1, 0], 8).with_source_csr()  # 2..7 isolated
+        st, _ = engine.run(g, AdaptiveFlood(source=5, k=8),
+                           jax.random.key(0), 4)
+        seen = np.asarray(st.seen)
+        assert seen[5] and seen.sum() == 1
+
+    def test_single_node_graph(self):
+        g = G.from_edges([], [], 1).with_source_csr()
+        _, out = engine.run_until_coverage(
+            g, AdaptiveFlood(source=0, k=4), jax.random.key(0),
+            coverage_target=0.99,
+        )
+        assert out["rounds"] == 0 and out["coverage"] == 1.0

@@ -220,3 +220,10 @@ class TestRunUntilConverged:
         with pytest.raises(ValueError, match="exposes stats"):
             engine.run_until_converged(g, PageRank(), jax.random.key(0),
                                        stat="residul", threshold=1e-6)
+
+    def test_coverage_loop_rejects_statless_protocol(self):
+        from p2pnetwork_tpu.models import Gossip
+
+        g = G.barabasi_albert(128, 3, seed=0)
+        with pytest.raises(ValueError, match="needs \\['coverage'\\]"):
+            engine.run_until_coverage(g, Gossip(), jax.random.key(0))
