@@ -747,6 +747,11 @@ def connect(sg: ShardedGraph, senders, receivers, *,
     keep = np.zeros(s.size, bool)
     keep[first] = True
 
+    # Dead endpoints reject the link (sim/topology.connect parity — the
+    # reference's connect to a crashed peer fails [ref: node.py:173-176]).
+    alive = np.asarray(sg.node_mask).reshape(-1)
+    keep &= alive[s] & alive[r]
+
     # Drop pairs that already exist — each shard probes the exact bucket
     # the pair would occupy (O(Q * E_bkt) on its own rows, not O(Q * E)).
     d = (r // B).astype(np.int32)

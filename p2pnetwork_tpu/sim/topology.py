@@ -18,8 +18,8 @@ Static-layout representations that bake in edge order (neighbor table for
 partner *sampling*, blocked/hybrid kernel layouts for the *static* edges)
 keep serving the static edges; the dynamic region rides alongside them.
 Leaves are sim/failures.py. When the dynamic region fills up or churn
-accumulates, consolidate: rebuild via ``from_edges`` with the merged edge
-list (one-off host cost, amortized over many rounds).
+accumulates, :func:`consolidate` rebuilds via ``from_edges`` with the
+merged live edge list (one-off host cost, amortized over many rounds).
 """
 
 from __future__ import annotations
@@ -149,7 +149,11 @@ def connect(graph: Graph, senders, receivers, *,
     [ref: nodeconnection.py]) stores both directions. Connecting an
     already-connected pair is a no-op, like the reference's duplicate
     ``connect_with_node`` [ref: node.py:136-139] — a silent parallel edge
-    would double-count infection pressure and inflate degrees.
+    would double-count infection pressure and inflate degrees. A link with
+    a DEAD endpoint is likewise dropped (the reference's connect to a
+    crashed peer fails [ref: node.py:173-176]); it also keeps
+    fail-then-connect and connect-then-fail equivalent, since the liveness
+    re-mask only sees links that exist when it runs.
 
     ``check_capacity=True`` verifies slot headroom and id bounds host-side,
     which forces a device sync per call when the ids live on device. For
@@ -178,7 +182,13 @@ def connect(graph: Graph, senders, receivers, *,
         & (r[:, None] == r[None, :])
         & jnp.tril(jnp.ones((s.size, s.size), bool), k=-1)
     )
-    valid = ~_edge_exists(graph, s, r) & ~dup_prior.any(axis=1)
+    # Dead endpoints reject the link, like the reference's connect to a
+    # crashed peer failing [ref: node.py:173-176] — otherwise
+    # fail-then-connect and connect-then-fail would leave different live
+    # link sets for the same topology (the liveness re-mask only sees
+    # links that exist when it runs).
+    valid = (~_edge_exists(graph, s, r) & ~dup_prior.any(axis=1)
+             & graph.node_mask[s] & graph.node_mask[r])
     free = ~graph.dyn_mask
     if check_capacity:
         try:
@@ -261,3 +271,71 @@ def join_node(graph: Graph, node_id: int, peers) -> Graph:
     g = dataclasses.replace(graph, node_mask=node_mask)
     peers = jnp.asarray(peers, jnp.int32).reshape(-1)
     return connect(g, jnp.full(peers.shape, node_id, jnp.int32), peers)
+
+
+def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
+                **from_edges_kwargs) -> Graph:
+    """Fold accumulated churn into a fresh static representation — the
+    documented consolidation path, as one call (one-off host cost,
+    amortized over many rounds).
+
+    The merged LIVE edge list (static + dynamic region) is rebuilt through
+    :func:`p2pnetwork_tpu.sim.graph.from_edges` — runtime links become
+    static edges (entering the neighbor table, so Gossip samples them, and
+    any blocked/hybrid/source-CSR layout requested via
+    ``from_edges_kwargs``), dead edges are dropped for good, and liveness
+    is preserved: failed nodes stay failed, joined spare nodes stay alive
+    (the rebuilt id space covers every live or referenced id).
+    ``extra_edges`` / ``extra_nodes`` re-reserve growth capacity on the
+    result. Propagation results are unchanged by construction
+    (tests/test_topology.py asserts flood parity before/after)."""
+    from p2pnetwork_tpu.sim.failures import with_node_liveness
+
+    emask = np.asarray(graph.edge_mask)
+    senders = np.asarray(graph.senders)[emask]
+    receivers = np.asarray(graph.receivers)[emask]
+    if graph.dyn_mask is not None:
+        dm = np.asarray(graph.dyn_mask)
+        senders = np.concatenate(
+            [senders, np.asarray(graph.dyn_senders)[dm]]
+        )
+        receivers = np.concatenate(
+            [receivers, np.asarray(graph.dyn_receivers)[dm]]
+        )
+    alive = np.asarray(graph.node_mask)
+    # The rebuilt id space must cover joined spare nodes (ids >=
+    # n_nodes) and every edge endpoint.
+    referenced = [graph.n_nodes]
+    if alive.any():
+        referenced.append(int(np.flatnonzero(alive).max()) + 1)
+    if senders.size:
+        referenced.append(int(max(senders.max(), receivers.max())) + 1)
+    n_eff = max(referenced)
+
+    from p2pnetwork_tpu.sim.graph import from_edges
+
+    # Kernel layouts attach LAST: node growth must precede them
+    # (with_capacity refuses to grow under a baked layout), and building
+    # them after the liveness re-mask means they never contain dead edges.
+    layout_kw = {
+        k: from_edges_kwargs.pop(k)
+        for k in ("blocked", "hybrid", "source_csr")
+        if k in from_edges_kwargs
+    }
+    g2 = from_edges(senders, receivers, n_eff, **from_edges_kwargs)
+    # from_edges marks [0, n_eff) all-alive; re-apply the real liveness
+    # (failed nodes stay failed; ids beyond the old padding stay dead).
+    alive2 = np.zeros(g2.n_nodes_padded, dtype=bool)
+    span = min(alive.shape[0], g2.n_nodes_padded)
+    alive2[:span] = alive[:span]
+    g2 = with_node_liveness(g2, jnp.asarray(alive2))
+    if extra_edges or extra_nodes:
+        g2 = with_capacity(g2, extra_edges=extra_edges,
+                           extra_nodes=extra_nodes)
+    if layout_kw.get("blocked"):
+        g2 = g2.with_blocked()
+    if layout_kw.get("hybrid"):
+        g2 = g2.with_hybrid()
+    if layout_kw.get("source_csr"):
+        g2 = g2.with_source_csr()
+    return g2

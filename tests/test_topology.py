@@ -276,3 +276,112 @@ def test_connect_duplicates_at_near_capacity_do_not_corrupt():
     # fifth phantom count for the destroyed slot-0 edge.
     assert int(np.asarray(g.in_degree)[7]) == 4
     assert int(np.asarray(g.out_degree)[7]) == 4
+
+
+class TestConsolidate:
+    def test_flood_parity_after_churn(self):
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.sim import engine, failures
+
+        g = G.watts_strogatz(512, 6, 0.2, seed=0)
+        g = failures.fail_nodes(topology.with_capacity(g, extra_edges=16),
+                                [7, 300])
+        g = topology.connect(g, [2, 5], [400, 450])
+        c = topology.consolidate(g)
+        # Runtime links became static edges; nothing rides the dyn region.
+        assert c.dyn_senders is None
+        assert c.n_edges == int(np.asarray(g.edge_mask).sum()
+                                + np.asarray(g.dyn_mask).sum())
+        key = jax.random.key(0)
+        st_g, stats_g = engine.run(g, Flood(source=0), key, 8)
+        st_c, stats_c = engine.run(c, Flood(source=0), key, 8)
+        np.testing.assert_array_equal(
+            np.asarray(st_c.seen)[: g.n_nodes],
+            np.asarray(st_g.seen)[: g.n_nodes],
+        )
+        np.testing.assert_array_equal(np.asarray(stats_c["messages"]),
+                                      np.asarray(stats_g["messages"]))
+        assert not np.asarray(st_c.seen)[7]  # failed stays failed
+
+    def test_joined_spare_survives_and_gossip_samples_new_links(self):
+        from p2pnetwork_tpu.sim import failures
+
+        g = topology.with_capacity(G.ring(250), extra_edges=16,
+                                   extra_nodes=10)
+        g = topology.join_node(g, 300, [5])
+        c = topology.consolidate(g, extra_edges=8)
+        alive = np.asarray(c.node_mask)
+        assert alive[300] and alive[:250].all() and not alive[250:300].any()
+        # The runtime link entered the neighbor table (partner sampling).
+        row = np.asarray(c.neighbors[300])
+        msk = np.asarray(c.neighbor_mask[300])
+        assert 5 in set(row[msk])
+        assert c.dyn_senders is not None  # capacity re-reserved
+
+    def test_rebuild_layouts_on_request(self):
+        g = topology.connect(
+            topology.with_capacity(G.watts_strogatz(256, 4, 0.2, seed=1),
+                                   extra_edges=8),
+            [0], [99],
+        )
+        c = topology.consolidate(g, hybrid=True, source_csr=True)
+        assert c.hybrid is not None and c.src_eid is not None
+        from p2pnetwork_tpu.models import AdaptiveFlood, Flood
+        from p2pnetwork_tpu.sim import engine
+
+        key = jax.random.key(0)
+        st_a, _ = engine.run(c, AdaptiveFlood(source=0, k=32), key, 6)
+        st_f, _ = engine.run(g, Flood(source=0), key, 6)
+        np.testing.assert_array_equal(
+            np.asarray(st_a.seen)[:256], np.asarray(st_f.seen)[:256]
+        )
+
+
+class TestConnectLiveness:
+    def test_connect_to_dead_endpoint_is_rejected(self):
+        # Reference parity: connect_with_node to a crashed peer fails
+        # [ref: node.py:173-176]. Without this, fail-then-connect vs
+        # connect-then-fail left different live link sets.
+        from p2pnetwork_tpu.sim import failures
+
+        g = failures.fail_nodes(
+            topology.with_capacity(G.ring(256), extra_edges=8), [77]
+        )
+        before = int(np.asarray(g.out_degree).sum())
+        g2 = topology.connect(g, [3], [77])
+        assert int(np.asarray(g2.dyn_mask).sum()) == 0
+        assert int(np.asarray(g2.out_degree).sum()) == before
+
+    def test_order_independence_fail_vs_connect(self):
+        from p2pnetwork_tpu.sim import failures
+
+        base = topology.with_capacity(G.ring(256), extra_edges=8)
+        a = topology.connect(failures.fail_nodes(base, [9]), [3], [9])
+        b = failures.fail_nodes(topology.connect(base, [3], [9]), [9])
+        np.testing.assert_array_equal(np.asarray(a.out_degree),
+                                      np.asarray(b.out_degree))
+        assert int(np.asarray(a.dyn_mask).sum()) == 0
+
+    def test_sharded_connect_liveness_parity(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.ring(512)
+        mesh = M.ring_mesh(4)
+        sg = sharded.with_capacity(
+            sharded.fail_nodes(sharded.shard_graph(g, mesh), [100]), 8
+        )
+        sg2 = sharded.connect(sg, [3], [100])
+        assert int(np.asarray(sg2.dyn_mask).sum()) == 0
+        np.testing.assert_array_equal(np.asarray(sg2.out_degree),
+                                      np.asarray(sg.out_degree))
+
+    def test_consolidate_extra_nodes_with_layouts(self):
+        # Growth + kernel layouts together: layouts attach after growth.
+        g = topology.connect(
+            topology.with_capacity(G.ring(250), extra_edges=8), [0], [99]
+        )
+        c = topology.consolidate(g, extra_nodes=10, extra_edges=8,
+                                 hybrid=True, source_csr=True)
+        assert c.hybrid is not None and c.src_eid is not None
+        assert c.src_offsets.shape[0] == c.n_nodes_padded + 1
+        assert c.n_nodes_padded > 256  # grown padding present
