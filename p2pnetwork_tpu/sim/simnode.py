@@ -92,7 +92,8 @@ class JaxSimNode(Node):
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  graph: Optional[Graph] = None, protocol=None, seed: int = 0,
                  mesh=None, dynamic_edges: int = 0, rng: Optional[str] = None,
-                 layout: str = "hybrid", **node_kwargs):
+                 layout: str = "hybrid", adaptive_k: int = 0,
+                 **node_kwargs):
         super().__init__(host, port, **node_kwargs)
         self.sim_graph: Optional[Graph] = None
         self.sim_protocol = None
@@ -104,18 +105,20 @@ class JaxSimNode(Node):
         self.sim_sharded = None
         self._sim_rng: Optional[str] = None
         self._sim_key: Optional[jax.Array] = None
+        self._sim_adaptive_k = 0
         self._churn_count = 0
         if graph is not None and protocol is not None:
             self.attach_simulation(graph, protocol, seed=seed, mesh=mesh,
                                    dynamic_edges=dynamic_edges, rng=rng,
-                                   layout=layout)
+                                   layout=layout, adaptive_k=adaptive_k)
 
     # ------------------------------------------------------------- plumbing
 
     def attach_simulation(self, graph: Graph, protocol, seed: int = 0,
                           mesh=None, dynamic_edges: int = 0,
                           rng: Optional[str] = None,
-                          layout: str = "hybrid") -> None:
+                          layout: str = "hybrid",
+                          adaptive_k: int = 0) -> None:
         """Attach (or replace) the simulated population.
 
         ``mesh`` switches the node onto the multi-chip backend
@@ -132,7 +135,10 @@ class JaxSimNode(Node):
         'fold', default tile when aligned); ``layout`` picks the sharded
         edge layout — 'hybrid' (ring-decomposed diagonals + MXU remainder,
         the fast default), 'mxu', or 'segment' (BENCH.md has the measured
-        ladder). All layouts are bit-exact.
+        ladder). All layouts are bit-exact. ``adaptive_k > 0`` additionally
+        builds the sender-CSR view and runs Flood's ``run_until_coverage``
+        through the frontier-adaptive loop (small-frontier rounds skip the
+        ring; bit-identical results).
         """
         if layout not in ("hybrid", "mxu", "segment"):
             # Validate regardless of backend: a typo'd layout must not be
@@ -141,16 +147,34 @@ class JaxSimNode(Node):
                 f"layout must be 'hybrid', 'mxu' or 'segment', got "
                 f"{layout!r}"
             )
+        if adaptive_k > 0:
+            from p2pnetwork_tpu.models.flood import Flood as _Flood
+
+            # A silent no-op would be worse than an error: the flag only
+            # drives the mesh backend's Flood coverage loop.
+            if mesh is None:
+                raise ValueError(
+                    "adaptive_k drives the mesh backend's coverage loop; "
+                    "on the single-device backend use "
+                    "protocol=AdaptiveFlood(...) on a source_csr=True graph"
+                )
+            if not isinstance(protocol, _Flood):
+                raise ValueError(
+                    f"adaptive_k applies to Flood on the mesh backend; got "
+                    f"{type(protocol).__name__}"
+                )
         self.sim_graph = graph
         self.sim_protocol = protocol
         self._sim_key = jax.random.key(seed)
         self.sim_mesh = mesh
         self._sim_rng = rng
+        self._sim_adaptive_k = adaptive_k
         if mesh is not None:
             from p2pnetwork_tpu.parallel import sharded
 
             sg = sharded.shard_graph(graph, mesh, mxu=layout == "mxu",
-                                     hybrid=layout == "hybrid")
+                                     hybrid=layout == "hybrid",
+                                     source_csr=adaptive_k > 0)
             if dynamic_edges:
                 sg = sharded.with_capacity(sg, dynamic_edges)
             self.sim_sharded = sg
@@ -274,6 +298,7 @@ class JaxSimNode(Node):
                     self.sim_sharded, self.sim_mesh, self.sim_protocol.source,
                     coverage_target=coverage_target, max_rounds=max_rounds,
                     state0=self.sim_state, return_state=True,
+                    adaptive_k=self._sim_adaptive_k,
                 )
             elif isinstance(self.sim_protocol, HopDistance):
                 self.sim_state, out = sharded.hopdist_until_coverage(

@@ -281,11 +281,13 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
 
     The merged LIVE edge list (static + dynamic region) is rebuilt through
     :func:`p2pnetwork_tpu.sim.graph.from_edges` — runtime links become
-    static edges (entering the neighbor table, so Gossip samples them, and
-    any blocked/hybrid/source-CSR layout requested via
-    ``from_edges_kwargs``), dead edges are dropped for good, and liveness
-    is preserved: failed nodes stay failed, joined spare nodes stay alive
-    (the rebuilt id space covers every live or referenced id).
+    static edges (entering the neighbor table, so Gossip samples them),
+    dead edges are dropped for good, and liveness is preserved: failed
+    nodes stay failed, joined spare nodes stay alive (the rebuilt id space
+    covers every live or referenced id). Kernel layouts
+    (blocked/hybrid/source-CSR) carry over from the input graph by
+    default — a population running ``method='hybrid'`` keeps running it —
+    and can be toggled via ``from_edges_kwargs``.
     ``extra_edges`` / ``extra_nodes`` re-reserve growth capacity on the
     result. Propagation results are unchanged by construction
     (tests/test_topology.py asserts flood parity before/after)."""
@@ -314,14 +316,21 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
 
     from p2pnetwork_tpu.sim.graph import from_edges
 
-    # Kernel layouts attach LAST: node growth must precede them
-    # (with_capacity refuses to grow under a baked layout), and building
-    # them after the liveness re-mask means they never contain dead edges.
+    # Kernel layouts: default to what the input graph carried (a graph
+    # running method='hybrid' must still run it after consolidation), let
+    # kwargs override. With node growth they attach AFTER with_capacity
+    # (which refuses to grow under a baked layout); otherwise they build
+    # inside from_edges from the host arrays already in hand — no device
+    # round trip.
     layout_kw = {
-        k: from_edges_kwargs.pop(k)
-        for k in ("blocked", "hybrid", "source_csr")
-        if k in from_edges_kwargs
+        "blocked": from_edges_kwargs.pop("blocked", graph.blocked is not None),
+        "hybrid": from_edges_kwargs.pop("hybrid", graph.hybrid is not None),
+        "source_csr": from_edges_kwargs.pop("source_csr",
+                                            graph.src_eid is not None),
     }
+    defer_layouts = bool(extra_nodes)
+    if not defer_layouts:
+        from_edges_kwargs.update(layout_kw)
     g2 = from_edges(senders, receivers, n_eff, **from_edges_kwargs)
     # from_edges marks [0, n_eff) all-alive; re-apply the real liveness
     # (failed nodes stay failed; ids beyond the old padding stay dead).
@@ -332,10 +341,11 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
     if extra_edges or extra_nodes:
         g2 = with_capacity(g2, extra_edges=extra_edges,
                            extra_nodes=extra_nodes)
-    if layout_kw.get("blocked"):
-        g2 = g2.with_blocked()
-    if layout_kw.get("hybrid"):
-        g2 = g2.with_hybrid()
-    if layout_kw.get("source_csr"):
-        g2 = g2.with_source_csr()
+    if defer_layouts:
+        if layout_kw["blocked"]:
+            g2 = g2.with_blocked()
+        if layout_kw["hybrid"]:
+            g2 = g2.with_hybrid()
+        if layout_kw["source_csr"]:
+            g2 = g2.with_source_csr()
     return g2

@@ -271,3 +271,16 @@ class TestMeshBackedValueProtocols:
             JaxSimNode(graph=g, protocol=PageRank(), seed=1,
                        mesh=M.ring_mesh(4)).run_until_converged("rank_max",
                                                                 0.5)
+
+    def test_flood_adaptive_coverage_matches(self):
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0)
+        b = JaxSimNode(graph=g, protocol=Flood(source=0), seed=0,
+                       mesh=M.ring_mesh(8), adaptive_k=64)
+        out_a = a.run_until_coverage(0.99)
+        out_b = b.run_until_coverage(0.99)
+        assert out_a == out_b
+        np.testing.assert_array_equal(
+            np.asarray(b.sim_state[0]).reshape(-1),
+            np.asarray(a.sim_state.seen),
+        )
