@@ -2040,13 +2040,14 @@ def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
     return ranks, out
 
 
-def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
-                         bkt_src, bkt_dst, bkt_mask,
-                         dyn_src, dyn_dst, dyn_mask,
-                         mxu_src, mxu_dst, mxu_mask, diag_masks,
-                         node_mask, out_degree, s0, w0, rounds):
-    """Per-shard body: ``rounds`` push-sum rounds (models/pushsum.py
-    arithmetic — mass split over out-edges, two ring sums per round)."""
+def _make_pushsum_round(axis_name, S, block, pieces, mxu_block,
+                        bkt_src, bkt_dst, bkt_mask,
+                        dyn_src, dyn_dst, dyn_mask,
+                        mxu_src, mxu_dst, mxu_mask, diag_masks,
+                        node_mask, out_degree):
+    """Build the per-shard push-sum round closure (models/pushsum.py
+    arithmetic — mass split over out-edges, two ring sums per round),
+    shared by the fixed-rounds scan and the run-to-variance while_loop."""
     pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
@@ -2062,8 +2063,7 @@ def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
         jnp.sum(jnp.where(node_mask_b, deg, 0)), axis_name
     )
 
-    def one_round(carry, _):
-        s, w = carry
+    def one_round(s, w):
         s_share = s * shares
         w_share = w * shares
         s = (s_share + pass_(s_share)) * mask_f
@@ -2080,11 +2080,102 @@ def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
             "variance": var,
             "mean": mean,
         }
+        return s, w, stats
+
+    return one_round
+
+
+def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
+                         bkt_src, bkt_dst, bkt_mask,
+                         dyn_src, dyn_dst, dyn_mask,
+                         mxu_src, mxu_dst, mxu_mask, diag_masks,
+                         node_mask, out_degree, s0, w0, rounds):
+    """Per-shard body: ``rounds`` push-sum rounds."""
+    one_round = _make_pushsum_round(
+        axis_name, S, block, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
+    )
+
+    def body(carry, _):
+        s, w, stats = one_round(*carry)
         return (s, w), stats
 
-    (s, w), stats = jax.lax.scan(one_round, (s0[0], w0[0]), None,
-                                 length=rounds)
+    (s, w), stats = jax.lax.scan(body, (s0[0], w0[0]), None, length=rounds)
     return s[None], w[None], stats
+
+
+def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block,
+                           tol, max_rounds,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks,
+                           node_mask, out_degree, s0, w0):
+    """Per-shard body: push-sum until the estimate variance drops below
+    ``tol`` — engine.run_until_converged's measurement on the multi-chip
+    path, with the packed single-transfer summary."""
+    one_round = _make_pushsum_round(
+        axis_name, S, block, pieces, mxu_block,
+        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
+    )
+
+    def cond(carry):
+        _, _, rounds, var, _, _ = carry
+        return (var >= tol) & (rounds < max_rounds)
+
+    def body(carry):
+        s, w, rounds, _, hi, lo = carry
+        s, w, stats = one_round(s, w)
+        hi, lo = accum.add((hi, lo), stats["messages"])
+        return s, w, rounds + 1, stats["variance"], hi, lo
+
+    init = (s0[0], w0[0], jnp.int32(0), jnp.float32(jnp.inf), *accum.zero())
+    s, w, rounds, var, hi, lo = jax.lax.while_loop(cond, body, init)
+    return s[None], w[None], accum.pack_summary(rounds, var, (hi, lo))
+
+
+@functools.lru_cache(maxsize=64)
+def _pushsum_variance_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                         max_rounds: int, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_variance_pushsum, axis_name, S, block,
+                             pieces, mxu_block)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda tol, *args: body(tol, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 14,
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def pushsum_until_variance(sg: ShardedGraph, mesh: Mesh, protocol,
+                           key: jax.Array, *,
+                           tol: float = 1e-9, max_rounds: int = 1024,
+                           axis_name: str = DEFAULT_AXIS, state0=None):
+    """Run push-sum until the estimate variance drops below ``tol`` — the
+    consensus-reached measurement (engine.run_until_converged with
+    stat="variance"), multi-chip. Returns ``((s, w), dict(rounds, value,
+    messages))`` with ``value`` the final variance."""
+    S, block = sg.n_shards, sg.block
+    if state0 is None:
+        state0 = init_state(sg, protocol, key)
+    s0, w0 = state0
+    fn = _pushsum_variance_fn(mesh, axis_name, S, block, max_rounds,
+                              sg.diag_pieces, sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    s, w, packed = fn(
+        jnp.float32(tol),
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, s0, w0,
+    )
+    out = accum.unpack_summary(packed)
+    out["value"] = out.pop("coverage")
+    return (s, w), out
 
 
 @functools.lru_cache(maxsize=64)

@@ -337,20 +337,28 @@ class JaxSimNode(Node):
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
         if self.sim_mesh is not None:
             from p2pnetwork_tpu.models.pagerank import PageRank
+            from p2pnetwork_tpu.models.pushsum import PushSum
             from p2pnetwork_tpu.parallel import sharded
 
-            if not (isinstance(self.sim_protocol, PageRank)
-                    and stat == "residual"):
+            if isinstance(self.sim_protocol, PageRank) and stat == "residual":
+                self.sim_state, out = sharded.pagerank_until_residual(
+                    self.sim_sharded, self.sim_mesh, self.sim_protocol,
+                    tol=threshold, max_rounds=max_rounds,
+                    ranks0=self.sim_state,
+                )
+            elif isinstance(self.sim_protocol, PushSum) and stat == "variance":
+                self.sim_state, out = sharded.pushsum_until_variance(
+                    self.sim_sharded, self.sim_mesh, self.sim_protocol,
+                    seg_key, tol=threshold, max_rounds=max_rounds,
+                    state0=self.sim_state,
+                )
+            else:
                 raise ValueError(
                     "run_until_converged on the sharded backend implements "
-                    "PageRank with stat='residual'; run other protocols on "
-                    "the single-device backend or step them with run_rounds"
+                    "PageRank (stat='residual') and PushSum "
+                    "(stat='variance'); run other protocols on the "
+                    "single-device backend or step them with run_rounds"
                 )
-            self.sim_state, out = sharded.pagerank_until_residual(
-                self.sim_sharded, self.sim_mesh, self.sim_protocol,
-                tol=threshold, max_rounds=max_rounds,
-                ranks0=self.sim_state,
-            )
         else:
             self.sim_state, out = engine.run_until_converged(
                 self.sim_graph, self.sim_protocol, seg_key, stat=stat,

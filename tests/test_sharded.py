@@ -1167,3 +1167,27 @@ class TestShardedPageRankResidual:
             np.asarray(ranks).reshape(-1)[: g.n_nodes],
             np.asarray(ref_ranks)[: g.n_nodes], rtol=1e-4, atol=1e-9,
         )
+
+
+class TestShardedPushSumVariance:
+    @pytest.mark.parametrize("n_shards", [1, 8])
+    def test_matches_engine_loop(self, n_shards):
+        from p2pnetwork_tpu.models import PushSum
+
+        g = G.watts_strogatz(1024, 8, 0.1, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        key = jax.random.key(4)
+        (s, w), out = sharded.pushsum_until_variance(
+            sg, mesh, PushSum(), key, tol=1e-9
+        )
+        _, ref = engine.run_until_converged(
+            g, PushSum(), key, stat="variance", threshold=1e-9
+        )
+        # f32 summation order differs; the loop may exit a round apart.
+        assert abs(out["rounds"] - ref["rounds"]) <= 1
+        assert out["value"] < 1e-9
+        # Conservation held all the way to consensus.
+        s0 = np.asarray(sharded.init_state(sg, PushSum(), key)[0]).sum()
+        np.testing.assert_allclose(np.asarray(s).sum(), s0, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(w).sum(), g.n_nodes, rtol=1e-5)

@@ -284,3 +284,15 @@ class TestMeshBackedValueProtocols:
             np.asarray(b.sim_state[0]).reshape(-1),
             np.asarray(a.sim_state.seen),
         )
+
+    def test_pushsum_run_until_converged(self):
+        from p2pnetwork_tpu.models import PushSum
+
+        g = _graph()
+        a = JaxSimNode(graph=g, protocol=PushSum(), seed=4)
+        b = JaxSimNode(graph=g, protocol=PushSum(), seed=4,
+                       mesh=M.ring_mesh(8))
+        out_a = a.run_until_converged("variance", 1e-9)
+        out_b = b.run_until_converged("variance", 1e-9)
+        assert out_a["value"] < 1e-9 and out_b["value"] < 1e-9
+        assert abs(out_a["rounds"] - out_b["rounds"]) <= 1
