@@ -9,7 +9,6 @@ dataclasses with defaults chosen for parity, no argparse/env/yaml machinery.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
 
 
 @dataclasses.dataclass

@@ -12,7 +12,6 @@ the BASELINE.json north-star workload.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -28,7 +28,7 @@ from __future__ import annotations
 import asyncio
 import socket
 import threading
-from typing import Any, Callable, List, Optional, Union
+from typing import Callable, List, Optional, Union
 
 from p2pnetwork_tpu.config import NodeConfig
 from p2pnetwork_tpu.nodeconnection import NodeConnection

@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS, ring_mesh
+from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
 from p2pnetwork_tpu.sim.graph import Graph, _round_up
 from p2pnetwork_tpu.utils import accum
 

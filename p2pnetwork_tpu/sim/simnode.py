@@ -331,8 +331,9 @@ class JaxSimNode(Node):
         """Device-side run-to-convergence continuing from the current state
         (engine.run_until_converged): advance until ``stats[stat]`` drops
         below ``threshold`` — PageRank to a residual, PushSum/Gossip to a
-        variance. On the mesh backend, PageRank rides the multi-chip
-        residual loop (sharded.pagerank_until_residual)."""
+        variance. On the mesh backend, PageRank (stat='residual') and
+        PushSum (stat='variance') ride the multi-chip loops
+        (sharded.pagerank_until_residual / pushsum_until_variance)."""
         self._require_sim()
         seg_key = jax.random.fold_in(self._sim_key, self.sim_round)
         if self.sim_mesh is not None:

@@ -38,7 +38,7 @@ import bz2
 import json
 import lzma
 import zlib
-from typing import Iterator, Optional, Union
+from typing import Iterator, Union
 
 Payload = Union[str, dict, list, bytes]
 

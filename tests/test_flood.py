@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
-import jax.numpy as jnp  # noqa: E402
 import networkx as nx  # noqa: E402
 
 from p2pnetwork_tpu.models.flood import Flood  # noqa: E402

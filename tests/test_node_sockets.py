@@ -7,9 +7,8 @@ hard-coded sleeps: nodes bind ephemeral ports and tests wait on observable
 conditions. Reconnection, which the reference leaves as a TODO
 [ref: tests/test_node.py:5], is tested here too."""
 
-import pytest
 
-from p2pnetwork_tpu import Node, NodeConfig, NodeConnection
+from p2pnetwork_tpu import Node, NodeConfig
 from tests.helpers import EventRecorder, stop_all, wait_until
 
 

@@ -5,7 +5,7 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
-from p2pnetwork_tpu.models import SIR, Flood, Gossip  # noqa: E402
+from p2pnetwork_tpu.models import SIR, Gossip  # noqa: E402
 from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE  # noqa: E402
 from p2pnetwork_tpu.sim import engine  # noqa: E402
 from p2pnetwork_tpu.sim import graph as G  # noqa: E402

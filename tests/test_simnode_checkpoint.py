@@ -7,9 +7,8 @@ jax = pytest.importorskip("jax")
 
 from p2pnetwork_tpu.models import SIR, Flood  # noqa: E402
 from p2pnetwork_tpu.sim import checkpoint as ckpt  # noqa: E402
-from p2pnetwork_tpu.sim import engine  # noqa: E402
 from p2pnetwork_tpu.sim import graph as G  # noqa: E402
-from p2pnetwork_tpu.sim.simnode import JaxSimNode, SimPeer  # noqa: E402
+from p2pnetwork_tpu.sim.simnode import JaxSimNode  # noqa: E402
 from tests.helpers import EventRecorder, stop_all, wait_until  # noqa: E402
 
 
