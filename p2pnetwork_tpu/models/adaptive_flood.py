@@ -13,29 +13,39 @@ in wasted gather cycles.
 ``AdaptiveFlood`` keeps TWO round implementations behind one
 ``lax.cond``, chosen per round by the live frontier count:
 
-- **sparse** (``count <= k``): the frontier lives as an index list
-  ``[k]``. One round gathers the ≤ ``k * max_out_span`` out-edge slots
-  through the graph's source-CSR view (graph.py ``src_eid``/
-  ``src_offsets``), re-checks runtime edge liveness through
-  ``edge_mask``, folds in the dynamic (runtime-connected) edge region,
-  dedups new receivers with a scatter-min claim pass, and scatter-marks
-  them seen — O(k·W) work instead of O(E).
-- **dense** (``count > k``): exactly models/flood.py's masked OR round
-  (same ``method`` lowerings). When the wave shrinks back under ``k``,
-  the branch pays one ``nonzero`` compaction to re-enter sparse mode.
+- **sparse** (item count ``<= k``): the frontier lives as a list of ``k``
+  fixed-width WORK ITEMS, each a ``(node, slice)`` pair naming one
+  ``W``-wide slice of that node's out-edge row in the graph's source-CSR
+  view (graph.py ``src_eid``/``src_offsets``). A quasi-regular node is one
+  item; a hub with out-degree ``d`` chunks into ``ceil(d/W)`` items, so
+  the round's gather is always exactly ``k·W`` slots — independent of the
+  largest degree. One round gathers those slots, re-checks runtime edge
+  liveness through ``edge_mask``, folds in the dynamic
+  (runtime-connected) edge region, dedups new receivers with a
+  scatter-min claim pass, scatter-marks them seen, and expands the
+  winners back into work items (cumsum + searchsorted, O(k log k)).
+- **dense** (item count ``> k``): exactly models/flood.py's masked OR
+  round (same ``method`` lowerings). When the wave's out-edge mass
+  shrinks back under ``k`` items, the branch pays one ``nonzero``
+  compaction to re-enter sparse mode.
+
+Because the sparse/dense switch budgets by the frontier's out-edge MASS
+(in ``W``-slice units), not its node count, a single hub waking up is
+charged for its whole row and tips the round dense when that is cheaper —
+degree-skewed (Barabási–Albert) graphs get the same adaptive win as the
+quasi-regular families instead of being excluded.
 
 State is a strict superset of FloodState (``seen``/``frontier`` bools
-plus the index list and its count). Results are
+plus the work-item lists and the item count). Results are
 bit-identical to ``Flood`` — same seen sets, same per-round message and
 coverage stats (tests/test_adaptive_flood.py asserts this through dense,
-sparse, and both transition directions, under failures and runtime
-connects).
+sparse, and both transition directions, under failures, runtime
+connects, and on hub-skewed graphs).
 
 Requires a graph built with ``source_csr=True`` (or
-``with_source_csr()``). Degree-skewed graphs bound the slot width by
-their largest out-degree: a Barabási–Albert hub makes ``k * max_out_span``
-rival the edge count, so this protocol targets the quasi-regular
-topologies (WS lattices, rings, ER) where the benchmark family lives.
+``with_source_csr()``). ``slice_width`` pins ``W`` explicitly; the
+default 0 picks ``min(max_out_span, 128)`` — on quasi-regular graphs
+(WS, ring, ER) that is one item per node, the pre-chunking layout.
 """
 
 from __future__ import annotations
@@ -55,26 +65,30 @@ from p2pnetwork_tpu.sim.graph import Graph
 class AdaptiveFloodState:
     seen: jax.Array  # bool[N_pad]
     frontier: jax.Array  # bool[N_pad] — nodes that first saw it last round
-    fidx: jax.Array  # i32[k] — frontier as indices (valid iff fcount <= k)
-    fcount: jax.Array  # i32[] — live frontier size (always exact)
+    fidx: jax.Array  # i32[k] — work-item node ids (valid iff fcount <= k)
+    fslice: jax.Array  # i32[k] — work-item slice index within the node's row
+    fcount: jax.Array  # i32[] — frontier out-edge mass in W-slice work items
 
 
 @dataclasses.dataclass(frozen=True, unsafe_hash=True)
 class AdaptiveFlood:
     """Single-source flood with frontier-sparse small rounds.
 
-    ``k`` is the sparse-mode capacity (index-list width, a compile-time
-    shape); ``method`` picks the dense round's aggregation lowering."""
+    ``k`` is the sparse-mode capacity in work items (a compile-time
+    shape); ``method`` picks the dense round's aggregation lowering;
+    ``slice_width`` is the per-item row-slice width W (0 = auto:
+    ``min(max_out_span, 128)``)."""
 
     source: int = 0
     method: str = "auto"
     k: int = 1024
+    slice_width: int = 0
 
     def init(self, graph: Graph, key: jax.Array) -> AdaptiveFloodState:
-        seed, fidx, count = _wave_seed(graph, self.source, self.k,
-                                       "AdaptiveFlood")
+        seed, fidx, fslice, count = _wave_seed(
+            graph, self.source, self.k, self.slice_width, "AdaptiveFlood")
         return AdaptiveFloodState(seen=seed, frontier=seed, fidx=fidx,
-                                  fcount=count)
+                                  fslice=fslice, fcount=count)
 
     def coverage(self, graph: Graph, state: AdaptiveFloodState) -> jax.Array:
         """Live-node coverage (Flood.coverage parity)."""
@@ -82,12 +96,14 @@ class AdaptiveFlood:
         return jnp.sum(state.seen & graph.node_mask) / n_real
 
     def step(self, graph: Graph, state: AdaptiveFloodState, key: jax.Array):
-        seen, frontier, fidx, fcount, msgs = _wave_step(
-            graph, self.k, self.method,
-            state.seen, state.frontier, state.fidx, state.fcount,
+        seen, frontier, fidx, fslice, fcount, ncount, msgs = _wave_step(
+            graph, self.k, self.slice_width, self.method,
+            state.seen, state.frontier, state.fidx, state.fslice,
+            state.fcount,
         )
         new_state = AdaptiveFloodState(seen=seen, frontier=frontier,
-                                       fidx=fidx, fcount=fcount)
+                                       fidx=fidx, fslice=fslice,
+                                       fcount=fcount)
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         stats = {
             "messages": msgs,
@@ -95,7 +111,7 @@ class AdaptiveFlood:
             # reduce is nearly free, and it stays exact across mid-run
             # node failures (models/flood.py parity).
             "coverage": jnp.sum(seen & graph.node_mask) / n_real,
-            "frontier": fcount,
+            "frontier": ncount,
         }
         return new_state, stats
 
@@ -103,19 +119,64 @@ class AdaptiveFlood:
 # --------------------------------------------------- shared wave rounds
 
 
-def _sparse_wave_round(graph: Graph, k: int, seen, frontier, fidx, fcount):
-    """One frontier-sparse wave round: O(k·max_out_span) work via the
-    source-CSR view. Returns ``(seen, frontier, fidx, new_count, msgs)``."""
-    w = max(graph.max_out_span, 1)
+def _slice_width(graph: Graph, slice_width: int) -> int:
+    """Resolve W, the per-work-item row-slice width. Auto (0) keeps one
+    item per node on quasi-regular graphs and chunks anything wider than
+    128 — a hub then costs ceil(d/W) items instead of widening every
+    item's gather to the hub's degree."""
+    if slice_width > 0:
+        return slice_width
+    return max(1, min(graph.max_out_span, 128))
+
+
+def _row_items(graph: Graph, w: int, nodes) -> jax.Array:
+    """Work items per node: its build-time CSR row in W-wide slices.
+    Empty rows still cost one (empty) item so every frontier node owns a
+    slice-0 item — message accounting reads out_degree through those."""
+    row_len = graph.src_offsets[nodes + 1] - graph.src_offsets[nodes]
+    return jnp.maximum((row_len + w - 1) // w, 1).astype(jnp.int32)
+
+
+def _expand_items(graph: Graph, w: int, k: int, wnode, node_count):
+    """Expand ``node_count`` frontier nodes (``wnode``, width-k list) into
+    ``(fidx, fslice, icount)`` work items: per-node counts -> cumsum ->
+    searchsorted assigns each of the k item slots its owning node and
+    slice index. O(k log k); never touches N or E. An ``icount > k``
+    result truncates silently — dense mode takes over and the lists are
+    never read (same overflow contract as the node lists had)."""
+    pad_node = graph.n_nodes_padded - 1
+    items_per = jnp.where(jnp.arange(k) < node_count,
+                          _row_items(graph, w, wnode), 0)
+    offs = jnp.cumsum(items_per)
+    icount = offs[-1].astype(jnp.int32)
+    starts = offs - items_per
+    p = jnp.arange(k, dtype=jnp.int32)
+    j = jnp.clip(jnp.searchsorted(offs, p, side="right"), 0, k - 1)
+    valid = p < icount
+    fidx = jnp.where(valid, wnode[j], pad_node)
+    fslice = jnp.where(valid, p - starts[j], 0).astype(jnp.int32)
+    return fidx, fslice, icount
+
+
+def _sparse_wave_round(graph: Graph, w: int, k: int, seen, frontier, fidx,
+                       fslice, fcount):
+    """One frontier-sparse wave round: exactly k·W gathered slots via the
+    source-CSR view, whatever the degree distribution. Returns
+    ``(seen, frontier, fidx, fslice, icount, node_count, msgs)``."""
     n_pad = graph.n_nodes_padded
     pad_node = n_pad - 1
 
     fvalid = jnp.arange(k) < fcount
     f = jnp.where(fvalid, fidx, pad_node)
-    base_off = graph.src_offsets[f]  # [k]
-    row_len = graph.src_offsets[f + 1] - base_off  # [k] build-time extent
+    # Each frontier node owns exactly one slice-0 item (empty rows
+    # included, _row_items), so counting out_degree through those matches
+    # frontier_messages' dense accounting send for send. Must read the
+    # INCOMING lists — fidx/fslice are rebuilt for the next round below.
+    msgs = jnp.sum(jnp.where(fvalid & (fslice == 0), graph.out_degree[f], 0))
+    base_off = graph.src_offsets[f] + fslice * w  # [k] slice start
+    row_end = graph.src_offsets[f + 1]  # [k] build-time row end
     slot = base_off[:, None] + jnp.arange(w)[None, :]  # [k, w]
-    svalid = (jnp.arange(w)[None, :] < row_len[:, None]) & fvalid[:, None]
+    svalid = (slot < row_end[:, None]) & fvalid[:, None]
     eid = graph.src_eid[jnp.where(svalid, slot, graph.n_edges_padded - 1)]
     # Runtime liveness re-check: failed edges (sim/failures.py) stay in
     # the build-time CSR rows but are masked here.
@@ -142,69 +203,91 @@ def _sparse_wave_round(graph: Graph, k: int, seen, frontier, fidx, fcount):
         claim, mode="drop"
     )
     winner = fresh & (scratch[cand] == order)
-    new_count = jnp.sum(winner).astype(jnp.int32)
+    node_count = jnp.sum(winner).astype(jnp.int32)
 
     seen = seen.at[jnp.where(fresh, cand, n_pad)].set(True, mode="drop")
     new_frontier = (
         jnp.zeros(n_pad, dtype=bool)
         .at[jnp.where(winner, cand, n_pad)].set(True, mode="drop")
     )
-    # Next index list: compact the winners (O(k·w) cumsum, not O(N)).
-    # Overflow past k only happens when new_count > k — dense mode
-    # takes over and the truncated list is never read.
+    # Next work-item lists: compact the winner nodes (O(k·w) nonzero over
+    # the candidate slots, not O(N)), then expand into W-slices. A
+    # node_count > k frontier truncates — but then icount > k too, dense
+    # mode takes over, and the truncated lists are never read.
     pos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
-    fidx = jnp.where(jnp.arange(k) < new_count, cand[pos], pad_node)
+    wnode = jnp.where(jnp.arange(k) < node_count, cand[pos], pad_node)
+    fidx, fslice, icount = _expand_items(graph, w, k, wnode, node_count)
+    # Guard the truncation case: cand[pos] repeats the fill slot when
+    # node_count > k, which could alias a real node's row and undercount
+    # icount back under k. Saturate instead so dense mode takes over.
+    icount = jnp.where(node_count > k, jnp.int32(k + 1), icount)
+    return seen, new_frontier, fidx, fslice, icount, node_count, msgs
 
-    msgs = jnp.sum(jnp.where(fvalid, graph.out_degree[f], 0))
-    return seen, new_frontier, fidx, new_count, msgs
 
-
-def _dense_wave_round(graph: Graph, k: int, method: str, seen, frontier,
-                      fidx):
+def _dense_wave_round(graph: Graph, w: int, k: int, method: str, seen,
+                      frontier, fidx, fslice):
     """One dense wave round (models/flood.py's masked OR), maintaining the
-    sparse index list on the crossing back under ``k``."""
+    sparse work-item lists on the crossing back under ``k`` items."""
     delivered = segment.propagate_or(graph, frontier, method)
     new = delivered & ~seen & graph.node_mask
     seen = seen | new
-    new_count = jnp.sum(new).astype(jnp.int32)
+    node_count = jnp.sum(new).astype(jnp.int32)
+    # Frontier out-edge mass in W-slice items — fused O(N) elementwise +
+    # reduce, nearly free next to the propagate. This is what decides
+    # sparse re-entry: a frontier of few-but-hub nodes stays dense.
+    items_all = _row_items(graph, w, jnp.arange(graph.n_nodes_padded))
+    icount = jnp.sum(jnp.where(new, items_all, 0)).astype(jnp.int32)
 
     # Re-enter sparse mode: pay the O(N) compaction only on the round
-    # that crosses back under k (lax.cond executes one branch).
+    # that crosses back under k items (lax.cond executes one branch).
     def compact(n):
-        return jnp.nonzero(
+        wnode = jnp.nonzero(
             n, size=k, fill_value=graph.n_nodes_padded - 1
         )[0].astype(jnp.int32)
+        out_fidx, out_fslice, _ = _expand_items(graph, w, k, wnode,
+                                                node_count)
+        return out_fidx, out_fslice
 
-    fidx = jax.lax.cond(new_count <= k, compact, lambda n: fidx, new)
+    fidx, fslice = jax.lax.cond(
+        icount <= k, compact, lambda n: (fidx, fslice), new)
     msgs = segment.frontier_messages(graph, frontier)
-    return seen, new, fidx, new_count, msgs
+    return seen, new, fidx, fslice, icount, node_count, msgs
 
 
-def _wave_seed(graph: Graph, source: int, k: int, proto_name: str):
+def _wave_seed(graph: Graph, source: int, k: int, slice_width: int,
+               proto_name: str):
     """Validated seed shared by the adaptive protocols: the source's
-    one-hot (masked by liveness), the fidx sentinel list, and the count."""
+    one-hot (masked by liveness), its work-item lists, and the item
+    count."""
     base.validate_source(graph, source)
     if graph.src_eid is None:
         raise ValueError(
             f"{proto_name} requires a source-CSR graph — build with "
             f"from_edges(source_csr=True) or graph.with_source_csr()"
         )
+    w = _slice_width(graph, slice_width)
     seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[source].set(True)
     seed = seed & graph.node_mask
-    fidx = jnp.full(k, graph.n_nodes_padded - 1, dtype=jnp.int32)
-    fidx = fidx.at[0].set(source)
-    return seed, fidx, jnp.sum(seed).astype(jnp.int32)
+    wnode = jnp.full(k, graph.n_nodes_padded - 1, dtype=jnp.int32)
+    wnode = wnode.at[0].set(source)
+    node_count = jnp.sum(seed).astype(jnp.int32)
+    fidx, fslice, icount = _expand_items(graph, w, k, wnode, node_count)
+    return seed, fidx, fslice, icount
 
 
-def _wave_step(graph: Graph, k: int, method: str, seen, frontier, fidx,
-               fcount):
+def _wave_step(graph: Graph, k: int, slice_width: int, method: str, seen,
+               frontier, fidx, fslice, fcount):
     """Adaptive wave round: lax.cond picks sparse vs dense by the live
-    frontier count. Shared by AdaptiveFlood and AdaptiveHopDistance."""
+    frontier's out-edge mass in work items. Shared by AdaptiveFlood and
+    AdaptiveHopDistance."""
+    w = _slice_width(graph, slice_width)
     return jax.lax.cond(
         fcount <= k,
-        lambda s, f, i: _sparse_wave_round(graph, k, s, f, i, fcount),
-        lambda s, f, i: _dense_wave_round(graph, k, method, s, f, i),
-        seen, frontier, fidx,
+        lambda s, f, i, sl: _sparse_wave_round(graph, w, k, s, f, i, sl,
+                                               fcount),
+        lambda s, f, i, sl: _dense_wave_round(graph, w, k, method, s, f,
+                                              i, sl),
+        seen, frontier, fidx, fslice,
     )
 
 
@@ -214,7 +297,8 @@ class AdaptiveHopDistanceState:
     dist: jax.Array  # i32[N_pad] — BFS hops from source, -1 = not reached
     frontier: jax.Array  # bool[N_pad]
     fidx: jax.Array  # i32[k]
-    fcount: jax.Array  # i32[]
+    fslice: jax.Array  # i32[k]
+    fcount: jax.Array  # i32[] — item count (W-slice out-edge mass)
     round: jax.Array  # i32[]
 
 
@@ -227,13 +311,15 @@ class AdaptiveHopDistance:
     source: int = 0
     method: str = "auto"
     k: int = 1024
+    slice_width: int = 0
 
     def init(self, graph: Graph, key: jax.Array) -> AdaptiveHopDistanceState:
-        seed, fidx, count = _wave_seed(graph, self.source, self.k,
-                                       "AdaptiveHopDistance")
+        seed, fidx, fslice, count = _wave_seed(
+            graph, self.source, self.k, self.slice_width,
+            "AdaptiveHopDistance")
         return AdaptiveHopDistanceState(
             dist=jnp.where(seed, 0, -1).astype(jnp.int32), frontier=seed,
-            fidx=fidx, fcount=count, round=jnp.int32(0),
+            fidx=fidx, fslice=fslice, fcount=count, round=jnp.int32(0),
         )
 
     def coverage(self, graph: Graph, state) -> jax.Array:
@@ -244,9 +330,9 @@ class AdaptiveHopDistance:
     def step(self, graph: Graph, state: AdaptiveHopDistanceState,
              key: jax.Array):
         seen = state.dist >= 0
-        seen2, frontier, fidx, fcount, msgs = _wave_step(
-            graph, self.k, self.method,
-            seen, state.frontier, state.fidx, state.fcount,
+        seen2, frontier, fidx, fslice, fcount, ncount, msgs = _wave_step(
+            graph, self.k, self.slice_width, self.method,
+            seen, state.frontier, state.fidx, state.fslice, state.fcount,
         )
         rnd = state.round + 1
         dist = jnp.where(frontier, rnd, state.dist)
@@ -255,9 +341,9 @@ class AdaptiveHopDistance:
         stats = {
             "messages": msgs,
             "coverage": jnp.sum(reached) / n_real,
-            "frontier": fcount,
+            "frontier": ncount,
             "max_dist": jnp.max(dist),
         }
         return AdaptiveHopDistanceState(dist=dist, frontier=frontier,
-                                        fidx=fidx, fcount=fcount,
-                                        round=rnd), stats
+                                        fidx=fidx, fslice=fslice,
+                                        fcount=fcount, round=rnd), stats
