@@ -2,7 +2,10 @@
 
 The capability shown in the reference's examples/my_own_p2p_application.py:
 three nodes on localhost, a small topology, broadcasts observed via
-subclass hooks. Run: ``python examples/my_p2p_application.py``
+subclass hooks. The node class lives in its own importable module
+(examples/my_peer2peer_node.py), mirroring the reference's documented app
+structure [ref: examples/MyOwnPeer2PeerNode.py].
+Run: ``python examples/my_p2p_application.py``
 """
 
 import sys
@@ -10,23 +13,7 @@ import time
 
 sys.path.insert(0, ".")
 
-from p2pnetwork_tpu import Node
-
-
-class MyNode(Node):
-    """Subclass-style extension: override the event hooks you care about."""
-
-    def inbound_node_connected(self, node):
-        print(f"  [{self.id}] peer connected: {node.id}")
-        super().inbound_node_connected(node)
-
-    def node_message(self, node, data):
-        print(f"  [{self.id}] message from {node.id}: {data!r}")
-        super().node_message(node, data)
-
-    def inbound_node_disconnected(self, node):
-        print(f"  [{self.id}] peer left: {node.id}")
-        super().inbound_node_disconnected(node)
+from examples.my_peer2peer_node import MyPeer2PeerNode as MyNode
 
 
 def main():

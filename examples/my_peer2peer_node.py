@@ -1,0 +1,60 @@
+"""A standalone, importable Node subclass — the documented way to
+structure an application on this framework.
+
+The reference ships the same pattern as its own module
+[ref: examples/MyOwnPeer2PeerNode.py:7-34, described in
+examples/README.md]: put your protocol class in one file, import it from
+your application scripts. Every event hook of the Extension API
+[ref: p2pnetwork/node.py:282-363] is overridden here so you can see the
+full vocabulary in one place; delete the ones you don't need.
+
+Use it from an application script::
+
+    from examples.my_peer2peer_node import MyPeer2PeerNode
+
+    node = MyPeer2PeerNode("127.0.0.1", 0)
+    node.start()
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu import Node
+
+
+class MyPeer2PeerNode(Node):
+    """Your protocol lives in these hooks; each falls through to the base
+    implementation so the callback channel and event log keep working."""
+
+    def __init__(self, host, port, id=None):
+        super().__init__(host, port, id)
+        print(f"MyPeer2PeerNode: started on {self.host}:{self.port}")
+
+    def outbound_node_connected(self, node):
+        print(f"[{self.id[:8]}] connected to peer {node.id[:8]}")
+        super().outbound_node_connected(node)
+
+    def inbound_node_connected(self, node):
+        print(f"[{self.id[:8]}] peer {node.id[:8]} connected to us")
+        super().inbound_node_connected(node)
+
+    def inbound_node_disconnected(self, node):
+        print(f"[{self.id[:8]}] inbound peer {node.id[:8]} left")
+        super().inbound_node_disconnected(node)
+
+    def outbound_node_disconnected(self, node):
+        print(f"[{self.id[:8]}] outbound peer {node.id[:8]} left")
+        super().outbound_node_disconnected(node)
+
+    def node_message(self, node, data):
+        print(f"[{self.id[:8]}] message from {node.id[:8]}: {data!r}")
+        super().node_message(node, data)
+
+    def node_disconnect_with_outbound_node(self, node):
+        print(f"[{self.id[:8]}] disconnecting from {node.id[:8]}")
+        super().node_disconnect_with_outbound_node(node)
+
+    def node_request_to_stop(self):
+        print(f"[{self.id[:8]}] stop requested")
+        super().node_request_to_stop()

@@ -36,6 +36,20 @@ class NodeConfig:
     listen_backlog: int = 16
     #: Default text encoding for str/dict payloads.
     encoding: str = "utf-8"
+    #: Frame delimiting: "eot" (reference-compatible 0x04 delimiter; raw
+    #: bytes containing 0x04 corrupt framing, wire.py) or "length"
+    #: (4-byte length prefix — safe for arbitrary binary, both peers must
+    #: opt in; no reference interop).
+    framing: str = "eot"
+
+    def __post_init__(self):
+        # Fail at construction, not deep inside per-connection setup where
+        # the error would surface as a generic connection failure.
+        if self.framing not in ("eot", "length"):
+            raise ValueError(
+                f"unknown framing mode: {self.framing!r} "
+                f"(choose 'eot' or 'length')"
+            )
 
 
 @dataclasses.dataclass

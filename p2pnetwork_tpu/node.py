@@ -35,7 +35,7 @@ from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.utils import EventLog, generate_id
 
 
-class Node:
+class Node(threading.Thread):
     """A peer node: TCP server, peer registry, broadcast, event hooks.
 
     Constructor parity [ref: node.py:32]: ``Node(host, port, id=None,
@@ -44,11 +44,18 @@ class Node:
     so port conflicts surface at construction like the reference's
     ``init_server`` [ref: node.py:92-98]. ``port=0`` binds an ephemeral port
     and stores the chosen one on ``self.port``.
+
+    ``Node`` IS a ``threading.Thread``, like the reference's
+    [ref: node.py:13] — ``isinstance`` checks, ``.name``, ``.daemon`` and
+    ``join``/``is_alive`` behave as applications expect. The thread body
+    (:meth:`run`) hosts the asyncio event loop rather than a blocking
+    accept loop.
     """
 
     def __init__(self, host: str, port: int, id: Optional[str] = None,
                  callback: Optional[Callable] = None, max_connections: int = 0,
                  config: Optional[NodeConfig] = None):
+        super().__init__(name=f"Node({host}:{port})", daemon=True)
         self.host = host
         self.port = port
         self.callback = callback
@@ -84,13 +91,16 @@ class Node:
         self.sock.setblocking(False)
         if self.port == 0:
             self.port = self.sock.getsockname()[1]
+            # Re-stamp the thread name with the resolved ephemeral port so
+            # thread dumps distinguish concurrent port-0 nodes.
+            self.name = f"Node({self.host}:{self.port})"
         print(f"Initialisation of the Node on port: {self.port} on node ({self.id})")
 
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        self._thread: Optional[threading.Thread] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._stop_event: Optional[asyncio.Event] = None
-        self._started = threading.Event()
+        # NOT named _started: threading.Thread owns that attribute.
+        self._ready = threading.Event()
 
     # ------------------------------------------------------------- registry
 
@@ -117,19 +127,17 @@ class Node:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        """Start the node's event loop thread and begin accepting peers.
+        """Start the node's thread and begin accepting peers
+        [ref: node.py:13 — ``Node`` is a ``threading.Thread``].
 
-        The facade for ``threading.Thread.start`` in the reference's
-        inheritance design [ref: node.py:13]."""
-        if self._thread is not None:
-            raise RuntimeError("Node.start: node already started")
-        self._thread = threading.Thread(
-            target=self._run_loop, name=f"Node({self.host}:{self.port})", daemon=True
-        )
-        self._thread.start()
-        self._started.wait()
+        Unlike a bare ``Thread.start``, returns only once the server is
+        accepting (or failed to start), so ``connect_with_node`` right
+        after ``start()`` never races the loop coming up."""
+        super().start()
+        self._ready.wait()
 
-    def _run_loop(self) -> None:
+    def run(self) -> None:
+        """Thread body: host the node's asyncio event loop."""
         asyncio.run(self._main())
 
     async def _main(self) -> None:
@@ -143,9 +151,9 @@ class Node:
             self._server = await asyncio.start_server(self._handle_inbound, sock=self.sock)
         except Exception as e:
             self.debug_print(f"Node: could not start server: {e}")
-            self._started.set()
+            self._ready.set()
             return
-        self._started.set()
+        self._ready.set()
         try:
             while not self._stop_event.is_set():
                 try:
@@ -192,14 +200,7 @@ class Node:
             except RuntimeError:
                 pass  # loop already closed — nothing left to stop
 
-    def join(self, timeout: Optional[float] = None) -> None:
-        """Wait for the node's loop thread to finish (``Thread.join`` facade)."""
-        if self._thread is not None:
-            self._thread.join(timeout)
-
-    def is_alive(self) -> bool:
-        """Whether the node's loop thread is running (``Thread`` facade)."""
-        return self._thread is not None and self._thread.is_alive()
+    # join() and is_alive() are the inherited threading.Thread methods.
 
     # ------------------------------------------------------------- inbound
 

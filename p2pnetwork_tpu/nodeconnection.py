@@ -67,8 +67,14 @@ class NodeConnection:
         # can observe it, like the reference's flag [ref: nodeconnection.py:32].
         self.terminate_flag = threading.Event()
 
-        self._decoder = wire.FrameDecoder(max_buffer=main_node.config.max_recv_buffer)
+        self._decoder = wire.make_decoder(
+            main_node.config.framing,
+            max_buffer=main_node.config.max_recv_buffer,
+        )
         self._task: Optional[asyncio.Task] = None
+        # Set when the transport is known bad (send failure / backpressure
+        # trip): stop() then force-aborts instead of draining gracefully.
+        self._abort = False
 
         self.main_node.debug_print(
             f"NodeConnection.send: Started with client ({self.id}) '{self.host}:{self.port}'"
@@ -141,13 +147,19 @@ class NodeConnection:
             self.main_node.message_count_rerr += 1
             return
         if compression == "none":
-            frame = raw + wire.EOT_CHAR
+            body = raw
         else:
             compressed = self.compress(raw, compression)
             if compressed is None:
                 self.main_node.message_count_rerr += 1
                 return
-            frame = compressed + wire.COMPR_CHAR + wire.EOT_CHAR
+            body = compressed + wire.COMPR_CHAR
+        try:
+            frame = wire.wrap_frame(body, self.main_node.config.framing)
+        except ValueError as e:  # e.g. body beyond the 4-byte length prefix
+            self.main_node.debug_print(f"nodeconnection send: {e}")
+            self.main_node.message_count_rerr += 1
+            return
 
         loop = self.main_node._loop
         if loop is None or loop.is_closed():
@@ -166,8 +178,13 @@ class NodeConnection:
                 self.main_node.debug_print("nodeconnection send: node is not running")
 
     def _write(self, frame: bytes) -> None:
-        """Write one frame on the event loop; failure closes the connection."""
-        if self.terminate_flag.is_set():
+        """Write one frame on the event loop; failure closes the connection.
+
+        Gates on transport state, not ``terminate_flag``: a send queued
+        just before ``stop()`` must still flush during the graceful close
+        (stop sets the flag synchronously, but this callback runs before
+        stop's close callback on the same loop queue)."""
+        if self._abort or self.writer.is_closing():
             return
         try:
             self.writer.write(frame)
@@ -185,7 +202,12 @@ class NodeConnection:
         except Exception as e:
             self.main_node.debug_print(f"nodeconnection send: Error sending data to node: {e}")
             self.main_node.message_count_rerr += 1
-            self.stop()  # "issue #19" policy [ref: nodeconnection.py:123-126]
+            # Failed transports don't drain: a graceful close would wait on
+            # the (possibly never-read) buffer forever, wedging the recv
+            # task. Mark for force-abort, then apply the "issue #19"
+            # close-on-failure policy [ref: nodeconnection.py:123-126].
+            self._abort = True
+            self.stop()
 
     # ------------------------------------------------------- receive lifecycle
 
@@ -267,7 +289,17 @@ class NodeConnection:
 
         def _close():
             try:
-                self.writer.close()
+                transport = self.writer.transport
+                if self._abort and transport is not None:
+                    # The transport already failed (send error or
+                    # max_send_buffer trip): a graceful close would wait
+                    # for a buffer the peer is not draining, so the recv
+                    # task would never see EOF. Drop the buffer and close.
+                    transport.abort()
+                else:
+                    # Graceful: flush anything queued, then FIN — in-flight
+                    # frames sent just before stop() still reach the peer.
+                    self.writer.close()
             except Exception:
                 pass
 
@@ -283,13 +315,29 @@ class NodeConnection:
             except RuntimeError:
                 pass  # loop closed between the check and the post — idempotent
 
-    async def wait_closed(self) -> None:
-        """Await full termination of the receive task (loop-side helper)."""
-        if self._task is not None:
+    async def wait_closed(self, timeout: float = 10.0) -> None:
+        """Await full termination of the receive task (loop-side helper).
+
+        Bounded: a peer that never drains our graceful close would
+        otherwise pin the recv task (no EOF) and wedge ``Node.stop()``;
+        past ``timeout`` the transport is force-aborted."""
+        if self._task is None:
+            return
+        try:
+            await asyncio.wait_for(asyncio.shield(self._task), timeout)
+        except asyncio.TimeoutError:
+            try:
+                transport = self.writer.transport
+                if transport is not None:
+                    transport.abort()
+            except Exception:
+                pass
             try:
                 await self._task
             except Exception:
                 pass
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ info
 

@@ -28,7 +28,11 @@ Deliberate fixes over the reference (SURVEY.md section 2.3):
 Inherited wire-format limitation (kept for interop): raw ``bytes`` payloads
 containing the EOT byte ``0x04`` corrupt framing, exactly as in the
 reference. Sending such payloads with ``compression=`` enabled is safe —
-the base64 alphabet contains no control bytes.
+the base64 alphabet contains no control bytes. Deployments that do not need
+reference interop can instead opt into ``framing="length"``
+(``NodeConfig.framing``): 4-byte big-endian length prefix + body, which
+carries arbitrary binary safely. Both peers must use the same framing; the
+default stays ``"eot"`` (reference-compatible).
 """
 
 from __future__ import annotations
@@ -121,17 +125,44 @@ def encode_payload(data: Payload, encoding: str = "utf-8") -> bytes:
     )
 
 
-def encode_frame(
-    data: Payload, encoding: str = "utf-8", compression: str = "none"
-) -> bytes:
-    """Build one on-wire frame: payload [+ COMPR] + EOT.
+def frame_body(data: Payload, encoding: str = "utf-8",
+               compression: str = "none") -> bytes:
+    """Serialize + optionally compress into a frame body: payload [+ COMPR].
 
-    [ref: nodeconnection.py:117 (plain) and :121 (compressed)].
-    """
+    The body is framing-agnostic — the trailing COMPR marker stays inside
+    it, so :func:`parse_packet` decodes bodies from either framing mode."""
     raw = encode_payload(data, encoding)
     if compression == "none":
-        return raw + EOT_CHAR
-    return compress(raw, compression) + COMPR_CHAR + EOT_CHAR
+        return raw
+    return compress(raw, compression) + COMPR_CHAR
+
+
+def wrap_frame(body: bytes, framing: str = "eot") -> bytes:
+    """Wrap a frame body for the wire — the single place framing rules
+    (and their bounds checks) live; used by :func:`encode_frame` and the
+    connection send path alike."""
+    if framing == "eot":
+        return body + EOT_CHAR
+    if framing == "length":
+        if len(body) > 0xFFFFFFFF:
+            raise ValueError("frame body exceeds the 4-byte length prefix")
+        return len(body).to_bytes(4, "big") + body
+    raise ValueError(f"unknown framing mode: {framing!r} "
+                     f"(choose 'eot' or 'length')")
+
+
+def encode_frame(
+    data: Payload, encoding: str = "utf-8", compression: str = "none",
+    framing: str = "eot",
+) -> bytes:
+    """Build one on-wire frame.
+
+    ``framing="eot"`` (default): body + EOT — byte-compatible with the
+    reference [ref: nodeconnection.py:117 (plain) and :121 (compressed)].
+    ``framing="length"``: 4-byte big-endian length prefix + body — safe for
+    arbitrary binary (no delimiter to corrupt), NOT reference-compatible.
+    """
+    return wrap_frame(frame_body(data, encoding, compression), framing)
 
 
 def parse_packet(packet: bytes) -> Payload:
@@ -205,3 +236,54 @@ class FrameDecoder:
     def pending(self) -> int:
         """Number of buffered bytes not yet terminated by an EOT."""
         return len(self._buffer)
+
+
+class LengthFrameDecoder:
+    """Incremental length-prefixed stream decoder (``framing="length"``).
+
+    Same ``feed``/``pending`` surface as :class:`FrameDecoder`, so the
+    connection layer swaps decoders without caring which framing is active.
+    A declared frame length beyond ``max_buffer`` is rejected immediately
+    (:class:`FrameOverflowError`) — a malicious 4 GiB header cannot make the
+    receiver buffer it first.
+    """
+
+    _HEADER = 4
+
+    def __init__(self, max_buffer: int = 64 * 1024 * 1024):
+        self.max_buffer = max_buffer
+        self._buffer = b""
+
+    def feed(self, chunk: bytes) -> Iterator[bytes]:
+        """Feed a received chunk; yield each complete frame body."""
+        if not chunk:
+            return
+        self._buffer += chunk
+        while len(self._buffer) >= self._HEADER:
+            body_len = int.from_bytes(self._buffer[:self._HEADER], "big")
+            if body_len > self.max_buffer:
+                self._buffer = b""
+                raise FrameOverflowError(
+                    f"declared frame length {body_len} exceeds the "
+                    f"{self.max_buffer}-byte receive bound"
+                )
+            end = self._HEADER + body_len
+            if len(self._buffer) < end:
+                break
+            yield self._buffer[self._HEADER:end]
+            self._buffer = self._buffer[end:]
+
+    @property
+    def pending(self) -> int:
+        """Number of buffered bytes not yet forming a complete frame."""
+        return len(self._buffer)
+
+
+def make_decoder(framing: str, max_buffer: int = 64 * 1024 * 1024):
+    """Decoder for a framing mode: ``"eot"`` or ``"length"``."""
+    if framing == "eot":
+        return FrameDecoder(max_buffer=max_buffer)
+    if framing == "length":
+        return LengthFrameDecoder(max_buffer=max_buffer)
+    raise ValueError(f"unknown framing mode: {framing!r} "
+                     f"(choose 'eot' or 'length')")

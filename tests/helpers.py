@@ -23,11 +23,15 @@ def wait_until(predicate: Callable[[], bool], timeout: float = 5.0,
 
 
 def stop_all(nodes) -> None:
-    """Stop and join a set of nodes (stop() is idempotent by contract)."""
+    """Stop and join a set of nodes (stop() is idempotent by contract).
+
+    Nodes that were never start()ed are only stopped: Node is a real
+    threading.Thread now, and joining an unstarted thread raises."""
     for n in nodes:
         n.stop()
     for n in nodes:
-        n.join(timeout=10.0)
+        if n.ident is not None:
+            n.join(timeout=10.0)
 
 
 class EventRecorder:
@@ -48,3 +52,7 @@ class EventRecorder:
 
     def data_for(self, name: str) -> List:
         return [e[2] for e in self.events if e[0] == name]
+
+    def messages(self) -> List:
+        """Payloads of the node_message events, in delivery order."""
+        return self.data_for("node_message")

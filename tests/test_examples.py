@@ -24,6 +24,7 @@ def test_examples_discovered():
     # demos. A refactor that drops one should fail loudly here.
     for required in (
         "my_p2p_application.py",
+        "my_peer2peer_node.py",
         "callback_application.py",
         "compression_application.py",
         "dict_application.py",

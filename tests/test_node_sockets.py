@@ -345,3 +345,86 @@ class TestReconnect:
             assert wait_until(lambda: client.reconnect_to_nodes == [], timeout=10.0)
         finally:
             stop_all([server, client])
+
+
+class TestThreadParity:
+    """Node IS a threading.Thread, like the reference's
+    [ref: p2pnetwork/node.py:13] — applications may isinstance-check it,
+    read .name/.daemon, and use join/is_alive as Thread methods."""
+
+    def test_node_is_a_thread(self):
+        import threading
+
+        n = Node("127.0.0.1", 0)
+        try:
+            assert isinstance(n, threading.Thread)
+            assert n.daemon  # reference sets daemon threads in examples
+            assert n.name.startswith("Node(")
+            assert not n.is_alive()
+            n.start()
+            assert n.is_alive()
+        finally:
+            stop_all([n])
+        assert wait_until(lambda: not n.is_alive())
+
+    def test_double_start_raises_thread_error(self):
+        n = make_node()
+        try:
+            import pytest
+
+            with pytest.raises(RuntimeError):
+                n.start()  # Thread contract: threads start once
+        finally:
+            stop_all([n])
+
+
+class TestConnectFromHandler:
+    """The documented contract of connect_with_node when called ON the
+    node's own loop (i.e. from an event handler): the attempt is scheduled,
+    the call reports True once the guards pass, and failures surface
+    through outbound_node_connection_error — the reference's error channel
+    [ref: node.py:173-176]."""
+
+    def test_scheduled_connect_failure_fires_error_event(self):
+        rec = EventRecorder()
+        results = []
+
+        def cb(event, main_node, connected_node, data):
+            rec(event, main_node, connected_node, data)
+            if event == "node_message" and data == "go":
+                # Dead port: nothing listens on port 1 on loopback.
+                results.append(main_node.connect_with_node("127.0.0.1", 1))
+
+        n1, n2 = make_node(cb), make_node()
+        try:
+            assert n2.connect_with_node("127.0.0.1", n1.port)
+            assert wait_until(lambda: len(n2.nodes_outbound) == 1)
+            n2.send_to_nodes("go")
+            # The scheduled path returns True immediately (guards passed)...
+            assert wait_until(lambda: results == [True])
+            # ...and the real outcome arrives as the error event.
+            assert wait_until(
+                lambda: "outbound_node_connection_error" in rec.names()
+            )
+            assert len(n1.nodes_outbound) == 0
+        finally:
+            stop_all([n1, n2])
+
+    def test_scheduled_connect_success_fires_connected_event(self):
+        rec = EventRecorder()
+
+        def cb(event, main_node, connected_node, data):
+            rec(event, main_node, connected_node, data)
+            if event == "node_message" and isinstance(data, dict):
+                main_node.connect_with_node("127.0.0.1", data["port"])
+
+        n1, n2, n3 = make_node(cb), make_node(), make_node()
+        try:
+            assert n2.connect_with_node("127.0.0.1", n1.port)
+            assert wait_until(lambda: len(n2.nodes_outbound) == 1)
+            n2.send_to_nodes({"port": n3.port})
+            assert wait_until(lambda: len(n1.nodes_outbound) == 1)
+            assert n1.nodes_outbound[0].id == n3.id
+            assert "outbound_node_connected" in rec.names()
+        finally:
+            stop_all([n1, n2, n3])

@@ -119,3 +119,123 @@ class TestCompressionOverSockets:
             assert client.message_count_rerr >= 1
         finally:
             stop_all([server, client])
+
+
+class TestSendBackpressure:
+    """A peer that stops reading must trip max_send_buffer: the writer
+    treats the over-full transport as a failed send and closes the
+    connection (the close-on-failure policy the reference applies to
+    sendall errors [ref: nodeconnection.py:123-126])."""
+
+    def test_unread_peer_trips_max_send_buffer(self):
+        import socket as socketlib
+
+        cfg = NodeConfig(max_send_buffer=64 * 1024)
+        sender = Node("127.0.0.1", 0, config=cfg)
+        sender.start()
+        # A raw socket that handshakes and then never reads again.
+        raw = socketlib.create_connection(("127.0.0.1", sender.port))
+        try:
+            raw.sendall(b"lazy-peer:12345")
+            assert raw.recv(4096)  # the sender's id — handshake done
+            assert wait_until(lambda: len(sender.nodes_inbound) == 1)
+            conn = sender.nodes_inbound[0]
+            rerr_before = sender.message_count_rerr
+            # Flood far beyond the 64 KiB bound + OS socket buffers while
+            # the peer reads nothing.
+            # Enough volume to blow past kernel send+recv buffers (which can
+            # absorb many MB on loopback) and land in the transport's
+            # user-space buffer where the bound is enforced.
+            chunk = "x" * 65536
+            for _ in range(1500):
+                if conn.terminate_flag.is_set():
+                    break
+                sender.send_to_node(conn, chunk)
+            assert wait_until(lambda: len(sender.nodes_inbound) == 0,
+                              timeout=10.0)
+            assert sender.message_count_rerr > rerr_before
+        finally:
+            raw.close()
+            stop_all([sender])
+
+
+class TestLengthPrefixedFraming:
+    """Opt-in framing="length" (NodeConfig): arbitrary binary — including
+    the EOT byte 0x04 the reference's delimiter framing cannot carry
+    [ref: nodeconnection.py:38] — travels intact."""
+
+    def pair_length(self, recorder):
+        cfg = NodeConfig(framing="length")
+        server = Node("127.0.0.1", 0, callback=recorder,
+                      config=NodeConfig(framing="length"))
+        server.start()
+        client = Node("127.0.0.1", 0, config=cfg)
+        client.start()
+        assert client.connect_with_node("127.0.0.1", server.port)
+        assert wait_until(lambda: len(server.nodes_inbound) == 1)
+        return server, client
+
+    def test_bytes_with_eot_bytes_survive(self):
+        rec = EventRecorder()
+        server, client = self.pair_length(rec)
+        try:
+            # Invalid utf-8 (so the parse chain keeps it as bytes) with
+            # embedded EOT 0x04 bytes (which delimiter framing would split).
+            payload = b"\xff\x04\xfe\x02stuff\x00\x04\xff"
+            client.send_to_nodes(payload)
+            assert wait_until(lambda: payload in rec.messages())
+        finally:
+            stop_all([server, client])
+
+    def test_str_dict_and_compression_roundtrip(self):
+        rec = EventRecorder()
+        server, client = self.pair_length(rec)
+        try:
+            client.send_to_nodes("hello length mode")
+            client.send_to_nodes({"k": [1, 2, 3]}, compression="zlib")
+            assert wait_until(lambda: "hello length mode" in rec.messages())
+            assert wait_until(lambda: {"k": [1, 2, 3]} in rec.messages())
+        finally:
+            stop_all([server, client])
+
+    def test_large_frames_cross_recv_chunks(self):
+        # The reference's large-frame scenario (5x5000 chars,
+        # tests/test_nodeconnection.py:17-77) under the new framing.
+        rec = EventRecorder()
+        server, client = self.pair_length(rec)
+        try:
+            msgs = [str(i) * 5000 for i in range(5)]
+            for m in msgs:
+                client.send_to_nodes(m)
+            assert wait_until(
+                lambda: all(m in rec.messages() for m in msgs), timeout=10.0)
+        finally:
+            stop_all([server, client])
+
+
+class TestCloseSemantics:
+    def test_graceful_stop_delivers_in_flight_frames(self):
+        # stop() right after send: the close must flush, not abort — the
+        # final frame still reaches the peer (abort is reserved for failed
+        # transports, e.g. the max_send_buffer trip).
+        rec = EventRecorder()
+        server, client = pair(rec)
+        try:
+            client.send_to_nodes("last words " * 2000)
+            client.nodes_outbound[0].stop()
+            assert wait_until(lambda: "last words " * 2000 in rec.messages(),
+                              timeout=10.0)
+        finally:
+            stop_all([server, client])
+
+    def test_bad_framing_config_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="framing"):
+            NodeConfig(framing="lenght")
+
+    def test_thread_name_carries_resolved_port(self):
+        n = Node("127.0.0.1", 0)
+        try:
+            assert n.name == f"Node(127.0.0.1:{n.port})"
+            assert n.port != 0
+        finally:
+            stop_all([n])

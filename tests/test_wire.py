@@ -106,3 +106,50 @@ class TestFrameDecoder:
             list(dec.feed(b"x" * 2048))
         # The decoder resets so the connection can report and die cleanly.
         assert dec.pending == 0
+
+
+class TestLengthFraming:
+    """framing="length": 4-byte big-endian prefix + body (wire.py). Not
+    reference-compatible by design; carries arbitrary binary safely."""
+
+    def test_encode_frame_length_mode(self):
+        frame = wire.encode_frame(b"\x04\x02\x00", framing="length")
+        assert frame == (3).to_bytes(4, "big") + b"\x04\x02\x00"
+
+    def test_roundtrip_all_payload_types(self):
+        dec = wire.make_decoder("length")
+        payloads = ["text", {"a": 1}, b"\xff\x04\xfe"]
+        stream = b"".join(
+            wire.encode_frame(p, framing="length") for p in payloads)
+        # Feed byte-by-byte to exercise partial-header and partial-body.
+        out = []
+        for i in range(len(stream)):
+            out.extend(wire.parse_packet(b)
+                       for b in dec.feed(stream[i:i + 1]))
+        assert out == payloads
+        assert dec.pending == 0
+
+    def test_compressed_body_keeps_marker(self):
+        dec = wire.make_decoder("length")
+        frame = wire.encode_frame({"k": 2}, compression="lzma",
+                                  framing="length")
+        (body,) = list(dec.feed(frame))
+        assert body.endswith(wire.COMPR_CHAR)
+        assert wire.parse_packet(body) == {"k": 2}
+
+    def test_oversize_declared_length_rejected_immediately(self):
+        dec = wire.LengthFrameDecoder(max_buffer=1024)
+        header = (1 << 30).to_bytes(4, "big")
+        with pytest.raises(wire.FrameOverflowError):
+            list(dec.feed(header))
+        assert dec.pending == 0  # poisoned stream was dropped
+
+    def test_empty_frame(self):
+        dec = wire.make_decoder("length")
+        assert list(dec.feed(wire.encode_frame(b"", framing="length"))) == [b""]
+
+    def test_unknown_framing_rejected(self):
+        with pytest.raises(ValueError, match="framing"):
+            wire.encode_frame("x", framing="sctp")
+        with pytest.raises(ValueError, match="framing"):
+            wire.make_decoder("sctp")
