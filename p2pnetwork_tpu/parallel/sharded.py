@@ -2511,15 +2511,17 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         fresh = evalid & ~seen[cand] & node_mask_b[cand]
         if has_dyn:
             # Dynamic out-edges: reconstruct the global sender from the
-            # ring step, membership-test against the frontier list. The
-            # -1 sentinel (never a node id) keeps padded F entries from
-            # matching a live spare node.
+            # ring step, membership-test against the frontier list via
+            # binary search in the sorted list — O(E_dyn·log k), where the
+            # naive broadcast compare is O(E_dyn·k) and can rival the
+            # dense pass with a generous dynamic capacity (ADVICE r3).
+            # The -1 sentinel (never a node id) keeps padded F entries
+            # from matching a live spare node.
             t_i = jnp.arange(S, dtype=jnp.int32)[:, None]
             g_send = ((my - t_i) % S) * block + dyn_src_b
-            probe = jnp.where(fvalid, F, -1)
-            member = jnp.any(
-                g_send[..., None] == probe[None, None, :], axis=-1
-            ) & dyn_mask_b
+            probe = jnp.sort(jnp.where(fvalid, F, -1))
+            j = jnp.clip(jnp.searchsorted(probe, g_send), 0, k - 1)
+            member = (probe[j] == g_send) & dyn_mask_b
             dcand = jnp.where(member, dyn_dst_b, block - 1).reshape(-1)
             dfresh = (member.reshape(-1) & ~seen[dcand]
                       & node_mask_b[dcand])

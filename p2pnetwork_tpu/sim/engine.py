@@ -156,8 +156,11 @@ def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
 
 #: Memoized stats-key sets per (protocol, graph structure) — the abstract
 #: trace of init+step runs once, not per call (the run-to-* entry points
-#: sit on paths budgeted in milliseconds).
+#: sit on paths budgeted in milliseconds). FIFO-bounded: a sweep over many
+#: protocol configs must not grow it without limit or pin every protocol
+#: instance alive (ADVICE r3).
 _stats_keys_cache: dict = {}
+_STATS_KEYS_CACHE_MAX = 128
 
 
 def _require_stats(graph, protocol, state0, key, required) -> None:
@@ -174,6 +177,8 @@ def _require_stats(graph, protocol, state0, key, required) -> None:
             )[1],
             graph, key, state0,
         )
+        if len(_stats_keys_cache) >= _STATS_KEYS_CACHE_MAX:
+            _stats_keys_cache.pop(next(iter(_stats_keys_cache)))
         keys = _stats_keys_cache[cache_key] = frozenset(shapes)
     missing = [r for r in required if r not in keys]
     if missing:

@@ -328,6 +328,16 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
         "source_csr": from_edges_kwargs.pop("source_csr",
                                             graph.src_eid is not None),
     }
+    # Neighbor-table settings carry over like the kernel layouts do: a
+    # graph built without one (the documented 10M-node path) must not get
+    # an O(N·max_in_degree) table silently rebuilt host-side, and an
+    # explicit width cap survives (only when one was actually applied —
+    # an uncapped table's width is just the old true max, and the merged
+    # edge list may legitimately exceed it).
+    from_edges_kwargs.setdefault("build_neighbor_table",
+                                 graph.neighbors is not None)
+    if graph.neighbors is not None and not graph.neighbors_complete:
+        from_edges_kwargs.setdefault("max_degree", graph.max_degree)
     defer_layouts = bool(extra_nodes)
     if not defer_layouts:
         from_edges_kwargs.update(layout_kw)

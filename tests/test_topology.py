@@ -385,3 +385,42 @@ class TestConnectLiveness:
         assert c.hybrid is not None and c.src_eid is not None
         assert c.src_offsets.shape[0] == c.n_nodes_padded + 1
         assert c.n_nodes_padded > 256  # grown padding present
+
+
+class TestConsolidateNeighborTable:
+    """Neighbor-table settings carry over like kernel layouts (ADVICE r3):
+    the documented 10M-node path builds with build_neighbor_table=False and
+    consolidation must not silently rebuild an O(N*max_in_degree) table."""
+
+    def test_no_table_stays_no_table(self):
+        g = G.watts_strogatz(256, 4, 0.2, seed=0,
+                             build_neighbor_table=False, source_csr=True)
+        g = topology.connect(topology.with_capacity(g, extra_edges=4),
+                             [1], [200])
+        c = topology.consolidate(g)
+        assert g.neighbors is None
+        assert c.neighbors is None
+        assert c.src_eid is not None  # layouts still carried
+
+    def test_capped_table_keeps_its_cap(self):
+        g = G.watts_strogatz(256, 6, 0.2, seed=1, max_degree=3)
+        assert g.neighbors.shape[1] == 3 and not g.neighbors_complete
+        c = topology.consolidate(g)
+        assert c.neighbors.shape[1] <= 3
+
+    def test_uncapped_table_may_widen(self):
+        # An uncapped table's width is just the old true max — the merged
+        # edge list may exceed it, and must be allowed to.
+        g = G.ring(64)  # every out-degree is 1... ring() is k=1 each way
+        w0 = g.neighbors.shape[1]
+        g = topology.with_capacity(g, extra_edges=8)
+        g = topology.connect(g, [5, 7, 9], [20, 20, 20])
+        c = topology.consolidate(g)
+        assert c.neighbors_complete
+        assert c.neighbors.shape[1] >= w0
+
+    def test_explicit_kwargs_still_win(self):
+        g = G.watts_strogatz(128, 4, 0.2, seed=2,
+                             build_neighbor_table=False)
+        c = topology.consolidate(g, build_neighbor_table=True)
+        assert c.neighbors is not None
