@@ -1,0 +1,73 @@
+"""Worker process for the real multi-process jax.distributed test.
+
+Launched (twice) by tests/test_multihost.py: each process brings up
+``jax.distributed`` over a loopback coordinator, builds the hierarchical
+ring mesh spanning both processes' CPU devices, runs a sharded flood over
+it, and cross-checks rounds/messages/coverage against the single-device
+engine oracle computed locally. Prints one MULTIHOST_OK line on success.
+
+Usage: python tests/multihost_worker.py <process_id> <coordinator_port>
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=N)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    from p2pnetwork_tpu.parallel import multihost
+
+    is_multi = multihost.initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert is_multi, "initialize_distributed must report multi-process"
+    assert jax.process_count() == 2
+    n_local = len(jax.local_devices())
+    assert len(jax.devices()) == 2 * n_local
+
+    mesh = multihost.hierarchical_ring_mesh()
+    # ICI-major ring: every process's devices sit consecutive on the ring.
+    procs = [d.process_index for d in mesh.devices.flat]
+    assert procs == sorted(procs), f"ring not host-major: {procs}"
+
+    from p2pnetwork_tpu.models import Flood
+    from p2pnetwork_tpu.parallel import sharded
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    g = G.watts_strogatz(512, 6, 0.2, seed=0)
+    sg = sharded.shard_graph(g, mesh)
+    seen, out = sharded.flood_until_coverage(
+        sg, mesh, source=0, coverage_target=0.99
+    )
+    _, ref = engine.run_until_coverage(
+        g, Flood(source=0), jax.random.key(0), coverage_target=0.99
+    )
+    assert out["rounds"] == ref["rounds"], (out, ref)
+    assert out["messages"] == ref["messages"], (out, ref)
+    assert abs(out["coverage"] - ref["coverage"]) < 1e-6
+
+    # 2-D DCN x ICI mesh builds over the same job.
+    m2 = multihost.mesh_2d()
+    assert m2.devices.shape == (2, n_local)
+    assert {d.process_index for d in m2.devices[0]} == {0}
+
+    print(f"MULTIHOST_OK pid={pid} rounds={out['rounds']} "
+          f"messages={out['messages']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
