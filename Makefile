@@ -1,0 +1,26 @@
+# Runnable encodings of the project's standard invocations (tox.ini holds
+# the same recipes for environments with tox installed; this image bakes
+# in make but not tox). `make test` reproduces the full suite exactly as
+# CI/judging runs it.
+
+PY ?= python
+TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test examples bench dryrun
+
+test:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q
+
+examples:
+	$(TEST_ENV) $(PY) -m pytest tests/test_examples.py -q
+
+# North-star benchmark on the real TPU chip. bench.py probes the backend
+# in a subprocess first and emits an error JSON instead of hanging when
+# the device tunnel is wedged.
+bench:
+	$(PY) bench.py
+
+# Compile-check the single-chip entry and the multi-chip sharded training
+# step on an 8-device virtual mesh (what the driver validates).
+dryrun:
+	$(TEST_ENV) $(PY) -c "import __graft_entry__ as g; fn, args = g.entry(); fn(*args); g.dryrun_multichip(8); print('dryrun OK')"

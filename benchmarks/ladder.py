@@ -197,12 +197,14 @@ def bench_flood_big(n, label, adaptive_k=1024):
 
 
 def bench_flood_auto():
-    """GSPMD auto path (parallel/auto.py) on every available device: the
-    compiler-partitioned segment-method flood. On one chip this measures
-    the unpartitioned program (= the engine's segment lowering) — the
-    auto idiom's wall-clock floor; its multi-device communication is
-    bounded node-extent by HLO inspection (tests/test_auto_comm.py),
-    which no single-chip wall-clock can show."""
+    """GSPMD auto path (parallel/auto.py) on every available device, both
+    lowerings: the segment-method flood (the idiom's historical floor,
+    paying the full scatter cost) and the hybrid-blocked method (diagonal
+    rolls + einsum remainder — every op partitionable), which closes the
+    gap to the explicit ring path. On one chip this measures the
+    unpartitioned programs; multi-device communication is bounded
+    node-extent by HLO inspection (tests/test_auto_comm.py), which no
+    single-chip wall-clock can show."""
     import jax
 
     from p2pnetwork_tpu.models import Flood
@@ -214,28 +216,29 @@ def bench_flood_auto():
     mesh = M.ring_mesh()
     g = auto.shard_graph_auto(
         G.watts_strogatz(1_000_000, 10, 0.1, seed=0,
-                         build_neighbor_table=False),
+                         build_neighbor_table=False, hybrid=True),
         mesh,
     )
-    p = Flood(source=0, method="segment")
     key = jax.random.key(0)
-    _, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
-                                       max_rounds=64)
-    _ = int(out["rounds"])  # warm
-    t0 = time.perf_counter()
-    _, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
-                                       max_rounds=64)
-    secs = time.perf_counter() - t0
-    emit({
-        "config": f"1M WS flood, GSPMD auto ({mesh.devices.size} dev, "
-                  f"segment lowering)",
-        "value": round(secs, 4),
-        "unit": "s to 99% coverage (compiler-placed collectives)",
-        "rounds": int(out["rounds"]),
-        "messages": int(out["messages"]),
-        "comm_evidence": "tests/test_auto_comm.py pins collectives to "
-                         "node-extent payloads on the 8-device mesh",
-    })
+    for method in ("segment", "hybrid-blocked"):
+        p = Flood(source=0, method=method)
+        _, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
+                                           max_rounds=64)
+        _ = int(out["rounds"])  # warm
+        t0 = time.perf_counter()
+        _, out = engine.run_until_coverage(g, p, key, coverage_target=0.99,
+                                           max_rounds=64)
+        secs = time.perf_counter() - t0
+        emit({
+            "config": f"1M WS flood, GSPMD auto ({mesh.devices.size} dev, "
+                      f"{method} lowering)",
+            "value": round(secs, 4),
+            "unit": "s to 99% coverage (compiler-placed collectives)",
+            "rounds": int(out["rounds"]),
+            "messages": int(out["messages"]),
+            "comm_evidence": "tests/test_auto_comm.py pins collectives to "
+                             "node-extent payloads on the 8-device mesh",
+        })
 
 
 def bench_gossip_sharded():

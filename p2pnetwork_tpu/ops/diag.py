@@ -199,29 +199,52 @@ def _diag_sum(hybrid: HybridEdges, core: jax.Array) -> jax.Array:
 
 
 def propagate_or_hybrid(
-    hybrid: HybridEdges, signal: jax.Array, node_mask: jax.Array
+    hybrid: HybridEdges, signal: jax.Array, node_mask: jax.Array,
+    kernel: str = "pallas",
 ) -> jax.Array:
-    """Per-node OR over incoming edges: diagonals by shift, rest by kernel."""
-    from p2pnetwork_tpu.ops import pallas_edge as PK
+    """Per-node OR over incoming edges: diagonals by shift, rest by kernel.
 
+    ``kernel="pallas"`` (default) runs the remainder through the fused
+    Pallas bucket kernel — the single-chip fast path. ``kernel="blocked"``
+    uses the pure-jnp one-hot einsum (ops/blocked.py) instead: same
+    result, but every op is partitionable, so the GSPMD auto path
+    (parallel/auto.py) can shard it — a pallas_call is an opaque custom
+    call the partitioner would have to replicate."""
     n_pad = node_mask.shape[0]
     out = jnp.pad(_diag_or(hybrid, signal[: hybrid.n]), (0, n_pad - hybrid.n))
     if hybrid.remainder is not None:
-        out = out | PK.propagate_or_pallas(hybrid.remainder, signal, node_mask)
+        if kernel == "pallas":
+            from p2pnetwork_tpu.ops import pallas_edge as PK
+
+            rem = PK.propagate_or_pallas(hybrid.remainder, signal, node_mask)
+        else:
+            from p2pnetwork_tpu.ops import blocked as B
+
+            rem = B.propagate_or_blocked(hybrid.remainder, signal, node_mask)
+        out = out | rem
     return out & node_mask
 
 
 def propagate_sum_hybrid(
     hybrid: HybridEdges, signal: jax.Array, node_mask: jax.Array,
-    exact: bool = True,
+    exact: bool = True, kernel: str = "pallas",
 ) -> jax.Array:
     """Per-node sum over incoming edges: diagonals by shift, rest by kernel.
-    ``exact=False``: single-pass MXU for the remainder (see ops/segment.py)."""
-    from p2pnetwork_tpu.ops import pallas_edge as PK
-
+    ``exact=False``: single-pass MXU for the remainder (see ops/segment.py).
+    ``kernel`` as in :func:`propagate_or_hybrid` (the blocked einsum is
+    always exact)."""
     n_pad = node_mask.shape[0]
     out = jnp.pad(_diag_sum(hybrid, signal[: hybrid.n]), (0, n_pad - hybrid.n))
     if hybrid.remainder is not None:
-        out = out + PK.propagate_sum_pallas(hybrid.remainder, signal, node_mask,
-                                            exact=exact)
+        if kernel == "pallas":
+            from p2pnetwork_tpu.ops import pallas_edge as PK
+
+            rem = PK.propagate_sum_pallas(hybrid.remainder, signal,
+                                          node_mask, exact=exact)
+        else:
+            from p2pnetwork_tpu.ops import blocked as B
+
+            rem = B.propagate_sum_blocked(hybrid.remainder, signal,
+                                          node_mask)
+        out = out + rem
     return out * node_mask.astype(out.dtype)

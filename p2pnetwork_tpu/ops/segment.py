@@ -91,12 +91,14 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
             raise ValueError(f"method={method!r} requires graph.with_blocked()")
         fn = B.propagate_or_blocked if method == "blocked" else PK.propagate_or_pallas
         return fn(graph.blocked, signal, graph.node_mask)
-    if method == "hybrid":
+    if method in ("hybrid", "hybrid-blocked"):
         from p2pnetwork_tpu.ops import diag as D
 
         if graph.hybrid is None:
-            raise ValueError("method='hybrid' requires graph.with_hybrid()")
-        return D.propagate_or_hybrid(graph.hybrid, signal, graph.node_mask)
+            raise ValueError(f"method={method!r} requires graph.with_hybrid()")
+        kernel = "pallas" if method == "hybrid" else "blocked"
+        return D.propagate_or_hybrid(graph.hybrid, signal, graph.node_mask,
+                                     kernel=kernel)
     contrib = (signal[graph.senders] & graph.edge_mask).astype(jnp.int32)
     agg = jax.ops.segment_max(
         contrib,
@@ -139,13 +141,14 @@ def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto",
             return B.propagate_sum_blocked(graph.blocked, signal, graph.node_mask)
         return PK.propagate_sum_pallas(graph.blocked, signal, graph.node_mask,
                                        exact=exact)
-    if method == "hybrid":
+    if method in ("hybrid", "hybrid-blocked"):
         from p2pnetwork_tpu.ops import diag as D
 
         if graph.hybrid is None:
-            raise ValueError("method='hybrid' requires graph.with_hybrid()")
+            raise ValueError(f"method={method!r} requires graph.with_hybrid()")
+        kernel = "pallas" if method == "hybrid" else "blocked"
         return D.propagate_sum_hybrid(graph.hybrid, signal, graph.node_mask,
-                                      exact=exact)
+                                      exact=exact, kernel=kernel)
     contrib = signal[graph.senders] * graph.edge_mask.astype(signal.dtype)
     agg = jax.ops.segment_sum(
         contrib,

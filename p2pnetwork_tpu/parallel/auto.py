@@ -11,9 +11,15 @@ with zero protocol changes; the explicit ring remains the
 bandwidth-predictable path for the flood benchmark.
 
 Layouts: every per-node array is sharded on its leading (node) axis, every
-per-edge array on its edge axis, the neighbor table on rows. The blocked /
-hybrid representations are layout-specialized for the single-chip kernels
-and are dropped here (use method="segment" or "gather").
+per-edge array on its edge axis, the neighbor table on rows. The blocked
+and hybrid representations carry over too — buckets are destination-block
+(node-order) slabs, so their leading axis shards in alignment with the
+node axis. Use ``method="hybrid-blocked"`` here: the diagonal rolls and
+the one-hot einsum remainder are all partitionable ops, which closes most
+of the gap to the explicit ring path (the plain segment lowering pays the
+full scatter floor); the Pallas remainder kernel (``method="hybrid"``)
+stays single-chip — a pallas_call is an opaque custom call the
+partitioner would have to replicate.
 
 Communication evidence (tests/test_auto_comm.py inspects the compiled
 HLO): for segment-method Flood/SIR on an 8-device mesh, every collective
@@ -57,6 +63,42 @@ def shard_graph_auto(graph: Graph, mesh: Mesh,
     def put(x):
         return None if x is None else jax.device_put(x, spec)
 
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+
+    def put_blocked(blocked):
+        # BlockedEdges buckets are destination blocks in node order, so
+        # sharding their leading axis aligns each bucket with the shard
+        # that owns its destination nodes; the einsum stays local and only
+        # the (node-extent) signal gather crosses shards. A remainder with
+        # fewer buckets than shards (tiny graphs) is replicated instead —
+        # device_put needs even division, and at that size it is noise.
+        if blocked is None:
+            return None
+        div = blocked.src.shape[0] % axis_size == 0
+        bspec = NamedSharding(mesh, P(axis_name) if div else P())
+        return dataclasses.replace(
+            blocked,
+            src=jax.device_put(blocked.src, bspec),
+            local_dst=jax.device_put(blocked.local_dst, bspec),
+            mask=jax.device_put(blocked.mask, bspec),
+        )
+
+    def put_hybrid(hybrid):
+        # Diagonal masks are [D, n] with n the (unpadded) node axis:
+        # shard axis 1 when it divides. The remainder rides the blocked
+        # (einsum) form — under this path use method="hybrid-blocked";
+        # the Pallas remainder kernel is an opaque custom call the
+        # partitioner cannot shard.
+        if hybrid is None:
+            return None
+        div = hybrid.masks.shape[1] % axis_size == 0
+        mspec = NamedSharding(mesh, P(None, axis_name) if div else P())
+        return dataclasses.replace(
+            hybrid,
+            masks=jax.device_put(hybrid.masks, mspec),
+            remainder=put_blocked(hybrid.remainder),
+        )
+
     return dataclasses.replace(
         graph,
         senders=put(graph.senders),
@@ -67,8 +109,8 @@ def shard_graph_auto(graph: Graph, mesh: Mesh,
         out_degree=put(graph.out_degree),
         neighbors=put(graph.neighbors),
         neighbor_mask=put(graph.neighbor_mask),
-        blocked=None,
-        hybrid=None,
+        blocked=put_blocked(graph.blocked),
+        hybrid=put_hybrid(graph.hybrid),
     )
 
 

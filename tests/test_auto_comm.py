@@ -104,3 +104,50 @@ def test_auto_flood_gathers_frontier_not_edges():
     assert gathers
     for op, dtype, shape, nbytes in gathers:
         assert dtype == "pred" and nbytes <= g.n_nodes_padded
+
+
+class TestHybridBlockedAuto:
+    """The hybrid layout under GSPMD (VERDICT r3 #3): method="hybrid-blocked"
+    keeps the diagonal rolls + einsum remainder — all partitionable ops —
+    so the auto path no longer pays the full segment-scatter floor. The
+    communication bound must hold for it exactly as for segment."""
+
+    def _hlo(self, protocol, rounds=5):
+        g = G.watts_strogatz(4096, 6, 0.2, seed=0, hybrid=True)
+        gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+        return g, engine.run.lower(
+            gs, protocol, jax.random.key(0), rounds
+        ).compile().as_text()
+
+    def test_collectives_are_node_extent_only(self):
+        g, hlo = self._hlo(Flood(source=0, method="hybrid-blocked"))
+        colls = _collectives(hlo)
+        assert colls, "no collectives found — program was not partitioned"
+        node_extent_bytes = g.n_nodes_padded * 4
+        for op, dtype, shape, nbytes in colls:
+            assert nbytes <= node_extent_bytes, (
+                f"{op} moves {nbytes} bytes ({dtype}{list(shape)}) — "
+                f"edge-extent traffic"
+            )
+
+    def test_matches_segment_auto_results(self):
+        g = G.watts_strogatz(4096, 6, 0.2, seed=0, hybrid=True)
+        gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+        key = jax.random.key(0)
+        st_h, stats_h = auto.run_auto(
+            gs, Flood(source=0, method="hybrid-blocked"), key, 8)
+        st_s, stats_s = engine.run(
+            g, Flood(source=0, method="segment"), key, 8)
+        assert (np.asarray(st_h.seen) == np.asarray(st_s.seen)).all()
+        np.testing.assert_array_equal(np.asarray(stats_h["messages"]),
+                                      np.asarray(stats_s["messages"]))
+
+    def test_sum_path_matches(self):
+        g = G.watts_strogatz(2048, 6, 0.2, seed=1, hybrid=True)
+        gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+        key = jax.random.key(0)
+        st_h, _ = auto.run_auto(
+            gs, SIR(beta=0.3, gamma=0.1, method="hybrid-blocked"), key, 6)
+        st_s, _ = engine.run(
+            g, SIR(beta=0.3, gamma=0.1, method="segment"), key, 6)
+        assert (np.asarray(st_h.status) == np.asarray(st_s.status)).all()

@@ -790,12 +790,21 @@ class TestAutoSharding:
     def test_auto_graph_is_actually_sharded(self):
         from p2pnetwork_tpu.parallel import auto
 
-        g = G.watts_strogatz(512, 4, 0.1, seed=0)
+        # Big enough that the bucket counts divide the 8 shards (the
+        # divisibility guard replicates tiny layouts instead).
+        g = G.watts_strogatz(8192, 4, 0.1, seed=0, hybrid=True, blocked=True)
         mesh = M.ring_mesh(8)
         gs = auto.shard_graph_auto(g, mesh)
         assert len(gs.node_mask.sharding.device_set) == 8
         assert len(gs.senders.sharding.device_set) == 8
-        assert gs.blocked is None and gs.hybrid is None
+        # The kernel layouts carry over ONTO the mesh (round 4): diagonal
+        # masks sharded on their node axis, remainder/blocked buckets on
+        # their destination-block axis — not dropped, not replicated.
+        assert gs.hybrid is not None and gs.blocked is not None
+        assert not gs.hybrid.masks.sharding.is_fully_replicated
+        assert not gs.blocked.src.sharding.is_fully_replicated
+        if gs.hybrid.remainder is not None:
+            assert not gs.hybrid.remainder.src.sharding.is_fully_replicated
 
 
 class TestShardedValueProtocols:
