@@ -74,7 +74,7 @@ def bench_1m(record):
                          source_csr=True)
     build_s = time.perf_counter() - t_build0
 
-    methods = ["pallas", "hybrid", "adaptive-1024"]
+    methods = ["pallas", "hybrid", "adaptive-1024", "adaptive-2048"]
     results = {}
     for m in methods:
         try:

@@ -30,6 +30,7 @@ def test_examples_discovered():
         "dict_application.py",
         "flood_demo.py",
         "simnode_demo.py",
+        "auto_sharding_demo.py",
         "epidemic_with_failures.py",
         "secure_node_demo.py",
     ):
