@@ -1286,12 +1286,15 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     the multi-chip path. One XLA program, zero host round-trips per round.
 
     ``adaptive_k > 0`` (requires ``shard_graph(source_csr=True)``) runs
-    rounds whose global frontier fits ``adaptive_k`` nodes through the
-    frontier-sparse path: the frontier rides as a replicated index list
-    and each shard gathers only its edges from those senders — O(k·span)
-    work plus one tiny all-gather instead of the full ring pass. Results
-    are bit-identical to the dense loop (the multi-chip mirror of
-    models/adaptive_flood.py).
+    rounds whose global frontier is small through the frontier-sparse
+    path: the frontier rides as a replicated index list and each shard
+    gathers only its edges from those senders, chunked into W-wide work
+    items — O(k·W) work plus one tiny all-gather instead of the full ring
+    pass. The budget is out-edge MASS (largest per-shard item count must
+    fit ``adaptive_k``), so degree-skewed graphs get the win too: a hub
+    costs ceil(row/W) items instead of widening every gather to its
+    degree. Results are bit-identical to the dense loop (the multi-chip
+    mirror of models/adaptive_flood.py).
 
     Returns ``(seen [S, block] bool, dict(rounds, coverage, messages))``
     with ``messages`` an exact Python int. Resume path (same contract as
@@ -2464,13 +2467,17 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
                           mxu_src, mxu_dst, mxu_mask, diag_masks,
                           node_mask, out_degree, csr_pos, csr_offsets,
                           seen0, frontier0):
-    """Per-shard body: run-to-coverage flood where rounds with a global
-    frontier of at most ``k`` nodes skip the ring entirely — the frontier
-    rides as a replicated index list, each shard gathers only ITS edges
-    from those senders through the sender-CSR view (O(k·span) work and one
-    tiny all-gather, instead of O(E/S) bucket work and S ppermute hops).
-    The multi-chip mirror of models/adaptive_flood.py; results stay
-    bit-identical to the dense loop."""
+    """Per-shard body: run-to-coverage flood where rounds with a small
+    global frontier skip the ring entirely — the frontier rides as a
+    replicated index list, and each shard gathers only ITS edges from
+    those senders through the sender-CSR view, chunked into W-wide WORK
+    ITEMS (O(k·W) work and one tiny all-gather, instead of O(E/S) bucket
+    work and S ppermute hops). Budgeting is by out-edge mass: the sparse
+    branch runs while the largest per-shard item count fits ``k``, so a
+    hub whose row rivals the budget tips the round dense instead of
+    widening every gather to its degree (the multi-chip mirror of
+    models/adaptive_flood.py's hub tolerance); results stay bit-identical
+    to the dense loop."""
     pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
@@ -2483,6 +2490,7 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
     has_dyn = dyn_src_b.shape[-1] > 0
     n_g = S * block
     pad_id = n_g - 1
+    w = max(1, min(span, 128))  # work-item slice width
     my = jax.lax.axis_index(axis_name)
     n_live = jnp.maximum(
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
@@ -2495,16 +2503,39 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         return jnp.where(idx_k < local_count,
                          my * block + lpos.astype(jnp.int32), pad_id)
 
-    def sparse_round(seen, frontier, F, fcount):
+    def item_budget(F, ncount):
+        """Replicated sparse-mode budget for frontier list ``F``: the
+        largest per-shard W-slice work-item count (pmax), saturated past
+        ``k`` when the node list itself overflowed (truncated F is never
+        read). Every shard computes the identical value, so it can drive
+        the replicated sparse/dense branch."""
+        fvalid = idx_k < ncount
+        f = jnp.where(fvalid, F, pad_id)
+        row_len = csr_offsets_b[f + 1] - csr_offsets_b[f]
+        items = jnp.where(fvalid, (row_len + w - 1) // w, 0)
+        icount = jax.lax.pmax(jnp.sum(items).astype(jnp.int32), axis_name)
+        return jnp.where(ncount > k, jnp.int32(k + 1), icount)
+
+    def sparse_round(seen, frontier, F, fncount, ficount):
         msgs = jax.lax.psum(
             jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
         )
-        fvalid = idx_k < fcount
+        # Expand the replicated node list into THIS shard's work items
+        # (cumsum + searchsorted over k entries): item p covers slots
+        # [base + slice*w, ...) of its owning node's local CSR row.
+        fvalid = idx_k < fncount
         f = jnp.where(fvalid, F, pad_id)
-        base = csr_offsets_b[f]
-        ln = csr_offsets_b[f + 1] - base
-        slot = base[:, None] + jnp.arange(span)[None, :]
-        svalid = (jnp.arange(span)[None, :] < ln[:, None]) & fvalid[:, None]
+        base_row = csr_offsets_b[f]
+        row_end = csr_offsets_b[f + 1]
+        items_per = jnp.where(fvalid, (row_end - base_row + w - 1) // w, 0)
+        offs = jnp.cumsum(items_per)
+        starts = offs - items_per
+        icount_local = offs[-1]
+        j = jnp.clip(jnp.searchsorted(offs, idx_k, side="right"), 0, k - 1)
+        ivalid = idx_k < icount_local
+        base = base_row[j] + (idx_k - starts[j]) * w
+        slot = base[:, None] + jnp.arange(w)[None, :]  # [k, w]
+        svalid = (slot < row_end[j][:, None]) & ivalid[:, None]
         pos = csr_pos_b[jnp.where(svalid, slot, 0)]
         evalid = (svalid & flat_mask[pos]).reshape(-1)
         cand = jnp.where(evalid, flat_dst[pos].reshape(-1), block - 1)
@@ -2543,11 +2574,11 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         wpos = jnp.nonzero(winner, size=k, fill_value=cand.shape[0] - 1)[0]
         local_ids = jnp.where(idx_k < local_count,
                               my * block + cand[wpos], pad_id)
-        F, fcount = _pack_global_frontier(axis_name, S, k, local_ids,
+        F, ncount = _pack_global_frontier(axis_name, S, k, local_ids,
                                           local_count, pad_id)
-        return seen, frontier, F, fcount, msgs
+        return seen, frontier, F, ncount, item_budget(F, ncount), msgs
 
-    def dense_round(seen, frontier, F, fcount):
+    def dense_round(seen, frontier, F, fncount, ficount):
         msgs = jax.lax.psum(
             jnp.sum(jnp.where(frontier, out_degree_b, 0)), axis_name
         )
@@ -2555,7 +2586,7 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         new = delivered & ~seen & node_mask_b
         seen = seen | new
         local_count = jnp.sum(new).astype(jnp.int32)
-        fcount = jax.lax.psum(local_count, axis_name)
+        ncount = jax.lax.psum(local_count, axis_name)
 
         def compact(_):
             return _pack_global_frontier(
@@ -2563,36 +2594,39 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
                 pad_id,
             )[0]
 
-        F = jax.lax.cond(fcount <= k, compact, lambda _: F, None)
-        return seen, new, F, fcount, msgs
+        F = jax.lax.cond(ncount <= k, compact, lambda _: F, None)
+        # item_budget saturates to k+1 when ncount > k, so the stale F of
+        # the non-compacted branch is never trusted.
+        return seen, new, F, ncount, item_budget(F, ncount), msgs
 
     def cond(carry):
-        _, _, _, _, rounds, covered, _, _ = carry
+        _, _, _, _, _, rounds, covered, _, _ = carry
         return (covered / n_live < coverage_target) & (rounds < max_rounds)
 
     def body(carry):
-        seen, frontier, F, fcount, rounds, _, hi, lo = carry
-        seen, frontier, F, fcount, msgs = jax.lax.cond(
-            fcount <= k, sparse_round, dense_round,
-            seen, frontier, F, fcount,
+        seen, frontier, F, fncount, ficount, rounds, _, hi, lo = carry
+        seen, frontier, F, fncount, ficount, msgs = jax.lax.cond(
+            ficount <= k, sparse_round, dense_round,
+            seen, frontier, F, fncount, ficount,
         )
         hi, lo = accum.add((hi, lo), msgs)
         covered = jax.lax.psum(
             jnp.sum((seen & node_mask_b).astype(jnp.int32)), axis_name
         )
-        return seen, frontier, F, fcount, rounds + 1, covered, hi, lo
+        return (seen, frontier, F, fncount, ficount, rounds + 1, covered,
+                hi, lo)
 
     seen_b, frontier_b = seen0[0], frontier0[0]
     count0 = jnp.sum(frontier_b).astype(jnp.int32)
-    F0, fcount0 = _pack_global_frontier(
+    F0, ncount0 = _pack_global_frontier(
         axis_name, S, k, my_new_ids(frontier_b, count0), count0, pad_id
     )
     covered0 = jax.lax.psum(
         jnp.sum((seen_b & node_mask_b).astype(jnp.int32)), axis_name
     )
-    init = (seen_b, frontier_b, F0, fcount0, jnp.int32(0), covered0,
-            *accum.zero())
-    seen, frontier, _, _, rounds, covered, hi, lo = jax.lax.while_loop(
+    init = (seen_b, frontier_b, F0, ncount0, item_budget(F0, ncount0),
+            jnp.int32(0), covered0, *accum.zero())
+    seen, frontier, _, _, _, rounds, covered, hi, lo = jax.lax.while_loop(
         cond, body, init
     )
     return seen[None], frontier[None], accum.pack_summary(

@@ -1200,3 +1200,70 @@ class TestShardedPushSumVariance:
         s0 = np.asarray(sharded.init_state(sg, PushSum(), key)[0]).sum()
         np.testing.assert_allclose(np.asarray(s).sum(), s0, rtol=1e-4)
         np.testing.assert_allclose(np.asarray(w).sum(), g.n_nodes, rtol=1e-5)
+
+
+class TestShardedAdaptiveHubGraphs:
+    """Degree-skewed graphs on the sharded adaptive path (the multi-chip
+    mirror of the models/adaptive_flood.py hub tolerance): budgeting by
+    per-shard work-item mass keeps sparse rounds exact and bounded."""
+
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_ba_matches_dense_and_engine(self, n_shards):
+        from p2pnetwork_tpu.models import Flood
+
+        g = G.barabasi_albert(2048, 4, seed=0)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=5, coverage_target=0.99, adaptive_k=64
+        )
+        seen_d, out_d = sharded.flood_until_coverage(
+            sg, mesh, source=5, coverage_target=0.99
+        )
+        _, ref = engine.run_until_coverage(
+            g, Flood(source=5), jax.random.key(0), coverage_target=0.99
+        )
+        np.testing.assert_array_equal(np.asarray(seen_a), np.asarray(seen_d))
+        assert out_a["rounds"] == out_d["rounds"] == ref["rounds"]
+        assert out_a["messages"] == out_d["messages"] == ref["messages"]
+
+    def test_hub_source_runs_exact_under_tiny_budget(self):
+        # Source 0 is a BA hub: its row overflows a tiny item budget, so
+        # round one must go dense — and stay bit-identical throughout.
+        from p2pnetwork_tpu.models import Flood
+
+        g = G.barabasi_albert(1024, 6, seed=1)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=0.99, adaptive_k=4
+        )
+        _, ref = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0), coverage_target=0.99
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+
+    def test_ba_with_churn(self):
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.sim import failures, topology
+
+        g = G.barabasi_albert(1024, 3, seed=2)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        sg = sharded.with_capacity(sharded.fail_nodes(sg, [2]), 8)
+        sg = sharded.connect(sg, [10], [1000])
+        gc = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [2]),
+                                   extra_edges=8),
+            [10], [1000],
+        )
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=5, coverage_target=0.95, adaptive_k=32
+        )
+        _, ref = engine.run_until_coverage(
+            gc, Flood(source=5), jax.random.key(0), coverage_target=0.95
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+        assert not np.asarray(seen_a).reshape(-1)[2]
