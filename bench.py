@@ -149,8 +149,14 @@ def _probe_backend_once(timeout_s: int):
     probe = (
         "import sys; sys.path.insert(0, {!r}); "
         "from p2pnetwork_tpu.utils.jax_env import apply_platform_env; "
-        "apply_platform_env(); import jax; "
-        "print(jax.devices())".format(os.path.dirname(os.path.abspath(__file__)))
+        "apply_platform_env(); import jax, jax.numpy as jnp; "
+        "print(jax.devices()); "
+        # Enumeration alone can succeed on a half-wedged tunnel: require a
+        # real compile + execute + device->host round trip. Not an assert —
+        # PYTHONOPTIMIZE would strip that and quietly weaken the probe.
+        "raise SystemExit(0 if int(jax.jit(lambda: "
+        "jnp.sum(jnp.arange(8)))()) == 28 else 1)"
+        .format(os.path.dirname(os.path.abspath(__file__)))
     )
     try:
         r = subprocess.run([sys.executable, "-c", probe],
