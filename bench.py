@@ -154,8 +154,10 @@ def _probe_backend_once(timeout_s: int):
         # Enumeration alone can succeed on a half-wedged tunnel: require a
         # real compile + execute + device->host round trip. Not an assert —
         # PYTHONOPTIMIZE would strip that and quietly weaken the probe.
-        "raise SystemExit(0 if int(jax.jit(lambda: "
-        "jnp.sum(jnp.arange(8)))()) == 28 else 1)"
+        "v = int(jax.jit(lambda: jnp.sum(jnp.arange(8)))()); "
+        "print(f'probe compute round-trip returned {{v}}, want 28', "
+        "file=sys.stderr); "
+        "raise SystemExit(0 if v == 28 else 1)"
         .format(os.path.dirname(os.path.abspath(__file__)))
     )
     try:
