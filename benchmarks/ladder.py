@@ -208,8 +208,10 @@ def bench_flood_big(n, label, adaptive_k=1024, *, make_graph=None,
 
 def bench_flood_ba(n=100_000, m=4, adaptive_k=1024):
     """Seen-set flood on the scale-free (Barabási–Albert) family — the
-    same 100K/m=4 topology as the BASELINE config-2 gossip rung, under
-    the flood workload. Round 4's work-item chunking budgets sparse
+    same 100K/m=4 edge topology as the BASELINE config-2 gossip rung
+    (which additionally caps its gather TABLE at 128 — the edges and the
+    hub degrees are identical), under the flood workload. Round 4's
+    work-item chunking budgets sparse
     rounds by out-edge mass, so the hub-skewed degree distribution gets
     the adaptive win too (it was excluded before; VERDICT r3 #2)."""
     bench_flood_big(

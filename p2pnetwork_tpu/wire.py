@@ -90,9 +90,16 @@ def decompress(blob: bytes) -> bytes:
 
     Mirrors the reference's tag sniffing [ref: nodeconnection.py:92-99]: an
     unrecognised tag, or a codec failure, returns the b64-decoded bytes as-is
-    [ref: nodeconnection.py:100-101].
+    [ref: nodeconnection.py:100-101]. Deliberate fix over the reference: its
+    b64decode sits outside the try, so a malformed frame carrying the COMPR
+    marker raises out of packet parsing [ref bug: nodeconnection.py:91];
+    here bytes that aren't base64 at all come back unchanged, honoring the
+    as-is contract.
     """
-    data = base64.b64decode(blob)
+    try:
+        data = base64.b64decode(blob)
+    except Exception:
+        return blob
     try:
         if data[-4:] == b"zlib":
             return zlib.decompress(data[:-4])

@@ -153,3 +153,13 @@ class TestLengthFraming:
             wire.encode_frame("x", framing="sctp")
         with pytest.raises(ValueError, match="framing"):
             wire.make_decoder("sctp")
+
+
+def test_decompress_of_non_base64_garbage_returns_input():
+    # The reference's decompress raises binascii.Error here (its b64decode
+    # sits outside the try, nodeconnection.py:91); ours honors the
+    # documented as-is contract for malformed frames.
+    junk = b"\xff\xfenot base64!!"
+    assert wire.decompress(junk) == junk
+    # ...and parse_packet survives a garbage frame carrying the marker.
+    assert wire.parse_packet(junk + wire.COMPR_CHAR) is not None
