@@ -15,6 +15,7 @@ from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
 from p2pnetwork_tpu.models.hopdist import HopDistance, HopDistanceState
+from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
@@ -31,6 +32,8 @@ __all__ = [
     "GossipState",
     "HopDistance",
     "HopDistanceState",
+    "LeaderElection",
+    "LeaderElectionState",
     "PageRank",
     "PageRankState",
     "PushSum",

@@ -1,0 +1,82 @@
+"""Leader election by highest-id flooding.
+
+The classic decentralized coordination protocol reference users build on
+the event hooks [ref: README.md:20 — the library "does not implement any
+protocol", users write discovery/election themselves]: every node starts
+by nominating itself, repeatedly broadcasts the highest live node id it
+has heard, and adopts anything higher that arrives. When no node learns
+anything new, every connected component has agreed on its highest live
+member — the leader. On the reference this is per-peer Python in
+``node_message`` overrides; here one round of the whole population is a
+single masked neighbor-max (ops/segment.propagate_max).
+
+Message accounting mirrors the flood family: a node re-broadcasts only in
+the round after it learned a better candidate (the reference node would
+``send_to_nodes`` from inside its handler), so ``messages`` counts what a
+gossip implementation actually sends, not N·degree every round.
+
+Convergence is a stats contract: ``changed`` (number of nodes that
+adopted a new candidate this round) reaches 0 exactly when election is
+done — run it with ``engine.run_until_converged(..., stat="changed",
+threshold=1)``. ``coverage`` is the fraction of live nodes already
+agreeing with the globally highest live id, so ``run_until_coverage``
+works too (note: per disconnected component, minority components never
+reach the global winner — coverage plateaus below 1 there, by design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LeaderElectionState:
+    known: jax.Array  # i32[N_pad] — highest live id heard; -1 on dead nodes
+    frontier: jax.Array  # bool[N_pad] — learned something last round
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class LeaderElection:
+    """Highest-live-id election. ``method`` picks the aggregation lowering
+    (``"auto"``/``"segment"``/``"gather"`` — see ops/segment.propagate_max)."""
+
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> LeaderElectionState:
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        known = jnp.where(graph.node_mask, ids, -1)
+        return LeaderElectionState(known=known, frontier=graph.node_mask)
+
+    def coverage(self, graph: Graph, state: LeaderElectionState) -> jax.Array:
+        """Fraction of live nodes already holding the global winner."""
+        winner = jnp.max(jnp.where(graph.node_mask, state.known, -1))
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        agreed = jnp.sum((state.known == winner) & graph.node_mask)
+        return agreed / n_real
+
+    def step(self, graph: Graph, state: LeaderElectionState, key: jax.Array):
+        # Only last round's learners re-broadcast; masking the signal to
+        # the frontier keeps max-propagation identical (a non-frontier
+        # node's candidate was already delivered in an earlier round).
+        neutral = segment.neutral_min(state.known.dtype)
+        signal = jnp.where(state.frontier, state.known, neutral)
+        heard = segment.propagate_max(graph, signal, self.method)
+        known = jnp.where(graph.node_mask,
+                          jnp.maximum(state.known, heard), -1)
+        changed = (known != state.known) & graph.node_mask
+        msgs = segment.frontier_messages(graph,
+                                         state.frontier & graph.node_mask)
+        new_state = LeaderElectionState(known=known, frontier=changed)
+        stats = {
+            "messages": msgs,
+            "changed": jnp.sum(changed),
+            "coverage": self.coverage(graph, new_state),
+        }
+        return new_state, stats

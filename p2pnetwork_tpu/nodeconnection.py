@@ -109,7 +109,13 @@ class NodeConnection:
 
         Routes through ``self.decompress`` so subclasses overriding the codec
         (e.g. to add encryption) affect the receive path, as in the reference
-        [ref: nodeconnection.py:171]."""
+        [ref: nodeconnection.py:171]. Under ``framing="length"`` the body
+        carries an explicit compression flag byte instead of the sniffable
+        trailing marker (wire.py), so arbitrary binary decodes intact."""
+        if self.main_node.config.framing == "length":
+            if packet[:1] == wire.LENGTH_COMPRESSED:
+                return wire.decode_payload(self.decompress(packet[1:]))
+            return wire.decode_payload(packet[1:])
         if packet.find(wire.COMPR_CHAR) == len(packet) - 1:
             packet = self.decompress(packet[:-1])
         return wire.decode_payload(packet)
@@ -147,15 +153,16 @@ class NodeConnection:
             self.main_node.message_count_rerr += 1
             return
         if compression == "none":
-            body = raw
+            payload, is_compressed = raw, False
         else:
-            compressed = self.compress(raw, compression)
-            if compressed is None:
+            blob = self.compress(raw, compression)
+            if blob is None:
                 self.main_node.message_count_rerr += 1
                 return
-            body = compressed + wire.COMPR_CHAR
+            payload, is_compressed = blob, True
         try:
-            frame = wire.wrap_frame(body, self.main_node.config.framing)
+            frame = wire.wrap_frame(payload, self.main_node.config.framing,
+                                    compressed=is_compressed)
         except ValueError as e:  # e.g. body beyond the 4-byte length prefix
             self.main_node.debug_print(f"nodeconnection send: {e}")
             self.main_node.message_count_rerr += 1

@@ -159,6 +159,68 @@ def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto",
     return agg * graph.node_mask.astype(signal.dtype)
 
 
+def neutral_min(dtype) -> jax.Array:
+    """The max-aggregation identity for ``dtype`` (-inf / int min)."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    if jnp.issubdtype(dtype, jnp.bool_):
+        raise ValueError(
+            "max-aggregation over bool signals is just OR — use "
+            "propagate_or / sharded.propagate(op='or') instead"
+        )
+    return jnp.array(jnp.iinfo(dtype).min, dtype)
+
+
+def _dynamic_max(graph: Graph, signal: jax.Array) -> jax.Array:
+    """Max-aggregate the dynamic edge region (sim/topology.py), if any."""
+    neutral = neutral_min(signal.dtype)
+    contrib = jnp.where(graph.dyn_mask, signal[graph.dyn_senders], neutral)
+    return jax.ops.segment_max(
+        contrib, graph.dyn_receivers, num_segments=graph.n_nodes_padded
+    )
+
+
+def propagate_max(graph: Graph, signal: jax.Array,
+                  method: str = "auto") -> jax.Array:
+    """Per-node max over incoming neighbors: ``out[v] = max(signal[u], u->v)``.
+
+    Nodes with no (live) incoming edges get the dtype's max-identity
+    (-inf / int min); dead nodes likewise — callers typically fold the
+    result with their own value (``jnp.maximum(value, incoming)``), which
+    makes both neutral. Methods: ``"segment"`` or ``"gather"`` (``"auto"``
+    picks gather when a complete neighbor table exists). The blocked /
+    pallas / hybrid lowerings do not apply — they ride one-hot MXU
+    matmuls, which compute sums, not maxima.
+    """
+    neutral = neutral_min(signal.dtype)
+    if graph.dyn_senders is not None:
+        static = dataclasses.replace(graph, dyn_senders=None,
+                                     dyn_receivers=None, dyn_mask=None)
+        return jnp.maximum(propagate_max(static, signal, method),
+                           _dynamic_max(graph, signal))
+    if method == "auto":
+        method = "gather" if _gather_ok(graph) else "segment"
+    if method == "gather":
+        _require_complete_table(graph)
+        vals = jnp.where(graph.neighbor_mask, signal[graph.neighbors],
+                         neutral)
+        agg = jnp.max(vals, axis=1)
+    elif method == "segment":
+        contrib = jnp.where(graph.edge_mask, signal[graph.senders], neutral)
+        agg = jax.ops.segment_max(
+            contrib,
+            graph.receivers,
+            num_segments=graph.n_nodes_padded,
+            indices_are_sorted=True,
+        )
+    else:
+        raise ValueError(
+            f"propagate_max supports method 'segment' or 'gather', got "
+            f"{method!r} (max does not ride the one-hot-matmul lowerings)"
+        )
+    return jnp.where(graph.node_mask, agg, neutral)
+
+
 def frontier_messages(graph: Graph, frontier: jax.Array) -> jax.Array:
     """Number of point-to-point sends this round: every node holding the
     frontier flag sends to each of its outgoing edges — the batched

@@ -993,6 +993,12 @@ def _diag_sum_piece(rot, r, mask):
     return jnp.roll(rot, -r) * mask
 
 
+def _diag_max_piece(rot, r, mask):
+    from p2pnetwork_tpu.ops.segment import neutral_min
+
+    return jnp.where(mask, jnp.roll(rot, -r), neutral_min(rot.dtype))
+
+
 def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None):
     """One full ring rotation. ``groups`` is a sequence of ``(apply_fn,
     *arrays)`` bucket groups, every array carrying a leading ring-step axis
@@ -1054,6 +1060,18 @@ def _bucket_sum(block, sorted_dst=True):
     def apply(rot, src, dst, m):
         contrib = rot[src] * m
         return jax.ops.segment_sum(
+            contrib, dst, num_segments=block, indices_are_sorted=sorted_dst
+        )
+
+    return apply
+
+
+def _bucket_max(block, sorted_dst=True):
+    def apply(rot, src, dst, m):
+        from p2pnetwork_tpu.ops.segment import neutral_min
+
+        contrib = jnp.where(m, rot[src], neutral_min(rot.dtype))
+        return jax.ops.segment_max(
             contrib, dst, num_segments=block, indices_are_sorted=sorted_dst
         )
 
@@ -1805,6 +1823,31 @@ def _make_or_pass(axis_name, S, block, pieces, mxu_block,
     return pass_
 
 
+def _make_max_pass(axis_name, S, block, pieces, mxu_block,
+                   bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                   mxu_src, mxu_dst, mxu_mask, diag_masks):
+    """Build ``pass_(x) -> x.dtype[block]``: one full ring rotation taking
+    the per-node MAX over every incoming edge — segment buckets and
+    diagonal shifts only (max cannot ride the one-hot-matmul MXU layout,
+    which computes sums; :func:`propagate` rejects such graphs up front)."""
+    from p2pnetwork_tpu.ops.segment import neutral_min
+
+    groups = [
+        (_bucket_max(block, sorted_dst=True),
+         bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (_bucket_max(block, sorted_dst=False),
+         dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    ]
+    diag = (pieces, diag_masks[0], _diag_max_piece)
+
+    def pass_(x):
+        return _ring_pass(axis_name, S, x, groups,
+                          jnp.full((block,), neutral_min(x.dtype), x.dtype),
+                          jnp.maximum, diag=diag)
+
+    return pass_
+
+
 def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -1816,6 +1859,15 @@ def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
                               dyn_src, dyn_dst, dyn_mask,
                               mxu_src, mxu_dst, mxu_mask, diag_masks)
         return (pass_(signal[0]) & node_mask_b)[None]
+    if op == "max":
+        from p2pnetwork_tpu.ops.segment import neutral_min
+
+        pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block,
+                               bkt_src, bkt_dst, bkt_mask,
+                               dyn_src, dyn_dst, dyn_mask,
+                               mxu_src, mxu_dst, mxu_mask, diag_masks)
+        out = pass_(signal[0])
+        return jnp.where(node_mask_b, out, neutral_min(out.dtype))[None]
     pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
@@ -1846,13 +1898,22 @@ def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
     call and it runs at ring-sharded scale.
 
     ``signal`` is ``[S, block]`` (bool for ``op="or"``, float for
-    ``op="sum"``); returns the per-node aggregate in the same layout, masked
-    to live nodes. Static + dynamic (runtime-connected) edges and the
-    ring-decomposed diagonals all contribute, exactly as in the shipped
-    protocol bodies.
+    ``op="sum"``, float/int for ``op="max"``); returns the per-node
+    aggregate in the same layout, masked to live nodes (``max`` masks to
+    the dtype's -inf/int-min identity). Static + dynamic
+    (runtime-connected) edges and the ring-decomposed diagonals all
+    contribute, exactly as in the shipped protocol bodies. ``op="max"``
+    needs the segment layout: shard the graph without the MXU remainder
+    (no ``hybrid=True``/``min_count``) — one-hot matmuls compute sums,
+    not maxima.
     """
-    if op not in ("or", "sum"):
-        raise ValueError(f"op must be 'or' or 'sum', got {op!r}")
+    if op not in ("or", "sum", "max"):
+        raise ValueError(f"op must be 'or', 'sum' or 'max', got {op!r}")
+    if op == "max" and sg.mxu_src is not None:
+        raise ValueError(
+            "op='max' cannot ride the MXU one-hot layout — shard_graph "
+            "without hybrid/min_count for max-aggregating protocols"
+        )
     fn = _propagate_fn(mesh, axis_name, sg.n_shards, sg.block, op,
                        sg.diag_pieces, sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)

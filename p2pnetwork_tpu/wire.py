@@ -30,9 +30,11 @@ containing the EOT byte ``0x04`` corrupt framing, exactly as in the
 reference. Sending such payloads with ``compression=`` enabled is safe —
 the base64 alphabet contains no control bytes. Deployments that do not need
 reference interop can instead opt into ``framing="length"``
-(``NodeConfig.framing``): 4-byte big-endian length prefix + body, which
-carries arbitrary binary safely. Both peers must use the same framing; the
-default stays ``"eot"`` (reference-compatible).
+(``NodeConfig.framing``): 4-byte big-endian length prefix + one compression
+flag byte + payload, which carries arbitrary binary safely — no delimiter to
+corrupt and no marker byte to sniff (a raw payload may freely end in 0x02).
+Both peers must use the same framing; the default stays ``"eot"``
+(reference-compatible).
 """
 
 from __future__ import annotations
@@ -132,25 +134,30 @@ def encode_payload(data: Payload, encoding: str = "utf-8") -> bytes:
     )
 
 
-def frame_body(data: Payload, encoding: str = "utf-8",
-               compression: str = "none") -> bytes:
-    """Serialize + optionally compress into a frame body: payload [+ COMPR].
+#: Length-framing body flag bytes. framing="length" is this framework's
+#: own format with no reference compatibility to preserve, so compression
+#: is an EXPLICIT leading flag — not the reference's sniffable trailing
+#: marker, which silently eats a 0x02 that legitimately ends a raw
+#: payload. Body layout (the one released layout of this mode): 1 flag
+#: byte + payload; both peers must run the same framework version, as
+#: with any non-interop wire format.
+LENGTH_PLAIN = b"\x00"
+LENGTH_COMPRESSED = b"\x01"
 
-    The body is framing-agnostic — the trailing COMPR marker stays inside
-    it, so :func:`parse_packet` decodes bodies from either framing mode."""
-    raw = encode_payload(data, encoding)
-    if compression == "none":
-        return raw
-    return compress(raw, compression) + COMPR_CHAR
 
-
-def wrap_frame(body: bytes, framing: str = "eot") -> bytes:
-    """Wrap a frame body for the wire — the single place framing rules
-    (and their bounds checks) live; used by :func:`encode_frame` and the
-    connection send path alike."""
+def wrap_frame(payload: bytes, framing: str = "eot",
+               compressed: bool = False) -> bytes:
+    """Wrap a serialized (and possibly compressed) payload for the wire —
+    the single place framing rules, compression marking, and bounds
+    checks live; used by :func:`encode_frame` and the connection send
+    path alike. ``payload`` is the raw encoded bytes, or the b64 blob
+    from :func:`compress` when ``compressed``."""
     if framing == "eot":
-        return body + EOT_CHAR
+        if compressed:
+            return payload + COMPR_CHAR + EOT_CHAR
+        return payload + EOT_CHAR
     if framing == "length":
+        body = (LENGTH_COMPRESSED if compressed else LENGTH_PLAIN) + payload
         if len(body) > 0xFFFFFFFF:
             raise ValueError("frame body exceeds the 4-byte length prefix")
         return len(body).to_bytes(4, "big") + body
@@ -164,12 +171,24 @@ def encode_frame(
 ) -> bytes:
     """Build one on-wire frame.
 
-    ``framing="eot"`` (default): body + EOT — byte-compatible with the
-    reference [ref: nodeconnection.py:117 (plain) and :121 (compressed)].
-    ``framing="length"``: 4-byte big-endian length prefix + body — safe for
-    arbitrary binary (no delimiter to corrupt), NOT reference-compatible.
+    ``framing="eot"`` (default): payload [+ COMPR] + EOT — byte-compatible
+    with the reference [ref: nodeconnection.py:117 (plain) and :121
+    (compressed)]. ``framing="length"``: 4-byte big-endian length prefix +
+    flag byte + payload — safe for arbitrary binary (no delimiter to
+    corrupt, no marker to sniff), NOT reference-compatible.
     """
-    return wrap_frame(frame_body(data, encoding, compression), framing)
+    raw = encode_payload(data, encoding)
+    if compression == "none":
+        return wrap_frame(raw, framing, compressed=False)
+    return wrap_frame(compress(raw, compression), framing, compressed=True)
+
+
+def parse_length_body(body: bytes) -> Payload:
+    """Decode one length-framed body (flag byte + payload) — the
+    ``framing="length"`` counterpart of :func:`parse_packet`."""
+    if body[:1] == LENGTH_COMPRESSED:
+        return decode_payload(decompress(body[1:]))
+    return decode_payload(body[1:])
 
 
 def parse_packet(packet: bytes) -> Payload:
@@ -268,7 +287,9 @@ class LengthFrameDecoder:
         self._buffer += chunk
         while len(self._buffer) >= self._HEADER:
             body_len = int.from_bytes(self._buffer[:self._HEADER], "big")
-            if body_len > self.max_buffer:
+            # Header-inclusive bound: buffered bytes never exceed
+            # max_buffer, exactly as advertised.
+            if body_len > self.max_buffer - self._HEADER:
                 self._buffer = b""
                 raise FrameOverflowError(
                     f"declared frame length {body_len} exceeds the "

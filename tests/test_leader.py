@@ -1,0 +1,160 @@
+"""LeaderElection (highest-live-id flooding) vs a numpy fixpoint oracle,
+plus the sharded max-propagation seam."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import LeaderElection  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _oracle(g):
+    """Per-node fixpoint of max-of-neighbors over live edges (numpy)."""
+    n_pad = g.n_nodes_padded
+    alive = np.asarray(g.node_mask)
+    known = np.where(alive, np.arange(n_pad), -1)
+    send = np.asarray(g.senders)
+    recv = np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    pairs = [(send[em], recv[em])]
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        pairs.append((np.asarray(g.dyn_senders)[dm],
+                      np.asarray(g.dyn_receivers)[dm]))
+    for _ in range(n_pad):
+        before = known.copy()
+        for s, r in pairs:
+            ok = alive[s] & alive[r]
+            np.maximum.at(known, r[ok], known[s[ok]])
+        known = np.where(alive, known, -1)
+        if (known == before).all():
+            break
+    return known
+
+
+def _run_to_convergence(g, method="auto"):
+    _, out = engine.run_until_converged(
+        g, LeaderElection(method=method), jax.random.key(0),
+        stat="changed", threshold=1, max_rounds=512,
+    )
+    st, _ = engine.run(g, LeaderElection(method=method), jax.random.key(0),
+                       int(out["rounds"]))
+    return st, out
+
+
+class TestLeaderElection:
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_ring_converges_to_max_id(self, method):
+        g = G.ring(128)
+        st, out = _run_to_convergence(g, method)
+        np.testing.assert_array_equal(np.asarray(st.known), _oracle(g))
+        alive = np.asarray(g.node_mask)
+        assert (np.asarray(st.known)[alive] == 127).all()
+        # Highest-id flooding on a ring needs about a diameter of rounds.
+        assert int(out["rounds"]) >= 32
+
+    def test_ws_matches_oracle(self):
+        g = G.watts_strogatz(1024, 6, 0.2, seed=0)
+        st, _ = _run_to_convergence(g)
+        np.testing.assert_array_equal(np.asarray(st.known), _oracle(g))
+
+    def test_dead_top_node_is_not_elected(self):
+        g = failures.fail_nodes(G.watts_strogatz(256, 6, 0.2, seed=1), [255])
+        st, _ = _run_to_convergence(g)
+        known = np.asarray(st.known)
+        alive = np.asarray(g.node_mask)
+        assert (known[alive] == 254).all()
+        assert known[255] == -1
+        np.testing.assert_array_equal(known, _oracle(g))
+
+    def test_disconnected_components_elect_separately(self):
+        # Two disjoint directed rings: 0..63 and 64..127.
+        idx = np.arange(64)
+        senders = np.concatenate([idx, 64 + idx])
+        receivers = np.concatenate([(idx + 1) % 64, 64 + (idx + 1) % 64])
+        g = G.from_edges(senders, receivers, 128)
+        st, _ = _run_to_convergence(g)
+        known = np.asarray(st.known)
+        assert (known[:64] == 63).all() and (known[64:128] == 127).all()
+        # Global coverage plateaus at the majority component's share.
+        proto = LeaderElection()
+        cov = float(proto.coverage(g, st))
+        assert cov == pytest.approx(
+            (known[: g.n_nodes] == known[: g.n_nodes].max()).mean())
+
+    def test_runtime_link_merges_components(self):
+        idx = np.arange(64)
+        senders = np.concatenate([idx, 64 + idx])
+        receivers = np.concatenate([(idx + 1) % 64, 64 + (idx + 1) % 64])
+        g = G.from_edges(senders, receivers, 128)
+        g = topology.connect(topology.with_capacity(g, extra_edges=4),
+                             [100], [3])  # high ring -> low ring
+        st, _ = _run_to_convergence(g)
+        known = np.asarray(st.known)
+        assert (known[: 128] == 127).all()  # everyone agrees now
+        np.testing.assert_array_equal(known, _oracle(g))
+
+    def test_message_accounting_quiesces(self):
+        g = G.watts_strogatz(512, 4, 0.1, seed=2)
+        _, stats = engine.run(g, LeaderElection(), jax.random.key(0), 40)
+        msgs = np.asarray(stats["messages"])
+        changed = np.asarray(stats["changed"])
+        # Once nothing changes, nothing is sent the round after — a
+        # converged overlay is silent (unlike naive re-broadcast).
+        done = np.nonzero(changed == 0)[0]
+        assert done.size > 0
+        assert (msgs[done[0] + 1:] == 0).all()
+
+
+class TestShardedMaxPropagate:
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_leader_election_via_max_seam(self, n_shards):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=3)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        S, block = sg.n_shards, sg.block
+        ids = jnp.arange(S * block, dtype=jnp.int32).reshape(S, block)
+        known = jnp.where(sg.node_mask, ids, -1)
+        for _ in range(40):
+            heard = sharded.propagate(sg, mesh, known, op="max")
+            known = jnp.where(sg.node_mask, jnp.maximum(known, heard), -1)
+        np.testing.assert_array_equal(
+            np.asarray(known).reshape(-1), _oracle(g))
+
+    def test_max_rejects_mxu_layout(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=4)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, hybrid=True, min_count=32)
+        with pytest.raises(ValueError, match="max"):
+            sharded.propagate(sg, mesh, sg.node_mask.astype(jnp.int32),
+                              op="max")
+
+    def test_max_with_dynamic_links_and_failures(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+        from p2pnetwork_tpu.sim import failures as F
+
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        sg = sharded.with_capacity(sharded.fail_nodes(sg, [255]), 8)
+        sg = sharded.connect(sg, [10], [200])
+        gc = topology.connect(
+            topology.with_capacity(F.fail_nodes(g, [255]), extra_edges=8),
+            [10], [200],
+        )
+        S, block = sg.n_shards, sg.block
+        ids = jnp.arange(S * block, dtype=jnp.int32).reshape(S, block)
+        known = jnp.where(sg.node_mask, ids, -1)
+        for _ in range(300):
+            heard = sharded.propagate(sg, mesh, known, op="max")
+            known = jnp.where(sg.node_mask, jnp.maximum(known, heard), -1)
+        np.testing.assert_array_equal(
+            np.asarray(known).reshape(-1), _oracle(gc))

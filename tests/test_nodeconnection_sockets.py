@@ -180,8 +180,10 @@ class TestLengthPrefixedFraming:
         server, client = self.pair_length(rec)
         try:
             # Invalid utf-8 (so the parse chain keeps it as bytes) with
-            # embedded EOT 0x04 bytes (which delimiter framing would split).
-            payload = b"\xff\x04\xfe\x02stuff\x00\x04\xff"
+            # embedded EOT 0x04 bytes (which delimiter framing would
+            # split) AND a trailing 0x02 (which EOT framing's compression
+            # sniff would strip) — length framing carries both intact.
+            payload = b"\xff\x04\xfe\x02stuff\x00\x04\xff\x02"
             client.send_to_nodes(payload)
             assert wait_until(lambda: payload in rec.messages())
         finally:

@@ -100,24 +100,26 @@ class TestDecoderFuzz:
                 body = bytes(rng.randrange(256)
                              for _ in range(rng.randrange(1, 200)))
                 if framing == "eot":
+                    # EOT framing cannot carry the delimiter, and its
+                    # parse chain sniffs a trailing 0x02 as the
+                    # compression marker (reference parity). Length
+                    # framing carries BOTH unmodified — that is its point.
                     body = body.replace(wire.EOT_CHAR, b"\xfe")
-                # 0x02-terminated raw bytes are sniffed as compressed by
-                # the parse chain in EITHER framing (reference parity) —
-                # a sender must compress such payloads to keep the type.
-                while body.endswith(wire.COMPR_CHAR):
-                    body = body[:-1] + b"\xfe"
-                if not body:
-                    body = b"\xfe"
+                    while body.endswith(wire.COMPR_CHAR):
+                        body = body[:-1] + b"\xfe"
+                    if not body:
+                        body = b"\xfe"
                 payloads.append(body)
         stream = b"".join(wire.encode_frame(p, framing=framing)
                           for p in payloads)
         dec = wire.make_decoder(framing)
+        parse = (wire.parse_length_body if framing == "length"
+                 else wire.parse_packet)
         out = []
         i = 0
         while i < len(stream):
             step = rng.randrange(1, 50)
-            out.extend(wire.parse_packet(b)
-                       for b in dec.feed(stream[i:i + step]))
+            out.extend(parse(b) for b in dec.feed(stream[i:i + step]))
             i += step
         assert dec.pending == 0
         assert len(out) == len(payloads)
@@ -139,13 +141,15 @@ class TestDecoderFuzz:
         for _ in range(300):
             chunk = bytes(rng.randrange(256)
                           for _ in range(rng.randrange(1, 400)))
+            parse = (wire.parse_length_body if framing == "length"
+                     else wire.parse_packet)
             try:
                 for packet in dec.feed(chunk):
-                    wire.parse_packet(packet)  # must not raise either
+                    parse(packet)  # must not raise either
             except wire.FrameOverflowError:
                 overflows += 1  # allowed: bound enforced, stream reset
-            # +4: a length header may sit atop an almost-complete body.
-            assert dec.pending <= 4096 + 4
+            # Header-inclusive bound: never more than max_buffer buffered.
+            assert dec.pending <= 4096
         # With random bytes the 4 KiB bound must have tripped at least
         # once in 300 x ~200 B for the length decoder (huge bogus
         # headers) — proves the bound is live, not decorative.

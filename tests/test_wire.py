@@ -109,33 +109,38 @@ class TestFrameDecoder:
 
 
 class TestLengthFraming:
-    """framing="length": 4-byte big-endian prefix + body (wire.py). Not
-    reference-compatible by design; carries arbitrary binary safely."""
+    """framing="length": 4-byte big-endian prefix + flag byte + payload
+    (wire.py). Not reference-compatible by design; carries arbitrary
+    binary safely — including payloads ending in the 0x02 marker the EOT
+    mode's sniff would strip."""
 
     def test_encode_frame_length_mode(self):
         frame = wire.encode_frame(b"\x04\x02\x00", framing="length")
-        assert frame == (3).to_bytes(4, "big") + b"\x04\x02\x00"
+        assert frame == ((4).to_bytes(4, "big") + wire.LENGTH_PLAIN
+                         + b"\x04\x02\x00")
 
     def test_roundtrip_all_payload_types(self):
         dec = wire.make_decoder("length")
-        payloads = ["text", {"a": 1}, b"\xff\x04\xfe"]
+        # The last payload ENDS in 0x02 — the case the sniffing EOT chain
+        # cannot carry raw.
+        payloads = ["text", {"a": 1}, b"\xff\x04\xfe", b"\xff\x02"]
         stream = b"".join(
             wire.encode_frame(p, framing="length") for p in payloads)
         # Feed byte-by-byte to exercise partial-header and partial-body.
         out = []
         for i in range(len(stream)):
-            out.extend(wire.parse_packet(b)
+            out.extend(wire.parse_length_body(b)
                        for b in dec.feed(stream[i:i + 1]))
         assert out == payloads
         assert dec.pending == 0
 
-    def test_compressed_body_keeps_marker(self):
+    def test_compressed_body_carries_flag(self):
         dec = wire.make_decoder("length")
         frame = wire.encode_frame({"k": 2}, compression="lzma",
                                   framing="length")
         (body,) = list(dec.feed(frame))
-        assert body.endswith(wire.COMPR_CHAR)
-        assert wire.parse_packet(body) == {"k": 2}
+        assert body[:1] == wire.LENGTH_COMPRESSED
+        assert wire.parse_length_body(body) == {"k": 2}
 
     def test_oversize_declared_length_rejected_immediately(self):
         dec = wire.LengthFrameDecoder(max_buffer=1024)
@@ -146,7 +151,9 @@ class TestLengthFraming:
 
     def test_empty_frame(self):
         dec = wire.make_decoder("length")
-        assert list(dec.feed(wire.encode_frame(b"", framing="length"))) == [b""]
+        (body,) = list(dec.feed(wire.encode_frame(b"", framing="length")))
+        assert body == wire.LENGTH_PLAIN
+        assert wire.parse_length_body(body) == ""  # decode chain: b"" -> ""
 
     def test_unknown_framing_rejected(self):
         with pytest.raises(ValueError, match="framing"):
