@@ -2104,6 +2104,100 @@ def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
     return ranks, out
 
 
+def _ring_leader_quiet(axis_name, S, block, pieces, mxu_block, max_rounds,
+                       bkt_src, bkt_dst, bkt_mask,
+                       dyn_src, dyn_dst, dyn_mask,
+                       mxu_src, mxu_dst, mxu_mask, diag_masks,
+                       node_mask, out_degree):
+    """Per-shard body: highest-live-id leader election run to quiescence —
+    the multi-chip mirror of models/leader.py under
+    engine.run_until_converged(stat="changed", threshold=1), as one
+    device-side while_loop. Nodes re-broadcast only the round after they
+    learned a better candidate; the loop exits on the first all-quiet
+    round (which is executed and message-counted, matching the engine)."""
+    from p2pnetwork_tpu.ops.segment import neutral_min
+
+    pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block,
+                           bkt_src, bkt_dst, bkt_mask,
+                           dyn_src, dyn_dst, dyn_mask,
+                           mxu_src, mxu_dst, mxu_mask, diag_masks)
+    node_mask_b, deg = node_mask[0], out_degree[0]
+    neutral = neutral_min(jnp.int32)
+    my = jax.lax.axis_index(axis_name)
+    ids = (my * block + jnp.arange(block)).astype(jnp.int32)
+    known0 = jnp.where(node_mask_b, ids, -1)
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def cond(carry):
+        _, _, rounds, changed, _, _ = carry
+        return (changed > 0) & (rounds < max_rounds)
+
+    def body(carry):
+        known, frontier, rounds, _, hi, lo = carry
+        msgs = jax.lax.psum(jnp.sum(jnp.where(frontier, deg, 0)), axis_name)
+        heard = pass_(jnp.where(frontier, known, neutral))
+        new_known = jnp.where(node_mask_b, jnp.maximum(known, heard), -1)
+        changed_mask = (new_known != known) & node_mask_b
+        changed = jax.lax.psum(
+            jnp.sum(changed_mask.astype(jnp.int32)), axis_name
+        )
+        hi, lo = accum.add((hi, lo), msgs)
+        return new_known, changed_mask, rounds + 1, changed, hi, lo
+
+    init = (known0, node_mask_b, jnp.int32(0),
+            jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name),
+            *accum.zero())
+    known, _, rounds, _, hi, lo = jax.lax.while_loop(cond, body, init)
+    winner = jax.lax.pmax(jnp.max(known), axis_name)
+    agreed = jax.lax.psum(
+        jnp.sum(((known == winner) & node_mask_b).astype(jnp.int32)),
+        axis_name,
+    )
+    return known[None], accum.pack_summary(rounds, agreed / n_live, (hi, lo))
+
+
+@functools.lru_cache(maxsize=64)
+def _leader_quiet_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                     max_rounds: int, pieces=(), mxu_block: int = 128):
+    body = functools.partial(_ring_leader_quiet, axis_name, S, block,
+                             pieces, mxu_block, max_rounds)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(body, mesh=mesh, check_vma=False,
+                       in_specs=(spec,) * 12, out_specs=(spec, P()))
+    return jax.jit(fn)
+
+
+def leader_until_quiet(sg: ShardedGraph, mesh: Mesh, *,
+                       max_rounds: int = 1024,
+                       axis_name: str = DEFAULT_AXIS):
+    """Highest-live-id leader election run until no node learns anything —
+    the multi-chip convergence loop of models/leader.py. Returns
+    ``(known [S, block] i32, dict(rounds, coverage, messages))`` where
+    ``coverage`` is the fraction of live nodes agreeing on the global
+    winner (1.0 on a connected live graph) and ``messages`` an exact
+    Python int. Requires the segment layout (``op="max"`` constraint —
+    shard_graph without hybrid/min_count)."""
+    if sg.mxu_src is not None:
+        raise ValueError(
+            "leader_until_quiet cannot ride the MXU one-hot layout — "
+            "shard_graph without hybrid/min_count for max aggregation"
+        )
+    S, block = sg.n_shards, sg.block
+    fn = _leader_quiet_fn(mesh, axis_name, S, block, max_rounds,
+                          sg.diag_pieces, sg.mxu_block)
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
+    known, packed = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree,
+    )
+    return known, accum.unpack_summary(packed)
+
+
 def _make_pushsum_round(axis_name, S, block, pieces, mxu_block,
                         bkt_src, bkt_dst, bkt_mask,
                         dyn_src, dyn_dst, dyn_mask,

@@ -158,3 +158,55 @@ class TestShardedMaxPropagate:
             known = jnp.where(sg.node_mask, jnp.maximum(known, heard), -1)
         np.testing.assert_array_equal(
             np.asarray(known).reshape(-1), _oracle(gc))
+
+
+class TestLeaderUntilQuiet:
+    """Device-side run-to-quiescence on the ring (leader_until_quiet) —
+    rounds and message totals must match the engine's
+    run_until_converged(stat='changed', threshold=1) exactly."""
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_matches_engine_convergence(self, n_shards):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=5)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        known, out = sharded.leader_until_quiet(sg, mesh)
+        _, ref = engine.run_until_converged(
+            g, LeaderElection(), jax.random.key(0),
+            stat="changed", threshold=1, max_rounds=512,
+        )
+        assert out["rounds"] == ref["rounds"]
+        assert out["messages"] == ref["messages"]
+        assert out["coverage"] == pytest.approx(1.0)
+        np.testing.assert_array_equal(
+            np.asarray(known).reshape(-1), _oracle(g))
+
+    def test_under_failures_and_links(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.ring(512)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        sg = sharded.with_capacity(sharded.fail_nodes(sg, [511, 7]), 8)
+        sg = sharded.connect(sg, [100], [300])
+        gc = topology.connect(
+            topology.with_capacity(failures.fail_nodes(g, [511, 7]),
+                                   extra_edges=8),
+            [100], [300],
+        )
+        known, out = sharded.leader_until_quiet(sg, mesh)
+        np.testing.assert_array_equal(
+            np.asarray(known).reshape(-1), _oracle(gc))
+        flat = np.asarray(known).reshape(-1)
+        assert flat[np.asarray(gc.node_mask)].max() == 510  # 511 is dead
+
+    def test_rejects_mxu_layout(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=6)
+        sg = sharded.shard_graph(g, M.ring_mesh(4), hybrid=True,
+                                 min_count=32)
+        with pytest.raises(ValueError, match="MXU"):
+            sharded.leader_until_quiet(sg, M.ring_mesh(4))
