@@ -1,9 +1,10 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Three questions reference users answer by hand-instrumenting callbacks
+Four questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
-(HopDistance / BFS), what's the network-wide average (PushSum) — each runs
-here as a batched protocol over the whole population in one compiled scan.
+(HopDistance / BFS), what's the network-wide average (PushSum), who
+coordinates (LeaderElection) — each runs here as a batched protocol over
+the whole population in one compiled scan.
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -15,7 +16,8 @@ sys.path.insert(0, ".")
 import jax
 import numpy as np
 
-from p2pnetwork_tpu.models import HopDistance, PageRank, PushSum
+from p2pnetwork_tpu.models import (HopDistance, LeaderElection, PageRank,
+                                   PushSum)
 from p2pnetwork_tpu.sim import engine
 from p2pnetwork_tpu.sim import graph as G
 
@@ -57,6 +59,14 @@ def main():
     print(f"PushSum: true mean {true_mean:+.5f}, "
           f"estimates [{est.min():+.5f}, {est.max():+.5f}] after 60 rounds "
           f"(variance {float(np.asarray(stats['variance'])[-1]):.2e})")
+
+    # Who coordinates: highest-live-id election, run until silent.
+    _, out = engine.run_until_converged(
+        g, LeaderElection(), jax.random.key(2), stat="changed", threshold=1,
+        max_rounds=128,
+    )
+    print(f"LeaderElection: node {n - 1} elected everywhere in "
+          f"{int(out['rounds'])} rounds ({int(out['messages'])} messages)")
 
 
 if __name__ == "__main__":
