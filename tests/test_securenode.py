@@ -286,3 +286,30 @@ def test_hmac_fallback_scheme(monkeypatch):
         assert w.check_envelope(env) == "bad signature"
     finally:
         stop_all([a, b, w])
+
+
+class TestSecureOverLengthFraming:
+    def test_signed_broadcast_on_length_framing(self):
+        # Feature composition: signed envelopes ride the opt-in
+        # length-prefixed framing unchanged (the envelope is a dict — the
+        # framing layer is invisible to the security layer).
+        from p2pnetwork_tpu import NodeConfig
+
+        rec = EventRecorder()
+        cfg = NodeConfig(framing="length")
+        a = SecureNode("127.0.0.1", 0, id="alice",
+                       config=NodeConfig(framing="length"))
+        b = SecureNode("127.0.0.1", 0, id="bob", callback=rec, config=cfg)
+        a.start()
+        b.start()
+        try:
+            assert a.connect_with_node("127.0.0.1", b.port)
+            assert wait_until(lambda: len(b.nodes_inbound) == 1)
+            a.send_to_nodes_signed({"tx": "framed", "n": 1},
+                                   compression="zlib")
+            assert wait_until(lambda: rec.count("secure_message") == 1)
+            assert rec.data_for("secure_message") == [{"tx": "framed",
+                                                       "n": 1}]
+            assert b.message_count_rerr == 0
+        finally:
+            stop_all([a, b])
