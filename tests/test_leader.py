@@ -210,3 +210,18 @@ class TestLeaderUntilQuiet:
                                  min_count=32)
         with pytest.raises(ValueError, match="MXU"):
             sharded.leader_until_quiet(sg, M.ring_mesh(4))
+
+
+class TestLeaderOnSimNode:
+    def test_jaxsimnode_runs_election_to_convergence(self):
+        # The bridge is protocol-agnostic: a JaxSimNode population runs
+        # the election with the same run_until_converged surface.
+        from p2pnetwork_tpu.sim.simnode import JaxSimNode
+
+        g = G.watts_strogatz(2048, 6, 0.2, seed=7)
+        node = JaxSimNode(graph=g, protocol=LeaderElection(), id="sim")
+        out = node.run_until_converged("changed", 1, max_rounds=128)
+        assert out["value"] == 0  # quiet: nobody learned anything
+        known = np.asarray(node.sim_state.known)
+        np.testing.assert_array_equal(known, _oracle(g))
+        assert node.sim_message_count > 0
