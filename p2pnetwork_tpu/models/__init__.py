@@ -19,6 +19,7 @@ from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
+from p2pnetwork_tpu.models.spanning import SpanningTree, SpanningTreeState
 
 __all__ = [
     "Protocol",
@@ -40,4 +41,6 @@ __all__ = [
     "PushSumState",
     "SIR",
     "SIRState",
+    "SpanningTree",
+    "SpanningTreeState",
 ]

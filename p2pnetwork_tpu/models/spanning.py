@@ -1,0 +1,88 @@
+"""Spanning-tree construction over the flood wave.
+
+Reference users who outgrow naive flooding build a broadcast TREE on the
+hooks: remember who you first heard a message from, forward only down-tree
+afterwards [ref: README.md:20 — protocols are the user's job]. This
+protocol is that construction, batched: the BFS wave expands exactly like
+models/flood.py, and every newly reached node records a PARENT — the
+highest-id frontier neighbor that delivered this round (deterministic,
+no RNG). The result is a rooted spanning tree of the source's reachable
+component: ``parent[source] == source``, every other reached node's
+parent sits one hop closer to the source.
+
+The parent choice rides :func:`ops.segment.propagate_max` over the
+frontier's ids — one masked neighbor-max per round, no gather of edge
+endpoints, no atomics; exactly the aggregation the leader election uses,
+pointed at a different question.
+
+Stats contract: ``messages`` (flood accounting), ``coverage`` (reached
+fraction of live nodes — run_until_coverage works), ``frontier``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SpanningTreeState:
+    parent: jax.Array  # i32[N_pad] — -1 until reached; parent[source]=source
+    frontier: jax.Array  # bool[N_pad] — reached last round
+    dist: jax.Array  # i32[N_pad] — hops from source, -1 until reached
+    round: jax.Array  # i32[]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class SpanningTree:
+    """BFS spanning tree from ``source``; parents picked as the highest-id
+    delivering neighbor. ``method`` as in ops/segment.propagate_max
+    (``"segment"``/``"gather"``/``"auto"``)."""
+
+    source: int = 0
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> SpanningTreeState:
+        base.validate_source(graph, self.source)
+        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[
+            self.source].set(True)
+        seed = seed & graph.node_mask
+        parent = jnp.where(seed, self.source, -1).astype(jnp.int32)
+        return SpanningTreeState(
+            parent=parent, frontier=seed,
+            dist=jnp.where(seed, 0, -1).astype(jnp.int32),
+            round=jnp.int32(0),
+        )
+
+    def coverage(self, graph: Graph, state: SpanningTreeState) -> jax.Array:
+        """Reached fraction of live nodes."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum((state.parent >= 0) & graph.node_mask) / n_real
+
+    def step(self, graph: Graph, state: SpanningTreeState, key: jax.Array):
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        neutral = segment.neutral_min(jnp.int32)
+        # Frontier nodes offer their id; each unreached receiver adopts
+        # the highest offer as its parent — one neighbor-max per round.
+        offer = jnp.where(state.frontier & graph.node_mask, ids, neutral)
+        best = segment.propagate_max(graph, offer, self.method)
+        newly = (best >= 0) & (state.parent < 0) & graph.node_mask
+        rnd = state.round + 1
+        parent = jnp.where(newly, best, state.parent)
+        dist = jnp.where(newly, rnd, state.dist)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            "messages": segment.frontier_messages(
+                graph, state.frontier & graph.node_mask),
+            "coverage": jnp.sum((parent >= 0) & graph.node_mask) / n_real,
+            "frontier": jnp.sum(newly),
+        }
+        return SpanningTreeState(parent=parent, frontier=newly, dist=dist,
+                                 round=rnd), stats

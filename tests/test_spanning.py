@@ -1,0 +1,126 @@
+"""SpanningTree: the extracted tree must be a valid rooted BFS tree of the
+source's reachable component — parents are live in-neighbors one hop
+closer to the source, depths match HopDistance exactly, and the parent
+choice (highest-id deliverer) is deterministic."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import HopDistance, SpanningTree  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _edge_set(g):
+    pairs = set()
+    em = np.asarray(g.edge_mask)
+    for s, r in zip(np.asarray(g.senders)[em], np.asarray(g.receivers)[em]):
+        pairs.add((int(s), int(r)))
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        for s, r in zip(np.asarray(g.dyn_senders)[dm],
+                        np.asarray(g.dyn_receivers)[dm]):
+            pairs.add((int(s), int(r)))
+    return pairs
+
+
+def _check_tree(g, st, source):
+    """Structural validity + BFS-depth parity against HopDistance."""
+    parent = np.asarray(st.parent)
+    dist = np.asarray(st.dist)
+    alive = np.asarray(g.node_mask)
+    edges = _edge_set(g)
+    ref, _ = engine.run(g, HopDistance(source=source), jax.random.key(0), 64)
+    ref_dist = np.asarray(ref.dist)
+    np.testing.assert_array_equal(dist, ref_dist)  # same BFS layers
+    assert parent[source] == source and dist[source] == 0
+    for v in np.nonzero((parent >= 0) & alive)[0]:
+        if v == source:
+            continue
+        p = int(parent[v])
+        assert alive[p], f"dead parent {p} for {v}"
+        assert (p, int(v)) in edges, f"parent edge {p}->{v} not in graph"
+        assert dist[p] == dist[v] - 1, f"non-BFS parent depth at {v}"
+    # Unreached nodes have no parent.
+    assert (parent[ref_dist < 0] == -1).all()
+
+
+class TestSpanningTree:
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_ws_tree_is_valid(self, method):
+        g = G.watts_strogatz(2048, 6, 0.2, seed=0)
+        st, out = engine.run_until_coverage(
+            g, SpanningTree(source=5, method=method), jax.random.key(0),
+            coverage_target=1.0, max_rounds=64,
+        )
+        st2, _ = engine.run(g, SpanningTree(source=5, method=method),
+                            jax.random.key(0), int(out["rounds"]))
+        _check_tree(g, st2, 5)
+
+    def test_parent_choice_is_highest_id(self):
+        # Node 3 is fed by 0, 1 and 2 in round one: the deterministic
+        # parent is the highest id, 2.
+        senders = [0, 0, 0, 1, 2]
+        receivers = [1, 2, 3, 3, 3]
+        g = G.from_edges(senders, receivers, 8)
+        st, _ = engine.run(g, SpanningTree(source=0), jax.random.key(0), 2)
+        assert int(np.asarray(st.parent)[3]) == 0  # round 1: only 0 sends
+        # Remove the direct 0->3 edge: now 3 is reached in round 2 via the
+        # higher of {1, 2}.
+        g2 = G.from_edges([0, 0, 1, 2], [1, 2, 3, 3], 8)
+        st2, _ = engine.run(g2, SpanningTree(source=0), jax.random.key(0), 3)
+        assert int(np.asarray(st2.parent)[3]) == 2
+
+    def test_under_failures_and_links(self):
+        g = failures.fail_nodes(G.watts_strogatz(1024, 6, 0.2, seed=1), [9])
+        g = topology.connect(topology.with_capacity(g, extra_edges=8),
+                             [2], [900])
+        st, out = engine.run_until_coverage(
+            g, SpanningTree(source=0), jax.random.key(0),
+            coverage_target=1.0, max_rounds=64,
+        )
+        st2, _ = engine.run(g, SpanningTree(source=0), jax.random.key(0),
+                            int(out["rounds"]))
+        _check_tree(g, st2, 0)
+        assert np.asarray(st2.parent)[9] == -1  # dead node outside the tree
+
+    def test_disconnected_remainder_unreached(self):
+        idx = np.arange(64)
+        g = G.from_edges(np.concatenate([idx, 64 + idx]),
+                         np.concatenate([(idx + 1) % 64,
+                                         64 + (idx + 1) % 64]), 128)
+        st, _ = engine.run(g, SpanningTree(source=0), jax.random.key(0), 70)
+        parent = np.asarray(st.parent)
+        assert (parent[:64] >= 0).all()
+        assert (parent[64:128] == -1).all()
+        proto = SpanningTree(source=0)
+        assert float(proto.coverage(g, st)) == pytest.approx(0.5)
+
+
+class TestSpanningTreeSharded:
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_tree_via_max_seam_matches_engine(self, n_shards):
+        import jax.numpy as jnp
+
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=2)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh)
+        S, block = sg.n_shards, sg.block
+        ids = jnp.arange(S * block, dtype=jnp.int32).reshape(S, block)
+        neutral = jnp.int32(jnp.iinfo(jnp.int32).min)
+        parent = jnp.where(
+            (ids == 0) & sg.node_mask, 0, -1).astype(jnp.int32)
+        frontier = (ids == 0) & sg.node_mask
+        for _ in range(20):
+            offer = jnp.where(frontier & sg.node_mask, ids, neutral)
+            best = sharded.propagate(sg, mesh, offer, op="max")
+            newly = (best >= 0) & (parent < 0) & sg.node_mask
+            parent = jnp.where(newly, best, parent)
+            frontier = newly
+        ref, _ = engine.run(g, SpanningTree(source=0), jax.random.key(0), 20)
+        np.testing.assert_array_equal(
+            np.asarray(parent).reshape(-1), np.asarray(ref.parent))
