@@ -2548,27 +2548,52 @@ def _hopdist_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
                            coverage_target: float = 0.99,
                            max_rounds: int = 1024,
-                           axis_name: str = DEFAULT_AXIS, state0=None):
+                           axis_name: str = DEFAULT_AXIS, state0=None,
+                           adaptive_k: int = 0):
     """BFS until the reached fraction of the LIVE population hits the
     target — engine.run_until_coverage's measurement for HopDistance,
     multi-chip — with an extra early exit the engine loop lacks: if the
     wave dies out first (unreachable remainder), the loop stops instead of
     spinning to ``max_rounds``. Returns ``((dist, frontier, round),
-    dict(rounds, coverage, messages))``."""
+    dict(rounds, coverage, messages))``.
+
+    ``adaptive_k > 0`` (requires ``shard_graph(source_csr=True)``) runs
+    small-frontier rounds through the work-item sparse path — the same
+    machinery, budget and bit-identity contract as
+    ``flood_until_coverage(adaptive_k=...)``; BFS layers, rounds and
+    message totals are unchanged."""
     S, block = sg.n_shards, sg.block
     if state0 is None:
         state0 = init_state(sg, protocol, None)
     dist0, frontier0, round0 = state0
-    fn = _hopdist_cov_fn(mesh, axis_name, S, block, max_rounds,
-                         sg.diag_pieces, sg.mxu_block)
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
-    dist, frontier, packed = fn(
-        jnp.float32(coverage_target),
-        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
-        sg.node_mask, sg.out_degree, dist0, frontier0, round0,
-    )
+    if adaptive_k > 0:
+        if sg.csr_pos is None:
+            raise ValueError(
+                "adaptive_k requires a sender-CSR sharded graph — build "
+                "with shard_graph(source_csr=True)"
+            )
+        fn = _hopdist_adaptive_cov_fn(
+            mesh, axis_name, S, block, max_rounds, adaptive_k,
+            max(sg.csr_span, 1), sg.diag_pieces, sg.mxu_block,
+        )
+        dist, frontier, packed = fn(
+            jnp.float32(coverage_target),
+            sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+            mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+            sg.node_mask, sg.out_degree, sg.csr_pos, sg.csr_offsets,
+            dist0, frontier0, round0,
+        )
+    else:
+        fn = _hopdist_cov_fn(mesh, axis_name, S, block, max_rounds,
+                             sg.diag_pieces, sg.mxu_block)
+        dist, frontier, packed = fn(
+            jnp.float32(coverage_target),
+            sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+            mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
+            sg.node_mask, sg.out_degree, dist0, frontier0, round0,
+        )
     out = accum.unpack_summary(packed)
     rnd = round0 + out["rounds"]
     return (dist, frontier, rnd), out
@@ -2576,16 +2601,19 @@ def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
 
 def hopdist_until_done(sg: ShardedGraph, mesh: Mesh, protocol, *,
                        max_rounds: int = 1024,
-                       axis_name: str = DEFAULT_AXIS, state0=None):
+                       axis_name: str = DEFAULT_AXIS, state0=None,
+                       adaptive_k: int = 0):
     """BFS until the wave dies out (or ``max_rounds``): the complete
     single-source reachability / eccentricity measurement — the
     coverage loop with an unreachable target, so only frontier death
     stops it. ``rounds`` includes the final round that observes the
     emptied frontier (one past the last delivery); the max over ``dist``
-    is the source's eccentricity."""
+    is the source's eccentricity. ``adaptive_k`` as in
+    :func:`hopdist_until_coverage` — the sparse tail is where adaptive
+    rounds pay off most (the wave's last layers are a trickle)."""
     return hopdist_until_coverage(
         sg, mesh, protocol, coverage_target=2.0, max_rounds=max_rounds,
-        axis_name=axis_name, state0=state0,
+        axis_name=axis_name, state0=state0, adaptive_k=adaptive_k,
     )
 
 
@@ -2615,24 +2643,27 @@ def _pack_global_frontier(axis_name, S, k, local_ids, local_count, pad_id):
     return out, jnp.sum(counts).astype(jnp.int32)
 
 
-def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
-                          coverage_target, max_rounds,
-                          bkt_src, bkt_dst, bkt_mask,
-                          dyn_src, dyn_dst, dyn_mask,
-                          mxu_src, mxu_dst, mxu_mask, diag_masks,
-                          node_mask, out_degree, csr_pos, csr_offsets,
-                          seen0, frontier0):
-    """Per-shard body: run-to-coverage flood where rounds with a small
-    global frontier skip the ring entirely — the frontier rides as a
-    replicated index list, and each shard gathers only ITS edges from
-    those senders through the sender-CSR view, chunked into W-wide WORK
-    ITEMS (O(k·W) work and one tiny all-gather, instead of O(E/S) bucket
-    work and S ppermute hops). Budgeting is by out-edge mass: the sparse
-    branch runs while the largest per-shard item count fits ``k``, so a
-    hub whose row rivals the budget tips the round dense instead of
-    widening every gather to its degree (the multi-chip mirror of
+def _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+                        bkt_src, bkt_dst, bkt_mask,
+                        dyn_src, dyn_dst, dyn_mask,
+                        mxu_src, mxu_dst, mxu_mask, diag_masks,
+                        node_mask, out_degree, csr_pos, csr_offsets):
+    """Build the adaptive wave-round closures shared by the run-to-coverage
+    flood and the adaptive BFS loops: rounds with a small global frontier
+    skip the ring entirely — the frontier rides as a replicated index
+    list, and each shard gathers only ITS edges from those senders
+    through the sender-CSR view, chunked into W-wide WORK ITEMS (O(k·W)
+    work and one tiny all-gather, instead of O(E/S) bucket work and S
+    ppermute hops). Budgeting is by out-edge mass: the sparse branch runs
+    while the largest per-shard item count fits ``k``, so a hub whose row
+    rivals the budget tips the round dense instead of widening every
+    gather to its degree (the multi-chip mirror of
     models/adaptive_flood.py's hub tolerance); results stay bit-identical
-    to the dense loop."""
+    to the dense loop.
+
+    Returns ``(sparse_round, dense_round, my_new_ids, item_budget,
+    n_live)`` — both rounds map ``(seen, frontier, F, fncount, ficount)
+    -> (seen, frontier, F, fncount, ficount, msgs)``."""
     pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
@@ -2754,6 +2785,28 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         # the non-compacted branch is never trusted.
         return seen, new, F, ncount, item_budget(F, ncount), msgs
 
+    return sparse_round, dense_round, my_new_ids, item_budget, n_live
+
+
+def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
+                          coverage_target, max_rounds,
+                          bkt_src, bkt_dst, bkt_mask,
+                          dyn_src, dyn_dst, dyn_mask,
+                          mxu_src, mxu_dst, mxu_mask, diag_masks,
+                          node_mask, out_degree, csr_pos, csr_offsets,
+                          seen0, frontier0):
+    """Per-shard body: run-to-coverage flood on the adaptive wave rounds
+    (see :func:`_make_adaptive_wave` for the work-item machinery)."""
+    sparse_round, dense_round, my_new_ids, item_budget, n_live = (
+        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+                            bkt_src, bkt_dst, bkt_mask,
+                            dyn_src, dyn_dst, dyn_mask,
+                            mxu_src, mxu_dst, mxu_mask, diag_masks,
+                            node_mask, out_degree, csr_pos, csr_offsets)
+    )
+    node_mask_b = node_mask[0]
+    pad_id = S * block - 1
+
     def cond(carry):
         _, _, _, _, _, rounds, covered, _, _ = carry
         return (covered / n_live < coverage_target) & (rounds < max_rounds)
@@ -2801,6 +2854,84 @@ def _flood_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 16,
+        out_specs=(spec, spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def _ring_adaptive_cov_hopdist(axis_name, S, block, pieces, mxu_block, k,
+                               span, coverage_target, max_rounds,
+                               bkt_src, bkt_dst, bkt_mask,
+                               dyn_src, dyn_dst, dyn_mask,
+                               mxu_src, mxu_dst, mxu_mask, diag_masks,
+                               node_mask, out_degree, csr_pos, csr_offsets,
+                               dist0, frontier0, round0):
+    """Per-shard body: BFS on the adaptive wave rounds — loop semantics of
+    :func:`_ring_coverage_hopdist` (stop on coverage, wave death, or
+    max_rounds), wave mechanics of :func:`_make_adaptive_wave`. ``seen``
+    is carried explicitly alongside ``dist`` so the round closures stay
+    shared with the flood loop; the two are linked by ``seen == (dist >=
+    0)`` at every step."""
+    sparse_round, dense_round, my_new_ids, item_budget, n_live = (
+        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+                            bkt_src, bkt_dst, bkt_mask,
+                            dyn_src, dyn_dst, dyn_mask,
+                            mxu_src, mxu_dst, mxu_mask, diag_masks,
+                            node_mask, out_degree, csr_pos, csr_offsets)
+    )
+    node_mask_b = node_mask[0]
+    pad_id = S * block - 1
+
+    def cond(carry):
+        _, _, _, _, fncount, _, rnd, covered, _, _ = carry
+        return ((fncount > 0) & (rnd - round0 < max_rounds)
+                & (covered / n_live < coverage_target))
+
+    def body(carry):
+        seen, dist, frontier, F, fncount, ficount, rnd, _, hi, lo = carry
+        seen, frontier, F, fncount, ficount, msgs = jax.lax.cond(
+            ficount <= k, sparse_round, dense_round,
+            seen, frontier, F, fncount, ficount,
+        )
+        rnd = rnd + 1
+        dist = jnp.where(frontier, rnd, dist)
+        hi, lo = accum.add((hi, lo), msgs)
+        covered = jax.lax.psum(
+            jnp.sum((seen & node_mask_b).astype(jnp.int32)), axis_name
+        )
+        return seen, dist, frontier, F, fncount, ficount, rnd, covered, hi, lo
+
+    dist_b, frontier_b = dist0[0], frontier0[0]
+    seen_b = (dist_b >= 0) & node_mask_b
+    count0 = jnp.sum(frontier_b).astype(jnp.int32)
+    F0, ncount0 = _pack_global_frontier(
+        axis_name, S, k, my_new_ids(frontier_b, count0), count0, pad_id
+    )
+    covered0 = jax.lax.psum(
+        jnp.sum(seen_b.astype(jnp.int32)), axis_name
+    )
+    init = (seen_b, dist_b, frontier_b, F0, ncount0,
+            item_budget(F0, ncount0), round0, covered0, *accum.zero())
+    _, dist, frontier, _, _, _, rnd, covered, hi, lo = jax.lax.while_loop(
+        cond, body, init
+    )
+    return dist[None], frontier[None], accum.pack_summary(
+        rnd - round0, covered / n_live, (hi, lo)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _hopdist_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                             max_rounds: int, k: int, span: int, pieces=(),
+                             mxu_block: int = 128):
+    body = functools.partial(_ring_adaptive_cov_hopdist, axis_name, S,
+                             block, pieces, mxu_block, k, span)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 16 + (P(),),
         out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)

@@ -149,19 +149,22 @@ class JaxSimNode(Node):
             )
         if adaptive_k > 0:
             from p2pnetwork_tpu.models.flood import Flood as _Flood
+            from p2pnetwork_tpu.models.hopdist import (
+                HopDistance as _HopDistance,
+            )
 
             # A silent no-op would be worse than an error: the flag only
-            # drives the mesh backend's Flood coverage loop.
+            # drives the mesh backend's Flood/HopDistance loops.
             if mesh is None:
                 raise ValueError(
                     "adaptive_k drives the mesh backend's coverage loop; "
                     "on the single-device backend use "
                     "protocol=AdaptiveFlood(...) on a source_csr=True graph"
                 )
-            if not isinstance(protocol, _Flood):
+            if not isinstance(protocol, (_Flood, _HopDistance)):
                 raise ValueError(
-                    f"adaptive_k applies to Flood on the mesh backend; got "
-                    f"{type(protocol).__name__}"
+                    f"adaptive_k applies to Flood and HopDistance on the "
+                    f"mesh backend; got {type(protocol).__name__}"
                 )
         self.sim_graph = graph
         self.sim_protocol = protocol
@@ -305,6 +308,7 @@ class JaxSimNode(Node):
                     self.sim_sharded, self.sim_mesh, self.sim_protocol,
                     coverage_target=coverage_target, max_rounds=max_rounds,
                     state0=self.sim_state,
+                    adaptive_k=self._sim_adaptive_k,
                 )
             elif isinstance(self.sim_protocol, SIR):
                 self.sim_state, out = sharded.sir_until_coverage(

@@ -1267,3 +1267,69 @@ class TestShardedAdaptiveHubGraphs:
         assert out_a["rounds"] == ref["rounds"]
         assert out_a["messages"] == ref["messages"]
         assert not np.asarray(seen_a).reshape(-1)[2]
+
+
+class TestShardedAdaptiveHopDistance:
+    """adaptive_k on the BFS loops: layers, rounds and message totals
+    bit-identical to the dense sharded loop, including the sparse tail
+    (the wave's last layers) and hub-skewed graphs."""
+
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    @pytest.mark.parametrize("k", [16, 256])
+    def test_until_done_matches_dense(self, n_shards, k):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=20)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        (d_a, _, r_a), out_a = sharded.hopdist_until_done(
+            sg, mesh, HopDistance(source=3), adaptive_k=k)
+        (d_d, _, r_d), out_d = sharded.hopdist_until_done(
+            sg, mesh, HopDistance(source=3))
+        np.testing.assert_array_equal(np.asarray(d_a), np.asarray(d_d))
+        assert out_a["rounds"] == out_d["rounds"]
+        assert out_a["messages"] == out_d["messages"]
+        assert int(r_a) == int(r_d)
+
+    def test_ba_hub_graph_until_coverage(self):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.barabasi_albert(2048, 4, seed=21)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        (d_a, _, _), out_a = sharded.hopdist_until_coverage(
+            sg, mesh, HopDistance(source=7), coverage_target=0.99,
+            adaptive_k=64)
+        (d_d, _, _), out_d = sharded.hopdist_until_coverage(
+            sg, mesh, HopDistance(source=7), coverage_target=0.99)
+        np.testing.assert_array_equal(np.asarray(d_a), np.asarray(d_d))
+        assert out_a["rounds"] == out_d["rounds"]
+        assert out_a["messages"] == out_d["messages"]
+
+    def test_under_churn_and_resume(self):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.ring(512)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        sg = sharded.with_capacity(sharded.fail_nodes(sg, [100]), 8)
+        sg = sharded.connect(sg, [5], [400])
+        proto = HopDistance(source=0)
+        st, _ = sharded.hopdist(sg, mesh, proto, 10)
+        (d_a, _, _), out_a = sharded.hopdist_until_done(
+            sg, mesh, proto, state0=st, adaptive_k=32)
+        (d_d, _, _), out_d = sharded.hopdist_until_done(
+            sg, mesh, proto, state0=st)
+        np.testing.assert_array_equal(np.asarray(d_a), np.asarray(d_d))
+        assert out_a["rounds"] == out_d["rounds"]
+        assert np.asarray(d_a).reshape(-1)[100] == -1
+
+    def test_requires_source_csr(self):
+        from p2pnetwork_tpu.models import HopDistance
+
+        g = G.ring(256)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh)
+        with pytest.raises(ValueError, match="source_csr"):
+            sharded.hopdist_until_done(sg, mesh, HopDistance(source=0),
+                                       adaptive_k=16)

@@ -296,3 +296,23 @@ class TestMeshBackedValueProtocols:
         out_b = b.run_until_converged("variance", 1e-9)
         assert out_a["value"] < 1e-9 and out_b["value"] < 1e-9
         assert abs(out_a["rounds"] - out_b["rounds"]) <= 1
+
+
+class TestSimNodeAdaptiveHopDistance:
+    def test_hopdist_adaptive_coverage_matches(self):
+        from p2pnetwork_tpu.models import HopDistance
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.sim import engine
+        from p2pnetwork_tpu.sim import graph as G
+        from p2pnetwork_tpu.sim.simnode import JaxSimNode
+
+        g = G.watts_strogatz(1024, 6, 0.2, seed=30)
+        node = JaxSimNode(graph=g, protocol=HopDistance(source=0),
+                          mesh=M.ring_mesh(8), adaptive_k=64)
+        out = node.run_until_coverage(0.99)
+        _, ref = engine.run_until_coverage(
+            g, HopDistance(source=0), jax.random.key(0),
+            coverage_target=0.99,
+        )
+        assert out["rounds"] == ref["rounds"]
+        assert out["messages"] == ref["messages"]
