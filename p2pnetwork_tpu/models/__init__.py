@@ -12,10 +12,16 @@ from p2pnetwork_tpu.models.adaptive_flood import (
     AdaptiveHopDistanceState,
 )
 from p2pnetwork_tpu.models.base import Protocol
+from p2pnetwork_tpu.models.components import (
+    ConnectedComponents,
+    ConnectedComponentsState,
+)
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
 from p2pnetwork_tpu.models.hopdist import HopDistance, HopDistanceState
+from p2pnetwork_tpu.models.kcore import KCore, KCoreState
 from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
+from p2pnetwork_tpu.models.mis import LubyMIS, LubyMISState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
@@ -27,14 +33,20 @@ __all__ = [
     "AdaptiveFloodState",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",
+    "ConnectedComponents",
+    "ConnectedComponentsState",
     "Flood",
     "FloodState",
     "Gossip",
     "GossipState",
     "HopDistance",
     "HopDistanceState",
+    "KCore",
+    "KCoreState",
     "LeaderElection",
     "LeaderElectionState",
+    "LubyMIS",
+    "LubyMISState",
     "PageRank",
     "PageRankState",
     "PushSum",

@@ -1,0 +1,77 @@
+"""Connected components / network-partition detection by label flooding.
+
+A question reference users answer by hand-rolling discovery protocols on
+the event hooks [ref: README.md:20 — the library "does not implement any
+protocol"]: *is the overlay partitioned, and into how many pieces?* Every
+node starts with its own id as its component label, repeatedly broadcasts
+the highest live label it has heard, and adopts anything higher. At
+quiescence each node holds the highest live id of its component, so the
+number of distinct surviving labels — equivalently, the number of live
+nodes still holding their own id — is the number of partitions.
+
+This is the same propagation as :class:`~p2pnetwork_tpu.models.leader.
+LeaderElection` (a leader election run *is* a partition labelling), but
+the public contract differs: the stats expose ``components`` (current
+count of label-maxima, i.e. partitions detected so far — monotonically
+non-increasing as floods merge) and ``changed`` for the quiescence test.
+Run with ``engine.run_until_converged(..., stat="changed", threshold=1)``;
+at that point ``state.label`` is the exact component labelling and
+``components`` the partition count.
+
+Directed-graph semantics: labels flow along edge direction, so the
+fixpoint groups nodes by "highest live id that can reach me". On the
+symmetric graphs the builders produce (watts_strogatz, erdos_renyi,
+barabasi_albert build undirected edge sets) this is exactly connected
+components; on an asymmetric overlay it is the max-ancestor relation —
+the same caveat the numpy oracle in tests/test_leader.py encodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models.leader import max_flood_step
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ConnectedComponentsState:
+    label: jax.Array  # i32[N_pad] — highest live id heard; -1 on dead nodes
+    frontier: jax.Array  # bool[N_pad] — adopted a new label last round
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class ConnectedComponents:
+    """Max-label flooding to a per-component fixpoint. ``method`` picks the
+    aggregation lowering (``"auto"``/``"segment"``/``"gather"`` — see
+    ops/segment.propagate_max)."""
+
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> ConnectedComponentsState:
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        label = jnp.where(graph.node_mask, ids, -1)
+        return ConnectedComponentsState(label=label, frontier=graph.node_mask)
+
+    def components(self, graph: Graph,
+                   state: ConnectedComponentsState) -> jax.Array:
+        """Number of live nodes still labelled with their own id — at
+        quiescence, exactly the number of connected components."""
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        return jnp.sum((state.label == ids) & graph.node_mask)
+
+    def step(self, graph: Graph, state: ConnectedComponentsState,
+             key: jax.Array):
+        label, changed, msgs = max_flood_step(
+            graph, state.label, state.frontier, self.method)
+        new_state = ConnectedComponentsState(label=label, frontier=changed)
+        stats = {
+            "messages": msgs,
+            "changed": jnp.sum(changed),
+            "components": self.components(graph, new_state),
+        }
+        return new_state, stats

@@ -42,6 +42,27 @@ class LeaderElectionState:
     frontier: jax.Array  # bool[N_pad] — learned something last round
 
 
+def max_flood_step(graph: Graph, known: jax.Array, frontier: jax.Array,
+                   method: str):
+    """One frontier-masked max-flood round, shared by LeaderElection and
+    ConnectedComponents (a leader election run *is* a partition labelling).
+
+    Only last round's learners re-broadcast; masking the signal to the
+    frontier keeps max-propagation identical (a non-frontier node's value
+    was already delivered in an earlier round). Returns
+    ``(known', frontier', messages)`` where ``frontier'`` is the changed
+    mask and ``messages`` the fan-out the reference's per-edge
+    ``send_to_nodes`` loop would have performed [ref: node.py:110-116].
+    """
+    neutral = segment.neutral_min(known.dtype)
+    signal = jnp.where(frontier, known, neutral)
+    heard = segment.propagate_max(graph, signal, method)
+    new_known = jnp.where(graph.node_mask, jnp.maximum(known, heard), -1)
+    changed = (new_known != known) & graph.node_mask
+    msgs = segment.frontier_messages(graph, frontier & graph.node_mask)
+    return new_known, changed, msgs
+
+
 @dataclasses.dataclass(frozen=True, unsafe_hash=True)
 class LeaderElection:
     """Highest-live-id election. ``method`` picks the aggregation lowering
@@ -62,17 +83,8 @@ class LeaderElection:
         return agreed / n_real
 
     def step(self, graph: Graph, state: LeaderElectionState, key: jax.Array):
-        # Only last round's learners re-broadcast; masking the signal to
-        # the frontier keeps max-propagation identical (a non-frontier
-        # node's candidate was already delivered in an earlier round).
-        neutral = segment.neutral_min(state.known.dtype)
-        signal = jnp.where(state.frontier, state.known, neutral)
-        heard = segment.propagate_max(graph, signal, self.method)
-        known = jnp.where(graph.node_mask,
-                          jnp.maximum(state.known, heard), -1)
-        changed = (known != state.known) & graph.node_mask
-        msgs = segment.frontier_messages(graph,
-                                         state.frontier & graph.node_mask)
+        known, changed, msgs = max_flood_step(
+            graph, state.known, state.frontier, self.method)
         new_state = LeaderElectionState(known=known, frontier=changed)
         stats = {
             "messages": msgs,

@@ -1,0 +1,80 @@
+"""Luby's maximal-independent-set protocol (randomized symmetry breaking).
+
+The classic building block for decentralized scheduling/clustering that
+reference users would hand-write on the event hooks [ref: README.md:20]:
+each undecided node draws a random priority and broadcasts it; a node
+whose draw strictly beats every undecided neighbor's joins the set and
+announces; the announcers' neighbors drop out of contention. Expected
+O(log n) rounds to decide everyone (Luby 1986 — PAPERS.md).
+
+One protocol round = one batched draw (`jax.random.randint` from the
+engine's per-round key) + one `propagate_max` of priorities over the
+undecided subgraph + one `propagate_or` of the join announcements. Ties
+(identical int32 draws between neighbors) leave both undecided for the
+round — correctness is unaffected, the pair re-draws next round.
+
+Independence of the result assumes the overlay is symmetric (every
+builder in sim/graph.py produces undirected edge sets): a strictly
+one-way edge lets the tail join without the head ever hearing it. The
+tests pin independence + maximality on the symmetric family.
+
+Run with ``engine.run_until_converged(..., stat="undecided",
+threshold=1)``; at quiescence ``state.in_mis`` is the set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LubyMISState:
+    in_mis: jax.Array  # bool[N_pad] — decided: member of the set
+    undecided: jax.Array  # bool[N_pad] — still in contention
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class LubyMIS:
+    """Randomized MIS. ``method`` picks the max-aggregation lowering
+    (``"auto"``/``"segment"``/``"gather"`` — ops/segment.propagate_max);
+    ``or_method`` the announcement lowering (propagate_or's choices)."""
+
+    method: str = "auto"
+    or_method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> LubyMISState:
+        dead = jnp.zeros(graph.n_nodes_padded, dtype=bool)
+        return LubyMISState(in_mis=dead, undecided=graph.node_mask)
+
+    def step(self, graph: Graph, state: LubyMISState, key: jax.Array):
+        undecided = state.undecided
+        # Per-round priorities; decided/dead nodes carry the max-identity
+        # so they never outrank anyone.
+        draws = jax.random.randint(key, undecided.shape, 0,
+                                   jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+        neutral = segment.neutral_min(draws.dtype)
+        prio = jnp.where(undecided, draws, neutral)
+        heard = segment.propagate_max(graph, prio, self.method)
+        join = undecided & (prio > heard)
+        # Winners announce; their neighbors leave contention.
+        lost = segment.propagate_or(graph, join, self.or_method)
+        in_mis = state.in_mis | join
+        undecided = undecided & ~join & ~lost
+        # Wire accounting: every contender broadcast its draw, every winner
+        # its announcement [ref: node.py:110-116 send_to_nodes fan-out].
+        msgs = (segment.frontier_messages(graph, state.undecided)
+                + segment.frontier_messages(graph, join))
+        new_state = LubyMISState(in_mis=in_mis, undecided=undecided)
+        stats = {
+            "messages": msgs,
+            "undecided": jnp.sum(undecided),
+            "mis_size": jnp.sum(in_mis),
+        }
+        return new_state, stats

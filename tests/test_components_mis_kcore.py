@@ -1,0 +1,259 @@
+"""ConnectedComponents / LubyMIS / KCore vs numpy oracles.
+
+Oracles are independent re-derivations (union-find, set-property checks,
+peeling loop) — not re-runs of the device code — so a wrong lowering
+cannot certify itself.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import (  # noqa: E402
+    ConnectedComponents,
+    KCore,
+    LubyMIS,
+)
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _live_edges(g):
+    """(senders, receivers) over live edges between live nodes, numpy."""
+    alive = np.asarray(g.node_mask)
+    send = np.asarray(g.senders)
+    recv = np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    pairs = [(send[em], recv[em])]
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        pairs.append((np.asarray(g.dyn_senders)[dm],
+                      np.asarray(g.dyn_receivers)[dm]))
+    s = np.concatenate([p[0] for p in pairs])
+    r = np.concatenate([p[1] for p in pairs])
+    ok = alive[s] & alive[r]
+    return s[ok], r[ok]
+
+
+def _union_find_components(g):
+    """Component id per live node via union-find (treating edges as
+    undirected — valid for the symmetric builders these tests use)."""
+    alive = np.asarray(g.node_mask)
+    parent = np.arange(g.n_nodes_padded)
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    s, r = _live_edges(g)
+    for a, b in zip(s, r):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+    roots = np.array([find(i) if alive[i] else -1
+                      for i in range(g.n_nodes_padded)])
+    return roots, len({x for x in roots if x >= 0})
+
+
+def _cc_converge(g, method="auto"):
+    st, out = engine.run_until_converged(
+        g, ConnectedComponents(method=method), jax.random.key(0),
+        stat="changed", threshold=1, max_rounds=1024,
+    )
+    return st, out
+
+
+class TestConnectedComponents:
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_single_component_ws(self, method):
+        g = G.watts_strogatz(512, 6, 0.2, seed=0)
+        st, _ = _cc_converge(g, method)
+        proto = ConnectedComponents(method=method)
+        assert int(proto.components(g, st)) == 1
+        # Every live node carries the globally highest live id.
+        label = np.asarray(st.label)
+        alive = np.asarray(g.node_mask)
+        assert (label[alive] == np.nonzero(alive)[0].max()).all()
+
+    def test_two_rings_detected_then_merged(self):
+        idx = np.arange(64)
+        senders = np.concatenate([idx, 64 + idx, (idx + 1) % 64,
+                                  64 + (idx + 1) % 64])
+        receivers = np.concatenate([(idx + 1) % 64, 64 + (idx + 1) % 64,
+                                    idx, 64 + idx])
+        g = G.from_edges(senders, receivers, 128)
+        st, _ = _cc_converge(g)
+        proto = ConnectedComponents()
+        assert int(proto.components(g, st)) == 2
+        label = np.asarray(st.label)
+        assert (label[:64] == 63).all() and (label[64:128] == 127).all()
+        # A runtime bridge merges the partitions: count drops to 1.
+        g2 = topology.connect(
+            topology.with_capacity(g, extra_edges=4), [100, 3], [3, 100])
+        st2, _ = _cc_converge(g2)
+        assert int(proto.components(g2, st2)) == 1
+
+    def test_component_count_matches_union_find_under_churn(self):
+        g = G.watts_strogatz(256, 4, 0.0, seed=1)  # pure ring lattice
+        # Cutting a contiguous run of nodes splits the k=4 ring lattice.
+        g = failures.fail_nodes(g, [0, 1, 128, 129])
+        st, _ = _cc_converge(g)
+        proto = ConnectedComponents()
+        _, want = _union_find_components(g)
+        assert int(proto.components(g, st)) == want
+        # Labels agree exactly with per-component maxima.
+        roots, _ = _union_find_components(g)
+        label = np.asarray(st.label)
+        alive = np.asarray(g.node_mask)
+        for root in {x for x in roots if x >= 0}:
+            members = np.nonzero((roots == root) & alive)[0]
+            assert (label[members] == members.max()).all()
+
+    def test_components_stat_is_monotone_nonincreasing(self):
+        g = G.watts_strogatz(512, 4, 0.1, seed=2)
+        _, stats = engine.run(g, ConnectedComponents(), jax.random.key(0), 24)
+        comps = np.asarray(stats["components"])
+        assert (np.diff(comps) <= 0).all()
+        assert comps[-1] == 1
+
+
+class TestLubyMIS:
+    def _converge(self, g, seed=0):
+        st, out = engine.run_until_converged(
+            g, LubyMIS(), jax.random.key(seed),
+            stat="undecided", threshold=1, max_rounds=256,
+        )
+        return st, out
+
+    @pytest.mark.parametrize("builder,args", [
+        ("watts_strogatz", (512, 6, 0.2)),
+        ("erdos_renyi", (256, 0.05)),
+        ("barabasi_albert", (256, 3)),
+    ])
+    def test_independent_and_maximal(self, builder, args):
+        g = getattr(G, builder)(*args, seed=3)
+        st, out = self._converge(g)
+        assert int(out["value"]) == 0  # everyone decided
+        in_mis = np.asarray(st.in_mis)
+        alive = np.asarray(g.node_mask)
+        s, r = _live_edges(g)
+        # Independence: no live edge inside the set.
+        assert not (in_mis[s] & in_mis[r]).any()
+        # Maximality (symmetric overlay): every live non-member hears a
+        # member.
+        covered = np.zeros_like(in_mis)
+        np.logical_or.at(covered, r, in_mis[s])
+        assert (in_mis | covered | ~alive).all()
+        assert not (in_mis & ~alive).any()
+
+    def test_deterministic_under_key(self):
+        g = G.watts_strogatz(256, 4, 0.1, seed=4)
+        a, _ = self._converge(g, seed=7)
+        b, _ = self._converge(g, seed=7)
+        np.testing.assert_array_equal(np.asarray(a.in_mis),
+                                      np.asarray(b.in_mis))
+
+    def test_respects_failures(self):
+        g = failures.fail_nodes(G.watts_strogatz(256, 6, 0.2, seed=5),
+                                [10, 11, 12])
+        st, _ = self._converge(g)
+        in_mis = np.asarray(st.in_mis)
+        assert not in_mis[[10, 11, 12]].any()
+        s, r = _live_edges(g)
+        assert not (in_mis[s] & in_mis[r]).any()
+
+    def test_complete_graph_elects_exactly_one(self):
+        g = G.complete(64)
+        st, _ = self._converge(g)
+        assert int(np.asarray(st.in_mis).sum()) == 1
+
+    def test_converges_in_log_rounds(self):
+        g = G.watts_strogatz(4096, 6, 0.2, seed=6)
+        _, out = self._converge(g)
+        # Luby's bound is expected O(log n); leave generous slack.
+        assert int(out["rounds"]) <= 64
+
+
+def _kcore_oracle(g, k):
+    """Numpy peeling fixpoint (directed in-degree, like the model)."""
+    alive = np.asarray(g.node_mask).copy()
+    while True:
+        s, r = _live_edges(g)
+        ok = alive[s] & alive[r]
+        deg = np.zeros(g.n_nodes_padded, dtype=np.int64)
+        np.add.at(deg, r[ok], 1)
+        new = alive & (deg >= k)
+        if (new == alive).all():
+            return new
+        alive = new
+
+
+class TestKCore:
+    def _converge(self, g, k, method="auto"):
+        st, out = engine.run_until_converged(
+            g, KCore(k=k, method=method), jax.random.key(0),
+            stat="removed", threshold=1, max_rounds=1024,
+        )
+        return st, out
+
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_ws_matches_oracle(self, method):
+        g = G.watts_strogatz(512, 6, 0.1, seed=0)
+        for k in (2, 4, 6, 7):
+            st, _ = self._converge(g, k, method)
+            np.testing.assert_array_equal(
+                np.asarray(st.in_core), _kcore_oracle(g, k),
+                err_msg=f"k={k}")
+
+    def test_ba_hubs_survive_high_k(self):
+        g = G.barabasi_albert(512, 4, seed=1)
+        st, _ = self._converge(g, 4)
+        np.testing.assert_array_equal(np.asarray(st.in_core),
+                                      _kcore_oracle(g, 4))
+        # The 4-core of a BA(m=4) graph is non-trivial but not everyone.
+        core = np.asarray(st.in_core)
+        assert 0 < core.sum()
+
+    def test_k_above_max_degree_empties(self):
+        g = G.ring(128)  # every node has in-degree 2
+        st, out = self._converge(g, 3)
+        assert int(np.asarray(st.in_core).sum()) == 0
+        assert int(out["rounds"]) >= 2  # peeling cascades, not one shot
+
+    def test_ring_is_its_own_2core(self):
+        g = G.ring(128)
+        st, _ = self._converge(g, 2)
+        np.testing.assert_array_equal(np.asarray(st.in_core),
+                                      np.asarray(g.node_mask))
+
+    def test_hybrid_lowering_matches(self):
+        g = G.watts_strogatz(512, 6, 0.1, seed=2, hybrid=True)
+        st_h, _ = self._converge(g, 5, "hybrid")
+        st_s, _ = self._converge(g, 5, "segment")
+        np.testing.assert_array_equal(np.asarray(st_h.in_core),
+                                      np.asarray(st_s.in_core))
+
+    def test_failures_shrink_the_core(self):
+        g = G.watts_strogatz(256, 6, 0.1, seed=3)
+        gf = failures.fail_nodes(g, list(range(0, 64)))
+        st, _ = self._converge(gf, 4)
+        np.testing.assert_array_equal(np.asarray(st.in_core),
+                                      _kcore_oracle(gf, 4))
+        assert not np.asarray(st.in_core)[:64].any()
+
+    def test_rejects_bad_k(self):
+        with pytest.raises(ValueError, match="k must be"):
+            KCore(k=0)
+
+    def test_message_accounting_counts_leaver_fanout(self):
+        g = G.ring(64)
+        _, stats = engine.run(g, KCore(k=3), jax.random.key(0), 3)
+        msgs = np.asarray(stats["messages"])
+        removed = np.asarray(stats["removed"])
+        # Round 1 removes everyone (ring in-degree 2 < 3); each of the 64
+        # leavers notifies its 2 out-neighbors exactly once.
+        assert removed[0] == 64 and msgs[0] == 128
+        assert removed[1:].sum() == 0 and msgs[1:].sum() == 0
