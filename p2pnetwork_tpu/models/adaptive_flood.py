@@ -129,6 +129,16 @@ def _slice_width(graph: Graph, slice_width: int) -> int:
     return max(1, min(graph.max_out_span, 128))
 
 
+def _one_item_per_node(graph: Graph, w: int) -> bool:
+    """STATIC (trace-time) predicate for the quasi-regular fast path:
+    when no build-time row is wider than ``w`` — every graph under the
+    auto slice width — each row is exactly one work item, so item
+    expansion is the identity and item mass equals node count. Both
+    specializations (``_expand_items``, ``_dense_wave_round``) key on
+    THIS predicate so they cannot desynchronize."""
+    return graph.max_out_span <= w
+
+
 def _row_items(graph: Graph, w: int, nodes) -> jax.Array:
     """Work items per node: its build-time CSR row in W-wide slices.
     Empty rows still cost one (empty) item so every frontier node owns a
@@ -143,7 +153,15 @@ def _expand_items(graph: Graph, w: int, k: int, wnode, node_count):
     searchsorted assigns each of the k item slots its owning node and
     slice index. O(k log k); never touches N or E. An ``icount > k``
     result truncates silently — dense mode takes over and the lists are
-    never read (same overflow contract as the node lists had)."""
+    never read (same overflow contract as the node lists had).
+
+    STATIC specialization: ``max_out_span <= w`` (every quasi-regular
+    graph under the auto slice width) makes every row exactly one item —
+    the expansion is the identity, so the compiled program skips the
+    cumsum/searchsorted entirely and this path costs what round 3's
+    node-list layout did. Both operands are trace-time Python ints."""
+    if _one_item_per_node(graph, w):
+        return wnode, jnp.zeros(k, dtype=jnp.int32), node_count
     pad_node = graph.n_nodes_padded - 1
     items_per = jnp.where(jnp.arange(k) < node_count,
                           _row_items(graph, w, wnode), 0)
@@ -232,11 +250,15 @@ def _dense_wave_round(graph: Graph, w: int, k: int, method: str, seen,
     new = delivered & ~seen & graph.node_mask
     seen = seen | new
     node_count = jnp.sum(new).astype(jnp.int32)
-    # Frontier out-edge mass in W-slice items — fused O(N) elementwise +
-    # reduce, nearly free next to the propagate. This is what decides
-    # sparse re-entry: a frontier of few-but-hub nodes stays dense.
-    items_all = _row_items(graph, w, jnp.arange(graph.n_nodes_padded))
-    icount = jnp.sum(jnp.where(new, items_all, 0)).astype(jnp.int32)
+    # Frontier out-edge mass in W-slice items — decides sparse re-entry:
+    # a frontier of few-but-hub nodes stays dense. One item per node when
+    # no row chunks (static, trace-time — the quasi-regular fast path);
+    # otherwise an O(N) row-length pass, still small next to the propagate.
+    if _one_item_per_node(graph, w):
+        icount = node_count
+    else:
+        items_all = _row_items(graph, w, jnp.arange(graph.n_nodes_padded))
+        icount = jnp.sum(jnp.where(new, items_all, 0)).astype(jnp.int32)
 
     # Re-enter sparse mode: pay the O(N) compaction only on the round
     # that crosses back under k items (lax.cond executes one branch).
