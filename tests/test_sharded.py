@@ -1227,6 +1227,37 @@ class TestShardedAdaptiveHubGraphs:
         assert out_a["rounds"] == out_d["rounds"] == ref["rounds"]
         assert out_a["messages"] == out_d["messages"] == ref["messages"]
 
+    def test_star_hub_forces_chunked_work_items(self):
+        # A star's hub row is ~n/S slots wide per shard — far past the
+        # 128-wide item limit — so sparse rounds MUST run the chunked
+        # work-item expansion (cumsum + searchsorted), the branch the
+        # quasi-regular fast path (span <= w) statically skips. Guards
+        # against that branch rotting now that every other test graph
+        # takes the fast path.
+        from p2pnetwork_tpu.models import Flood
+
+        n = 2048
+        hub = np.zeros(n - 1, dtype=np.int32)
+        leaves = np.arange(1, n, dtype=np.int32)
+        g = G.from_edges(np.concatenate([hub, leaves]),
+                         np.concatenate([leaves, hub]), n)
+        mesh = M.ring_mesh(2)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        assert sg.csr_span > 128  # the chunked branch really runs
+        seen_a, out_a = sharded.flood_until_coverage(
+            sg, mesh, source=5, coverage_target=0.99, adaptive_k=512
+        )
+        seen_d, out_d = sharded.flood_until_coverage(
+            sg, mesh, source=5, coverage_target=0.99
+        )
+        np.testing.assert_array_equal(np.asarray(seen_a), np.asarray(seen_d))
+        assert out_a == out_d
+        _, ref = engine.run_until_coverage(
+            g, Flood(source=5), jax.random.key(0), coverage_target=0.99
+        )
+        assert out_a["rounds"] == ref["rounds"]
+        assert out_a["messages"] == ref["messages"]
+
     def test_hub_source_runs_exact_under_tiny_budget(self):
         # Source 0 is a BA hub: its row overflows a tiny item budget, so
         # round one must go dense — and stay bit-identical throughout.
