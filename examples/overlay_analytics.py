@@ -1,10 +1,12 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Four questions reference users answer by hand-instrumenting callbacks
+Six questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
 (HopDistance / BFS), what's the network-wide average (PushSum), who
-coordinates (LeaderElection) — each runs here as a batched protocol over
-the whole population in one compiled scan.
+coordinates (LeaderElection), is the network partitioned and how badly
+(ConnectedComponents, after node failures), and which peers form the
+resilient core (KCore) — each runs here as a batched protocol over the
+whole population in one compiled scan.
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -16,9 +18,9 @@ sys.path.insert(0, ".")
 import jax
 import numpy as np
 
-from p2pnetwork_tpu.models import (HopDistance, LeaderElection, PageRank,
-                                   PushSum)
-from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.models import (ConnectedComponents, HopDistance, KCore,
+                                   LeaderElection, PageRank, PushSum)
+from p2pnetwork_tpu.sim import engine, failures
 from p2pnetwork_tpu.sim import graph as G
 
 
@@ -70,6 +72,30 @@ def main():
     agree = float((known == leader).mean())
     print(f"LeaderElection: node {leader} elected by {agree:.1%} of peers "
           f"in {int(out['rounds'])} rounds ({int(out['messages'])} messages)")
+
+    # Is the overlay partitioned: knock out the top hubs, then count the
+    # surviving components by max-label flooding.
+    top_hubs = [int(i) for i in np.argsort(ranks)[::-1][:50]]
+    gf = failures.fail_nodes(g, top_hubs)
+    proto = ConnectedComponents()
+    state, out = engine.run_until_converged(
+        gf, proto, jax.random.key(3), stat="changed", threshold=1,
+        max_rounds=256,
+    )
+    parts = int(proto.components(gf, state))
+    print(f"ConnectedComponents: after failing the top-50 hubs the overlay "
+          f"splits into {parts} partition(s) "
+          f"({int(out['rounds'])} rounds to quiesce)")
+
+    # Who forms the resilient core: recursive peeling of under-connected
+    # peers (the k-core) on the intact overlay.
+    state, out = engine.run_until_converged(
+        g, KCore(k=4), jax.random.key(4), stat="removed", threshold=1,
+        max_rounds=256,
+    )
+    core = int(np.asarray(state.in_core).sum())
+    print(f"KCore k=4: {core}/{n} peers survive recursive peeling "
+          f"({int(out['rounds'])} rounds)")
 
 
 if __name__ == "__main__":

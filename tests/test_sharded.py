@@ -762,15 +762,22 @@ class TestShardedCoverage:
 
 
 class TestAutoSharding:
-    @pytest.mark.parametrize("protocol_name", ["flood", "sir", "gossip"])
+    @pytest.mark.parametrize("protocol_name", [
+        "flood", "sir", "gossip", "components", "mis", "kcore",
+    ])
     def test_auto_matches_single_device(self, protocol_name):
-        from p2pnetwork_tpu.models import SIR, Flood, Gossip
+        from p2pnetwork_tpu.models import (
+            SIR, ConnectedComponents, Flood, Gossip, KCore, LubyMIS,
+        )
         from p2pnetwork_tpu.parallel import auto
 
         proto = {
             "flood": Flood(source=0, method="segment"),
             "sir": SIR(beta=0.3, gamma=0.1, method="segment"),
             "gossip": Gossip(alpha=0.5),
+            "components": ConnectedComponents(method="segment"),
+            "mis": LubyMIS(method="segment", or_method="segment"),
+            "kcore": KCore(k=4, method="segment"),
         }[protocol_name]
         g = G.watts_strogatz(512, 6, 0.2, seed=0)
         mesh = M.ring_mesh(8)
