@@ -32,8 +32,22 @@ import jax.numpy as jnp
 from p2pnetwork_tpu.sim.graph import Graph
 
 
+#: ``auto`` prefers the neighbor-table gather only while the table's
+#: padding waste (slots / true edges) stays under this bound. The padded
+#: gather touches rows x width slots at the TPU's flat ~8 cycles/element
+#: gather floor (BENCH.md), so a degree-skewed graph poisons it: one hub
+#: widens EVERY row. Measured on chip (single-source flood to 99%):
+#: WS 1M @ 1.7x waste — gather 1.53 s vs segment 1.87 s (gather wins);
+#: ER 100K @ 2.5x — 0.142 vs 0.163 s (gather wins); BA 100K @ 178x —
+#: 3.97 vs 0.12 s (gather loses 33x). Break-even sits near 3-4x.
+_GATHER_WASTE_BOUND = 4.0
+
+
 def _gather_ok(graph: Graph) -> bool:
-    return graph.neighbors is not None and graph.neighbors_complete
+    if graph.neighbors is None or not graph.neighbors_complete:
+        return False
+    slots = graph.neighbors.shape[0] * graph.neighbors.shape[1]
+    return slots <= _GATHER_WASTE_BOUND * max(graph.n_edges, 1)
 
 
 def _require_complete_table(graph: Graph) -> None:
@@ -69,9 +83,11 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
     """Per-node OR over incoming neighbors: ``out[v] = any(signal[u], u->v)``.
 
     ``signal`` is bool[N_pad]; masked (padding) edges and nodes contribute
-    nothing. ``method`` is ``"segment"``, ``"gather"`` or ``"auto"`` (gather
-    when the graph carries a complete neighbor table). Dynamic edges
-    (sim/topology.py) are folded in for every method.
+    nothing. ``method`` is ``"segment"``, ``"gather"`` or ``"auto"``
+    (gather when the graph carries a complete neighbor table whose
+    padding waste stays under ``_GATHER_WASTE_BOUND`` — degree-skewed
+    tables route to segment). Dynamic edges (sim/topology.py) are folded
+    in for every method.
     """
     if graph.dyn_senders is not None:
         static = dataclasses.replace(graph, dyn_senders=None,
@@ -188,7 +204,8 @@ def propagate_max(graph: Graph, signal: jax.Array,
     (-inf / int min); dead nodes likewise — callers typically fold the
     result with their own value (``jnp.maximum(value, incoming)``), which
     makes both neutral. Methods: ``"segment"`` or ``"gather"`` (``"auto"``
-    picks gather when a complete neighbor table exists). The blocked /
+    picks gather when a complete, not-too-padded neighbor table exists —
+    see ``_GATHER_WASTE_BOUND``). The blocked /
     pallas / hybrid lowerings do not apply — they ride one-hot MXU
     matmuls, which compute sums, not maxima.
     """

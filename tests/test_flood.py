@@ -8,6 +8,22 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
+
+
+def test_auto_method_avoids_padded_gather_on_skewed_graphs():
+    """'auto' must route degree-skewed graphs to the segment lowering:
+    one hub widens EVERY padded neighbor-table row, and the flat
+    per-slot gather floor then loses to sorted segment reductions
+    (measured 33x at BA 100K — ops/segment._GATHER_WASTE_BOUND)."""
+    from p2pnetwork_tpu.ops import segment as S
+    from p2pnetwork_tpu.sim import graph as G
+
+    ws = G.watts_strogatz(1024, 6, 0.1, seed=0)
+    assert S._gather_ok(ws)  # quasi-regular: table waste ~1.5x, gather wins
+    ba = G.barabasi_albert(1024, 3, seed=0)
+    waste = ba.neighbors.shape[0] * ba.neighbors.shape[1] / ba.n_edges
+    assert waste > S._GATHER_WASTE_BOUND  # the scenario the bound exists for
+    assert not S._gather_ok(ba)
 import networkx as nx  # noqa: E402
 
 from p2pnetwork_tpu.models.flood import Flood  # noqa: E402
