@@ -26,6 +26,7 @@ from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
 from p2pnetwork_tpu.models.spanning import SpanningTree, SpanningTreeState
+from p2pnetwork_tpu.models.walk import RandomWalks, RandomWalksState
 
 __all__ = [
     "Protocol",
@@ -51,6 +52,8 @@ __all__ = [
     "PageRankState",
     "PushSum",
     "PushSumState",
+    "RandomWalks",
+    "RandomWalksState",
     "SIR",
     "SIRState",
     "SpanningTree",

@@ -191,11 +191,11 @@ def _sparse_wave_round(graph: Graph, w: int, k: int, seen, frontier, fidx,
     # frontier_messages' dense accounting send for send. Must read the
     # INCOMING lists — fidx/fslice are rebuilt for the next round below.
     msgs = jnp.sum(jnp.where(fvalid & (fslice == 0), graph.out_degree[f], 0))
-    base_off = graph.src_offsets[f] + fslice * w  # [k] slice start
-    row_end = graph.src_offsets[f + 1]  # [k] build-time row end
-    slot = base_off[:, None] + jnp.arange(w)[None, :]  # [k, w]
-    svalid = (slot < row_end[:, None]) & fvalid[:, None]
-    eid = graph.src_eid[jnp.where(svalid, slot, graph.n_edges_padded - 1)]
+    eid, in_row = graph.gather_row_slots(
+        graph.src_offsets[f] + fslice * w,  # [k] slice start
+        graph.src_offsets[f + 1], w,  # [k] build-time row end
+    )
+    svalid = in_row & fvalid[:, None]
     # Runtime liveness re-check: failed edges (sim/failures.py) stay in
     # the build-time CSR rows but are masked here.
     evalid = svalid & graph.edge_mask[eid]

@@ -1,0 +1,168 @@
+"""Batched random walks: the peer-sampling / discovery protocol family.
+
+Discovery is the canonical protocol the reference tells users to build
+themselves [ref: README.md:20, GETTING_STARTED.md:9 — "no protocol"]:
+Cyclon/Brahms-style services walk the overlay to collect uniform peer
+samples; crawlers walk it to map membership. A reference node forwards a
+walk by picking one neighbor in ``node_message`` and calling
+``send_to_node``; here a whole cohort of ``n_walkers`` walkers advances
+in one batched step — gather each walker's out-edge row through the
+source-CSR view, draw a uniform LIVE edge per walker, move.
+
+Semantics per round, per walker:
+
+- uniform choice among the walker's currently-live out-edges (runtime
+  edge liveness via ``edge_mask``; dead receivers excluded — churn
+  needs no rebuild, mirroring the adaptive flood's liveness re-check);
+- a walker whose node has no live out-edge STAYS PUT (a crawler stuck in
+  a sink keeps retrying — matching the reference node whose sends all
+  failed [ref: nodeconnection.py:123-126 close-on-error]);
+- with probability ``restart_p`` the walker teleports back to its start
+  node instead (PPR-style restart — turns the cohort into a
+  personalized sampler around its seeds).
+
+``visited`` accumulates every node any walker has stood on, so
+``coverage`` is discovery progress and ``engine.run_until_coverage``
+answers "how many rounds until the cohort has mapped 99% of the
+overlay". ``messages`` counts one send per moving walker per round (a
+stay-put walker sends nothing).
+
+The per-round gather is ``[n_walkers, max_out_span]`` — the row-width
+cost of quasi-regular graphs is a handful of slots; on degree-skewed
+families a hub widens every walker's row slice, the same skew tax the
+flood lowerings pay (BENCH.md "auto" waste bound), so size cohorts
+accordingly there.
+
+Requires a graph built with ``source_csr=True`` (or
+``with_source_csr()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RandomWalksState:
+    pos: jax.Array  # i32[W] — each walker's current node
+    start: jax.Array  # i32[W] — restart target (initial position)
+    visited: jax.Array  # bool[N_pad] — any walker has stood here
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class RandomWalks:
+    """``n_walkers`` uniform random walkers with optional restart.
+
+    ``init`` seeds walkers on distinct live nodes (evenly spread when
+    more live nodes than walkers exist; wrapping otherwise)."""
+
+    n_walkers: int = 1024
+    restart_p: float = 0.0
+
+    def __post_init__(self):
+        if self.n_walkers < 1:
+            raise ValueError(f"n_walkers must be >= 1, got {self.n_walkers}")
+        if not 0.0 <= self.restart_p <= 1.0:
+            raise ValueError(f"restart_p must be in [0, 1], got {self.restart_p}")
+
+    def _require_csr(self, graph: Graph) -> None:
+        if graph.src_eid is None:
+            raise ValueError(
+                "RandomWalks requires a source-CSR graph — build with "
+                "from_edges(source_csr=True) or graph.with_source_csr()"
+            )
+
+    def init(self, graph: Graph, key: jax.Array) -> RandomWalksState:
+        self._require_csr(graph)
+        # Evenly spread over the live nodes: stride walker w to the
+        # (w * stride mod n_live)-th live id — deterministic, wraps when
+        # W exceeds the live population, and stays in int32 (w * stride
+        # <= n_live * W / W; a w*n_live/W spread would overflow at 10M
+        # nodes x 1K walkers).
+        live_ids = jnp.nonzero(
+            graph.node_mask, size=graph.n_nodes_padded, fill_value=0
+        )[0]
+        n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stride = jnp.maximum(n_live // self.n_walkers, 1)
+        w = jnp.arange(self.n_walkers)
+        pos = live_ids[(w * stride) % n_live].astype(jnp.int32)
+        visited = (
+            jnp.zeros(graph.n_nodes_padded, dtype=bool)
+            .at[pos].set(True)
+            & graph.node_mask
+        )
+        return RandomWalksState(pos=pos, start=pos, visited=visited)
+
+    def coverage(self, graph: Graph, state: RandomWalksState) -> jax.Array:
+        """Fraction of live nodes some walker has visited."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum(state.visited & graph.node_mask) / n_real
+
+    def step(self, graph: Graph, state: RandomWalksState, key: jax.Array):
+        self._require_csr(graph)
+        w = max(graph.max_out_span, 1)
+        k_edge, k_restart = jax.random.split(key)
+
+        # Each walker's out-edge row, liveness-masked [W, w].
+        eid, svalid = graph.gather_row_slots(
+            graph.src_offsets[state.pos],
+            graph.src_offsets[state.pos + 1], w,
+        )
+        rcv = graph.receivers[eid]
+        live = svalid & graph.edge_mask[eid] & graph.node_mask[rcv]
+
+        # Dynamic (runtime-connected) out-edges ride along: the region is
+        # a small unsorted COO block, membership-tested per walker
+        # ([W, D] compare — size cohorts to the reserved capacity), so a
+        # runtime bridge is walkable the round it appears.
+        if graph.dyn_senders is not None:
+            dmember = (
+                (graph.dyn_senders[None, :] == state.pos[:, None])
+                & graph.dyn_mask[None, :]
+                & graph.node_mask[graph.dyn_receivers][None, :]
+            )
+            rcv = jnp.concatenate(
+                [rcv, jnp.broadcast_to(graph.dyn_receivers[None, :],
+                                       dmember.shape)], axis=1)
+            live = jnp.concatenate([live, dmember], axis=1)
+
+        # Uniform live choice via Gumbel-max over the masked row — one
+        # draw per slot, exact uniformity among live slots, no cumsum.
+        g = jax.random.gumbel(k_edge, live.shape)
+        pick = jnp.argmax(jnp.where(live, g, -jnp.inf), axis=1)
+        can_move = jnp.any(live, axis=1)
+        dest = jnp.where(can_move,
+                         rcv[jnp.arange(self.n_walkers), pick], state.pos)
+
+        if self.restart_p > 0.0:
+            # Restart wins over the edge move; a dead start (churn) falls
+            # back to the edge move so walkers never stand on dead nodes.
+            restart = (
+                (jax.random.uniform(k_restart, (self.n_walkers,))
+                 < self.restart_p)
+                & graph.node_mask[state.start]
+            )
+            dest = jnp.where(restart, state.start, dest)
+            moved = (restart | can_move) & (dest != state.pos)
+        else:
+            moved = can_move & (dest != state.pos)
+
+        visited = state.visited.at[dest].set(True) & graph.node_mask
+        new_state = RandomWalksState(pos=dest, start=state.start,
+                                     visited=visited)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            # One send per walker that actually moved [ref: node.py:116
+            # message_count_send — the reference counts sends, and a
+            # stuck walker sends nothing].
+            "messages": jnp.sum(moved),
+            "coverage": jnp.sum(visited & graph.node_mask) / n_real,
+            "stuck": jnp.sum(~can_move),
+        }
+        return new_state, stats

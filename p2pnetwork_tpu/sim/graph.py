@@ -156,6 +156,22 @@ class Graph:
             max_out_span=span,
         )
 
+    def gather_row_slots(self, start, end, width: int):
+        """``[K, width]`` out-edge slot gather through the source-CSR view:
+        ``(eid, valid)`` for slots ``start[i] + j`` while ``< end[i]``.
+
+        This is THE place the ``e_pad - 1`` padding sentinel of
+        ``_build_source_csr`` is masked — that slot can name a LIVE edge
+        (whenever the edge count is an exact pad multiple), so every
+        consumer of the gathered ``eid`` must AND with the returned
+        ``valid`` (and its own liveness masks) before trusting it. Used
+        by the frontier-sparse wave rounds (models/adaptive_flood.py)
+        and the walker cohort (models/walk.py)."""
+        slot = start[:, None] + jnp.arange(width)[None, :]
+        valid = slot < end[:, None]
+        eid = self.src_eid[jnp.where(valid, slot, self.n_edges_padded - 1)]
+        return eid, valid
+
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
