@@ -12,6 +12,7 @@ from p2pnetwork_tpu.models.adaptive_flood import (
     AdaptiveHopDistanceState,
 )
 from p2pnetwork_tpu.models.base import Protocol
+from p2pnetwork_tpu.models.coloring import color_via_mis
 from p2pnetwork_tpu.models.components import (
     ConnectedComponents,
     ConnectedComponentsState,
@@ -30,6 +31,7 @@ from p2pnetwork_tpu.models.walk import RandomWalks, RandomWalksState
 
 __all__ = [
     "Protocol",
+    "color_via_mis",
     "AdaptiveFlood",
     "AdaptiveFloodState",
     "AdaptiveHopDistance",

@@ -1,0 +1,76 @@
+"""Distributed greedy graph coloring via iterated Luby MIS.
+
+The classic decentralized resource-assignment pattern (TDMA slots,
+gossip schedules, channel assignment) reference users would build on the
+event hooks [ref: README.md:20]: color class c is a maximal independent
+set of the graph with classes 0..c-1 removed, so adjacent nodes never
+share a color and every node is colored after at most Δ+1 classes
+(Δ = max degree; typically far fewer on sparse overlays).
+
+This is a *utility on top of the protocol layer*, not a protocol itself:
+each color class runs :class:`~p2pnetwork_tpu.models.mis.LubyMIS` to
+quiescence through ``engine.run_until_converged`` (one compiled
+device-side loop per class, cached across classes since the graph
+structure is unchanged), then removes the class with
+``failures.with_node_liveness`` — the same masking churn uses, so the
+residual needs no rebuild.
+
+Like the MIS it iterates, correctness of the coloring assumes a
+symmetric overlay (every builder in sim/graph.py produces one).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models.mis import LubyMIS
+from p2pnetwork_tpu.sim import engine, failures
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+def color_via_mis(
+    graph: Graph,
+    key: jax.Array,
+    *,
+    max_colors: int = 256,
+    max_rounds_per_color: int = 256,
+    method: str = "auto",
+) -> Tuple[jax.Array, int]:
+    """Greedy-color ``graph``; returns ``(colors, n_colors)``.
+
+    ``colors`` is i32[N_pad]: the color of every live node, ``-1`` on
+    dead/padding nodes. ``n_colors`` is the number of classes used.
+    Raises if ``max_colors`` classes leave nodes uncolored (raise the
+    bound — Δ+1 always suffices) or a class fails to converge within
+    ``max_rounds_per_color``.
+    """
+    proto = LubyMIS(method=method, or_method=method)
+    colors = jnp.full(graph.n_nodes_padded, -1, dtype=jnp.int32)
+    g = graph
+    for c in range(max_colors):
+        if int(jnp.sum(g.node_mask)) == 0:
+            return colors, c
+        st, out = engine.run_until_converged(
+            g, proto, jax.random.fold_in(key, c),
+            stat="undecided", threshold=1,
+            max_rounds=max_rounds_per_color,
+        )
+        if int(out["value"]) != 0:
+            raise RuntimeError(
+                f"color class {c} did not quiesce in "
+                f"{max_rounds_per_color} rounds ({int(out['value'])} nodes "
+                f"undecided) — raise max_rounds_per_color"
+            )
+        colors = jnp.where(st.in_mis, c, colors)
+        # Remove the class from contention; liveness masking IS removal
+        # (edges at colored endpoints die with them).
+        g = failures.with_node_liveness(g, g.node_mask & ~st.in_mis)
+    if int(jnp.sum(g.node_mask)) != 0:
+        raise RuntimeError(
+            f"{int(jnp.sum(g.node_mask))} nodes uncolored after "
+            f"{max_colors} classes — raise max_colors (Δ+1 always suffices)"
+        )
+    return colors, max_colors

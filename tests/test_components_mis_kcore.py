@@ -257,3 +257,52 @@ class TestKCore:
         # leavers notifies its 2 out-neighbors exactly once.
         assert removed[0] == 64 and msgs[0] == 128
         assert removed[1:].sum() == 0 and msgs[1:].sum() == 0
+
+
+class TestColoring:
+    def _check_proper(self, g, colors):
+        colors = np.asarray(colors)
+        alive = np.asarray(g.node_mask)
+        assert (colors[alive] >= 0).all()  # every live node colored
+        assert (colors[~alive] == -1).all()
+        s, r = _live_edges(g)
+        assert (colors[s] != colors[r]).all(), "adjacent nodes share a color"
+
+    def test_ws_coloring_is_proper_and_small(self):
+        from p2pnetwork_tpu.models import color_via_mis
+
+        g = G.watts_strogatz(512, 6, 0.2, seed=0)
+        colors, n = color_via_mis(g, jax.random.key(0))
+        self._check_proper(g, colors)
+        # Δ+1 bounds it; a WS(k=6) greedy coloring lands far under 64.
+        assert 2 <= n <= 16
+
+    def test_ba_hubs_color_legally(self):
+        from p2pnetwork_tpu.models import color_via_mis
+
+        g = G.barabasi_albert(512, 3, seed=1)
+        colors, n = color_via_mis(g, jax.random.key(1))
+        self._check_proper(g, colors)
+
+    def test_respects_failures(self):
+        from p2pnetwork_tpu.models import color_via_mis
+
+        g = failures.fail_nodes(G.watts_strogatz(256, 4, 0.1, seed=2),
+                                [7, 8, 9])
+        colors, _ = color_via_mis(g, jax.random.key(2))
+        self._check_proper(g, colors)
+
+    def test_ring_needs_at_least_two(self):
+        from p2pnetwork_tpu.models import color_via_mis
+
+        g = G.ring(64)
+        colors, n = color_via_mis(g, jax.random.key(3))
+        self._check_proper(g, colors)
+        assert n >= 2  # a cycle is not 1-colorable
+
+    def test_max_colors_bound_raises(self):
+        from p2pnetwork_tpu.models import color_via_mis
+
+        g = G.complete(16)  # needs 16 colors
+        with pytest.raises(RuntimeError, match="uncolored"):
+            color_via_mis(g, jax.random.key(4), max_colors=3)
