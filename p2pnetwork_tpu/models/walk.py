@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.utils.edgehash import edge_uniform
 
 
 @jax.tree_util.register_dataclass
@@ -132,13 +133,24 @@ class RandomWalks:
                                        dmember.shape)], axis=1)
             live = jnp.concatenate([live, dmember], axis=1)
 
-        # Uniform live choice via Gumbel-max over the masked row — one
-        # draw per slot, exact uniformity among live slots, no cumsum.
-        g = jax.random.gumbel(k_edge, live.shape)
-        pick = jnp.argmax(jnp.where(live, g, -jnp.inf), axis=1)
-        can_move = jnp.any(live, axis=1)
-        dest = jnp.where(can_move,
-                         rcv[jnp.arange(self.n_walkers), pick], state.pos)
+        # Uniform live choice by max-u, where each candidate's u is keyed
+        # by the EDGE IDENTITY (round key, walker, sender, receiver —
+        # utils/edgehash.py), not its slot: any party naming the same
+        # edge draws the same number, which is what lets the sharded ring
+        # (parallel/sharded.py walk) reproduce this choice bit-for-bit
+        # with the edges scattered across shards. Equal-u ties (2^-24)
+        # break on the higher receiver id — deterministic on every
+        # layout. Dead pos rows gather only dead slots, so live is all
+        # False there and the walker stays put.
+        walkers = jnp.arange(self.n_walkers, dtype=jnp.int32)
+        u = edge_uniform(k_edge, walkers[:, None], state.pos[:, None], rcv)
+        u = jnp.where(live, u, -1.0)
+        m = jnp.max(u, axis=1)
+        can_move = m >= 0.0
+        best_rcv = jnp.max(
+            jnp.where(live & (u == m[:, None]), rcv, -1), axis=1
+        )
+        dest = jnp.where(can_move, best_rcv, state.pos)
 
         if self.restart_p > 0.0:
             # Restart wins over the edge move; a dead start (churn) falls

@@ -2947,3 +2947,319 @@ def _hopdist_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
         out_specs=(spec, spec, P()),
     )
     return jax.jit(fn)
+
+
+# ------------------------------------------------------------ random walks
+
+
+def _make_walk_round(axis_name, S, block, W, span, restart_p,
+                     bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                     node_mask, csr_pos, csr_offsets):
+    """Per-shard walker-cohort round (models/walk.py, multi-chip).
+
+    The cohort's positions ride REPLICATED [W]; each shard owns the
+    edges INTO its node block, so it scores exactly the candidates the
+    engine would gather for those receivers — through the per-shard
+    sender-CSR over the bucket arrays (liveness re-masks and disconnects
+    apply with no rebuild). Because every candidate's uniform is keyed
+    by the edge IDENTITY (utils/edgehash.py), not its slot, the global
+    argmax = pmax of per-shard maxima reproduces the engine's choice
+    bit-for-bit: equal-u ties break on the higher receiver id, composed
+    here as a second pmax over the per-shard best receivers among
+    global-max holders.
+
+    Returns ``one_round(pos, start, alive_start, visited_b, key) ->
+    (pos, visited_b, moved, can_move, covered)``.
+    """
+    from p2pnetwork_tpu.utils.edgehash import edge_uniform
+
+    node_mask_b = node_mask[0]
+    csr_pos_b, csr_offsets_b = csr_pos[0], csr_offsets[0]
+    flat_mask = bkt_mask[0].reshape(-1)
+    flat_dst = bkt_dst[0].reshape(-1)
+    dyn_src_b, dyn_dst_b, dyn_mask_b = dyn_src[0], dyn_dst[0], dyn_mask[0]
+    has_dyn = dyn_src_b.shape[-1] > 0
+    my = jax.lax.axis_index(axis_name)
+    w = max(span, 1)
+    walkers = jnp.arange(W, dtype=jnp.int32)
+
+    def one_round(pos, start, alive_start, visited, key):
+        # Same split as RandomWalks.step — the engine and every shard
+        # derive identical sub-keys from the identical round key.
+        k_edge, k_restart = jax.random.split(key)
+
+        base = csr_offsets_b[pos]
+        end = csr_offsets_b[pos + 1]
+        slot = base[:, None] + jnp.arange(w)[None, :]
+        svalid = slot < end[:, None]  # out-of-row slots masked (csr_pos
+        # padding stays in bounds but can alias live slots — same
+        # contract as the adaptive wave)
+        p = csr_pos_b[jnp.where(svalid, slot, 0)]
+        dst_local = flat_dst[p]
+        rcv = my * block + dst_local
+        live = svalid & flat_mask[p] & node_mask_b[dst_local]
+        u = jnp.where(live,
+                      edge_uniform(k_edge, walkers[:, None], pos[:, None],
+                                   rcv),
+                      -1.0)
+        m_loc = jnp.max(u, axis=1)
+        r_loc = jnp.max(jnp.where(live & (u == m_loc[:, None]), rcv, -1),
+                        axis=1)
+        if has_dyn:
+            # Dynamic out-edges: reconstruct global senders from the ring
+            # step, membership-test against the cohort ([W, S, K]).
+            t_i = jnp.arange(S, dtype=jnp.int32)[:, None]
+            g_send = ((my - t_i) % S) * block + dyn_src_b  # [S, K]
+            member = ((g_send[None] == pos[:, None, None])
+                      & dyn_mask_b[None]
+                      & node_mask_b[dyn_dst_b][None])  # [W, S, K]
+            drcv = jnp.broadcast_to((my * block + dyn_dst_b)[None],
+                                    member.shape)
+            du = jnp.where(member,
+                           edge_uniform(k_edge, walkers[:, None, None],
+                                        pos[:, None, None], drcv),
+                           -1.0).reshape(W, -1)
+            dm = jnp.max(du, axis=1)
+            dr = jnp.max(jnp.where(
+                member.reshape(W, -1) & (du == dm[:, None]),
+                drcv.reshape(W, -1), -1), axis=1)
+            r_loc = jnp.where(dm > m_loc, dr,
+                              jnp.where(dm == m_loc, jnp.maximum(r_loc, dr),
+                                        r_loc))
+            m_loc = jnp.maximum(m_loc, dm)
+
+        m = jax.lax.pmax(m_loc, axis_name)  # [W], replicated
+        r = jax.lax.pmax(
+            jnp.where((m_loc == m) & (m >= 0), r_loc, -1), axis_name
+        )
+        can_move = m >= 0.0
+        dest = jnp.where(can_move, r, pos)
+
+        if restart_p > 0.0:
+            restart = (
+                (jax.random.uniform(k_restart, (W,)) < restart_p)
+                & alive_start
+            )
+            dest = jnp.where(restart, start, dest)
+            moved = (restart | can_move) & (dest != pos)
+        else:
+            moved = can_move & (dest != pos)
+
+        owned = (dest // block) == my
+        visited = (
+            visited.at[jnp.where(owned, dest % block, block)]
+            .set(True, mode="drop")
+            & node_mask_b
+        )
+        covered = jax.lax.psum(
+            jnp.sum((visited & node_mask_b).astype(jnp.int32)), axis_name
+        )
+        return dest, visited, moved, can_move, covered
+
+    return one_round
+
+
+def _ring_rounds_walk(axis_name, S, block, W, span, restart_p,
+                      bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                      node_mask, csr_pos, csr_offsets,
+                      pos0, start0, alive_start, visited0, round_keys):
+    one_round = _make_walk_round(axis_name, S, block, W, span, restart_p,
+                                 bkt_dst, bkt_mask, dyn_src, dyn_dst,
+                                 dyn_mask, node_mask, csr_pos, csr_offsets)
+    node_mask_b = node_mask[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def body(carry, rkey):
+        pos, visited = carry
+        pos, visited, moved, can_move, covered = one_round(
+            pos, start0, alive_start, visited,
+            jax.random.wrap_key_data(rkey),
+        )
+        stats = {
+            "messages": jnp.sum(moved),
+            "coverage": covered / n_live,
+            "stuck": jnp.sum(~can_move),
+        }
+        return (pos, visited), stats
+
+    (pos, visited), stats = jax.lax.scan(body, (pos0, visited0[0]),
+                                         round_keys)
+    return pos, visited[None], stats
+
+
+@functools.lru_cache(maxsize=64)
+def _walk_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+             W: int, span: int, restart_p: float):
+    """The scan length rides on round_keys' shape, so the round count is
+    deliberately NOT part of this cache key (jit retraces on shape)."""
+    body = functools.partial(_ring_rounds_walk, axis_name, S, block, W,
+                             span, restart_p)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        body, mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 8 + (P(), P(), P(), spec, P()),
+        out_specs=(P(), spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def _ring_cov_walk(axis_name, S, block, W, span, restart_p,
+                   coverage_target, max_rounds,
+                   bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                   node_mask, csr_pos, csr_offsets,
+                   pos0, start0, alive_start, visited0, key_data):
+    one_round = _make_walk_round(axis_name, S, block, W, span, restart_p,
+                                 bkt_dst, bkt_mask, dyn_src, dyn_dst,
+                                 dyn_mask, node_mask, csr_pos, csr_offsets)
+    node_mask_b = node_mask[0]
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
+    )
+
+    def cond(carry):
+        _, _, _, rounds, covered, _, _ = carry
+        return (covered / n_live < coverage_target) & (rounds < max_rounds)
+
+    def body(carry):
+        pos, visited, kd, rounds, _, hi, lo = carry
+        # Chained split, mirroring engine._stat_while round for round.
+        k, sub = jax.random.split(jax.random.wrap_key_data(kd))
+        pos, visited, moved, _, covered = one_round(
+            pos, start0, alive_start, visited, sub
+        )
+        hi, lo = accum.add((hi, lo), jnp.sum(moved))
+        return (pos, visited, jax.random.key_data(k), rounds + 1, covered,
+                hi, lo)
+
+    covered0 = jax.lax.psum(
+        jnp.sum((visited0[0] & node_mask_b).astype(jnp.int32)), axis_name
+    )
+    init = (pos0, visited0[0], key_data, jnp.int32(0), covered0,
+            *accum.zero())
+    pos, visited, _, rounds, covered, hi, lo = jax.lax.while_loop(
+        cond, body, init
+    )
+    return pos, visited[None], accum.pack_summary(
+        rounds, covered / n_live, (hi, lo)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _walk_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                 max_rounds: int, W: int, span: int, restart_p: float):
+    body = functools.partial(_ring_cov_walk, axis_name, S, block, W, span,
+                             restart_p)
+    spec = P(axis_name)
+    fn = jax.shard_map(
+        lambda target, *args: body(target, max_rounds, *args),
+        mesh=mesh, check_vma=False,
+        in_specs=(P(),) + (spec,) * 8 + (P(), P(), P(), spec, P()),
+        out_specs=(P(), spec, P()),
+    )
+    return jax.jit(fn)
+
+
+def _walk_require_csr(sg: ShardedGraph):
+    if sg.csr_pos is None:
+        raise ValueError(
+            "the sharded walk requires a sender-CSR sharded graph — build "
+            "with shard_graph(source_csr=True)"
+        )
+
+
+def _walk_state0(sg: ShardedGraph, protocol):
+    """RandomWalks.init parity on the sharded representation — a one-off
+    host-side O(N) setup (eager jnp on mesh-sharded operands would trip
+    sharding propagation outside a mesh context)."""
+    mask = np.asarray(sg.node_mask).reshape(-1)
+    n_pad = sg.n_shards * sg.block
+    live_ids = np.flatnonzero(mask)
+    if live_ids.size:
+        n_live = live_ids.size
+        stride = max(n_live // protocol.n_walkers, 1)
+        pos = live_ids[
+            (np.arange(protocol.n_walkers) * stride) % n_live
+        ].astype(np.int32)
+    else:
+        pos = np.zeros(protocol.n_walkers, np.int32)
+    visited = np.zeros(n_pad, dtype=bool)
+    visited[pos] = True
+    visited &= mask
+    return (jnp.asarray(pos), jnp.asarray(pos),
+            jnp.asarray(visited.reshape(sg.n_shards, sg.block)))
+
+
+def _walk_call(sg: ShardedGraph, protocol, state0):
+    """Shared argument marshalling for walk()/walk_until_coverage()."""
+    if state0 is None:
+        pos0, start0, visited0 = _walk_state0(sg, protocol)
+    else:
+        pos0, start0, visited0 = state0
+    # Host-side gather for the same reason as _walk_state0.
+    alive_start = jnp.asarray(
+        np.asarray(sg.node_mask).reshape(-1)[np.asarray(start0)]
+    )
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    common = (sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+              sg.node_mask, sg.csr_pos, sg.csr_offsets)
+    return common, pos0, start0, alive_start, visited0
+
+
+def walk(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
+         rounds: int, axis_name: str = DEFAULT_AXIS, state0=None,
+         return_state: bool = False):
+    """Run ``rounds`` of the walker cohort (models/walk.py RandomWalks) on
+    the sharded graph — bit-identical to ``engine.run(graph, protocol,
+    key, rounds)`` for any shard count, because candidate draws are keyed
+    by edge identity (utils/edgehash.py), not layout.
+
+    Returns ``(visited [S, block] bool, stats dict of [rounds] arrays)``;
+    with ``return_state=True``, ``((pos, start, visited), stats)`` — the
+    resume triple ``walk_until_coverage`` also accepts.
+    """
+    _walk_require_csr(sg)
+    S, block = sg.n_shards, sg.block
+    common, pos0, start0, alive_start, visited0 = _walk_call(
+        sg, protocol, state0)
+    keys = jax.random.split(jax.random.fold_in(key, 1), rounds)
+    fn = _walk_fn(mesh, axis_name, S, block, protocol.n_walkers,
+                  max(sg.csr_span, 1), float(protocol.restart_p))
+    pos, visited, stats = fn(*common, pos0, start0, alive_start, visited0,
+                             jax.random.key_data(keys))
+    if return_state:
+        return (pos, start0, visited), stats
+    return visited, stats
+
+
+def walk_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
+                        key: jax.Array, *,
+                        coverage_target: float = 0.99,
+                        max_rounds: int = 1024,
+                        axis_name: str = DEFAULT_AXIS, state0=None,
+                        return_state: bool = False):
+    """Walk until the cohort has visited ``coverage_target`` of the live
+    population — ``engine.run_until_coverage`` with RandomWalks,
+    multi-chip, one XLA program (the discovery question: rounds to map
+    the overlay). Same identity-keyed draws as :func:`walk`, so the
+    trajectory is bit-identical to the engine loop's for any shard count.
+
+    Returns ``(visited, dict(rounds, coverage, messages))``; with
+    ``return_state=True``, ``((pos, start, visited), dict)``.
+    """
+    _walk_require_csr(sg)
+    S, block = sg.n_shards, sg.block
+    common, pos0, start0, alive_start, visited0 = _walk_call(
+        sg, protocol, state0)
+    fn = _walk_cov_fn(mesh, axis_name, S, block, max_rounds,
+                      protocol.n_walkers, max(sg.csr_span, 1),
+                      float(protocol.restart_p))
+    pos, visited, packed = fn(
+        jnp.float32(coverage_target), *common, pos0, start0, alive_start,
+        visited0, jax.random.key_data(key),
+    )
+    out = accum.unpack_summary(packed)
+    if return_state:
+        return (pos, start0, visited), out
+    return visited, out

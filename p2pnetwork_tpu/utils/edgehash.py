@@ -1,0 +1,48 @@
+"""Stateless per-(walker, edge) uniforms, identical on every backend.
+
+The walker cohort (models/walk.py) draws one uniform per candidate edge
+per round. Drawing by ARRAY SLOT (`jax.random.uniform` over the gathered
+row) would tie the stream to the memory layout — the sharded ring
+(parallel/sharded.py) sees the same edges in different positions on
+different shards, so slot-keyed draws could never match the engine. This
+module keys the draw by the edge's IDENTITY instead: a mixing hash of
+(round key, walker, global sender, global receiver) → f32 in [0, 1).
+Any party that can name the edge computes the same number, which is what
+makes the sharded walk bit-identical to the engine and invariant to the
+shard count.
+
+The mix is a boost-style hash_combine over the inputs followed by the
+murmur3 finalizer (fmix32) — not cryptographic, but full-avalanche, and
+the statistical quality is pinned by tests (uniform occupancy over a
+star hub; KS-style bounds in tests/test_walk.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def edge_uniform(key: jax.Array, walker, sender, receiver) -> jax.Array:
+    """f32 uniforms in [0, 1), one per broadcast element of
+    ``(walker, sender, receiver)`` under PRNG ``key``.
+
+    Inputs broadcast like jnp operands ([W, 1] walker against [W, slots]
+    receivers is the typical shape). int32 inputs are reinterpreted as
+    uint32 — negative sentinels hash fine (consumers mask them anyway).
+    """
+    kd = jax.random.key_data(key).astype(jnp.uint32)
+    golden = jnp.uint32(0x9E3779B9)
+    h = kd[..., 0] ^ golden
+    for v in (kd[..., 1], walker, sender, receiver):
+        v = jnp.asarray(v).astype(jnp.uint32)
+        # boost::hash_combine, elementwise over the broadcast shape.
+        h = h ^ (v + golden + (h << 6) + (h >> 2))
+    # murmur3 fmix32 finalizer: full avalanche.
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # Top 24 bits -> [0, 1) exactly representable in f32.
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1 / (1 << 24))

@@ -183,3 +183,117 @@ class TestRandomWalks:
         assert counts.sum() == 4096
         # Expected 16 per leaf; all leaves hit within a generous band.
         assert counts.min() >= 2 and counts.max() <= 48
+
+class TestShardedWalk:
+    """The walker cohort on the ring: bit-identical to the engine for any
+    shard count, because candidate draws are keyed by edge identity
+    (utils/edgehash.py), not array slot."""
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 8])
+    def test_matches_engine_bitexact(self, n_shards):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(512, 6, 0.2, seed=0, source_csr=True)
+        proto = RandomWalks(n_walkers=64)
+        ref_state, ref_stats = engine.run(g, proto, jax.random.key(0), 15)
+        mesh = M.ring_mesh(n_shards)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        (pos, _, visited), stats = sharded.walk(
+            sg, mesh, proto, jax.random.key(0), 15, return_state=True)
+        np.testing.assert_array_equal(np.asarray(pos),
+                                      np.asarray(ref_state.pos))
+        np.testing.assert_array_equal(np.asarray(visited).reshape(-1),
+                                      np.asarray(ref_state.visited))
+        np.testing.assert_array_equal(np.asarray(stats["messages"]),
+                                      np.asarray(ref_stats["messages"]))
+        np.testing.assert_array_equal(np.asarray(stats["stuck"]),
+                                      np.asarray(ref_stats["stuck"]))
+
+    def test_restart_parity(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(256, 4, 0.1, seed=1, source_csr=True)
+        proto = RandomWalks(n_walkers=32, restart_p=0.3)
+        ref_state, _ = engine.run(g, proto, jax.random.key(5), 20)
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        (pos, _, _), _ = sharded.walk(sg, mesh, proto, jax.random.key(5),
+                                      20, return_state=True)
+        np.testing.assert_array_equal(np.asarray(pos),
+                                      np.asarray(ref_state.pos))
+
+    def test_coverage_loop_matches_engine(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(512, 8, 0.3, seed=2, source_csr=True)
+        proto = RandomWalks(n_walkers=64)
+        ref_state, ref_out = engine.run_until_coverage(
+            g, proto, jax.random.key(3), coverage_target=0.9,
+            max_rounds=512,
+        )
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        visited, out = sharded.walk_until_coverage(
+            sg, mesh, proto, jax.random.key(3), coverage_target=0.9,
+            max_rounds=512,
+        )
+        assert out["rounds"] == ref_out["rounds"]
+        assert out["messages"] == ref_out["messages"]
+        np.testing.assert_array_equal(np.asarray(visited).reshape(-1),
+                                      np.asarray(ref_state.visited))
+
+    def test_churn_and_dynamic_links_parity(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+        from p2pnetwork_tpu.sim import failures as F
+
+        g = G.ring(256, source_csr=True)
+        gc = topology.connect(
+            topology.with_capacity(F.fail_nodes(g, [7, 100]),
+                                   extra_edges=8),
+            [10, 200], [180, 30],
+        )
+        mesh = M.ring_mesh(4)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        sg = sharded.connect(
+            sharded.with_capacity(sharded.fail_nodes(sg, [7, 100]), 8),
+            [10, 200], [180, 30],
+        )
+        proto = RandomWalks(n_walkers=16)
+        ref_state, ref_stats = engine.run(gc, proto, jax.random.key(9), 60)
+        (pos, _, visited), stats = sharded.walk(
+            sg, mesh, proto, jax.random.key(9), 60, return_state=True)
+        np.testing.assert_array_equal(np.asarray(pos),
+                                      np.asarray(ref_state.pos))
+        np.testing.assert_array_equal(np.asarray(visited).reshape(-1),
+                                      np.asarray(ref_state.visited))
+        np.testing.assert_array_equal(np.asarray(stats["messages"]),
+                                      np.asarray(ref_stats["messages"]))
+
+    def test_resume_roundtrip(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(256, 6, 0.2, seed=4, source_csr=True)
+        proto = RandomWalks(n_walkers=32)
+        mesh = M.ring_mesh(2)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        state, _ = sharded.walk(sg, mesh, proto, jax.random.key(1), 5,
+                                return_state=True)
+        state2, out = sharded.walk_until_coverage(
+            sg, mesh, proto, jax.random.key(2), coverage_target=0.8,
+            max_rounds=512, state0=state, return_state=True,
+        )
+        assert out["coverage"] >= 0.8
+        # visited only grows across the resume.
+        v1 = np.asarray(state[2]).reshape(-1)
+        v2 = np.asarray(state2[2]).reshape(-1)
+        assert v2[v1].all()
+
+    def test_requires_csr(self):
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.ring(128)
+        mesh = M.ring_mesh(2)
+        sg = sharded.shard_graph(g, mesh)
+        with pytest.raises(ValueError, match="source_csr"):
+            sharded.walk(sg, mesh, RandomWalks(n_walkers=4),
+                         jax.random.key(0), 3)
