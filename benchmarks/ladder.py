@@ -231,6 +231,46 @@ def bench_flood_ba(n=100_000, m=4, adaptive_k=1024):
     )
 
 
+def bench_discovery(n=1_000_000, walkers=4096):
+    """Peer-sampling discovery rung: how long a walker cohort takes to
+    map 99% of a 1M-node overlay — the protocol family reference users
+    hand-roll for crawling/peer sampling [ref: README.md:20], whole run
+    device-side (models/walk.py RandomWalks + run_until_coverage)."""
+    import jax
+
+    from p2pnetwork_tpu.models import RandomWalks
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    t0 = time.perf_counter()
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, build_neighbor_table=False,
+                         source_csr=True)
+    build_s = time.perf_counter() - t0
+    proto = RandomWalks(n_walkers=walkers)
+
+    def once():
+        _, out = engine.run_until_coverage(
+            g, proto, jax.random.key(0), coverage_target=0.99,
+            max_rounds=8192,
+        )
+        return out
+
+    out = once()  # warm
+    t0 = time.perf_counter()
+    out = once()
+    secs = time.perf_counter() - t0
+    emit({
+        "config": f"{n//1_000_000}M WS overlay discovery, "
+                  f"{walkers}-walker cohort (single chip)",
+        "value": round(secs, 3),
+        "unit": "s to 99% of the overlay visited",
+        "rounds": int(out["rounds"]),
+        "messages": int(out["messages"]),
+        "rounds_per_s": round(int(out["rounds"]) / secs, 1),
+        "graph_build_s": round(build_s, 1),
+    })
+
+
 def bench_flood_auto():
     """GSPMD auto path (parallel/auto.py) on every available device, both
     lowerings: the segment-method flood (the idiom's historical floor,
@@ -403,6 +443,7 @@ def main():
     bench_flood_sharded_ring()
     bench_flood_auto()
     bench_flood_ba()
+    bench_discovery()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
     if args.full:
         bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)",

@@ -5,7 +5,7 @@ reference users would hand-write on the event hooks [ref: README.md:20]:
 each undecided node draws a random priority and broadcasts it; a node
 whose draw strictly beats every undecided neighbor's joins the set and
 announces; the announcers' neighbors drop out of contention. Expected
-O(log n) rounds to decide everyone (Luby 1986 — PAPERS.md).
+O(log n) rounds to decide everyone (Luby, SIAM J. Comput. 1986).
 
 One protocol round = one batched draw (`jax.random.randint` from the
 engine's per-round key) + one `propagate_max` of priorities over the
