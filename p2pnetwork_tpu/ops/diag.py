@@ -108,13 +108,18 @@ def select_diagonals(
     if min_count is None:
         min_count = max(n // 256, 128)
     diag_sel = np.zeros(senders.shape[0], dtype=bool)
-    senders = senders.astype(np.int64)
-    receivers = receivers.astype(np.int64)
+    senders = np.asarray(senders)
+    receivers = np.asarray(receivers)
     real = np.flatnonzero((senders < n) & (receivers < n))
     kept: list = []
     per_sel: list = []
     if real.size:
-        off = (senders[real] - receivers[real]) % n  # in [0, n)
+        # (s - r) mod n without the modulo: ids are < n so the difference
+        # is in (-n, n) and one conditional add folds it into [0, n). The
+        # per-element int64 division of `% n` was a measured hotspot of
+        # graph build at BASELINE scale.
+        d = senders[real].astype(np.int32) - receivers[real].astype(np.int32)
+        off = np.where(d < 0, d + np.int32(n), d)
         counts = np.bincount(off)
         # Filter (self-loops, below-threshold) BEFORE truncating to
         # max_diags — a frequent self-loop offset ranking in the top
@@ -133,17 +138,31 @@ def select_diagonals(
         from p2pnetwork_tpu import native
 
         sorted_off, by_off = native.sort_pairs(
-            off.astype(np.int32),
-            np.arange(off.shape[0], dtype=np.int32),
+            off, np.arange(off.shape[0], dtype=np.int32)
         )
         lo = np.searchsorted(sorted_off, kept)
         hi = np.searchsorted(sorted_off, kept, side="right")
+        # Both sorters are STABLE (native LSD radix; numpy fallback uses
+        # kind="stable"), so when the input edges arrive receiver-sorted —
+        # the documented precondition of both call sites — each offset's
+        # slice keeps its receivers non-decreasing and first-per-receiver
+        # is one neighbor compare instead of an np.unique sort per offset.
+        rsorted = bool(receivers.size == 0 or
+                       (receivers[1:] >= receivers[:-1]).all())
         for d, o in enumerate(kept):
             sel = real[by_off[lo[d]:hi[d]]]
             # A mask slot holds ONE edge; duplicate (offset, receiver)
             # pairs beyond the first stay in the remainder.
-            _, first = np.unique(receivers[sel], return_index=True)
-            sel = sel[first]
+            rs = receivers[sel]
+            if rsorted:
+                first = np.empty(rs.shape[0], dtype=bool)
+                if rs.shape[0]:
+                    first[0] = True
+                    np.not_equal(rs[1:], rs[:-1], out=first[1:])
+                sel = sel[first]
+            else:
+                _, first = np.unique(rs, return_index=True)
+                sel = sel[first]
             per_sel.append(sel)
             diag_sel[sel] = True
     return kept, per_sel, diag_sel
@@ -162,8 +181,8 @@ def build_hybrid_from_arrays(
     """:func:`build_hybrid` on host edge arrays (``receivers`` sorted
     non-decreasing, active edges only) — lets graph construction build the
     representation before anything is transferred to device."""
-    senders = senders.astype(np.int64)
-    receivers = receivers.astype(np.int64)
+    senders = np.asarray(senders)
+    receivers = np.asarray(receivers)
 
     kept, per_sel, diag_sel = select_diagonals(
         senders, receivers, n, max_diags, min_count
@@ -176,8 +195,8 @@ def build_hybrid_from_arrays(
         for d, sel in enumerate(per_sel):
             masks[d, receivers[sel]] = True
 
-    rem_s = senders[~diag_sel].astype(np.int32)
-    rem_r = receivers[~diag_sel].astype(np.int32)
+    rem_s = senders[~diag_sel].astype(np.int32, copy=False)
+    rem_r = receivers[~diag_sel].astype(np.int32, copy=False)
     remainder = None
     if rem_s.size:
         # The remainder inherits receiver-sortedness from the graph's edges.
