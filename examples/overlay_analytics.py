@@ -1,12 +1,13 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Six questions reference users answer by hand-instrumenting callbacks
+Seven questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
 (HopDistance / BFS), what's the network-wide average (PushSum), who
 coordinates (LeaderElection), is the network partitioned and how badly
-(ConnectedComponents, after node failures), and which peers form the
-resilient core (KCore) — each runs here as a batched protocol over the
-whole population in one compiled scan.
+(ConnectedComponents, after node failures), can peers be 2-colored into
+roles (BipartiteCheck), and which peers form the resilient core (KCore)
+— each runs here as a batched protocol over the whole population in one
+compiled scan.
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -18,8 +19,9 @@ sys.path.insert(0, ".")
 import jax
 import numpy as np
 
-from p2pnetwork_tpu.models import (ConnectedComponents, HopDistance, KCore,
-                                   LeaderElection, PageRank, PushSum)
+from p2pnetwork_tpu.models import (BipartiteCheck, ConnectedComponents,
+                                   HopDistance, KCore, LeaderElection,
+                                   PageRank, PushSum)
 from p2pnetwork_tpu.sim import engine, failures
 from p2pnetwork_tpu.sim import graph as G
 
@@ -85,6 +87,19 @@ def main():
     parts = int(proto.components(gf, state))
     print(f"ConnectedComponents: after failing the top-50 hubs the overlay "
           f"splits into {parts} partition(s) "
+          f"({int(out['rounds'])} rounds to quiesce)")
+
+    # Can peers be split into two roles with links only across the split
+    # (request/response, storage/index): odd-cycle detection by the same
+    # max-label flood, recording BFS layers as it goes.
+    proto = BipartiteCheck()
+    state, out = engine.run_until_converged(
+        g, proto, jax.random.key(5), stat="changed", threshold=1,
+        max_rounds=256,
+    )
+    odd = int(proto.odd_edges(g, state))
+    verdict = "bipartite" if odd == 0 else f"not bipartite ({odd} odd edge slots)"
+    print(f"BipartiteCheck: the overlay is {verdict} "
           f"({int(out['rounds'])} rounds to quiesce)")
 
     # Who forms the resilient core: recursive peeling of under-connected

@@ -12,6 +12,7 @@ from p2pnetwork_tpu.models.adaptive_flood import (
     AdaptiveHopDistanceState,
 )
 from p2pnetwork_tpu.models.base import Protocol
+from p2pnetwork_tpu.models.bipartite import BipartiteCheck, BipartiteCheckState
 from p2pnetwork_tpu.models.coloring import color_via_mis
 from p2pnetwork_tpu.models.components import (
     ConnectedComponents,
@@ -36,6 +37,8 @@ __all__ = [
     "AdaptiveFloodState",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",
+    "BipartiteCheck",
+    "BipartiteCheckState",
     "ConnectedComponents",
     "ConnectedComponentsState",
     "Flood",

@@ -1,0 +1,138 @@
+"""Bipartiteness / odd-cycle detection by rooted parity flooding.
+
+Another overlay-structure question reference users would answer by
+hand-rolling a probe protocol on the event hooks [ref: README.md:20 — the
+library "does not implement any protocol"]: *is the overlay 2-colorable*
+(e.g. does a request/response role split hold globally), equivalently
+*does it contain an odd cycle?*
+
+The classical distributed answer is a rooted BFS 2-coloring per component.
+Batched TPU form: run the same max-label flood as
+:class:`~p2pnetwork_tpu.models.components.ConnectedComponents` while
+recording, per node, the round of its LAST label adoption. Synchronous
+max-flooding delivers the component's maximum id to a node at exactly its
+BFS distance from that maximum's holder (the wave travels one hop per
+round and ids are unique, so the last strict increase IS the arrival of
+the component max). At quiescence ``dist`` therefore holds exact BFS
+layers from each component's root, with no second phase and no extra
+propagation primitive: the labelling run and the layering run are the
+same flood.
+
+A graph is bipartite iff no edge joins two nodes in layers of equal
+parity (BFS layers of adjacent nodes differ by at most one, so equal
+parity means equal layer — the witness of an odd cycle through their
+lowest common BFS ancestor). ``stats["odd_edges"]`` counts the directed
+edge slots violating parity — FINAL ONLY AT QUIESCENCE (run with
+``engine.run_until_converged(..., stat="changed", threshold=1)``, like
+ConnectedComponents); transient labels can briefly flag edges while the
+floods are still merging. Self-loops count as odd (a length-1 cycle), and
+each undirected edge of the symmetric builder graphs occupies two
+directed slots, so a single undirected odd edge reports as 2.
+
+Deterministic — no RNG consumed. Dynamic runtime links
+(sim/topology.py connect) participate in both the flood (via
+ops/segment) and the parity scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models.leader import max_flood_step
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BipartiteCheckState:
+    label: jax.Array  # i32[N_pad] — highest live id heard; -1 on dead nodes
+    dist: jax.Array  # i32[N_pad] — round of last label adoption (BFS layer
+    #                  from the component root at quiescence); -1 on dead
+    frontier: jax.Array  # bool[N_pad] — adopted a new label last round
+    round: jax.Array  # i32[] — rounds executed so far
+
+
+def _odd_edge_slots(graph: Graph, label: jax.Array,
+                    dist: jax.Array) -> jax.Array:
+    """Count directed edge slots joining same-component endpoints whose BFS
+    layers share parity (valid once the flood has quiesced)."""
+
+    def scan(s, r, mask):
+        ls, lr = label[s], label[r]
+        same = mask & (ls >= 0) & (ls == lr)
+        par = ((dist[s] ^ dist[r]) & 1) == 0
+        return jnp.sum(same & par)
+
+    count = scan(graph.senders, graph.receivers, graph.edge_mask)
+    if graph.dyn_senders is not None:
+        count = count + scan(graph.dyn_senders, graph.dyn_receivers,
+                             graph.dyn_mask)
+    return count
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class BipartiteCheck:
+    """Rooted parity flood to a per-component fixpoint. ``method`` picks the
+    aggregation lowering (``"auto"``/``"segment"``/``"gather"`` — see
+    ops/segment.propagate_max)."""
+
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> BipartiteCheckState:
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        label = jnp.where(graph.node_mask, ids, -1)
+        dist = jnp.where(graph.node_mask, 0, -1).astype(jnp.int32)
+        return BipartiteCheckState(label=label, dist=dist,
+                                   frontier=graph.node_mask,
+                                   round=jnp.int32(0))
+
+    def odd_edges(self, graph: Graph,
+                  state: BipartiteCheckState) -> jax.Array:
+        """Directed edge slots violating 2-colorability (valid at
+        quiescence; 0 means the whole live graph is bipartite). The same
+        scalar ``stats["odd_edges"]`` reports per round — this method reads
+        it from a converged state, e.g. after ``run_until_converged`` whose
+        packed summary carries only the convergence stat."""
+        return _odd_edge_slots(graph, state.label, state.dist)
+
+    def component_bipartite(self, graph: Graph,
+                            state: BipartiteCheckState) -> jax.Array:
+        """bool[N_pad]: does this node's component contain NO odd edge?
+        (False on dead nodes; valid at quiescence.)"""
+
+        bad = jnp.zeros(graph.n_nodes_padded, dtype=bool)
+
+        def mark(bad, s, r, mask):
+            ls, lr = state.label[s], state.label[r]
+            same = mask & (ls >= 0) & (ls == lr)
+            par = ((state.dist[s] ^ state.dist[r]) & 1) == 0
+            odd = same & par
+            # The component label is the root's own id — scatter the odd
+            # flag there, then read it back through every member's label.
+            return bad.at[jnp.where(odd, ls, 0)].max(odd)
+
+        bad = mark(bad, graph.senders, graph.receivers, graph.edge_mask)
+        if graph.dyn_senders is not None:
+            bad = mark(bad, graph.dyn_senders, graph.dyn_receivers,
+                       graph.dyn_mask)
+        safe_label = jnp.maximum(state.label, 0)
+        return graph.node_mask & ~bad[safe_label]
+
+    def step(self, graph: Graph, state: BipartiteCheckState, key: jax.Array):
+        label, changed, msgs = max_flood_step(
+            graph, state.label, state.frontier, self.method)
+        rnd = state.round + 1
+        dist = jnp.where(changed, rnd, state.dist)
+        odd = _odd_edge_slots(graph, label, dist)
+        new_state = BipartiteCheckState(label=label, dist=dist,
+                                        frontier=changed, round=rnd)
+        stats = {
+            "messages": msgs,
+            "changed": jnp.sum(changed),
+            "odd_edges": odd,
+            "bipartite": (odd == 0).astype(jnp.int32),
+        }
+        return new_state, stats

@@ -1,0 +1,138 @@
+"""BipartiteCheck (rooted parity flooding) vs a numpy 2-coloring oracle."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import BipartiteCheck  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _live_pairs(g):
+    pairs = []
+    send, recv = np.asarray(g.senders), np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    pairs.append((send[em], recv[em]))
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        pairs.append((np.asarray(g.dyn_senders)[dm],
+                      np.asarray(g.dyn_receivers)[dm]))
+    return pairs
+
+
+def _oracle(g):
+    """(bipartite_overall, per_node_component_bipartite) by BFS 2-coloring
+    each component of the undirected live-edge graph."""
+    n_pad = g.n_nodes_padded
+    alive = np.asarray(g.node_mask)
+    adj = [[] for _ in range(n_pad)]
+    for s, r in _live_pairs(g):
+        for a, b in zip(s, r):
+            adj[a].append(b)
+            adj[b].append(a)  # bipartiteness is an undirected question
+    color = np.full(n_pad, -1)
+    comp_ok = np.ones(n_pad, dtype=bool)
+    for root in range(n_pad):
+        if not alive[root] or color[root] >= 0:
+            continue
+        comp, ok, queue = [root], True, [root]
+        color[root] = 0
+        while queue:
+            u = queue.pop()
+            for v in adj[u]:
+                if color[v] < 0:
+                    color[v] = color[u] ^ 1
+                    comp.append(v)
+                    queue.append(v)
+                elif color[v] == color[u]:
+                    ok = False
+        for v in comp:
+            comp_ok[v] = ok
+    comp_ok[~alive] = False
+    return bool(comp_ok[alive].all()), comp_ok
+
+
+def _run(g, method="auto"):
+    p = BipartiteCheck(method=method)
+    st, out = engine.run_until_converged(
+        g, p, jax.random.key(0), stat="changed", threshold=1, max_rounds=512,
+    )
+    return p, st, out
+
+
+def _check_against_oracle(g, method="auto"):
+    want_all, want_per_node = _oracle(g)
+    p, st, _ = _run(g, method)
+    odd = int(p.odd_edges(g, st))
+    assert (odd == 0) == want_all
+    got = np.asarray(p.component_bipartite(g, st))
+    np.testing.assert_array_equal(got, want_per_node)
+    return odd
+
+
+class TestBipartiteCheck:
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_even_ring_is_bipartite(self, method):
+        odd = _check_against_oracle(G.ring(128), method)
+        assert odd == 0
+
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_odd_ring_is_not(self, method):
+        odd = _check_against_oracle(G.ring(127), method)
+        # Exactly one odd edge in a 2-coloring attempt of an odd ring —
+        # two directed slots.
+        assert odd == 2
+
+    def test_star_is_bipartite(self):
+        hub = np.zeros(63, dtype=np.int32)
+        leaves = np.arange(1, 64, dtype=np.int32)
+        g = G.from_edges(*G._undirect(hub, leaves), 64)
+        _check_against_oracle(g)
+
+    def test_triangle_plus_square_components(self):
+        # Component {0,1,2} is an odd cycle; component {3,4,5,6} an even one.
+        s = np.array([0, 1, 2, 3, 4, 5, 6], dtype=np.int32)
+        r = np.array([1, 2, 0, 4, 5, 6, 3], dtype=np.int32)
+        g = G.from_edges(*G._undirect(s, r), 7)
+        want_all, want_per = _oracle(g)
+        assert not want_all
+        assert not want_per[:3].any() and want_per[3:7].all()
+        _check_against_oracle(g)
+
+    def test_er_matches_oracle(self):
+        _check_against_oracle(G.erdos_renyi(96, 0.03, seed=3))
+
+    def test_ws_matches_oracle(self):
+        # k=2, p=0: a pure even ring (bipartite); rewired: almost surely not.
+        _check_against_oracle(G.watts_strogatz(64, 2, 0.0, seed=0))
+        _check_against_oracle(G.watts_strogatz(64, 4, 0.2, seed=1))
+
+    def test_failing_a_node_can_restore_bipartiteness(self):
+        # An odd ring loses its odd cycle when any node dies.
+        g = G.ring(9)
+        _check_against_oracle(g)
+        _check_against_oracle(failures.fail_nodes(g, [4]))
+
+    def test_dynamic_edge_creates_odd_cycle(self):
+        # A path 0-1-2-3 is bipartite; adding 0-2 closes a triangle.
+        s = np.array([0, 1, 2], dtype=np.int32)
+        r = np.array([1, 2, 3], dtype=np.int32)
+        g = topology.with_capacity(
+            G.from_edges(*G._undirect(s, r), 4), extra_edges=4)
+        _check_against_oracle(g)
+        g2 = topology.connect(g, [0], [2])
+        want_all, _ = _oracle(g2)
+        assert not want_all
+        _check_against_oracle(g2)
+
+    def test_dist_is_bfs_layer_from_component_max(self):
+        g = G.ring(8)
+        p, st, _ = _run(g)
+        # Root (max id 7) at layer 0; ring distances from 7.
+        dist = np.asarray(st.dist)[:8]
+        want = np.array([1, 2, 3, 4, 3, 2, 1, 0])
+        np.testing.assert_array_equal(dist, want)
+        label = np.asarray(st.label)[:8]
+        assert (label == 7).all()

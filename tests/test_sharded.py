@@ -763,11 +763,12 @@ class TestShardedCoverage:
 
 class TestAutoSharding:
     @pytest.mark.parametrize("protocol_name", [
-        "flood", "sir", "gossip", "components", "mis", "kcore",
+        "flood", "sir", "gossip", "components", "mis", "kcore", "bipartite",
     ])
     def test_auto_matches_single_device(self, protocol_name):
         from p2pnetwork_tpu.models import (
-            SIR, ConnectedComponents, Flood, Gossip, KCore, LubyMIS,
+            SIR, BipartiteCheck, ConnectedComponents, Flood, Gossip, KCore,
+            LubyMIS,
         )
         from p2pnetwork_tpu.parallel import auto
 
@@ -778,6 +779,7 @@ class TestAutoSharding:
             "components": ConnectedComponents(method="segment"),
             "mis": LubyMIS(method="segment", or_method="segment"),
             "kcore": KCore(k=4, method="segment"),
+            "bipartite": BipartiteCheck(method="segment"),
         }[protocol_name]
         g = G.watts_strogatz(512, 6, 0.2, seed=0)
         mesh = M.ring_mesh(8)
