@@ -1,13 +1,14 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Seven questions reference users answer by hand-instrumenting callbacks
+Eight questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
 (HopDistance / BFS), what's the network-wide average (PushSum), who
 coordinates (LeaderElection), is the network partitioned and how badly
 (ConnectedComponents, after node failures), can peers be 2-colored into
-roles (BipartiteCheck), and which peers form the resilient core (KCore)
+roles (BipartiteCheck), how clustered is the overlay
+(transitivity_sample), and which peers form the resilient core (KCore)
 — each runs here as a batched protocol over the whole population in one
-compiled scan.
+compiled scan (clustering as a one-shot device query).
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -101,6 +102,15 @@ def main():
     verdict = "bipartite" if odd == 0 else f"not bipartite ({odd} odd edge slots)"
     print(f"BipartiteCheck: the overlay is {verdict} "
           f"({int(out['rounds'])} rounds to quiesce)")
+
+    # How clustered is the overlay: unbiased wedge sampling (the BA hubs
+    # make the exact [B, d, d] intersection path quadratic in hub degree;
+    # the sampler is degree-free).
+    from p2pnetwork_tpu.models import transitivity_sample
+    gcsr = g.with_source_csr()
+    t_est = transitivity_sample(gcsr, jax.random.key(6), 1 << 16)
+    print(f"transitivity_sample: global clustering ~ {t_est:.4f} "
+          f"(65536 wedges)")
 
     # Who forms the resilient core: recursive peeling of under-connected
     # peers (the k-core) on the intact overlay.

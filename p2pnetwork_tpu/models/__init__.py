@@ -28,11 +28,23 @@ from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
 from p2pnetwork_tpu.models.spanning import SpanningTree, SpanningTreeState
+from p2pnetwork_tpu.models.triangles import (
+    count_triangles,
+    local_clustering,
+    transitivity,
+    transitivity_sample,
+    triangles_per_node,
+)
 from p2pnetwork_tpu.models.walk import RandomWalks, RandomWalksState
 
 __all__ = [
     "Protocol",
     "color_via_mis",
+    "count_triangles",
+    "local_clustering",
+    "transitivity",
+    "transitivity_sample",
+    "triangles_per_node",
     "AdaptiveFlood",
     "AdaptiveFloodState",
     "AdaptiveHopDistance",

@@ -1,0 +1,130 @@
+"""Triangle counting / clustering vs numpy set-intersection oracles."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import (  # noqa: E402
+    count_triangles,
+    local_clustering,
+    transitivity,
+    transitivity_sample,
+    triangles_per_node,
+)
+from p2pnetwork_tpu.sim import failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _adj_sets(g):
+    adj = [set() for _ in range(g.n_nodes_padded)]
+    s = np.asarray(g.senders)
+    r = np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    for a, b in zip(s[em], r[em]):
+        adj[b].add(int(a))  # in-neighbors; symmetric graphs: == neighbors
+        adj[a].add(int(b))
+    return adj
+
+
+def _oracle_tri_per_node(g):
+    adj = _adj_sets(g)
+    tri = np.zeros(g.n_nodes_padded, dtype=np.int64)
+    for v, nv in enumerate(adj):
+        t = 0
+        for u in nv:
+            t += len(nv & adj[u])
+        tri[v] = t // 2
+    return tri
+
+
+def _oracle_total(g):
+    return int(_oracle_tri_per_node(g).sum()) // 3
+
+
+class TestExactCounts:
+    def test_single_triangle(self):
+        g = G.from_edges(*G._undirect(np.array([0, 1, 2]), np.array([1, 2, 0])), 3)
+        assert count_triangles(g) == 1
+        np.testing.assert_array_equal(
+            np.asarray(triangles_per_node(g))[:3], [1, 1, 1])
+
+    def test_ring_has_none(self):
+        assert count_triangles(G.ring(64)) == 0
+
+    def test_complete_graph(self):
+        g = G.complete(8)
+        assert count_triangles(g) == 8 * 7 * 6 // 6
+        np.testing.assert_allclose(np.asarray(local_clustering(g))[:8], 1.0)
+        assert transitivity(g) == pytest.approx(1.0)
+
+    @pytest.mark.parametrize("build", [
+        lambda: G.watts_strogatz(256, 6, 0.2, seed=1),
+        lambda: G.erdos_renyi(200, 0.05, seed=2),
+        lambda: G.barabasi_albert(200, 3, seed=3),
+    ])
+    def test_random_graphs_match_oracle(self, build):
+        g = build()
+        assert count_triangles(g) == _oracle_total(g)
+        np.testing.assert_array_equal(
+            np.asarray(triangles_per_node(g), dtype=np.int64),
+            _oracle_tri_per_node(g))
+
+    def test_small_edge_block_same_answer(self):
+        g = G.watts_strogatz(128, 6, 0.2, seed=0)
+        assert count_triangles(g, edge_block=7) == count_triangles(g)
+
+    def test_failures_respected(self):
+        g = G.watts_strogatz(128, 6, 0.1, seed=4)
+        gf = failures.fail_nodes(g, [3, 17, 60])
+        assert count_triangles(gf) == _oracle_total(gf)
+
+    def test_local_clustering_matches_oracle(self):
+        g = G.erdos_renyi(150, 0.06, seed=5)
+        tri = _oracle_tri_per_node(g)
+        d = np.asarray(g.in_degree, dtype=np.int64)
+        want = np.where(d >= 2, 2.0 * tri / np.maximum(d * (d - 1), 1), 0.0)
+        np.testing.assert_allclose(np.asarray(local_clustering(g)), want,
+                                   rtol=1e-6)
+
+    def test_transitivity_matches_formula(self):
+        g = G.barabasi_albert(150, 3, seed=6)
+        d = np.asarray(g.in_degree, dtype=np.int64)
+        wedges = int((d * (d - 1)).sum()) // 2
+        assert transitivity(g) == pytest.approx(
+            3.0 * _oracle_total(g) / wedges)
+
+
+class TestGuards:
+    def test_dynamic_region_rejected(self):
+        g = topology.with_capacity(G.ring(16), extra_edges=4)
+        with pytest.raises(ValueError, match="consolidate"):
+            count_triangles(g)
+        with pytest.raises(ValueError, match="consolidate"):
+            transitivity_sample(g, jax.random.key(0))
+
+    def test_capped_table_rejected(self):
+        g = G.watts_strogatz(64, 6, 0.1, seed=0, max_degree=2)
+        with pytest.raises(ValueError, match="capped"):
+            count_triangles(g)
+
+    def test_sampler_needs_source_csr(self):
+        g = G.ring(16)
+        with pytest.raises(ValueError, match="source_csr"):
+            transitivity_sample(g, jax.random.key(0))
+
+
+class TestSampler:
+    def test_complete_graph_closes_every_wedge(self):
+        g = G.complete(12, source_csr=True)
+        assert transitivity_sample(g, jax.random.key(0), 2048) == 1.0
+
+    def test_ring_closes_none(self):
+        g = G.ring(64, source_csr=True)
+        assert transitivity_sample(g, jax.random.key(1), 2048) == 0.0
+
+    def test_estimate_tracks_exact(self):
+        g = G.barabasi_albert(300, 4, seed=7, source_csr=True)
+        exact = transitivity(g)
+        est = transitivity_sample(g, jax.random.key(2), 1 << 16)
+        assert abs(est - exact) < 0.03
