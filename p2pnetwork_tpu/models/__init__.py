@@ -20,7 +20,12 @@ from p2pnetwork_tpu.models.components import (
 )
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
-from p2pnetwork_tpu.models.hopdist import HopDistance, HopDistanceState
+from p2pnetwork_tpu.models.hopdist import (
+    HopDistance,
+    HopDistanceState,
+    diameter_bounds,
+    eccentricities,
+)
 from p2pnetwork_tpu.models.kcore import KCore, KCoreState
 from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.mis import LubyMIS, LubyMISState
@@ -41,6 +46,8 @@ __all__ = [
     "Protocol",
     "color_via_mis",
     "count_triangles",
+    "diameter_bounds",
+    "eccentricities",
     "local_clustering",
     "transitivity",
     "transitivity_sample",

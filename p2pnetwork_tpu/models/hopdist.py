@@ -18,6 +18,7 @@ reach fraction with the device-side early-exit loop.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -71,3 +72,74 @@ class HopDistance:
             "max_dist": jnp.max(dist),
         }
         return HopDistanceState(dist=dist, frontier=new, round=rnd), stats
+
+
+@functools.partial(jax.jit, static_argnames=("method",))
+def eccentricities(graph: Graph, sources: jax.Array,
+                   method: str = "auto"):
+    """Batched exact eccentricities: one full BFS per source, run as
+    ``lax.map`` over sequential device-side ``while_loop``s (one XLA
+    program, no host round trips, peak memory one wave).
+
+    Returns ``(ecc, reached)``, both ``i32[S]``: the farthest hop from
+    each source within its component, and how many live nodes its wave
+    touched (``ecc`` is -1 for a dead source). The batched form of
+    reading ``stats["max_dist"]`` off a finished :class:`HopDistance`
+    run, for the multi-source sweeps diameter estimation wants.
+    """
+    sources = jnp.asarray(sources, dtype=jnp.int32)
+    n_pad = graph.n_nodes_padded
+
+    def one(src):
+        seed = jnp.zeros(n_pad, dtype=bool).at[src].set(True)
+        seed = seed & graph.node_mask
+        dist0 = jnp.where(seed, 0, -1).astype(jnp.int32)
+
+        def cond(carry):
+            _, frontier, _ = carry
+            return jnp.any(frontier)
+
+        def body(carry):
+            dist, frontier, rnd = carry
+            delivered = segment.propagate_or(graph, frontier, method)
+            new = delivered & (dist < 0) & graph.node_mask
+            return jnp.where(new, rnd + 1, dist), new, rnd + 1
+
+        dist, _, _ = jax.lax.while_loop(cond, body,
+                                        (dist0, seed, jnp.int32(0)))
+        reached = (dist >= 0) & graph.node_mask
+        return jnp.max(dist), jnp.sum(reached, dtype=jnp.int32)
+
+    return jax.lax.map(one, sources)
+
+
+def diameter_bounds(graph: Graph, key: jax.Array, samples: int = 16,
+                    method: str = "auto"):
+    """Classical sampled diameter bracket: from any vertex ``v``,
+    ``ecc(v) <= diameter <= 2 * ecc(v)`` (triangle inequality through
+    ``v``), so over a sample the tightest bracket is
+    ``[max ecc, 2 * min ecc]``.
+
+    Returns ``dict(lower, upper, radius_upper, connected)`` as Python
+    scalars — ``radius_upper`` is the smallest sampled eccentricity and
+    ``connected`` whether every sampled wave reached all live nodes (the
+    bracket only brackets the sampled component's diameter otherwise).
+    Sources are drawn uniformly from live nodes.
+    """
+    import numpy as np
+
+    alive = np.flatnonzero(np.asarray(graph.node_mask))
+    if alive.size == 0:
+        return {"lower": 0, "upper": 0, "radius_upper": 0, "connected": False}
+    picks = jax.random.choice(key, jnp.asarray(alive, dtype=jnp.int32),
+                              shape=(min(samples, alive.size),),
+                              replace=False)
+    ecc, reached = eccentricities(graph, picks, method)
+    ecc = np.asarray(ecc)
+    reached = np.asarray(reached)
+    return {
+        "lower": int(ecc.max()),
+        "upper": int(2 * ecc.min()),
+        "radius_upper": int(ecc.min()),
+        "connected": bool((reached == alive.size).all()),
+    }

@@ -227,3 +227,66 @@ class TestRunUntilConverged:
         g = G.barabasi_albert(128, 3, seed=0)
         with pytest.raises(ValueError, match="needs \\['coverage'\\]"):
             engine.run_until_coverage(g, Gossip(), jax.random.key(0))
+
+
+class TestEccentricities:
+    def _oracle_ecc(self, g, src):
+        import collections
+        adj = collections.defaultdict(list)
+        s, r = np.asarray(g.senders), np.asarray(g.receivers)
+        em = np.asarray(g.edge_mask)
+        alive = np.asarray(g.node_mask)
+        for a, b in zip(s[em], r[em]):
+            adj[a].append(b)
+        if not alive[src]:
+            return -1, 0
+        dist = {src: 0}
+        q = collections.deque([src])
+        while q:
+            u = q.popleft()
+            for v in adj[u]:
+                if alive[v] and v not in dist:
+                    dist[v] = dist[u] + 1
+                    q.append(v)
+        return max(dist.values()), len(dist)
+
+    def test_ring_eccentricities(self):
+        from p2pnetwork_tpu.models import eccentricities
+        g = G.ring(12)
+        ecc, reached = eccentricities(g, np.arange(12))
+        np.testing.assert_array_equal(np.asarray(ecc), np.full(12, 6))
+        np.testing.assert_array_equal(np.asarray(reached), np.full(12, 12))
+
+    def test_matches_bfs_oracle(self):
+        from p2pnetwork_tpu.models import eccentricities
+        g = G.watts_strogatz(128, 4, 0.2, seed=9)
+        srcs = np.array([0, 5, 63, 127], dtype=np.int32)
+        ecc, reached = eccentricities(g, srcs)
+        for i, s in enumerate(srcs):
+            want_ecc, want_reached = self._oracle_ecc(g, int(s))
+            assert int(ecc[i]) == want_ecc
+            assert int(reached[i]) == want_reached
+
+    def test_dead_source(self):
+        from p2pnetwork_tpu.models import eccentricities
+        g = failures.fail_nodes(G.ring(8), [3])
+        ecc, reached = eccentricities(g, np.array([3], dtype=np.int32))
+        assert int(ecc[0]) == -1 and int(reached[0]) == 0
+
+    def test_diameter_bounds_ring(self):
+        from p2pnetwork_tpu.models import diameter_bounds
+        g = G.ring(32)  # true diameter 16, every ecc = 16
+        out = diameter_bounds(g, jax.random.key(0), samples=4)
+        assert out["lower"] == 16 and out["upper"] == 32
+        assert out["connected"]
+
+    def test_diameter_bounds_bracket_truth(self):
+        from p2pnetwork_tpu.models import diameter_bounds, eccentricities
+        g = G.erdos_renyi(100, 0.06, seed=11)
+        ecc_all, reached_all = eccentricities(
+            g, np.arange(g.n_nodes_padded, dtype=np.int32))
+        alive = np.asarray(g.node_mask)
+        if bool((np.asarray(reached_all)[alive] == alive.sum()).all()):
+            true_diam = int(np.asarray(ecc_all)[alive].max())
+            out = diameter_bounds(g, jax.random.key(1), samples=8)
+            assert out["lower"] <= true_diam <= out["upper"]
