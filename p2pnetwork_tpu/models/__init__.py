@@ -31,6 +31,7 @@ from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.mis import LubyMIS, LubyMISState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
+from p2pnetwork_tpu.models.routing import DistanceVector, DistanceVectorState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
 from p2pnetwork_tpu.models.spanning import SpanningTree, SpanningTreeState
 from p2pnetwork_tpu.models.triangles import (
@@ -60,6 +61,8 @@ __all__ = [
     "BipartiteCheckState",
     "ConnectedComponents",
     "ConnectedComponentsState",
+    "DistanceVector",
+    "DistanceVectorState",
     "Flood",
     "FloodState",
     "Gossip",

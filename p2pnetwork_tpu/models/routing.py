@@ -1,0 +1,117 @@
+"""Distance-vector routing: weighted shortest paths + next-hop tables.
+
+THE routing question of a P2P overlay — *what is the cheapest path to
+this peer, and which neighbor do I forward through* — which reference
+users implement as RIP-style hand-rolled relays on ``node_message``
+(re-broadcasting advertised costs and keeping the best [ref:
+README.md:20, p2pnetwork/node.py:110-116]). Batched TPU form: the whole
+population's Bellman-Ford relaxation is one ``propagate_min_plus`` per
+round (ops/segment.py — the tropical-semiring sibling of the max flood),
+with the frontier optimization every distance-vector protocol has
+implicitly: only nodes whose cost improved last round advertise.
+
+At quiescence (``engine.run_until_converged(..., stat="changed",
+threshold=1)``) ``state.dist`` holds exact single-source shortest-path
+costs over ``graph.edge_weight`` (unit costs when unweighted — then this
+IS HopDistance, in f32), and ``state.parent`` the deterministic
+next-hop table: the lowest-id in-neighbor achieving the optimum, i.e.
+where node v forwards traffic TOWARD the source on the symmetric graphs
+the builders produce (-1 at the source / unreached). Negative weights
+converge too while no negative cycle is reachable; ``max_rounds`` is the
+guard, as everywhere.
+
+Dynamic runtime links participate at ``segment.DYNAMIC_LINK_COST``
+(unit) until consolidated. Deterministic — no RNG consumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DistanceVectorState:
+    dist: jax.Array  # f32[N_pad] — best known cost from source; +inf unreached
+    parent: jax.Array  # i32[N_pad] — lowest-id neighbor achieving it; -1 none
+    frontier: jax.Array  # bool[N_pad] — improved last round (advertisers)
+    round: jax.Array  # i32[] — rounds executed so far
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class DistanceVector:
+    """Single-source Bellman-Ford with next-hop extraction. ``method``
+    picks the aggregation lowering (see ops/segment.propagate_min_plus)."""
+
+    source: int = 0
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> DistanceVectorState:
+        base.validate_source(graph, self.source)
+        seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
+        seed = seed & graph.node_mask
+        dist = jnp.where(seed, 0.0, jnp.inf).astype(jnp.float32)
+        parent = jnp.full(graph.n_nodes_padded, -1, dtype=jnp.int32)
+        return DistanceVectorState(dist=dist, parent=parent, frontier=seed,
+                                   round=jnp.int32(0))
+
+    def coverage(self, graph: Graph, state: DistanceVectorState) -> jax.Array:
+        """Reached fraction of live nodes (run_until_coverage seed)."""
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        return jnp.sum(jnp.isfinite(state.dist) & graph.node_mask) / n_real
+
+    def _parents(self, graph: Graph, signal: jax.Array,
+                 incoming: jax.Array) -> jax.Array:
+        """Lowest-id sender whose relaxation achieves ``incoming`` — the
+        same f32 add re-evaluated on the edge layout compares bitwise
+        equal to the aggregation's pick, whichever lowering produced it."""
+        w = graph.edge_weight if graph.edge_weight is not None else 1.0
+        contrib = jnp.where(graph.edge_mask, signal[graph.senders] + w,
+                            jnp.inf)
+        hit = (contrib == incoming[graph.receivers]) & jnp.isfinite(contrib)
+        cand = jnp.where(hit, graph.senders, _I32_MAX)
+        best = jax.ops.segment_min(
+            cand, graph.receivers, num_segments=graph.n_nodes_padded,
+            indices_are_sorted=True)
+        if graph.dyn_senders is not None:
+            dcontrib = jnp.where(
+                graph.dyn_mask,
+                signal[graph.dyn_senders] + segment.DYNAMIC_LINK_COST,
+                jnp.inf)
+            dhit = ((dcontrib == incoming[graph.dyn_receivers])
+                    & jnp.isfinite(dcontrib))
+            dcand = jnp.where(dhit, graph.dyn_senders, _I32_MAX)
+            best = jnp.minimum(best, jax.ops.segment_min(
+                dcand, graph.dyn_receivers,
+                num_segments=graph.n_nodes_padded))
+        return best
+
+    def step(self, graph: Graph, state: DistanceVectorState, key: jax.Array):
+        signal = jnp.where(state.frontier, state.dist, jnp.inf)
+        incoming = segment.propagate_min_plus(graph, signal, self.method)
+        improved = incoming < state.dist
+        dist = jnp.where(improved, incoming, state.dist)
+        parent = jnp.where(improved, self._parents(graph, signal, incoming),
+                           state.parent)
+        reached = jnp.isfinite(dist) & graph.node_mask
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        stats = {
+            "messages": segment.frontier_messages(
+                graph, state.frontier & graph.node_mask),
+            "changed": jnp.sum(improved),
+            "coverage": jnp.sum(reached) / n_real,
+            "max_cost": jnp.max(jnp.where(reached, dist, -jnp.inf)),
+        }
+        new_state = DistanceVectorState(dist=dist, parent=parent,
+                                        frontier=improved,
+                                        round=state.round + 1)
+        return new_state, stats

@@ -3,7 +3,9 @@ blocked (blocked.py), and the fused Pallas TPU kernel (pallas_edge.py)."""
 
 from p2pnetwork_tpu.ops import segment
 from p2pnetwork_tpu.ops.segment import (frontier_messages, propagate_max,
-                                        propagate_or, propagate_sum)
+                                        propagate_min_plus, propagate_or,
+                                        propagate_sum)
 
-__all__ = ["segment", "propagate_max", "propagate_or", "propagate_sum",
+__all__ = ["segment", "propagate_max", "propagate_min_plus",
+           "propagate_or", "propagate_sum",
            "frontier_messages"]

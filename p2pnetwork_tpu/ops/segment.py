@@ -238,6 +238,79 @@ def propagate_max(graph: Graph, signal: jax.Array,
     return jnp.where(graph.node_mask, agg, neutral)
 
 
+#: Cost of a dynamic runtime link (sim/topology.py connect) in weighted
+#: propagation — the dynamic region has no weight channel, so new links
+#: enter at unit cost until topology.consolidate folds them in (where
+#: they keep that cost as a static weight).
+DYNAMIC_LINK_COST = 1.0
+
+
+def _dynamic_min_plus(graph: Graph, dist: jax.Array) -> jax.Array:
+    """Min-plus over the dynamic edge region (unit link cost)."""
+    contrib = jnp.where(graph.dyn_mask,
+                        dist[graph.dyn_senders] + DYNAMIC_LINK_COST,
+                        jnp.inf)
+    return jax.ops.segment_min(
+        contrib, graph.dyn_receivers, num_segments=graph.n_nodes_padded
+    )
+
+
+def propagate_min_plus(graph: Graph, dist: jax.Array,
+                       method: str = "auto") -> jax.Array:
+    """Per-node min-plus relaxation: ``out[v] = min(dist[u] + w(u, v))``
+    over live incoming edges — one Bellman-Ford round over the whole
+    population, the tropical-semiring sibling of :func:`propagate_max`.
+
+    Weights come from ``graph.edge_weight`` (``from_edges(weights=...)``
+    / ``Graph.with_weights``); an unweighted graph costs 1 per hop, so
+    the fixpoint is BFS hop distance. Nodes with no live in-edge — and
+    dead nodes — get ``+inf``; callers fold with their own value
+    (``jnp.minimum``), which makes that neutral. ``dist`` is ``f32``.
+    Methods as in propagate_max: ``"segment"`` / ``"gather"`` (gather
+    needs the aligned ``neighbor_weight`` view on weighted graphs; auto
+    falls back to segment when it is absent).
+    """
+    if graph.dyn_senders is not None:
+        static = dataclasses.replace(graph, dyn_senders=None,
+                                     dyn_receivers=None, dyn_mask=None)
+        return jnp.minimum(propagate_min_plus(static, dist, method),
+                           _dynamic_min_plus(graph, dist))
+    weighted = graph.edge_weight is not None
+    if method == "auto":
+        gather_fits = _gather_ok(graph) and (
+            not weighted or graph.neighbor_weight is not None)
+        method = "gather" if gather_fits else "segment"
+    if method == "gather":
+        _require_complete_table(graph)
+        if weighted and graph.neighbor_weight is None:
+            raise ValueError(
+                "method='gather' on a weighted graph needs the aligned "
+                "neighbor_weight view — build with from_edges(weights=...) "
+                "or Graph.with_weights, or use method='segment'"
+            )
+        w = graph.neighbor_weight if weighted else 1.0
+        vals = jnp.where(graph.neighbor_mask, dist[graph.neighbors] + w,
+                         jnp.inf)
+        agg = jnp.min(vals, axis=1)
+    elif method == "segment":
+        w = graph.edge_weight if weighted else 1.0
+        contrib = jnp.where(graph.edge_mask, dist[graph.senders] + w,
+                            jnp.inf)
+        agg = jax.ops.segment_min(
+            contrib,
+            graph.receivers,
+            num_segments=graph.n_nodes_padded,
+            indices_are_sorted=True,
+        )
+    else:
+        raise ValueError(
+            f"propagate_min_plus supports method 'segment' or 'gather', "
+            f"got {method!r} (min does not ride the one-hot-matmul "
+            f"lowerings)"
+        )
+    return jnp.where(graph.node_mask, agg, jnp.inf)
+
+
 def frontier_messages(graph: Graph, frontier: jax.Array) -> jax.Array:
     """Number of point-to-point sends this round: every node holding the
     frontier flag sends to each of its outgoing edges — the batched

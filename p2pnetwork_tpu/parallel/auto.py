@@ -109,6 +109,8 @@ def shard_graph_auto(graph: Graph, mesh: Mesh,
         out_degree=put(graph.out_degree),
         neighbors=put(graph.neighbors),
         neighbor_mask=put(graph.neighbor_mask),
+        edge_weight=put(graph.edge_weight),
+        neighbor_weight=put(graph.neighbor_weight),
         blocked=put_blocked(graph.blocked),
         hybrid=put_hybrid(graph.hybrid),
     )

@@ -121,6 +121,15 @@ class Graph:
     #: Widest build-time out-edge row (static slot width for the sparse
     #: frontier gather), 0 when no CSR is attached.
     max_out_span: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # Optional per-edge weights (latency / link cost — f32[E_pad], aligned
+    # with senders/receivers; padded slots masked like everything else).
+    # None means the unweighted graph every propagate treats as cost-1.
+    # Attach via ``from_edges(weights=...)`` or :meth:`with_weights`.
+    edge_weight: Optional[jax.Array] = None  # f32[E_pad]
+    # Gather-layout view of edge_weight ([N_pad, max_degree], aligned with
+    # the neighbor table rows); built alongside the table when weights are
+    # present so propagate_min_plus's gather lowering has aligned costs.
+    neighbor_weight: Optional[jax.Array] = None  # f32[N_pad, max_degree]
 
     @property
     def n_nodes_padded(self) -> int:
@@ -172,6 +181,51 @@ class Graph:
         eid = self.src_eid[jnp.where(valid, slot, self.n_edges_padded - 1)]
         return eid, valid
 
+    def with_weights(self, weights) -> "Graph":
+        """Return a copy carrying per-edge costs.
+
+        ``weights`` is either a callable ``(senders, receivers) -> f32``
+        evaluated on the padded edge arrays (deterministic link-cost
+        models, e.g. id-hash latency), or an array aligned with the
+        receiver-sorted padded edge slots. When a complete neighbor table
+        exists its aligned weight view is rebuilt host-side (the same
+        one-off cost as ``with_hybrid``); a width-capped table cannot be
+        re-aligned post hoc — pass ``weights=`` to ``from_edges`` instead.
+        """
+        if callable(weights):
+            w = jnp.asarray(weights(self.senders, self.receivers),
+                            dtype=jnp.float32)
+        else:
+            w = jnp.asarray(weights, dtype=jnp.float32)
+        if w.shape != self.senders.shape:
+            raise ValueError("weights must align with the padded edge slots")
+        nw = None
+        if self.neighbors is not None:
+            if not self.neighbors_complete:
+                raise ValueError(
+                    "cannot re-align weights to a width-capped neighbor "
+                    "table; rebuild via from_edges(weights=..., "
+                    "max_degree=...)"
+                )
+            # Complete-table rows are the contiguous receiver runs of the
+            # BUILD-time (unpadded) edge list, in order — recompute the
+            # slot -> edge map the builder used. Build-time extents, not
+            # in_degree: liveness re-masking since build changes degrees
+            # but not slot layout (failures re-mask neighbor_mask, which
+            # still guards every consumer of these values).
+            rh = np.asarray(self.receivers)[: self.n_edges]
+            ids = np.arange(self.n_nodes_padded)
+            starts = np.searchsorted(rh, ids)
+            counts = np.searchsorted(rh, ids, side="right") - starts
+            width = self.neighbors.shape[1]
+            take, valid = _padded_row_fill(
+                starts, np.minimum(counts, width), width)
+            wh = np.asarray(w)
+            nw = jnp.asarray(np.where(
+                valid, wh[np.minimum(take, max(self.n_edges - 1, 0))], 0.0
+            ).astype(np.float32))
+        return dataclasses.replace(self, edge_weight=w, neighbor_weight=nw)
+
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
@@ -221,6 +275,7 @@ def from_edges(
     blocked: bool = False,
     hybrid: bool = False,
     source_csr: bool = False,
+    weights=None,
 ) -> Graph:
     """Build a :class:`Graph` from host-side edge arrays.
 
@@ -245,7 +300,18 @@ def from_edges(
     if senders.size and (senders.max() >= n_nodes or receivers.max() >= n_nodes):
         raise ValueError("edge endpoint out of range")
 
-    receivers, senders = native.sort_pairs(receivers, senders)
+    if weights is not None:
+        # Per-edge costs (latency-weighted overlays): permute through the
+        # same receiver sort as the endpoints so everything stays aligned.
+        weights = np.asarray(weights, dtype=np.float32)
+        if weights.shape != senders.shape:
+            raise ValueError("weights must align with senders/receivers")
+        receivers, perm = native.sort_pairs(
+            receivers, np.arange(senders.size, dtype=np.int32))
+        senders = senders[perm]
+        weights = weights[perm]
+    else:
+        receivers, senders = native.sort_pairs(receivers, senders)
 
     n_pad = _round_up(max(n_nodes, 1), node_pad_multiple)
     e = senders.size
@@ -258,6 +324,10 @@ def from_edges(
     s[:e], r[:e] = senders, receivers
     emask = np.zeros(e_pad, dtype=bool)
     emask[:e] = True
+    w = None
+    if weights is not None:
+        w = np.zeros(e_pad, dtype=np.float32)
+        w[:e] = weights
     nmask = np.zeros(n_pad, dtype=bool)
     nmask[:n_nodes] = True
 
@@ -268,7 +338,7 @@ def from_edges(
     # window only needs to span the widest LIVE run.
     max_in_span = max(int(in_deg.max()) if e else 0, 1)
 
-    neighbors = neighbor_mask = None
+    neighbors = neighbor_mask = neighbor_weight = None
     neighbors_complete = True
     if build_neighbor_table:
         width = int(in_deg.max()) if e else 0
@@ -306,8 +376,13 @@ def from_edges(
         # A dummy pool entry keeps the (eagerly evaluated) gather in-bounds
         # for zero-edge graphs; `valid` masks it out.
         pool = senders if e else np.zeros(1, dtype=np.int32)
-        neighbors = np.where(valid, pool[np.minimum(take, max(e - 1, 0))], 0).astype(np.int32)
+        take_safe = np.minimum(take, max(e - 1, 0))
+        neighbors = np.where(valid, pool[take_safe], 0).astype(np.int32)
         neighbor_mask = valid
+        if weights is not None:
+            wpool = weights if e else np.zeros(1, dtype=np.float32)
+            neighbor_weight = np.where(valid, wpool[take_safe], 0.0).astype(
+                np.float32)
 
     blocked_rep = hybrid_rep = None
     if blocked:
@@ -346,6 +421,9 @@ def from_edges(
         src_eid=src_eid,
         src_offsets=src_offsets,
         max_out_span=max_out_span,
+        edge_weight=None if w is None else jnp.asarray(w),
+        neighbor_weight=(None if neighbor_weight is None
+                         else jnp.asarray(neighbor_weight)),
     )
 
 

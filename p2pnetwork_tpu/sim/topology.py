@@ -296,6 +296,9 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
     emask = np.asarray(graph.edge_mask)
     senders = np.asarray(graph.senders)[emask]
     receivers = np.asarray(graph.receivers)[emask]
+    weights = None
+    if graph.edge_weight is not None:
+        weights = np.asarray(graph.edge_weight)[emask]
     if graph.dyn_mask is not None:
         dm = np.asarray(graph.dyn_mask)
         senders = np.concatenate(
@@ -304,6 +307,16 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
         receivers = np.concatenate(
             [receivers, np.asarray(graph.dyn_receivers)[dm]]
         )
+        if weights is not None:
+            # Runtime links propagated at unit cost; consolidation bakes
+            # that in as their static weight (ops/segment.py
+            # DYNAMIC_LINK_COST).
+            from p2pnetwork_tpu.ops.segment import DYNAMIC_LINK_COST
+
+            weights = np.concatenate([
+                weights,
+                np.full(int(dm.sum()), DYNAMIC_LINK_COST, dtype=np.float32),
+            ])
     alive = np.asarray(graph.node_mask)
     # The rebuilt id space must cover joined spare nodes (ids >=
     # n_nodes) and every edge endpoint.
@@ -341,6 +354,8 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
     defer_layouts = bool(extra_nodes)
     if not defer_layouts:
         from_edges_kwargs.update(layout_kw)
+    if weights is not None:
+        from_edges_kwargs.setdefault("weights", weights)
     g2 = from_edges(senders, receivers, n_eff, **from_edges_kwargs)
     # from_edges marks [0, n_eff) all-alive; re-apply the real liveness
     # (failed nodes stay failed; ids beyond the old padding stay dead).

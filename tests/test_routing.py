@@ -1,0 +1,174 @@
+"""DistanceVector (weighted Bellman-Ford + next hops) vs numpy oracles,
+and the edge-weight plumbing (from_edges / with_weights / consolidate)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import DistanceVector, HopDistance  # noqa: E402
+from p2pnetwork_tpu.ops import propagate_min_plus  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _live_weighted_edges(g):
+    s, r = np.asarray(g.senders), np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    w = (np.asarray(g.edge_weight) if g.edge_weight is not None
+         else np.ones(s.shape, np.float32))
+    out = [(s[em], r[em], w[em])]
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        out.append((np.asarray(g.dyn_senders)[dm],
+                    np.asarray(g.dyn_receivers)[dm],
+                    np.ones(int(dm.sum()), np.float32)))
+    return out
+
+
+def _oracle_sssp(g, source):
+    """Bellman-Ford fixpoint over the live weighted edges (numpy)."""
+    n_pad = g.n_nodes_padded
+    alive = np.asarray(g.node_mask)
+    dist = np.full(n_pad, np.inf, dtype=np.float32)
+    if alive[source]:
+        dist[source] = 0.0
+    for _ in range(n_pad):
+        before = dist.copy()
+        for s, r, w in _live_weighted_edges(g):
+            cand = dist[s] + w
+            np.minimum.at(dist, r, cand.astype(np.float32))
+        dist[~alive] = np.inf
+        if (dist == before).all():
+            break
+    return dist
+
+
+def _converge(g, source=0, method="auto"):
+    p = DistanceVector(source=source, method=method)
+    st, out = engine.run_until_converged(
+        g, p, jax.random.key(0), stat="changed", threshold=1, max_rounds=1024)
+    return p, st, out
+
+
+def _ws_weighted(n=96, seed=7, **kw):
+    g = G.watts_strogatz(n, 4, 0.2, seed=seed, **kw)
+    # Deterministic pseudo-random positive costs from the edge endpoints.
+    return g.with_weights(
+        lambda s, r: 0.25 + ((s * 7919 + r * 104729) % 97) / 50.0)
+
+
+class TestDistanceVector:
+    def test_unweighted_equals_hopdistance(self):
+        g = G.watts_strogatz(128, 4, 0.2, seed=1)
+        _, st, _ = _converge(g)
+        hst, _ = engine.run_until_coverage(
+            g, HopDistance(source=0), jax.random.key(0),
+            coverage_target=1.0, max_rounds=256)
+        hops = np.asarray(hst.dist).astype(np.float32)
+        want = np.where(hops < 0, np.inf, hops)
+        np.testing.assert_array_equal(np.asarray(st.dist), want)
+
+    @pytest.mark.parametrize("method", ["segment", "gather"])
+    def test_weighted_matches_oracle(self, method):
+        g = _ws_weighted()
+        _, st, _ = _converge(g, method=method)
+        np.testing.assert_allclose(np.asarray(st.dist), _oracle_sssp(g, 0),
+                                   rtol=1e-6)
+
+    def test_parents_are_optimal_and_deterministic(self):
+        g = _ws_weighted(seed=8)
+        _, st, _ = _converge(g)
+        dist = np.asarray(st.dist)
+        parent = np.asarray(st.parent)
+        wmap = {}
+        for s, r, w in _live_weighted_edges(g):
+            for a, b, c in zip(s, r, w):
+                wmap.setdefault(int(b), []).append((int(a), float(c)))
+        for v in range(g.n_nodes):
+            if v == 0 or not np.isfinite(dist[v]):
+                assert parent[v] == -1
+                continue
+            best = min(dist[a] + c for a, c in wmap[v])
+            assert dist[v] == pytest.approx(best, rel=1e-6)
+            achievers = [a for a, c in wmap[v]
+                         if np.float32(dist[a] + np.float32(c)) == dist[v]]
+            assert parent[v] == min(achievers)  # lowest-id tie break
+
+    def test_failures_reroute(self):
+        g = _ws_weighted(seed=9)
+        gf = failures.fail_nodes(g, [3, 40, 77])
+        _, st, _ = _converge(gf)
+        np.testing.assert_allclose(np.asarray(st.dist), _oracle_sssp(gf, 0),
+                                   rtol=1e-6)
+
+    def test_dynamic_link_shortens_routes(self):
+        # A long path graph; a runtime shortcut from 0 to the far end.
+        n = 64
+        base = np.arange(n - 1, dtype=np.int32)
+        g = G.from_edges(*G._undirect(base, base + 1), n)
+        g = topology.with_capacity(g, extra_edges=4)
+        g2 = topology.connect(g, [0], [n - 1])
+        _, st, _ = _converge(g2)
+        np.testing.assert_allclose(np.asarray(st.dist), _oracle_sssp(g2, 0),
+                                   rtol=1e-6)
+        assert float(st.dist[n - 1]) == 1.0  # the unit-cost dynamic hop
+
+    def test_dead_source_reaches_nothing(self):
+        g = failures.fail_nodes(G.ring(16), [5])
+        _, st, out = _converge(g, source=5)
+        assert not np.isfinite(np.asarray(st.dist)).any()
+        assert int(out["rounds"]) <= 1
+
+    def test_auto_sharded_matches_engine(self):
+        from p2pnetwork_tpu.parallel import auto, mesh as M
+
+        g = _ws_weighted(n=512, seed=10)
+        gs = auto.shard_graph_auto(g, M.ring_mesh(8))
+        p = DistanceVector(source=0, method="segment")
+        st, _ = auto.run_auto(gs, p, jax.random.key(0), 6)
+        ref, _ = engine.run(g, p, jax.random.key(0), 6)
+        np.testing.assert_allclose(np.asarray(st.dist), np.asarray(ref.dist),
+                                   rtol=1e-6)
+
+
+class TestWeightPlumbing:
+    def test_from_edges_weights_survive_sort(self):
+        s = np.array([2, 0, 1], dtype=np.int32)
+        r = np.array([0, 1, 2], dtype=np.int32)
+        w = np.array([5.0, 7.0, 9.0], dtype=np.float32)
+        g = G.from_edges(s, r, 3, weights=w)
+        hs = np.asarray(g.senders)[np.asarray(g.edge_mask)]
+        hw = np.asarray(g.edge_weight)[np.asarray(g.edge_mask)]
+        want = {2: 5.0, 0: 7.0, 1: 9.0}
+        assert {int(a): float(b) for a, b in zip(hs, hw)} == want
+
+    def test_neighbor_weight_aligned(self):
+        g = _ws_weighted(n=64, seed=3)
+        # Gather and segment lowerings agree => the [N, d] view is aligned.
+        dist = jnp.where(jnp.arange(g.n_nodes_padded) == 0, 0.0, jnp.inf)
+        a = propagate_min_plus(g, dist, "segment")
+        b = propagate_min_plus(g, dist, "gather")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_with_weights_needs_alignment(self):
+        g = G.ring(8)
+        with pytest.raises(ValueError, match="align"):
+            g.with_weights(np.ones(3, np.float32))
+
+    def test_capped_table_rejected_post_hoc(self):
+        g = G.watts_strogatz(64, 6, 0.1, seed=0, max_degree=2)
+        with pytest.raises(ValueError, match="width-capped"):
+            g.with_weights(lambda s, r: s + r + 1.0)
+
+    def test_consolidate_preserves_weights_and_routes(self):
+        g = _ws_weighted(seed=11)
+        g = topology.with_capacity(g, extra_edges=8)
+        g2 = topology.connect(g, [0, 7], [33, 61])
+        _, st_before, _ = _converge(g2)
+        g3 = topology.consolidate(g2)
+        _, st_after, _ = _converge(g3)
+        n = g2.n_nodes
+        np.testing.assert_allclose(np.asarray(st_before.dist)[:n],
+                                   np.asarray(st_after.dist)[:n], rtol=1e-6)
