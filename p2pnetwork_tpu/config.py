@@ -56,7 +56,7 @@ class NodeConfig:
 class TopologyConfig:
     """Which random graph to build (see sim/graph.py generators)."""
 
-    kind: str = "watts_strogatz"  # erdos_renyi | barabasi_albert | watts_strogatz | ring | complete
+    kind: str = "watts_strogatz"  # erdos_renyi | barabasi_albert | watts_strogatz | ring | chord | complete
     n_nodes: int = 1024
     #: erdos_renyi: edge probability; watts_strogatz: rewire probability.
     p: float = 0.01

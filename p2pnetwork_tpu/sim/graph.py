@@ -568,6 +568,25 @@ def ring(n: int, **kw) -> Graph:
     return from_edges(*_undirect(base, (base + 1) % n), n, **kw)
 
 
+def chord(n: int, **kw) -> Graph:
+    """Chord-style structured overlay: the identifier ring plus a finger
+    to ``(v + 2^i) mod n`` for every ``i`` with ``2^i < n`` — the DHT
+    topology (successor lists + finger tables) that P2P deployments build
+    on top of unstructured libraries like the reference. O(log n) degree,
+    O(log n) diameter: greedy/BFS routing here is the batched form of a
+    Chord lookup. Edges are undirected (the reference's TCP-connection
+    semantic: traffic flows both ways)."""
+    base = np.arange(n, dtype=np.int64)
+    srcs, dsts = [], []
+    i = 0
+    while (1 << i) < n:
+        srcs.append(base)
+        dsts.append((base + (1 << i)) % n)
+        i += 1
+    lo, hi = _dedup_undirected(np.concatenate(srcs), np.concatenate(dsts), n)
+    return from_edges(*_undirect(lo, hi), n, **kw)
+
+
 def complete(n: int, **kw) -> Graph:
     """Complete graph (every pair connected) — small n only."""
     src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
@@ -586,6 +605,8 @@ def build(topology) -> Graph:
         return watts_strogatz(topology.n_nodes, topology.k, topology.p, topology.seed)
     if kind == "ring":
         return ring(topology.n_nodes)
+    if kind == "chord":
+        return chord(topology.n_nodes)
     if kind == "complete":
         return complete(topology.n_nodes)
     raise ValueError(f"unknown topology kind: {kind!r}")

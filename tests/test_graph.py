@@ -210,3 +210,30 @@ class TestPaddingSortedness:
         r = np.asarray(g.receivers)[emask]
         keys = s.astype(np.int64) * 500 + r
         assert np.unique(keys).size == keys.size
+
+
+class TestChord:
+    def test_degree_and_diameter_are_logarithmic(self):
+        from p2pnetwork_tpu.models import eccentricities
+        n = 256
+        g = G.chord(n)
+        deg = np.asarray(g.in_degree)[:n]
+        # Ring + fingers 2^1..2^7, both directions, dedup'd: ~2*log2(n).
+        assert deg.max() <= 2 * n.bit_length()
+        ecc, reached = eccentricities(g, np.array([0, 17, 255]))
+        assert (np.asarray(reached) == n).all()
+        assert int(np.asarray(ecc).max()) <= n.bit_length() - 1
+
+    def test_non_power_of_two(self):
+        g = G.chord(100)
+        s = np.asarray(g.senders)[np.asarray(g.edge_mask)]
+        r = np.asarray(g.receivers)[np.asarray(g.edge_mask)]
+        assert ((s >= 0) & (s < 100) & (r >= 0) & (r < 100)).all()
+        # Symmetric edge set (undirected).
+        fwd = set(zip(s.tolist(), r.tolist()))
+        assert all((b, a) in fwd for a, b in fwd)
+
+    def test_config_build(self):
+        from p2pnetwork_tpu.config import TopologyConfig
+        g = G.build(TopologyConfig(kind="chord", n_nodes=64))
+        assert g.n_nodes == 64
