@@ -14,6 +14,10 @@ from p2pnetwork_tpu.models.adaptive_flood import (
 from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.bipartite import BipartiteCheck, BipartiteCheckState
 from p2pnetwork_tpu.models.coloring import color_via_mis
+from p2pnetwork_tpu.models.detector import (
+    FailureDetector,
+    FailureDetectorState,
+)
 from p2pnetwork_tpu.models.components import (
     ConnectedComponents,
     ConnectedComponentsState,
@@ -63,6 +67,8 @@ __all__ = [
     "ConnectedComponentsState",
     "DistanceVector",
     "DistanceVectorState",
+    "FailureDetector",
+    "FailureDetectorState",
     "Flood",
     "FloodState",
     "Gossip",

@@ -1,0 +1,123 @@
+"""Probabilistic failure detection: randomized ping/ack with suspicion.
+
+The reference detects dead peers passively — a recv timeout or EOF fires
+``node_disconnected`` and the socket is dropped [ref:
+p2pnetwork/nodeconnection.py:196-236, node.py events]. Real deployments
+layer an ACTIVE detector on top (SWIM-style: ping a random member each
+tick, suspect on silence, confirm after repeated misses) because a TCP
+session can sit half-open for minutes. Batched TPU form: every
+responsive node pings one uniformly drawn neighbor-table slot per round
+(the same k-th-set-bit draw as Gossip); a ping answered resets that
+slot's suspicion, silence increments it, and ``threshold`` consecutive
+misses latch the slot as declared-dead. Message loss (``loss_prob``
+per direction, independently) makes the detector properly
+probabilistic: false suspicions happen and the threshold is the
+precision/latency dial — exactly the SWIM trade-off, now measurable
+over a whole population in one compiled loop.
+
+Run against :func:`p2pnetwork_tpu.sim.failures.mark_unresponsive` (NOT
+``fail_nodes``): the detector's whole premise is that survivors still
+hold the silent peer in their tables and must discover the silence —
+``fail_nodes`` would re-mask the table and hide the corpse from the
+pinger. Converge with ``engine.run_until_converged(...,
+stat="undetected", threshold=1)``: at that point every dead watched
+slot is declared.
+
+State is ``[N_pad, max_degree]`` — per (watcher, watched-slot) — so
+memory matches the neighbor table the watchers already hold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FailureDetectorState:
+    suspicion: jax.Array  # i32[N_pad, d] — consecutive unanswered pings
+    declared: jax.Array  # bool[N_pad, d] — latched declarations
+    round: jax.Array  # i32[]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class FailureDetector:
+    """SWIM-style randomized ping/ack over the neighbor table."""
+
+    #: Consecutive misses before a slot is declared dead.
+    threshold: int = 3
+    #: Per-direction message-loss probability (ping and ack drawn
+    #: independently) — 0 makes the detector exact.
+    loss_prob: float = 0.0
+
+    def init(self, graph: Graph, key: jax.Array) -> FailureDetectorState:
+        if graph.neighbors is None:
+            raise ValueError(
+                "FailureDetector requires a graph with a neighbor table")
+        shape = graph.neighbors.shape
+        return FailureDetectorState(
+            suspicion=jnp.zeros(shape, dtype=jnp.int32),
+            declared=jnp.zeros(shape, dtype=bool),
+            round=jnp.int32(0),
+        )
+
+    def _dead_watched(self, graph: Graph) -> jax.Array:
+        """bool[N_pad, d]: watched slots whose target is unresponsive,
+        seen from a responsive watcher (the detector's ground truth)."""
+        return (graph.neighbor_mask
+                & ~graph.node_mask[graph.neighbors]
+                & graph.node_mask[:, None])
+
+    def step(self, graph: Graph, state: FailureDetectorState, key: jax.Array):
+        n_pad = graph.n_nodes_padded
+        mask = graph.neighbor_mask
+        count = jnp.sum(mask, axis=1)
+        k1, k2, k3 = jax.random.split(key, 3)
+        # Uniform slot among the watched (valid) table slots — Gossip's
+        # k-th-set-bit draw, over the build-time rows mark_unresponsive
+        # deliberately leaves intact.
+        u = jax.random.randint(k1, (n_pad,), 0, jnp.int32(2**31 - 1))
+        k = u % jnp.maximum(count, 1)
+        csum = jnp.cumsum(mask, axis=1)
+        slot = jnp.argmax((csum == (k + 1)[:, None]) & mask, axis=1)
+        target = jnp.take_along_axis(graph.neighbors, slot[:, None],
+                                     axis=1)[:, 0]
+        pinger = (count > 0) & graph.node_mask
+        responsive = graph.node_mask[target]
+        ping_ok = jax.random.uniform(k2, (n_pad,)) >= self.loss_prob
+        ack_ok = jax.random.uniform(k3, (n_pad,)) >= self.loss_prob
+        acked = responsive & ping_ok & ack_ok
+
+        probed = ((jnp.arange(mask.shape[1])[None, :] == slot[:, None])
+                  & mask & pinger[:, None])
+        suspicion = jnp.where(
+            probed,
+            jnp.where(acked[:, None], 0, state.suspicion + 1),
+            state.suspicion,
+        )
+        declared = state.declared | (suspicion >= self.threshold)
+
+        dead = self._dead_watched(graph)
+        n_dead = jnp.sum(dead)
+        detected = jnp.sum(declared & dead)
+        false_pos = jnp.sum(declared & mask & ~dead
+                            & graph.node_mask[:, None])
+        stats = {
+            # One ping per prober + one ack per delivered ping to a
+            # responsive target — the reference's send/recv counters.
+            "messages": (jnp.sum(pinger)
+                         + jnp.sum(pinger & responsive & ping_ok)),
+            "undetected": n_dead - detected,
+            "detected": detected,
+            "dead_slots": n_dead,
+            "false_positives": false_pos,
+        }
+        new_state = FailureDetectorState(suspicion=suspicion,
+                                         declared=declared,
+                                         round=state.round + 1)
+        return new_state, stats

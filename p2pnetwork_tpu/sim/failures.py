@@ -141,6 +141,21 @@ def fail_nodes(graph: Graph, node_ids) -> Graph:
     return with_node_liveness(graph, alive)
 
 
+def mark_unresponsive(graph: Graph, node_ids) -> Graph:
+    """Flip ``node_mask`` for the given ids WITHOUT re-masking edges,
+    degrees, or the neighbor table — the crashed-but-still-configured
+    view a failure DETECTOR needs: survivors still hold the dead peer in
+    their tables (the reference keeps the socket in ``nodes_inbound``
+    until a timeout fires [ref: nodeconnection.py]) and must discover the
+    silence by probing. For every other protocol use :func:`fail_nodes`,
+    which models the loss consistently (a mark-only graph still counts
+    the dead peer's table slots as live links)."""
+    _check_ids_in_range(node_ids, graph.n_nodes_padded, "node")
+    ids = jnp.asarray(node_ids, dtype=jnp.int32)
+    node_mask = graph.node_mask.at[ids].set(False)
+    return dataclasses.replace(graph, node_mask=node_mask)
+
+
 def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
     """Apply a per-edge liveness mask (bool[E_pad]; False = cut link).
 

@@ -1,0 +1,78 @@
+"""FailureDetector (SWIM-style ping/ack) against ground-truth liveness."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import FailureDetector  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _detect(g, threshold=3, loss=0.0, max_rounds=512, key=0):
+    p = FailureDetector(threshold=threshold, loss_prob=loss)
+    st, out = engine.run_until_converged(
+        g, p, jax.random.key(key), stat="undetected", threshold=1,
+        max_rounds=max_rounds)
+    return p, st, out
+
+
+class TestMarkUnresponsive:
+    def test_tables_and_edges_stay_intact(self):
+        g = G.watts_strogatz(64, 4, 0.1, seed=0)
+        gm = failures.mark_unresponsive(g, [5, 9])
+        np.testing.assert_array_equal(np.asarray(gm.neighbor_mask),
+                                      np.asarray(g.neighbor_mask))
+        np.testing.assert_array_equal(np.asarray(gm.edge_mask),
+                                      np.asarray(g.edge_mask))
+        np.testing.assert_array_equal(np.asarray(gm.in_degree),
+                                      np.asarray(g.in_degree))
+        alive = np.asarray(gm.node_mask)
+        assert not alive[5] and not alive[9] and alive[:64].sum() == 62
+
+
+class TestFailureDetector:
+    def test_lossless_detects_all_with_no_false_positives(self):
+        g = failures.mark_unresponsive(
+            G.watts_strogatz(128, 4, 0.1, seed=1), [7, 40, 99])
+        p, st, out = _detect(g, threshold=3)
+        assert int(out["value"]) == 0  # undetected at quiescence
+        # Every declaration is real: no responsive target ever declared.
+        declared = np.asarray(st.declared)
+        dead = np.asarray(p._dead_watched(g))
+        assert not (declared & ~dead).any()
+        # Latching needs at least `threshold` probes of the slot.
+        assert int(out["rounds"]) >= 3
+
+    def test_nothing_to_detect_quiesces_immediately(self):
+        g = G.ring(32)
+        _, st, out = _detect(g)
+        assert int(out["rounds"]) <= 1
+        assert not np.asarray(st.declared).any()
+
+    def test_lossy_channel_still_converges(self):
+        g = failures.mark_unresponsive(G.ring(64), [10, 30])
+        p, st, out = _detect(g, threshold=4, loss=0.3, max_rounds=2048,
+                             key=2)
+        assert int(out["value"]) == 0
+        stats_fp = int(np.asarray(
+            p.step(g, st, jax.random.key(3))[1]["false_positives"]))
+        # False positives are possible but bounded by the latched count.
+        assert stats_fp <= int(np.asarray(st.declared).sum())
+
+    def test_threshold_is_a_precision_dial(self):
+        # Same lossy channel: a higher threshold declares fewer live slots.
+        g = failures.mark_unresponsive(G.ring(128), [5])
+        fps = []
+        for thr in (1, 6):
+            p, st, _ = _detect(g, threshold=thr, loss=0.4, max_rounds=256,
+                               key=4)
+            dead = np.asarray(p._dead_watched(g))
+            fps.append(int((np.asarray(st.declared) & ~dead).sum()))
+        assert fps[1] <= fps[0]
+
+    def test_requires_neighbor_table(self):
+        g = G.ring(16, build_neighbor_table=False)
+        with pytest.raises(ValueError, match="neighbor table"):
+            FailureDetector().init(g, jax.random.key(0))
