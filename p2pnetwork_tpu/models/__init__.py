@@ -11,6 +11,7 @@ from p2pnetwork_tpu.models.adaptive_flood import (
     AdaptiveHopDistance,
     AdaptiveHopDistanceState,
 )
+from p2pnetwork_tpu.models.antientropy import AntiEntropy, AntiEntropyState
 from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.bipartite import BipartiteCheck, BipartiteCheckState
 from p2pnetwork_tpu.models.coloring import color_via_mis
@@ -59,6 +60,8 @@ __all__ = [
     "triangles_per_node",
     "AdaptiveFlood",
     "AdaptiveFloodState",
+    "AntiEntropy",
+    "AntiEntropyState",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",
     "BipartiteCheck",

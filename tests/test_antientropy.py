@@ -1,0 +1,77 @@
+"""AntiEntropy push-pull reconciliation: monotonicity, locality, and
+full replication."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import AntiEntropy, AntiEntropyState  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _neighbors_of(g, v):
+    s, r = np.asarray(g.senders), np.asarray(g.receivers)
+    em = np.asarray(g.edge_mask)
+    return set(s[em & (r == v)]) | set(r[em & (s == v)])
+
+
+class TestAntiEntropy:
+    @pytest.mark.parametrize("push,pull", [(True, True), (True, False),
+                                           (False, True)])
+    def test_full_replication_on_connected_graph(self, push, pull):
+        g = G.watts_strogatz(128, 4, 0.1, seed=0)
+        p = AntiEntropy(n_items=16, push=push, pull=pull)
+        st, out = engine.run_until_converged(
+            g, p, jax.random.key(1), stat="missing", threshold=1,
+            max_rounds=2048)
+        assert int(out["value"]) == 0
+        have = np.asarray(st.have)
+        assert have[:128].all()
+
+    def test_possession_is_monotone_and_local(self):
+        g = G.watts_strogatz(64, 4, 0.2, seed=2)
+        p = AntiEntropy(n_items=8)
+        # Craft: item 0 held only by node 5.
+        have = jnp.zeros((g.n_nodes_padded, 8), dtype=bool).at[5, 0].set(True)
+        st = AntiEntropyState(have=have, round=jnp.int32(0))
+        st2, _ = p.step(g, st, jax.random.key(3))
+        before = np.asarray(st.have)
+        after = np.asarray(st2.have)
+        assert (after | before == after).all()  # monotone
+        gained = np.flatnonzero(after[:, 0] & ~before[:, 0])
+        allowed = _neighbors_of(g, 5)
+        assert set(gained) <= allowed  # one hop per round, edges only
+
+    def test_dead_nodes_neither_give_nor_take(self):
+        g = failures.fail_nodes(G.ring(16), [4])
+        p = AntiEntropy(n_items=4)
+        st = p.init(g, jax.random.key(4))
+        # The surviving graph is a 15-node path — epidemic spread there
+        # is O(n) rounds with real variance, so give it slack.
+        for i in range(160):
+            st, out = p.step(g, st, jax.random.key(100 + i))
+        have = np.asarray(st.have)
+        assert not have[4].any()
+        alive = np.asarray(g.node_mask)
+        assert have[alive].all()  # the ring minus one node stays connected
+
+    def test_requires_neighbor_table(self):
+        g = G.ring(16, build_neighbor_table=False)
+        with pytest.raises(ValueError, match="neighbor table"):
+            AntiEntropy().init(g, jax.random.key(0))
+
+    def test_push_pull_beats_pull_only(self):
+        g = G.watts_strogatz(256, 4, 0.1, seed=5)
+        rounds = {}
+        for name, (push, pull) in {"both": (True, True),
+                                   "pull": (False, True)}.items():
+            p = AntiEntropy(n_items=32, push=push, pull=pull)
+            _, out = engine.run_until_converged(
+                g, p, jax.random.key(6), stat="missing", threshold=1,
+                max_rounds=4096)
+            assert int(out["value"]) == 0
+            rounds[name] = int(out["rounds"])
+        assert rounds["both"] <= rounds["pull"]
