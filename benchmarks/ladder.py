@@ -271,6 +271,51 @@ def bench_discovery(n=1_000_000, walkers=4096):
     })
 
 
+def bench_routing(n=1_000_000):
+    """Weighted routing rung: latency-weighted distance-vector tables
+    for the whole overlay (models/routing.py DistanceVector — one
+    propagate_min_plus per round, run-to-quiescence device-side), the
+    RIP-style protocol reference users hand-roll on node_message."""
+    import jax
+    import numpy as np
+
+    from p2pnetwork_tpu.models import DistanceVector
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    t0 = time.perf_counter()
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, build_neighbor_table=False)
+
+    def latency(s, r):
+        h = (s.astype(np.uint32) * np.uint32(2654435761)
+             + r.astype(np.uint32))
+        return 1.0 + (h % 2048).astype(np.float32) / 1024.0
+
+    g = g.with_weights(latency)
+    build_s = time.perf_counter() - t0
+
+    def once():
+        _, out = engine.run_until_converged(
+            g, DistanceVector(source=0, method="segment"),
+            jax.random.key(0), stat="changed", threshold=1, max_rounds=256,
+        )
+        return out
+
+    out = once()  # warm
+    t0 = time.perf_counter()
+    out = once()
+    secs = time.perf_counter() - t0
+    emit({
+        "config": f"{n:,}-node WS weighted distance-vector routing "
+                  f"(single chip)",
+        "value": round(secs, 3),
+        "unit": "s to converged cost + next-hop tables",
+        "rounds": int(out["rounds"]),
+        "messages": int(out["messages"]),
+        "graph_build_s": round(build_s, 1),
+    })
+
+
 def bench_flood_auto():
     """GSPMD auto path (parallel/auto.py) on every available device, both
     lowerings: the segment-method flood (the idiom's historical floor,
@@ -444,6 +489,7 @@ def main():
     bench_flood_auto()
     bench_flood_ba()
     bench_discovery()
+    bench_routing()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
     if args.full:
         bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)",
