@@ -23,7 +23,7 @@ from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.securenode import SecureNode
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "Node",
