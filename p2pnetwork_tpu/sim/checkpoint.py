@@ -315,3 +315,129 @@ def load_orbax(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
         int(restored["round_index"]),
         int(restored["message_count"]),
     )
+
+
+# ------------------------------------------------------ graph persistence
+
+#: Array-valued Graph fields serialized by save_graph (optionals skipped
+#: when None); static ints/bools/tuples travel in the JSON meta record.
+_GRAPH_ARRAYS = (
+    "senders", "receivers", "edge_mask", "node_mask", "in_degree",
+    "out_degree", "neighbors", "neighbor_mask", "dyn_senders",
+    "dyn_receivers", "dyn_mask", "src_eid", "src_offsets", "edge_weight",
+    "neighbor_weight",
+)
+
+
+def save_graph(path: str, graph) -> None:
+    """Atomically persist a built :class:`~p2pnetwork_tpu.sim.graph.Graph`
+    — including kernel layouts (blocked/hybrid/source-CSR), weights, the
+    dynamic region, and any liveness re-masking — as one ``.npz``.
+
+    The complement of the state checkpoints above: graph CONSTRUCTION is
+    the host-side cost at scale (tens of seconds for the 100M-edge build,
+    BENCH.md), so a pipeline that reuses a topology should pay it once.
+    No pickle: arrays plus a JSON record of the static fields.
+    """
+    import json
+
+    payload: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {
+        "version": 1,
+        "n_nodes": graph.n_nodes,
+        "n_edges": graph.n_edges,
+        "neighbors_complete": graph.neighbors_complete,
+        "max_in_span": graph.max_in_span,
+        "max_out_span": graph.max_out_span,
+    }
+    for name in _GRAPH_ARRAYS:
+        v = getattr(graph, name)
+        if v is not None:
+            payload[name] = np.asarray(jax.device_get(v))
+    if graph.blocked is not None:
+        meta["blocked_block"] = graph.blocked.block
+        payload["blocked_src"] = np.asarray(jax.device_get(graph.blocked.src))
+        payload["blocked_local_dst"] = np.asarray(
+            jax.device_get(graph.blocked.local_dst))
+        payload["blocked_mask"] = np.asarray(
+            jax.device_get(graph.blocked.mask))
+    if graph.hybrid is not None:
+        meta["hybrid_offsets"] = list(graph.hybrid.offsets)
+        meta["hybrid_n"] = graph.hybrid.n
+        payload["hybrid_masks"] = np.asarray(
+            jax.device_get(graph.hybrid.masks))
+        rem = graph.hybrid.remainder
+        if rem is not None:
+            meta["hybrid_rem_block"] = rem.block
+            payload["hybrid_rem_src"] = np.asarray(jax.device_get(rem.src))
+            payload["hybrid_rem_local_dst"] = np.asarray(
+                jax.device_get(rem.local_dst))
+            payload["hybrid_rem_mask"] = np.asarray(jax.device_get(rem.mask))
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_graph(path: str):
+    """Load a graph written by :func:`save_graph` (arrays land on the
+    default device lazily, via the first jitted use)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from p2pnetwork_tpu.ops.blocked import BlockedEdges
+    from p2pnetwork_tpu.ops.diag import HybridEdges
+    from p2pnetwork_tpu.sim.graph import Graph
+
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        if meta.get("version") != 1:
+            raise ValueError(f"unknown graph file version: {meta.get('version')}")
+        fields: Dict[str, Any] = {
+            name: jnp.asarray(data[name])
+            for name in _GRAPH_ARRAYS if name in data.files
+        }
+        blocked = None
+        if "blocked_src" in data.files:
+            blocked = BlockedEdges(
+                src=jnp.asarray(data["blocked_src"]),
+                local_dst=jnp.asarray(data["blocked_local_dst"]),
+                mask=jnp.asarray(data["blocked_mask"]),
+                block=int(meta["blocked_block"]),
+            )
+        hybrid = None
+        if "hybrid_masks" in data.files:
+            rem = None
+            if "hybrid_rem_src" in data.files:
+                rem = BlockedEdges(
+                    src=jnp.asarray(data["hybrid_rem_src"]),
+                    local_dst=jnp.asarray(data["hybrid_rem_local_dst"]),
+                    mask=jnp.asarray(data["hybrid_rem_mask"]),
+                    block=int(meta["hybrid_rem_block"]),
+                )
+            hybrid = HybridEdges(
+                masks=jnp.asarray(data["hybrid_masks"]),
+                remainder=rem,
+                offsets=tuple(meta["hybrid_offsets"]),
+                n=int(meta["hybrid_n"]),
+            )
+        return Graph(
+            n_nodes=int(meta["n_nodes"]),
+            n_edges=int(meta["n_edges"]),
+            neighbors_complete=bool(meta["neighbors_complete"]),
+            max_in_span=int(meta["max_in_span"]),
+            max_out_span=int(meta["max_out_span"]),
+            blocked=blocked,
+            hybrid=hybrid,
+            **fields,
+        )

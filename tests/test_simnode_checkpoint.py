@@ -7,6 +7,7 @@ jax = pytest.importorskip("jax")
 
 from p2pnetwork_tpu.models import SIR, Flood  # noqa: E402
 from p2pnetwork_tpu.sim import checkpoint as ckpt  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
 from p2pnetwork_tpu.sim import graph as G  # noqa: E402
 from p2pnetwork_tpu.sim.simnode import JaxSimNode  # noqa: E402
 from tests.helpers import EventRecorder, stop_all, wait_until  # noqa: E402
@@ -394,3 +395,57 @@ class TestCheckpoint:
         # The message counter is part of the checkpoint: both nodes report
         # the same cumulative total after the same 10 rounds.
         assert a.sim_message_count == b.sim_message_count
+
+
+class TestGraphPersistence:
+    def _roundtrip(self, g, tmp_path):
+        from p2pnetwork_tpu.sim import checkpoint as ckpt
+        p = str(tmp_path / "graph.npz")
+        ckpt.save_graph(p, g)
+        return ckpt.load_graph(p)
+
+    def test_full_layout_roundtrip(self, tmp_path):
+        g = G.watts_strogatz(512, 6, 0.2, seed=0, blocked=True, hybrid=True,
+                             source_csr=True)
+        g = g.with_weights(lambda s, r: 1.0 + (s % 7).astype(np.float32))
+        g2 = self._roundtrip(g, tmp_path)
+        assert (g2.n_nodes, g2.n_edges) == (g.n_nodes, g.n_edges)
+        assert g2.max_in_span == g.max_in_span
+        assert g2.max_out_span == g.max_out_span
+        for name in ("senders", "receivers", "edge_mask", "node_mask",
+                     "in_degree", "out_degree", "neighbors", "neighbor_mask",
+                     "src_eid", "src_offsets", "edge_weight",
+                     "neighbor_weight"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(g2, name)), np.asarray(getattr(g, name)),
+                err_msg=name)
+        assert g2.blocked.block == g.blocked.block
+        np.testing.assert_array_equal(np.asarray(g2.blocked.src),
+                                      np.asarray(g.blocked.src))
+        assert g2.hybrid.offsets == g.hybrid.offsets
+        np.testing.assert_array_equal(np.asarray(g2.hybrid.masks),
+                                      np.asarray(g.hybrid.masks))
+
+    def test_flood_parity_after_reload(self, tmp_path):
+        from p2pnetwork_tpu.models import Flood
+        g = G.watts_strogatz(256, 4, 0.2, seed=1, hybrid=True)
+        g2 = self._roundtrip(g, tmp_path)
+        a, out_a = engine.run_until_coverage(
+            g, Flood(source=0, method="hybrid"), jax.random.key(0))
+        b, out_b = engine.run_until_coverage(
+            g2, Flood(source=0, method="hybrid"), jax.random.key(0))
+        assert out_a == out_b
+        np.testing.assert_array_equal(np.asarray(a.seen), np.asarray(b.seen))
+
+    def test_churned_graph_roundtrips(self, tmp_path):
+        from p2pnetwork_tpu.sim import failures, topology
+        g = G.ring(64)
+        g = topology.connect(topology.with_capacity(
+            failures.fail_nodes(g, [5]), extra_edges=8), [0], [32])
+        g2 = self._roundtrip(g, tmp_path)
+        np.testing.assert_array_equal(np.asarray(g2.node_mask),
+                                      np.asarray(g.node_mask))
+        np.testing.assert_array_equal(np.asarray(g2.dyn_senders),
+                                      np.asarray(g.dyn_senders))
+        np.testing.assert_array_equal(np.asarray(g2.dyn_mask),
+                                      np.asarray(g.dyn_mask))
