@@ -64,16 +64,10 @@ class AntiEntropy:
                                 round=jnp.int32(0))
 
     def step(self, graph: Graph, state: AntiEntropyState, key: jax.Array):
-        n_pad = graph.n_nodes_padded
-        mask = graph.neighbor_mask
-        count = jnp.sum(mask, axis=1)
-        u = jax.random.randint(key, (n_pad,), 0, jnp.int32(2**31 - 1))
-        k = u % jnp.maximum(count, 1)
-        csum = jnp.cumsum(mask, axis=1)
-        slot = jnp.argmax((csum == (k + 1)[:, None]) & mask, axis=1)
-        partner = jnp.take_along_axis(graph.neighbors, slot[:, None],
-                                      axis=1)[:, 0]
-        active = (count > 0) & graph.node_mask & graph.node_mask[partner]
+        from p2pnetwork_tpu.models.base import draw_neighbor_slot
+
+        _, partner, has_slot = draw_neighbor_slot(graph, key)
+        active = has_slot & graph.node_mask & graph.node_mask[partner]
 
         have = state.have
         sendable = have & active[:, None]

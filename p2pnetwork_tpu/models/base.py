@@ -36,6 +36,39 @@ class Protocol(TypingProtocol):
     def step(self, graph: Graph, state: State, key: jax.Array) -> Tuple[State, Stats]: ...
 
 
+def draw_neighbor_slot(graph: Graph, key: jax.Array):
+    """One uniform draw per node over its VALID neighbor-table slots — the
+    k-th-set-bit sampler shared by Gossip's partner pick, the failure
+    detector's probe target, and anti-entropy's exchange partner (one
+    implementation, so a sampling fix lands on all of them).
+
+    On a healthy graph this is exactly uniform over the stored neighbors;
+    after failures it stays uniform over the LIVE ones, because
+    sim/failures.py re-masks the table (a draw over a min(in_degree,
+    width) prefix would hit dead neighbors and, after runtime connects
+    grow in_degree past the stored row, padding garbage). Runtime
+    (dynamic-region) links are not candidates until a consolidation
+    rebuild folds them into the table.
+
+    Returns ``(slot, partner, has_neighbor)``: the drawn column per row,
+    the neighbor id it holds (row 0's entry where no valid slot exists),
+    and whether the row had any valid slot — callers must gate on
+    ``has_neighbor`` (ANDed with their own liveness masks).
+    """
+    import jax.numpy as jnp
+
+    mask = graph.neighbor_mask
+    count = jnp.sum(mask, axis=1)
+    u = jax.random.randint(key, (graph.n_nodes_padded,), 0,
+                           jnp.int32(2**31 - 1))
+    k = u % jnp.maximum(count, 1)
+    csum = jnp.cumsum(mask, axis=1)
+    slot = jnp.argmax((csum == (k + 1)[:, None]) & mask, axis=1)
+    partner = jnp.take_along_axis(graph.neighbors, slot[:, None],
+                                  axis=1)[:, 0]
+    return slot, partner, count > 0
+
+
 def validate_source(graph: Graph, source: int) -> None:
     """Reject a source index outside the padded id space (the jit scatter
     would silently clamp it to the last padded index, which the node mask

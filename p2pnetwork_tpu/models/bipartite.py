@@ -21,11 +21,14 @@ same flood.
 A graph is bipartite iff no edge joins two nodes in layers of equal
 parity (BFS layers of adjacent nodes differ by at most one, so equal
 parity means equal layer — the witness of an odd cycle through their
-lowest common BFS ancestor). ``stats["odd_edges"]`` counts the directed
-edge slots violating parity — FINAL ONLY AT QUIESCENCE (run with
-``engine.run_until_converged(..., stat="changed", threshold=1)``, like
-ConnectedComponents); transient labels can briefly flag edges while the
-floods are still merging. Self-loops count as odd (a length-1 cycle), and
+lowest common BFS ancestor). Run to quiescence with
+``engine.run_until_converged(..., stat="changed", threshold=1)`` (like
+ConnectedComponents), then read the verdict from the converged state:
+``odd_edges(graph, state)`` counts the directed edge slots violating
+parity (0 = bipartite) and ``component_bipartite`` maps it per
+component — one O(E) scan each, deliberately NOT recomputed per round
+(transient labels mid-merge would flag edges spuriously anyway).
+Self-loops count as odd (a length-1 cycle), and
 each undirected edge of the symmetric builder graphs occupies two
 directed slots, so a single undirected odd edge reports as 2.
 
@@ -92,10 +95,7 @@ class BipartiteCheck:
     def odd_edges(self, graph: Graph,
                   state: BipartiteCheckState) -> jax.Array:
         """Directed edge slots violating 2-colorability (valid at
-        quiescence; 0 means the whole live graph is bipartite). The same
-        scalar ``stats["odd_edges"]`` reports per round — this method reads
-        it from a converged state, e.g. after ``run_until_converged`` whose
-        packed summary carries only the convergence stat."""
+        quiescence; 0 means the whole live graph is bipartite)."""
         return _odd_edge_slots(graph, state.label, state.dist)
 
     def component_bipartite(self, graph: Graph,
@@ -126,13 +126,14 @@ class BipartiteCheck:
             graph, state.label, state.frontier, self.method)
         rnd = state.round + 1
         dist = jnp.where(changed, rnd, state.dist)
-        odd = _odd_edge_slots(graph, label, dist)
         new_state = BipartiteCheckState(label=label, dist=dist,
                                         frontier=changed, round=rnd)
+        # No per-round parity scan: the verdict is only meaningful at
+        # quiescence, and the O(E) edge scan would double every round's
+        # edge traffic to produce transient values callers are told to
+        # ignore — read it once from the converged state via odd_edges().
         stats = {
             "messages": msgs,
             "changed": jnp.sum(changed),
-            "odd_edges": odd,
-            "bipartite": (odd == 0).astype(jnp.int32),
         }
         return new_state, stats

@@ -74,20 +74,16 @@ class FailureDetector:
                 & graph.node_mask[:, None])
 
     def step(self, graph: Graph, state: FailureDetectorState, key: jax.Array):
+        from p2pnetwork_tpu.models.base import draw_neighbor_slot
+
         n_pad = graph.n_nodes_padded
         mask = graph.neighbor_mask
-        count = jnp.sum(mask, axis=1)
         k1, k2, k3 = jax.random.split(key, 3)
-        # Uniform slot among the watched (valid) table slots — Gossip's
+        # Uniform slot among the watched (valid) table slots — the shared
         # k-th-set-bit draw, over the build-time rows mark_unresponsive
         # deliberately leaves intact.
-        u = jax.random.randint(k1, (n_pad,), 0, jnp.int32(2**31 - 1))
-        k = u % jnp.maximum(count, 1)
-        csum = jnp.cumsum(mask, axis=1)
-        slot = jnp.argmax((csum == (k + 1)[:, None]) & mask, axis=1)
-        target = jnp.take_along_axis(graph.neighbors, slot[:, None],
-                                     axis=1)[:, 0]
-        pinger = (count > 0) & graph.node_mask
+        slot, target, has_slot = draw_neighbor_slot(graph, k1)
+        pinger = has_slot & graph.node_mask
         responsive = graph.node_mask[target]
         ping_ok = jax.random.uniform(k2, (n_pad,)) >= self.loss_prob
         ack_ok = jax.random.uniform(k3, (n_pad,)) >= self.loss_prob

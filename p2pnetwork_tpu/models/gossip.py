@@ -41,24 +41,10 @@ class Gossip:
         return GossipState(values=values * graph.node_mask)
 
     def step(self, graph: Graph, state: GossipState, key: jax.Array):
-        n_pad = graph.n_nodes_padded
-        # Each node draws one partner uniformly among its VALID table slots
-        # (neighbor_mask) — the k-th set bit of its row. On a healthy graph
-        # this is exactly a uniform draw over the stored neighbors; after
-        # failures it keeps sampling uniform over the LIVE ones, because
-        # sim/failures.py re-masks the table (a draw over min(in_degree,
-        # width) prefix slots would hit dead neighbors and, after runtime
-        # connects grow in_degree past the stored row, padding garbage).
-        # Runtime (dynamic-region) links are not partner candidates until a
-        # consolidation rebuild folds them into the table.
-        mask = graph.neighbor_mask
-        count = jnp.sum(mask, axis=1)
-        u = jax.random.randint(key, (n_pad,), 0, jnp.int32(2**31 - 1))
-        k = u % jnp.maximum(count, 1)
-        csum = jnp.cumsum(mask, axis=1)
-        slot = jnp.argmax((csum == (k + 1)[:, None]) & mask, axis=1)
-        partner = jnp.take_along_axis(graph.neighbors, slot[:, None], axis=1)[:, 0]
-        has_neighbor = (count > 0) & graph.node_mask
+        from p2pnetwork_tpu.models.base import draw_neighbor_slot
+
+        _, partner, has_slot = draw_neighbor_slot(graph, key)
+        has_neighbor = has_slot & graph.node_mask
         pulled = state.values[partner]
         mixed = (1.0 - self.alpha) * state.values + self.alpha * pulled
         values = jnp.where(has_neighbor, mixed, state.values)

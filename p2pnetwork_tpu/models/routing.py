@@ -13,10 +13,16 @@ implicitly: only nodes whose cost improved last round advertise.
 At quiescence (``engine.run_until_converged(..., stat="changed",
 threshold=1)``) ``state.dist`` holds exact single-source shortest-path
 costs over ``graph.edge_weight`` (unit costs when unweighted — then this
-IS HopDistance, in f32), and ``state.parent`` the deterministic
-next-hop table: the lowest-id in-neighbor achieving the optimum, i.e.
-where node v forwards traffic TOWARD the source on the symmetric graphs
-the builders produce (-1 at the source / unreached). Negative weights
+IS HopDistance, in f32), and ``state.parent`` a deterministic OPTIMAL
+next hop: an in-neighbor achieving the optimum, i.e. where node v
+forwards traffic TOWARD the source on the symmetric graphs the builders
+produce (-1 at the source / unreached). ``state.parent`` breaks
+equal-cost ties by lowest id among the advertisers of the round the
+node last improved — an achiever that settles in a LATER round never
+advertises an improvement, so it cannot win retroactively; for the
+canonical globally-lowest-id-achiever table, :meth:`DistanceVector.
+next_hops` recomputes the tie-break against the converged costs in one
+O(E) pass. Negative weights
 converge too while no negative cycle is reachable; ``max_rounds`` is the
 guard, as everywhere.
 
@@ -42,7 +48,8 @@ _I32_MAX = jnp.iinfo(jnp.int32).max
 @dataclasses.dataclass(frozen=True)
 class DistanceVectorState:
     dist: jax.Array  # f32[N_pad] — best known cost from source; +inf unreached
-    parent: jax.Array  # i32[N_pad] — lowest-id neighbor achieving it; -1 none
+    parent: jax.Array  # i32[N_pad] — an optimal neighbor (see module
+    #                    docstring for the tie-break); -1 none
     frontier: jax.Array  # bool[N_pad] — improved last round (advertisers)
     round: jax.Array  # i32[] — rounds executed so far
 
@@ -68,6 +75,17 @@ class DistanceVector:
         """Reached fraction of live nodes (run_until_coverage seed)."""
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         return jnp.sum(jnp.isfinite(state.dist) & graph.node_mask) / n_real
+
+    def next_hops(self, graph: Graph,
+                  state: DistanceVectorState) -> jax.Array:
+        """Canonical routing table from a converged state: per reached
+        non-source node, the globally LOWEST-id in-neighbor achieving
+        ``dist[u] + w(u, v) == dist[v]`` (the tie-break ``state.parent``
+        cannot promise across rounds — an equal-cost achiever that
+        settles later never advertises an improvement); -1 at the source
+        and unreached nodes. One O(E) pass."""
+        best = self._parents(graph, state.dist, state.dist)
+        return jnp.where(best == _I32_MAX, -1, best)
 
     def _parents(self, graph: Graph, signal: jax.Array,
                  incoming: jax.Array) -> jax.Array:

@@ -17,7 +17,7 @@ README.md:20, p2pnetwork/node.py:110-116]. Batched TPU forms:
   d(d-1) through a cumulative-weight ``searchsorted``, two distinct
   out-slots through the source-CSR view, closure checked by the same
   windowed membership probe runtime connect uses
-  (sim/topology.py ``_edge_exists``). P(closed) = 3T / #wedges exactly,
+  (sim/topology.py ``static_edge_exists``). P(closed) = 3T / #wedges exactly,
   so transitivity estimates are unbiased with plain Monte Carlo error.
 
 Undirected semantics: rows are in-neighbor lists, so counts are exact on
@@ -125,21 +125,6 @@ def transitivity(graph: Graph, *, edge_block: int | None = None) -> float:
     return 3.0 * t / wedges if wedges else 0.0
 
 
-def _static_edge_exists(graph: Graph, s: jax.Array, r: jax.Array) -> jax.Array:
-    """bool[B]: windowed membership probe over the receiver-sorted COO —
-    the static half of sim/topology.py ``_edge_exists``."""
-    lo = jnp.searchsorted(graph.receivers, r, side="left")
-    span = max(graph.max_in_span, 1)
-    idx = lo[:, None] + jnp.arange(span, dtype=jnp.int32)[None, :]
-    idx = jnp.minimum(idx, graph.n_edges_padded - 1)
-    return jnp.any(
-        (graph.receivers[idx] == r[:, None])
-        & (graph.senders[idx] == s[:, None])
-        & graph.edge_mask[idx],
-        axis=1,
-    )
-
-
 @functools.partial(jax.jit, static_argnames=("samples",))
 def _sample_closed(graph: Graph, key: jax.Array, samples: int):
     d = graph.out_degree
@@ -158,7 +143,11 @@ def _sample_closed(graph: Graph, key: jax.Array, samples: int):
     e2 = graph.src_eid[jnp.minimum(row0 + j2, graph.n_edges_padded - 1)]
     a, b = graph.receivers[e1], graph.receivers[e2]
     valid = (dc >= 2) & graph.edge_mask[e1] & graph.edge_mask[e2]
-    closed = _static_edge_exists(graph, a, b) & valid
+    # The same windowed membership probe runtime connect's duplicate
+    # guard uses (sim/topology.py), span-0 broadcast fallback included.
+    from p2pnetwork_tpu.sim.topology import static_edge_exists
+
+    closed = static_edge_exists(graph, a, b) & valid
     return jnp.sum(closed), jnp.sum(valid)
 
 

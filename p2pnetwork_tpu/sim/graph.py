@@ -576,6 +576,8 @@ def chord(n: int, **kw) -> Graph:
     O(log n) diameter: greedy/BFS routing here is the batched form of a
     Chord lookup. Edges are undirected (the reference's TCP-connection
     semantic: traffic flows both ways)."""
+    if n < 2:
+        raise ValueError("chord requires n >= 2 (no fingers exist below that)")
     base = np.arange(n, dtype=np.int64)
     srcs, dsts = [], []
     i = 0

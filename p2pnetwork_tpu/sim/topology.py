@@ -101,36 +101,41 @@ def _require_dynamic(graph: Graph) -> None:
         )
 
 
-def _edge_exists(graph: Graph, s: jax.Array, r: jax.Array) -> jax.Array:
-    """bool[B]: is each directed (s, r) pair already a live edge (static or
-    dynamic)?
+def static_edge_exists(graph: Graph, s: jax.Array, r: jax.Array) -> jax.Array:
+    """bool[B]: is each directed (s, r) pair a live STATIC edge?
 
-    Static edges: the COO is receiver-sorted, so each receiver's in-edges
-    are one contiguous run no wider than ``graph.max_in_span`` (static
-    metadata from the build). One ``searchsorted`` per query plus a
+    The COO is receiver-sorted, so each receiver's in-edges are one
+    contiguous run no wider than ``graph.max_in_span`` (static metadata
+    from the build). One ``searchsorted`` per query plus a
     ``[B, max_in_span]`` window scan — O(B log E + B * max_deg), sublinear
     in E, vs the O(B * E) broadcast compare this replaces. Graphs predating
-    ``max_in_span`` (== 0) fall back to the broadcast compare. The dynamic
-    region is unsorted by design, but its capacity K is small — the brute
-    compare there is the cheap part.
+    ``max_in_span`` (== 0) fall back to the broadcast compare. Shared by
+    runtime connect's duplicate guard and the wedge-closure sampler
+    (models/triangles.py) — one probe, one set of edge cases.
     """
     if graph.max_in_span > 0:
         lo = jnp.searchsorted(graph.receivers, r, side="left")
         idx = lo[:, None] + jnp.arange(graph.max_in_span, dtype=jnp.int32)[None, :]
         idx = jnp.minimum(idx, graph.n_edges_padded - 1)
-        static = jnp.any(
+        return jnp.any(
             (graph.receivers[idx] == r[:, None])
             & (graph.senders[idx] == s[:, None])
             & graph.edge_mask[idx],
             axis=1,
         )
-    else:
-        static = jnp.any(
-            (graph.senders[None, :] == s[:, None])
-            & (graph.receivers[None, :] == r[:, None])
-            & graph.edge_mask[None, :],
-            axis=1,
-        )
+    return jnp.any(
+        (graph.senders[None, :] == s[:, None])
+        & (graph.receivers[None, :] == r[:, None])
+        & graph.edge_mask[None, :],
+        axis=1,
+    )
+
+
+def _edge_exists(graph: Graph, s: jax.Array, r: jax.Array) -> jax.Array:
+    """bool[B]: is each directed (s, r) pair already a live edge (static or
+    dynamic)? The dynamic region is unsorted by design, but its capacity K
+    is small — the brute compare there is the cheap part."""
+    static = static_edge_exists(graph, s, r)
     dyn = jnp.any(
         (graph.dyn_senders[None, :] == s[:, None])
         & (graph.dyn_receivers[None, :] == r[:, None])

@@ -77,24 +77,37 @@ class TestDistanceVector:
         np.testing.assert_allclose(np.asarray(st.dist), _oracle_sssp(g, 0),
                                    rtol=1e-6)
 
-    def test_parents_are_optimal_and_deterministic(self):
+    def test_parents_are_optimal_and_next_hops_canonical(self):
         g = _ws_weighted(seed=8)
-        _, st, _ = _converge(g)
+        p, st, _ = _converge(g)
         dist = np.asarray(st.dist)
         parent = np.asarray(st.parent)
+        hops = np.asarray(p.next_hops(g, st))
         wmap = {}
         for s, r, w in _live_weighted_edges(g):
             for a, b, c in zip(s, r, w):
                 wmap.setdefault(int(b), []).append((int(a), float(c)))
         for v in range(g.n_nodes):
             if v == 0 or not np.isfinite(dist[v]):
-                assert parent[v] == -1
+                assert parent[v] == -1 and hops[v] == -1
                 continue
             best = min(dist[a] + c for a, c in wmap[v])
             assert dist[v] == pytest.approx(best, rel=1e-6)
             achievers = [a for a, c in wmap[v]
                          if np.float32(dist[a] + np.float32(c)) == dist[v]]
-            assert parent[v] == min(achievers)  # lowest-id tie break
+            # state.parent promises AN optimal predecessor (round-scoped
+            # tie-break); next_hops promises the canonical lowest id.
+            assert parent[v] in achievers
+            assert hops[v] == min(achievers)
+
+    def test_parent_and_next_hops_are_deterministic(self):
+        g = _ws_weighted(seed=12)
+        p, st1, _ = _converge(g)
+        p2, st2, _ = _converge(g)
+        np.testing.assert_array_equal(np.asarray(st1.parent),
+                                      np.asarray(st2.parent))
+        np.testing.assert_array_equal(np.asarray(p.next_hops(g, st1)),
+                                      np.asarray(p2.next_hops(g, st2)))
 
     def test_failures_reroute(self):
         g = _ws_weighted(seed=9)
