@@ -1,0 +1,65 @@
+"""Sim backend demo: the membership layer — detect the dead, replicate
+to the living.
+
+What a real P2P deployment runs continuously on top of a library like
+the reference (which only fires ``node_disconnected`` when TCP notices
+[ref: p2pnetwork/nodeconnection.py:196-236]): an ACTIVE failure
+detector (SWIM-style random ping/ack with suspicion thresholds) and an
+anti-entropy replication loop that keeps every living peer's data set
+complete despite the losses. Both run here as batched protocols over
+one 10K-node overlay with 2% of peers crashed and a lossy network.
+
+Run: ``python examples/membership_demo.py`` (CPU ok; TPU if available).
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from p2pnetwork_tpu.models import AntiEntropy, FailureDetector
+from p2pnetwork_tpu.sim import engine, failures
+from p2pnetwork_tpu.sim import graph as G
+
+
+def main():
+    n, dead_frac = 10_000, 0.02
+    print(f"building {n}-node Watts-Strogatz overlay ...")
+    g = G.watts_strogatz(n, 8, 0.1, seed=0)
+    rng = np.random.default_rng(0)
+    dead = rng.choice(n, size=int(n * dead_frac), replace=False)
+
+    # --- failure detection: peers crashed, tables still configured.
+    gm = failures.mark_unresponsive(g, dead)
+    proto = FailureDetector(threshold=3, loss_prob=0.05)
+    st, out = engine.run_until_converged(
+        gm, proto, jax.random.key(1), stat="undetected", threshold=1,
+        max_rounds=4096,
+    )
+    declared = np.asarray(st.declared)
+    truly = np.asarray(proto._dead_watched(gm))
+    fp = int((declared & ~truly).sum())
+    print(f"FailureDetector: all {int(truly.sum())} dead table slots "
+          f"declared in {int(out['rounds'])} rounds "
+          f"(5% message loss, threshold 3, {fp} false-positive slots, "
+          f"{int(out['messages'])} ping/ack messages)")
+
+    # --- replication among the survivors: edges of the dead are gone now.
+    gf = failures.fail_nodes(g, dead)
+    proto = AntiEntropy(n_items=64)
+    st, out = engine.run_until_converged(
+        gf, proto, jax.random.key(2), stat="missing", threshold=1,
+        max_rounds=4096,
+    )
+    have = np.asarray(st.have)
+    alive = np.asarray(gf.node_mask)
+    print(f"AntiEntropy: 64 items fully replicated to all "
+          f"{int(alive.sum())} survivors in {int(out['rounds'])} rounds "
+          f"({int(out['messages'])} set exchanges); "
+          f"dead peers hold {int(have[~alive].sum())} items")
+
+
+if __name__ == "__main__":
+    main()
