@@ -14,6 +14,8 @@ from p2pnetwork_tpu.models.adaptive_flood import (
 from p2pnetwork_tpu.models.antientropy import AntiEntropy, AntiEntropyState
 from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.bipartite import BipartiteCheck, BipartiteCheckState
+from p2pnetwork_tpu.models.boruvka import Boruvka, BoruvkaState
+from p2pnetwork_tpu.models.bracha import Bracha, BrachaState
 from p2pnetwork_tpu.models.coloring import color_via_mis
 from p2pnetwork_tpu.models.detector import (
     FailureDetector,
@@ -32,6 +34,10 @@ from p2pnetwork_tpu.models.hopdist import (
     eccentricities,
 )
 from p2pnetwork_tpu.models.kcore import KCore, KCoreState
+from p2pnetwork_tpu.models.labelprop import (
+    LabelPropagation,
+    LabelPropagationState,
+)
 from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.mis import LubyMIS, LubyMISState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
@@ -66,6 +72,10 @@ __all__ = [
     "AdaptiveHopDistanceState",
     "BipartiteCheck",
     "BipartiteCheckState",
+    "Boruvka",
+    "BoruvkaState",
+    "Bracha",
+    "BrachaState",
     "ConnectedComponents",
     "ConnectedComponentsState",
     "DistanceVector",
@@ -80,6 +90,8 @@ __all__ = [
     "HopDistanceState",
     "KCore",
     "KCoreState",
+    "LabelPropagation",
+    "LabelPropagationState",
     "LeaderElection",
     "LeaderElectionState",
     "LubyMIS",

@@ -1,0 +1,213 @@
+"""Bracha reliable broadcast — Byzantine-tolerant delivery, batched.
+
+The reference's trust model is "every peer is honest": one spoofed
+message is delivered like any other [ref: p2pnetwork/nodeconnection.py:216
+— no authentication or voting anywhere; the handshake is self-described
+"not secure", p2pnetwork/node.py:148]. The canonical repair in the
+distributed-systems literature is Bracha's reliable broadcast (1987):
+with ``n >= 3f + 1`` nodes of which at most ``f`` are Byzantine, every
+honest node delivers the SAME value (agreement) and if the broadcaster
+is honest that value is the broadcaster's (validity), despite
+equivocation. The three-message-type state machine, batched per round:
+
+- round 1, INITIAL: the broadcaster's value reaches its out-neighbors;
+- on INITIAL(v): send ECHO(v) — at most one value, ever;
+- on ``2f+1`` ECHO(v) or ``f+1`` READY(v): send READY(v) — at most one;
+- on ``2f+1`` READY(v): deliver v.
+
+The value domain is binary ({0, 1}), which is where equivocation lives;
+each threshold check is one ``propagate_sum`` per value over the graph
+(ops/segment.py — indicator sums, exact in every lowering).
+
+**The adversary is part of the model.** ``byzantine`` is a static tuple
+of node ids running a deterministic worst-case-flavored strategy: from
+round 1 on, every Byzantine node sends ECHO(r % 2) and READY(r % 2) to
+each neighbor r — maximal equivocation, splitting the population by id
+parity; a Byzantine BROADCASTER likewise sends INITIAL(r % 2). Because
+the strategy factorizes by receiver, its contribution to r's count for
+value v is ``(r % 2 == v) * |byzantine in-neighbors of r|`` — one
+propagate_sum of the Byzantine mask, paid at ``init`` and carried in
+the state (``byz_in``, like the broadcaster's reach ``from_src``).
+Byzantine nodes never deliver (their state is not meaningful).
+
+Guarantees hold on the complete topology Bracha assumes
+(sim/graph.complete); the protocol runs on any graph, where sparse
+connectivity weakens it exactly as it would a real deployment (the
+quorum-connectivity literature's territory, not modeled here).
+
+Quiescence: ``engine.run_until_converged(..., stat="changed",
+threshold=1)``; ``coverage`` (honest delivered fraction) also supports
+``run_until_coverage``. Deterministic — no RNG consumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BrachaState:
+    echo_sent: jax.Array  # bool[N_pad, 2] — ECHO(v) broadcast (honest: <=1 col)
+    ready_sent: jax.Array  # bool[N_pad, 2] — READY(v) broadcast (<=1 col)
+    value: jax.Array  # i32[N_pad] — delivered value; -1 undelivered/Byzantine
+    round: jax.Array  # i32[]
+    # Round-invariant propagations, paid once at init instead of per step:
+    byz_in: jax.Array  # f32[N_pad] — Byzantine in-neighbor count (+self)
+    from_src: jax.Array  # bool[N_pad] — broadcaster reaches this node (+self)
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class Bracha:
+    """Byzantine reliable broadcast with a parity-equivocating adversary.
+
+    ``f`` sets the quorum thresholds (2f+1 / f+1); it is the TOLERANCE
+    the deployment is sized for, independent of how many ids are actually
+    listed in ``byzantine`` (listing more than f voids the guarantees,
+    as it must)."""
+
+    source: int = 0
+    source_value: int = 1
+    f: int = 1
+    byzantine: tuple = ()
+    method: str = "auto"
+
+    def __post_init__(self):
+        if self.source_value not in (0, 1):
+            raise ValueError("source_value must be 0 or 1")
+        if self.f < 0:
+            raise ValueError("f must be >= 0")
+
+    def _byz_mask(self, graph: Graph) -> jax.Array:
+        m = jnp.zeros(graph.n_nodes_padded, dtype=bool)
+        if self.byzantine:
+            ids = jnp.asarray(self.byzantine, dtype=jnp.int32)
+            m = m.at[ids].set(True)
+        return m & graph.node_mask
+
+    def init(self, graph: Graph, key: jax.Array) -> BrachaState:
+        base.validate_source(graph, self.source)
+        for b in self.byzantine:
+            if not 0 <= b < graph.n_nodes_padded:
+                # Same silent-clamp hazard validate_source exists for: an
+                # out-of-range id would scatter to a masked padded slot and
+                # the adversary would quietly not exist.
+                raise ValueError(
+                    f"byzantine id {b} out of range for padded id space "
+                    f"[0, {graph.n_nodes_padded})")
+        n_pad = graph.n_nodes_padded
+        byz = self._byz_mask(graph)
+        src_hot = jnp.zeros(n_pad, dtype=bool).at[self.source].set(True)
+        src_hot = src_hot & graph.node_mask
+        one = lambda sig: segment.propagate_sum(  # noqa: E731
+            graph, sig.astype(jnp.float32), self.method)
+        return BrachaState(
+            echo_sent=jnp.zeros((n_pad, 2), dtype=bool),
+            ready_sent=jnp.zeros((n_pad, 2), dtype=bool),
+            value=jnp.full(n_pad, -1, dtype=jnp.int32),
+            round=jnp.int32(0),
+            byz_in=one(byz),
+            # Everyone "sends to itself" too (standard quorum counting —
+            # the arithmetic at n = 3f+1 exactly needs the node's own
+            # vote): the source receives its own INITIAL, and own
+            # ECHO/READY count in step().
+            from_src=(one(src_hot) > 0) | src_hot,
+        )
+
+    def coverage(self, graph: Graph, state: BrachaState) -> jax.Array:
+        """Delivered fraction of live HONEST nodes."""
+        honest = graph.node_mask & ~self._byz_mask(graph)
+        n = jnp.maximum(jnp.sum(honest), 1)
+        return jnp.sum((state.value >= 0) & honest) / n
+
+    def step(self, graph: Graph, state: BrachaState, key: jax.Array):
+        n_pad = graph.n_nodes_padded
+        ids = jnp.arange(n_pad, dtype=jnp.int32)
+        parity = ids % 2
+        byz = self._byz_mask(graph)
+        honest = graph.node_mask & ~byz
+        rnd = state.round + 1
+
+        one = lambda sig: segment.propagate_sum(  # noqa: E731
+            graph, sig.astype(jnp.float32), self.method)
+        # Byzantine in-neighbor count per receiver (state.byz_in, computed
+        # once at init): their ECHO/READY for value v lands exactly on
+        # receivers with parity v, every round.
+        byz_for = jnp.stack([jnp.where(parity == 0, state.byz_in, 0.0),
+                             jnp.where(parity == 1, state.byz_in, 0.0)],
+                            axis=1)
+
+        # INITIAL: round 1 only. Honest source sends source_value to all
+        # out-neighbors; a Byzantine source equivocates by parity (its
+        # byz_for share already counts its ECHO/READY, but INITIAL is a
+        # separate message type). Reachability is state.from_src from init.
+        src_is_byz = byz[self.source]
+        init_val = jnp.where(src_is_byz, parity,
+                             jnp.int32(self.source_value))
+        got_initial = state.from_src & (rnd == 1)
+        initial = jnp.stack([got_initial & (init_val == 0),
+                             got_initial & (init_val == 1)], axis=1)
+
+        def counted(sent):
+            own = (sent & honest[:, None]).astype(jnp.float32)
+            return jnp.stack([one(sent[:, 0] & honest),
+                              one(sent[:, 1] & honest)],
+                             axis=1) + byz_for + own
+
+        echo_cnt = counted(state.echo_sent)
+        ready_cnt = counted(state.ready_sent)
+
+        q_echo = jnp.float32(2 * self.f + 1)
+        q_amp = jnp.float32(self.f + 1)
+        q_deliver = jnp.float32(2 * self.f + 1)
+
+        # ECHO: on INITIAL(v), if never echoed (honest discipline).
+        never_echoed = ~jnp.any(state.echo_sent, axis=1)
+        new_echo = initial & never_echoed[:, None] & honest[:, None]
+        echo_sent = state.echo_sent | new_echo
+
+        # READY: quorum of ECHOs or amplification quorum of READYs, at
+        # most one value ever; simultaneous crossings break toward the
+        # larger count, then value 0.
+        ready_ok = (echo_cnt >= q_echo) | (ready_cnt >= q_amp)
+        never_ready = ~jnp.any(state.ready_sent, axis=1)
+        pick1 = ready_ok[:, 1] & (~ready_ok[:, 0]
+                                  | (ready_cnt[:, 1] > ready_cnt[:, 0]))
+        pick = jnp.stack([ready_ok[:, 0] & ~pick1, pick1], axis=1)
+        new_ready = pick & never_ready[:, None] & honest[:, None]
+        ready_sent = state.ready_sent | new_ready
+
+        # DELIVER: 2f+1 READYs; an honest node delivers once. Both values
+        # crossing at once means the Byzantine count exceeded f — pick 0
+        # deterministically rather than hide it.
+        deliver = (ready_cnt >= q_deliver) & (state.value == -1)[:, None] \
+            & honest[:, None]
+        value = jnp.where(deliver[:, 0], 0,
+                          jnp.where(deliver[:, 1], 1, state.value))
+
+        new_state = BrachaState(echo_sent=echo_sent, ready_sent=ready_sent,
+                                value=value, round=rnd,
+                                byz_in=state.byz_in, from_src=state.from_src)
+        any0 = jnp.any((value == 0) & honest)
+        any1 = jnp.any((value == 1) & honest)
+        changed = (jnp.sum(new_echo) + jnp.sum(new_ready)
+                   + jnp.sum(value != state.value))
+        out_deg = graph.out_degree.astype(jnp.float32)
+        stats = {
+            "messages": (jnp.sum(jnp.any(new_echo, axis=1) * out_deg)
+                         + jnp.sum(jnp.any(new_ready, axis=1) * out_deg)
+                         + jnp.where(rnd == 1, out_deg[self.source], 0.0)
+                         + jnp.sum(jnp.where(byz, out_deg, 0.0))),
+            "changed": changed,
+            "delivered": jnp.sum((value >= 0) & honest),
+            "coverage": self.coverage(graph, new_state),
+            "agreement": (~(any0 & any1)).astype(jnp.int32),
+        }
+        return new_state, stats

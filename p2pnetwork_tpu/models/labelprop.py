@@ -1,0 +1,116 @@
+"""Label-propagation community detection (Raghavan et al. 2007), batched.
+
+*Which peers cluster together?* — the overlay-analytics sibling of
+:class:`~p2pnetwork_tpu.models.components.ConnectedComponents`: where
+component labelling finds the partition structure the graph FORCES,
+label propagation finds the community structure it SUGGESTS. Every node
+starts as its own community and repeatedly adopts the most frequent
+label among its neighbors; dense regions agree in a few rounds and the
+surviving labels are the communities. Reference users would build this
+on ``node_message`` like any other protocol [ref: README.md:20].
+
+TPU form of the per-node mode (most-frequent neighbor label): gather
+the neighbor-table labels ``[N, D+1]`` (own label appended — the
+standard self-vote that stabilizes singletons), sort each row, and read
+run lengths off the sorted row with two vmapped ``searchsorted`` calls —
+O(D log D) per node, static shapes, no per-label histograms. Ties break
+toward the SMALLEST label (argmax hits the first maximal run of the
+ascending sort), making the whole protocol deterministic — no RNG.
+
+Synchronous LPA famously oscillates two-colorable neighborhoods (the
+bipartite "label swap" cycle); the standard fix, deterministic here, is
+a parity schedule: even ids update on even rounds, odd ids on odd
+rounds. Quiescence therefore needs a STABLE PAIR of rounds, exposed as
+the ``unsettled`` stat — the adopter count summed over the last two
+rounds, 0 only when BOTH halves just held still: run with
+``engine.run_until_converged(..., stat="unsettled", threshold=1)``.
+(``changed_prev`` seeds to 1, not 0, so the very first round can never
+read as settled before the odd half has had a turn.)
+
+Uses the gather (neighbor-table) layout only — the mode is not a
+semiring reduction, so the segment/MXU lowerings don't apply; the table
+must be complete (from_edges' default). Dead nodes hold label -1 and
+dead neighbors don't vote (the mask re-applied by sim/failures.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+_SENTINEL = jnp.int32(2**31 - 1)
+
+
+def _row_mode(row: jax.Array) -> jax.Array:
+    """Most frequent value of an ascending-sorted row, ignoring
+    ``_SENTINEL`` padding; ties -> smallest value. Returns the value
+    (``_SENTINEL`` when the row is all padding)."""
+    left = jnp.searchsorted(row, row, side="left")
+    right = jnp.searchsorted(row, row, side="right")
+    count = jnp.where(row == _SENTINEL, 0, right - left)
+    return row[jnp.argmax(count)]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LabelPropagationState:
+    label: jax.Array  # i32[N_pad] — community label; -1 on dead nodes
+    changed_prev: jax.Array  # i32[] — adopters in the previous round
+    round: jax.Array  # i32[]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class LabelPropagation:
+    """Community detection by iterated neighborhood-majority voting."""
+
+    def init(self, graph: Graph, key: jax.Array) -> LabelPropagationState:
+        if graph.neighbors is None or not graph.neighbors_complete:
+            raise ValueError(
+                "LabelPropagation needs the complete neighbor table "
+                "(build with from_edges(build_neighbor_table=True))")
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        label = jnp.where(graph.node_mask, ids, -1)
+        # changed_prev = 1: "the other half hasn't moved yet" — a 0 seed
+        # lets round 1 report unsettled == 0 and stop the convergence loop
+        # before the odd parity class has ever updated.
+        return LabelPropagationState(label=label,
+                                     changed_prev=jnp.int32(1),
+                                     round=jnp.int32(0))
+
+    def communities(self, graph: Graph,
+                    state: LabelPropagationState) -> jax.Array:
+        """Distinct labels currently held by live nodes."""
+        used = jnp.zeros(graph.n_nodes_padded, dtype=bool)
+        lab = jnp.where(graph.node_mask, state.label, 0)
+        used = used.at[lab].max(graph.node_mask)
+        return jnp.sum(used)
+
+    def step(self, graph: Graph, state: LabelPropagationState,
+             key: jax.Array):
+        ids = jnp.arange(graph.n_nodes_padded, dtype=jnp.int32)
+        live_vote = graph.neighbor_mask & graph.node_mask[graph.neighbors]
+        votes = jnp.where(live_vote, state.label[graph.neighbors],
+                          _SENTINEL)
+        own = jnp.where(graph.node_mask, state.label, _SENTINEL)
+        votes = jnp.concatenate([votes, own[:, None]], axis=1)
+        mode = jax.vmap(_row_mode)(jnp.sort(votes, axis=1))
+        # Parity schedule: half the population holds still each round.
+        turn = (ids % 2) == (state.round % 2)
+        adopt = turn & graph.node_mask & (mode != _SENTINEL)
+        label = jnp.where(adopt, mode, state.label)
+
+        changed = jnp.sum(label != state.label)
+        new_state = LabelPropagationState(label=label,
+                                          changed_prev=changed,
+                                          round=state.round + 1)
+        stats = {
+            "messages": jnp.sum(live_vote),
+            "changed": changed,
+            "unsettled": changed + state.changed_prev,
+            "communities": self.communities(graph, new_state),
+        }
+        return new_state, stats

@@ -1,0 +1,185 @@
+"""Boruvka minimum spanning forest vs a numpy Kruskal oracle.
+
+Forest weight is compared (unique across all MSTs even under weight
+ties — the sorted weight multiset of a minimum spanning forest is an
+invariant), plus the structural invariants: committed edge count equals
+live nodes minus components, the committed set is acyclic (union-find),
+and every committed edge stays inside one final component.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import Boruvka  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _live_edges(g):
+    s = np.asarray(g.senders)
+    r = np.asarray(g.receivers)
+    em = (np.asarray(g.edge_mask)
+          & np.asarray(g.node_mask)[s] & np.asarray(g.node_mask)[r])
+    w = (np.asarray(g.edge_weight) if g.edge_weight is not None
+         else np.ones(s.shape, np.float32))
+    return s[em], r[em], w[em]
+
+
+class _UF:
+    def __init__(self, n):
+        self.p = list(range(n))
+
+    def find(self, x):
+        while self.p[x] != x:
+            self.p[x] = self.p[self.p[x]]
+            x = self.p[x]
+        return x
+
+    def union(self, a, b):
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return False
+        self.p[ra] = rb
+        return True
+
+
+def _oracle_msf(g):
+    """Kruskal over the live undirected edges: (total weight, edge count,
+    component count over live nodes)."""
+    s, r, w = _live_edges(g)
+    # Dedup the two stored directions into one undirected edge each.
+    lo, hi = np.minimum(s, r), np.maximum(s, r)
+    key = lo.astype(np.int64) * g.n_nodes_padded + hi
+    _, first = np.unique(key, return_index=True)
+    lo, hi, w = lo[first], hi[first], w[first]
+    order = np.lexsort((hi, lo, w))
+    uf = _UF(g.n_nodes_padded)
+    total, count = 0.0, 0
+    for i in order:
+        if uf.union(int(lo[i]), int(hi[i])):
+            total += float(w[i])
+            count += 1
+    alive = np.asarray(g.node_mask)
+    n_live = int(alive.sum())
+    comps = n_live - count
+    return total, count, comps
+
+
+def _run(g, max_rounds=64):
+    p = Boruvka()
+    st, out = engine.run_until_converged(
+        g, p, jax.random.key(0), stat="changed", threshold=1,
+        max_rounds=max_rounds)
+    return p, st, out
+
+
+def _check_forest(g, p, st):
+    """Structural invariants of the committed edge set."""
+    mst = np.asarray(st.mst_edge)
+    s = np.asarray(g.senders)[mst]
+    r = np.asarray(g.receivers)[mst]
+    uf = _UF(g.n_nodes_padded)
+    for a, b in zip(s, r):
+        assert uf.union(int(a), int(b)), "committed edges form a cycle"
+    comp = np.asarray(st.comp)
+    assert (comp[s] == comp[r]).all(), "edge straddles two final components"
+    oracle_w, oracle_cnt, oracle_comps = _oracle_msf(g)
+    got_w = float(st.mst_weight)
+    assert mst.sum() == oracle_cnt
+    assert int(Boruvka().components(g, st)) == oracle_comps
+    assert got_w == pytest.approx(oracle_w, rel=1e-5)
+    # mst_weight (incremental sum) agrees with re-summing the mask.
+    if g.edge_weight is not None:
+        resum = float(np.asarray(g.edge_weight)[mst].sum())
+        assert got_w == pytest.approx(resum, rel=1e-5)
+
+
+def _ws_weighted(n=96, seed=7, **kw):
+    g = G.watts_strogatz(n, 4, 0.2, seed=seed, **kw)
+    return g.with_weights(
+        lambda s, r: 0.25
+        + ((jnp.minimum(s, r) * 7919 + jnp.maximum(s, r) * 104729) % 97)
+        / 50.0)
+
+
+class TestBoruvka:
+    def test_weighted_ws_matches_kruskal(self):
+        g = _ws_weighted()
+        p, st, out = _run(g)
+        _check_forest(g, p, st)
+        # Connected graph: a spanning tree in O(log n) phases.
+        assert int(out["rounds"]) <= 12
+
+    def test_unweighted_spanning_forest(self):
+        g = G.erdos_renyi(128, 0.06, seed=3)
+        p, st, out = _run(g)
+        _check_forest(g, p, st)
+
+    def test_equal_weights_tie_stress(self):
+        # Every edge weight identical: correctness rests entirely on the
+        # direction-independent (lo, hi) tie-break.
+        g = G.watts_strogatz(80, 6, 0.3, seed=11).with_weights(
+            lambda s, r: jnp.ones(s.shape, jnp.float32))
+        p, st, out = _run(g)
+        _check_forest(g, p, st)
+
+    def test_two_cliques_forest(self):
+        # Two disjoint cliques -> a 2-tree forest, components == 2.
+        n = 16
+        edges = []
+        for base in (0, n // 2):
+            for i in range(n // 2):
+                for j in range(i + 1, n // 2):
+                    edges.append((base + i, base + j))
+        s = np.array([e[0] for e in edges] + [e[1] for e in edges],
+                     dtype=np.int32)
+        r = np.array([e[1] for e in edges] + [e[0] for e in edges],
+                     dtype=np.int32)
+        g = G.from_edges(s, r, n).with_weights(
+            lambda a, b: 1.0
+            + ((jnp.minimum(a, b) * 31 + jnp.maximum(a, b) * 17) % 13)
+            .astype(jnp.float32))
+        p, st, out = _run(g)
+        _check_forest(g, p, st)
+        assert int(p.components(g, st)) == 2
+
+    def test_dead_nodes_excluded(self):
+        g = _ws_weighted(n=64, seed=5)
+        dead_ids = np.array([3, 7, 12, 13, 30, 31, 48, 55, 60, 61, 62, 63])
+        g = failures.fail_nodes(g, dead_ids)
+        p, st, out = _run(g)
+        _check_forest(g, p, st)
+        dead = ~np.asarray(g.node_mask)
+        mst = np.asarray(st.mst_edge)
+        s = np.asarray(g.senders)[mst]
+        r = np.asarray(g.receivers)[mst]
+        assert not dead[s].any() and not dead[r].any()
+        assert (np.asarray(st.comp)[dead] == -1).all()
+
+    def test_auto_path_parity(self):
+        # GSPMD auto-sharded run is bit-identical to the engine (the
+        # scatter-min phases partition like any other reduction).
+        from p2pnetwork_tpu.parallel import auto
+        from p2pnetwork_tpu.parallel import mesh as M
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            pytest.skip("needs a multi-device mesh")
+        g = _ws_weighted(n=128, seed=13)
+        mesh = M.ring_mesh(n_dev)
+        ga = auto.shard_graph_auto(g, mesh)
+        p = Boruvka()
+        st_a, _ = auto.run_auto(ga, p, jax.random.key(0), 10)
+        st_r, _ = engine.run(g, p, jax.random.key(0), 10)
+        assert (np.asarray(st_a.comp) == np.asarray(st_r.comp)).all()
+        assert (np.asarray(st_a.mst_edge) == np.asarray(st_r.mst_edge)).all()
+
+    def test_deterministic(self):
+        g = _ws_weighted(n=72, seed=9)
+        _, st1, _ = _run(g)
+        _, st2, _ = _run(g)
+        assert (np.asarray(st1.mst_edge) == np.asarray(st2.mst_edge)).all()
+        assert (np.asarray(st1.comp) == np.asarray(st2.comp)).all()
