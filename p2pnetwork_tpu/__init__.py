@@ -22,6 +22,7 @@ from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyCon
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.securenode import SecureNode
+from p2pnetwork_tpu.snapshot import SnapshotNode
 
 __version__ = "0.3.0"
 
@@ -29,6 +30,7 @@ __all__ = [
     "Node",
     "NodeConnection",
     "SecureNode",
+    "SnapshotNode",
     "NodeConfig",
     "SimConfig",
     "TopologyConfig",

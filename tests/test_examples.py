@@ -33,6 +33,7 @@ def test_examples_discovered():
         "auto_sharding_demo.py",
         "epidemic_with_failures.py",
         "secure_node_demo.py",
+        "snapshot_application.py",
     ):
         assert required in EXAMPLES, f"missing example: {required}"
 

@@ -1,0 +1,268 @@
+"""Chandy-Lamport snapshots over real loopback sockets.
+
+The headline oracle is conservation: a fixed number of tokens circulates
+among three live nodes while snapshots are taken mid-stream; ANY
+consistent cut must account for exactly that many tokens across recorded
+node states + recorded channel states, for every interleaving the real
+sockets produce. Plus the state machine's edges: markers never reach
+app_message, duplicate/unknown markers are inert, a peerless snapshot
+completes immediately, and a peer dying mid-snapshot releases its
+channel instead of hanging the cut.
+"""
+
+import threading
+
+from p2pnetwork_tpu import SnapshotNode
+from p2pnetwork_tpu.snapshot import MARKER_KEY
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+class TokenNode(SnapshotNode):
+    """Holds tokens; all mutation happens on the event loop (handlers and
+    posted movers), per the snapshot atomicity contract."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.tokens = 0
+        self.app_seen = []
+
+    def capture_state(self):
+        return {"tokens": self.tokens}
+
+    def app_message(self, node, data):
+        self.app_seen.append(data)
+        if isinstance(data, dict) and "token" in data:
+            self.tokens += data["token"]
+
+    def move_token(self, to_node):
+        """Post a one-token transfer to ``to_node`` onto the loop: the
+        decrement and the send land atomically w.r.t. any cut."""
+
+        def _do():
+            if self.tokens > 0:
+                self.tokens -= 1
+                peers = [c for c in self.all_nodes if c.id == to_node.id]
+                if peers:
+                    self.send_to_node(peers[0], {"token": 1})
+                else:  # peer gone: put it back rather than destroy it
+                    self.tokens += 1
+
+        self.post(_do)
+
+
+def _triangle(cls=TokenNode):
+    a = cls(HOST, 0, id="A")
+    b = cls(HOST, 0, id="B")
+    c = cls(HOST, 0, id="C")
+    for n in (a, b, c):
+        n.start()
+    assert a.connect_with_node(HOST, b.port)
+    assert b.connect_with_node(HOST, c.port)
+    assert c.connect_with_node(HOST, a.port)
+    assert wait_until(lambda: all(len(n.all_nodes) == 2 for n in (a, b, c)))
+    return a, b, c
+
+
+class TestSnapshotBasics:
+    def test_peerless_snapshot_completes_immediately(self):
+        a = TokenNode(HOST, 0, id="solo")
+        a.start()
+        try:
+            sid = a.take_snapshot()
+            snap = a.wait_snapshot(sid, timeout=5.0)
+            assert snap is not None
+            assert snap["state"] == {"tokens": 0}
+            assert snap["channels"] == {}
+        finally:
+            stop_all([a])
+
+    def test_markers_never_reach_app_message(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            sid = a.take_snapshot()
+            for n in nodes:
+                assert n.wait_snapshot(sid, timeout=10.0) is not None
+            for n in nodes:
+                assert not any(
+                    isinstance(m, dict) and MARKER_KEY in m
+                    for m in n.app_seen
+                ), f"marker leaked to app_message on {n.id}"
+        finally:
+            stop_all(nodes)
+
+    def test_all_nodes_complete_with_empty_channels_when_idle(self):
+        nodes = _triangle()
+        try:
+            sid = nodes[1].take_snapshot()
+            for n in nodes:
+                snap = n.wait_snapshot(sid, timeout=10.0)
+                assert snap is not None
+                assert snap["state"] == {"tokens": 0}
+                # Idle network: every recorded channel is empty.
+                assert all(msgs == [] for msgs in snap["channels"].values())
+                assert len(snap["channels"]) == 2
+        finally:
+            stop_all(nodes)
+
+    def test_snapshot_complete_event_dispatched(self):
+        events = []
+
+        def cb(event, main_node, connected_node, data):
+            events.append((event, data))
+
+        a = TokenNode(HOST, 0, id="solo", callback=cb)
+        a.start()
+        try:
+            sid = a.take_snapshot()
+            assert a.wait_snapshot(sid, timeout=5.0) is not None
+            assert any(e == "snapshot_complete" and d["id"] == sid
+                       for e, d in events)
+        finally:
+            stop_all([a])
+
+
+class TestTokenConservation:
+    TOTAL = 12
+
+    def test_conservation_under_churn_of_messages(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            a.post(lambda: setattr(a, "tokens", self.TOTAL))
+            assert wait_until(lambda: a.tokens == self.TOTAL)
+
+            stop_flag = threading.Event()
+
+            def pump():
+                ring = [(a, b), (b, c), (c, a), (a, c), (c, b), (b, a)]
+                i = 0
+                while not stop_flag.is_set():
+                    src, dst = ring[i % len(ring)]
+                    src.move_token(dst)
+                    i += 1
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            try:
+                sids = [n.take_snapshot() for n in (a, b, c)]
+                snaps = []
+                for sid in sids:
+                    for n in nodes:
+                        snap = n.wait_snapshot(sid, timeout=15.0)
+                        assert snap is not None, \
+                            f"snapshot {sid} never completed on {n.id}"
+                        snaps.append(snap)
+            finally:
+                stop_flag.set()
+                t.join(timeout=5.0)
+
+            for sid in sids:
+                cut = [s for s in snaps if s["id"] == sid]
+                assert len(cut) == 3
+                in_states = sum(s["state"]["tokens"] for s in cut)
+                in_flight = sum(
+                    m.get("token", 0)
+                    for s in cut
+                    for msgs in s["channels"].values()
+                    for m in msgs
+                    if isinstance(m, dict)
+                )
+                assert in_states + in_flight == self.TOTAL, (
+                    f"cut {sid}: {in_states} in states + {in_flight} "
+                    f"in flight != {self.TOTAL}"
+                )
+        finally:
+            stop_all(nodes)
+
+
+class TestSnapshotEdges:
+    def test_duplicate_and_unknown_markers_are_inert(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            sid = a.take_snapshot()
+            for n in nodes:
+                assert n.wait_snapshot(sid, timeout=10.0) is not None
+            # Re-delivering markers for a finished id must not resurrect it.
+            b.send_to_nodes({MARKER_KEY: sid})
+            done = b.get_snapshot(sid)
+            assert wait_until(lambda: b.get_snapshot(sid) is done)
+            assert a.get_snapshot(sid) is not None
+        finally:
+            stop_all(nodes)
+
+    def test_dead_peer_releases_channel_mid_cut(self):
+        # C is a PLAIN reference-style Node: it never answers with a
+        # marker, so A's snapshot genuinely stalls on the A<-C channel
+        # (the mid-cut state) until C dies — then the release path must
+        # complete the cut WITH the app message C sent while recording.
+        from p2pnetwork_tpu import Node
+
+        a = TokenNode(HOST, 0, id="A")
+        b = TokenNode(HOST, 0, id="B")
+        c = Node(HOST, 0, id="C")
+        nodes = [a, b, c]
+        try:
+            for n in nodes:
+                n.start()
+            assert a.connect_with_node(HOST, b.port)
+            assert b.connect_with_node(HOST, c.port)
+            assert c.connect_with_node(HOST, a.port)
+            assert wait_until(
+                lambda: all(len(n.all_nodes) == 2 for n in nodes))
+            sid = a.take_snapshot()
+            # Both A and B stall mid-cut: each has a C channel that will
+            # never deliver a marker while C lives.
+            assert a.wait_snapshot(sid, timeout=0.5) is None
+            assert b.wait_snapshot(sid, timeout=0.5) is None
+            # Traffic from C while A records that channel -> channel state.
+            c.send_to_nodes({"token": 1})
+            assert wait_until(lambda: len(a.app_seen) > 0)
+            c.stop()
+            c.join(timeout=10.0)
+            snap = a.wait_snapshot(sid, timeout=10.0)
+            assert snap is not None, "snapshot hung on the dead channel"
+            assert {"token": 1} in snap["channels"].get("C", [])
+            assert b.wait_snapshot(sid, timeout=10.0) is not None
+        finally:
+            stop_all(nodes)
+
+    def test_reused_snapshot_id_rejected(self):
+        a = TokenNode(HOST, 0, id="solo")
+        a.start()
+        try:
+            sid = a.take_snapshot("cut-1")
+            assert a.wait_snapshot(sid, timeout=5.0) is not None
+            import pytest as _pytest
+            with _pytest.raises(ValueError):
+                a.take_snapshot("cut-1")
+        finally:
+            stop_all([a])
+
+    def test_discard_releases_retention(self):
+        a = TokenNode(HOST, 0, id="solo")
+        a.start()
+        try:
+            sid = a.take_snapshot()
+            assert a.wait_snapshot(sid, timeout=5.0) is not None
+            snap = a.discard_snapshot(sid)
+            assert snap is not None and snap["id"] == sid
+            assert wait_until(lambda: a.get_snapshot(sid) is None)
+        finally:
+            stop_all([a])
+
+    def test_concurrent_snapshot_ids_interleave(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            sid1 = a.take_snapshot()
+            sid2 = b.take_snapshot()
+            for sid in (sid1, sid2):
+                for n in nodes:
+                    assert n.wait_snapshot(sid, timeout=10.0) is not None
+            assert sid1 != sid2
+        finally:
+            stop_all(nodes)
