@@ -21,6 +21,7 @@ from p2pnetwork_tpu import wire
 from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
+from p2pnetwork_tpu.causal import CausalNode
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
 
@@ -29,6 +30,7 @@ __version__ = "0.3.0"
 __all__ = [
     "Node",
     "NodeConnection",
+    "CausalNode",
     "SecureNode",
     "SnapshotNode",
     "NodeConfig",

@@ -56,11 +56,12 @@ class NodeConfig:
 class TopologyConfig:
     """Which random graph to build (see sim/graph.py generators)."""
 
-    kind: str = "watts_strogatz"  # erdos_renyi | barabasi_albert | watts_strogatz | ring | chord | complete
+    kind: str = "watts_strogatz"  # erdos_renyi | barabasi_albert | watts_strogatz | ring | chord | kademlia | complete
     n_nodes: int = 1024
     #: erdos_renyi: edge probability; watts_strogatz: rewire probability.
     p: float = 0.01
-    #: barabasi_albert: edges per new node; watts_strogatz: ring degree.
+    #: barabasi_albert: edges per new node; watts_strogatz: ring degree;
+    #: kademlia: bucket width.
     k: int = 10
     seed: int = 0
 

@@ -589,6 +589,49 @@ def chord(n: int, **kw) -> Graph:
     return from_edges(*_undirect(lo, hi), n, **kw)
 
 
+def kademlia(n: int, k: int = 1, **kw) -> Graph:
+    """Kademlia-style structured overlay: for every node ``v`` and every
+    XOR-distance bucket ``[2^i, 2^(i+1))`` with ``2^i < n``, edges to the
+    ``k`` CLOSEST ids in that bucket — ``v ^ d`` for ``d = 2^i ..
+    2^i + k - 1`` (the k smallest XOR distances the band contains), kept
+    when the partner id exists. The other classic DHT geometry beside
+    :func:`chord`: XOR-metric buckets instead of modular fingers, so at
+    ``k = 1`` on a fully-populated (power-of-two) id space this is
+    exactly the binary hypercube Kademlia lookups walk, with O(log n)
+    degree and O(log n) diameter; larger ``k`` is the bucket width
+    (Kademlia's replication parameter) adding redundancy per band. Ids
+    above ``n - 1`` don't exist (a partially-populated id space): when
+    the rank-``j`` closest partner ``v ^ (2^i + j)`` is such a ghost,
+    the edge falls back to the ``j``-th LOWEST id of the bucket's live
+    range — farther by XOR but a legitimate bucket contact, so every
+    populated bucket gets at least its ``j = 0`` edge (a bucket holding
+    fewer than ``j + 1`` live ids simply has no rank-``j`` contact, like
+    a real routing table's short bucket). Deterministic; edges
+    undirected (the reference's TCP-connection semantic)."""
+    if n < 2:
+        raise ValueError("kademlia requires n >= 2 (no buckets below that)")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    v = np.arange(n, dtype=np.int64)
+    srcs, dsts = [], []
+    i = 0
+    while (1 << i) < n:
+        width = 1 << i
+        # The bucket's id range: v's prefix above bit i with bit i flipped.
+        bucket_base = ((v >> i) ^ 1) << i
+        v_low = v & (width - 1)
+        for j in range(min(k, width)):
+            ideal = bucket_base + (v_low ^ j)  # XOR distance 2^i + j
+            fallback = bucket_base + j  # always exists when the bucket does
+            cand = np.where(ideal < n, ideal, fallback)
+            keep = cand < n
+            srcs.append(v[keep])
+            dsts.append(cand[keep])
+        i += 1
+    lo, hi = _dedup_undirected(np.concatenate(srcs), np.concatenate(dsts), n)
+    return from_edges(*_undirect(lo, hi), n, **kw)
+
+
 def complete(n: int, **kw) -> Graph:
     """Complete graph (every pair connected) — small n only."""
     src, dst = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
@@ -609,6 +652,8 @@ def build(topology) -> Graph:
         return ring(topology.n_nodes)
     if kind == "chord":
         return chord(topology.n_nodes)
+    if kind == "kademlia":
+        return kademlia(topology.n_nodes, topology.k)
     if kind == "complete":
         return complete(topology.n_nodes)
     raise ValueError(f"unknown topology kind: {kind!r}")

@@ -1,0 +1,167 @@
+"""Causal broadcast (Birman-Schiper-Stephenson) delivery machine + live
+ordering.
+
+The delivery state machine is driven directly with adversarial arrival
+orders (the races real networks produce, made deterministic), then a
+live three-node integration confirms end-to-end causal order: every
+node's delivery sequence must respect per-sender order and the
+happened-before edges the vector clocks encode.
+"""
+
+from p2pnetwork_tpu import CausalNode
+from p2pnetwork_tpu.causal import VC_FROM_KEY, VC_KEY
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+class Recorder(CausalNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.delivered = []
+        self.delivered_clocks = []
+
+    def causal_message(self, node, data):
+        self.delivered.append(data)
+        self.delivered_clocks.append(dict(self.vc))
+
+
+class _FakeConn:
+    def __init__(self, id):
+        self.id = id
+
+
+def _env(sender, clock, payload):
+    return {VC_KEY: clock, VC_FROM_KEY: sender, "payload": payload}
+
+
+class TestDeliveryMachine:
+    """node_message driven directly — no sockets, no loop, pure ordering."""
+
+    def _node(self):
+        return Recorder(HOST, 0, id="me")
+
+    def test_out_of_order_chain_buffers_then_releases(self):
+        n = self._node()
+        ca, cb = _FakeConn("A"), _FakeConn("B")
+        # B's m2 causally follows A's m1 (B had delivered m1 before
+        # sending), but m2 arrives FIRST.
+        n.node_message(cb, _env("B", {"A": 1, "B": 1}, "m2"))
+        assert n.delivered == [] and n.undelivered() == 1
+        n.node_message(ca, _env("A", {"A": 1}, "m1"))
+        assert n.delivered == ["m1", "m2"]
+        assert n.undelivered() == 0
+
+    def test_per_sender_gap_blocks(self):
+        n = self._node()
+        ca = _FakeConn("A")
+        n.node_message(ca, _env("A", {"A": 2}, "second"))
+        assert n.delivered == []
+        n.node_message(ca, _env("A", {"A": 1}, "first"))
+        assert n.delivered == ["first", "second"]
+
+    def test_one_arrival_releases_whole_chain(self):
+        n = self._node()
+        ca, cb, cc = _FakeConn("A"), _FakeConn("B"), _FakeConn("C")
+        n.node_message(cc, _env("C", {"A": 1, "B": 1, "C": 1}, "m3"))
+        n.node_message(cb, _env("B", {"A": 1, "B": 1}, "m2"))
+        assert n.delivered == [] and n.undelivered() == 2
+        n.node_message(ca, _env("A", {"A": 1}, "m1"))
+        assert n.delivered == ["m1", "m2", "m3"]
+
+    def test_stale_duplicate_dropped(self):
+        n = self._node()
+        ca = _FakeConn("A")
+        n.node_message(ca, _env("A", {"A": 1}, "m1"))
+        n.node_message(ca, _env("A", {"A": 1}, "m1-again"))
+        assert n.delivered == ["m1"]
+
+    def test_duplicate_of_held_message_purged_on_release(self):
+        # Regression: a resent copy buffered WHILE the original was held
+        # used to survive delivery of the original and sit in _held
+        # forever, inflating undelivered().
+        n = self._node()
+        ca = _FakeConn("A")
+        n.node_message(ca, _env("A", {"A": 2}, "second"))
+        n.node_message(ca, _env("A", {"A": 2}, "second-resent"))
+        assert n.undelivered() == 2
+        n.node_message(ca, _env("A", {"A": 1}, "first"))
+        assert n.delivered == ["first", "second"]
+        assert n.undelivered() == 0
+
+    def test_concurrent_senders_any_order(self):
+        n = self._node()
+        ca, cb = _FakeConn("A"), _FakeConn("B")
+        # A:1 and B:1 are concurrent — both deliverable on arrival,
+        # either order is causal.
+        n.node_message(cb, _env("B", {"B": 1}, "b1"))
+        n.node_message(ca, _env("A", {"A": 1}, "a1"))
+        assert sorted(n.delivered) == ["a1", "b1"]
+
+    def test_plain_messages_bypass(self):
+        seen = []
+
+        class Plain(Recorder):
+            def node_message(self, node, data):
+                if isinstance(data, dict) and VC_KEY in data \
+                        and VC_FROM_KEY in data:
+                    return super().node_message(node, data)
+                seen.append(data)
+
+        n = Plain(HOST, 0, id="me")
+        n.node_message(_FakeConn("A"), {"just": "a dict"})
+        n.node_message(_FakeConn("A"), _env("A", {"A": 1}, "stamped"))
+        assert seen == [{"just": "a dict"}]
+        assert n.delivered == ["stamped"]
+
+
+class TestLiveCausalOrder:
+    def test_three_nodes_reactive_chain(self):
+        a = Recorder(HOST, 0, id="A")
+        b = Recorder(HOST, 0, id="B")
+        c = Recorder(HOST, 0, id="C")
+        nodes = [a, b, c]
+        try:
+            for n in nodes:
+                n.start()
+            assert a.connect_with_node(HOST, b.port)
+            assert b.connect_with_node(HOST, c.port)
+            assert c.connect_with_node(HOST, a.port)
+            assert wait_until(
+                lambda: all(len(n.all_nodes) == 2 for n in nodes))
+
+            # B reacts to every message from A — each reaction causally
+            # follows the message it answers.
+            reacted = []
+            orig = b.causal_message.__func__
+
+            def reacting(node, data):
+                orig(b, node, data)
+                if isinstance(data, str) and data.startswith("a-"):
+                    reacted.append(data)
+                    b.send_causal(f"b-re-{data}")
+
+            b.causal_message = reacting
+
+            rounds = 10
+            for i in range(rounds):
+                a.send_causal(f"a-{i}")
+
+            assert wait_until(
+                lambda: len(c.delivered) >= 2 * rounds, timeout=10.0), \
+                f"C delivered only {len(c.delivered)}"
+
+            for n in (a, c):
+                seq = [d for d in n.delivered if isinstance(d, str)]
+                a_msgs = [d for d in seq if d.startswith("a-")]
+                assert a_msgs == [f"a-{i}" for i in range(rounds)], \
+                    f"per-sender order broken at {n.id}: {a_msgs}"
+                # Every reaction lands after the message it reacts to.
+                for i in range(rounds):
+                    re = f"b-re-a-{i}"
+                    if re in seq:
+                        assert seq.index(f"a-{i}") < seq.index(re), \
+                            f"causality violated at {n.id}: {re} before a-{i}"
+            assert all(n.undelivered() == 0 for n in nodes)
+        finally:
+            stop_all(nodes)

@@ -237,3 +237,64 @@ class TestChord:
         from p2pnetwork_tpu.config import TopologyConfig
         g = G.build(TopologyConfig(kind="chord", n_nodes=64))
         assert g.n_nodes == 64
+
+
+class TestKademlia:
+    def test_power_of_two_is_hypercube(self):
+        # k=1 on a fully-populated id space: partner per bucket is v^2^i —
+        # exactly the binary hypercube.
+        n = 64
+        g = G.kademlia(n)
+        emask = np.asarray(g.edge_mask)
+        s = np.asarray(g.senders)[emask]
+        r = np.asarray(g.receivers)[emask]
+        d = s ^ r
+        assert (d == (d & -d)).all(), "non-power-of-two XOR distance at k=1"
+        deg = np.asarray(g.in_degree)[:n]
+        assert (deg == 6).all()  # log2(64) buckets, one partner each
+
+    def test_bucket_coverage(self):
+        # Every node has a partner in every bucket the id space populates.
+        n, k = 100, 2
+        g = G.kademlia(n, k)
+        emask = np.asarray(g.edge_mask)
+        s = np.asarray(g.senders)[emask]
+        r = np.asarray(g.receivers)[emask]
+        d = s ^ r
+        for v in (0, 1, 37, 99):
+            mine = d[s == v]
+            i = 0
+            while (1 << i) < n:
+                lo, hi = 1 << i, 1 << (i + 1)
+                # The bucket is coverable iff some existing id lands in it.
+                coverable = any(
+                    lo <= (v ^ u) < hi for u in range(n) if u != v)
+                got = ((mine >= lo) & (mine < hi)).any()
+                assert got or not coverable, \
+                    f"node {v} missing coverable bucket {i}"
+                i += 1
+
+    def test_diameter_logarithmic_and_symmetric(self):
+        from p2pnetwork_tpu.models import eccentricities
+        n = 200
+        g = G.kademlia(n, k=1)
+        ecc, reached = eccentricities(g, np.array([0, 3, 127, 199]))
+        assert (np.asarray(reached) == n).all(), "kademlia graph disconnected"
+        assert int(np.asarray(ecc).max()) <= 2 * n.bit_length()
+        emask = np.asarray(g.edge_mask)
+        s = np.asarray(g.senders)[emask]
+        r = np.asarray(g.receivers)[emask]
+        fwd = set(zip(s.tolist(), r.tolist()))
+        assert all((b, a) in fwd for a, b in fwd)
+
+    def test_rejects_bad_params(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            G.kademlia(1)
+        with _pytest.raises(ValueError):
+            G.kademlia(16, k=0)
+
+    def test_config_build(self):
+        from p2pnetwork_tpu.config import TopologyConfig
+        g = G.build(TopologyConfig(kind="kademlia", n_nodes=64, k=2))
+        assert g.n_nodes == 64
