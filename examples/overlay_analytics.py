@@ -1,14 +1,15 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Eight questions reference users answer by hand-instrumenting callbacks
+Nine questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
 (HopDistance / BFS), what's the network-wide average (PushSum), who
 coordinates (LeaderElection), is the network partitioned and how badly
 (ConnectedComponents, after node failures), can peers be 2-colored into
 roles (BipartiteCheck), how clustered is the overlay
-(transitivity_sample), and which peers form the resilient core (KCore)
-— each runs here as a batched protocol over the whole population in one
-compiled scan (clustering as a one-shot device query).
+(transitivity_sample), which peers form the resilient core (KCore), and
+which peers the shortest paths route through (betweenness_sample) — each
+runs here as a batched protocol over the whole population in one
+compiled scan (clustering and betweenness as one-shot device queries).
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -121,6 +122,15 @@ def main():
     core = int(np.asarray(state.in_core).sum())
     print(f"KCore k=4: {core}/{n} peers survive recursive peeling "
           f"({int(out['rounds'])} rounds)")
+
+    # Which peers the traffic actually routes through: sampled Brandes
+    # betweenness (64 sources -> unbiased estimate of the full sum).
+    from p2pnetwork_tpu.models import betweenness_sample
+    src = jax.random.choice(jax.random.key(8), n, (64,), replace=False)
+    bc = np.asarray(betweenness_sample(g, src, normalized=True))
+    top_bc = np.argsort(bc)[-5:][::-1]
+    print("betweenness (sampled): top-5 relays:",
+          ", ".join(f"node {i} ({bc[i]:.0f})" for i in top_bc))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+"""Brandes betweenness vs the networkx oracle: exact (all sources) on
+several graph families, the sampled estimator's scaling, dead-node
+masking, and lowering-independence."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import networkx as nx  # noqa: E402
+
+from p2pnetwork_tpu.models import betweenness_sample  # noqa: E402
+from p2pnetwork_tpu.sim import failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _nx_graph(g):
+    s = np.asarray(g.senders)
+    r = np.asarray(g.receivers)
+    em = (np.asarray(g.edge_mask)
+          & np.asarray(g.node_mask)[s] & np.asarray(g.node_mask)[r])
+    H = nx.Graph()
+    H.add_nodes_from(np.nonzero(np.asarray(g.node_mask))[0].tolist())
+    H.add_edges_from(zip(s[em].tolist(), r[em].tolist()))
+    return H
+
+
+def _exact(g, method="auto"):
+    # All live nodes as sources = exact betweenness (directed-sum
+    # convention: 2x the undirected unordered-pair count).
+    src = np.nonzero(np.asarray(g.node_mask))[0].astype(np.int32)
+    return np.asarray(betweenness_sample(g, src, method=method))
+
+
+def _oracle(g):
+    H = _nx_graph(g)
+    bc = nx.betweenness_centrality(H, normalized=False)
+    out = np.zeros(g.n_nodes_padded, dtype=np.float64)
+    for v, x in bc.items():
+        out[v] = 2.0 * x  # undirected nx counts each pair once
+    return out
+
+
+class TestBetweennessExact:
+    @pytest.mark.parametrize("build", [
+        lambda: G.watts_strogatz(60, 4, 0.2, seed=3),
+        lambda: G.erdos_renyi(48, 0.12, seed=5),
+        lambda: G.kademlia(40, k=1),
+        lambda: G.ring(16),
+    ])
+    def test_matches_networkx(self, build):
+        g = build()
+        got = _exact(g)
+        want = _oracle(g)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_star_center_dominates(self):
+        # K_{1,6}: every pair routes through the hub; leaves are 0.
+        n = 7
+        s = np.array([0] * 6 + list(range(1, 7)), dtype=np.int32)
+        r = np.array(list(range(1, 7)) + [0] * 6, dtype=np.int32)
+        g = G.from_edges(s, r, n)
+        got = _exact(g)
+        assert got[0] == pytest.approx(6 * 5)  # 30 ordered pairs via hub
+        assert np.allclose(got[1:7], 0.0)
+
+    def test_lowering_independence(self):
+        g = G.watts_strogatz(64, 4, 0.1, seed=9)
+        a = _exact(g, method="segment")
+        b = _exact(g, method="gather")
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+    def test_dead_nodes_excluded(self):
+        g = G.watts_strogatz(40, 4, 0.2, seed=7)
+        g = failures.fail_nodes(g, np.array([5, 11, 23]))
+        got = _exact(g)
+        want = _oracle(g)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        assert got[5] == got[11] == got[23] == 0.0
+
+    def test_dead_source_contributes_nothing(self):
+        g = G.watts_strogatz(32, 4, 0.2, seed=1)
+        g = failures.fail_nodes(g, np.array([3]))
+        with_dead = np.asarray(betweenness_sample(g, np.array([0, 3, 7])))
+        without = np.asarray(betweenness_sample(g, np.array([0, 7])))
+        np.testing.assert_allclose(with_dead, without, rtol=1e-6)
+
+
+class TestBetweennessSampled:
+    def test_normalized_estimator_unbiased_at_full_sample(self):
+        g = G.erdos_renyi(40, 0.15, seed=2)
+        src = np.nonzero(np.asarray(g.node_mask))[0].astype(np.int32)
+        est = np.asarray(betweenness_sample(g, src, normalized=True))
+        exact = _exact(g)
+        # Full sample: rescale factor is n/n = 1.
+        np.testing.assert_allclose(est, exact, rtol=1e-5)
+
+    def test_sampled_tracks_exact_ranking(self):
+        g = G.watts_strogatz(128, 4, 0.05, seed=4)
+        exact = _exact(g)
+        rng = np.random.default_rng(0)
+        src = rng.choice(128, size=48, replace=False).astype(np.int32)
+        est = np.asarray(betweenness_sample(g, src, normalized=True))
+        # The estimator needn't match pointwise at this sample size, but
+        # the top-decile hub sets should overlap substantially.
+        top_true = set(np.argsort(exact)[-13:].tolist())
+        top_est = set(np.argsort(est)[-13:].tolist())
+        assert len(top_true & top_est) >= 7
