@@ -23,7 +23,8 @@ import numpy as np
 
 from p2pnetwork_tpu.models import (BipartiteCheck, ConnectedComponents,
                                    HopDistance, KCore, LeaderElection,
-                                   PageRank, PushSum)
+                                   PageRank, PushSum, betweenness_sample,
+                                   transitivity_sample)
 from p2pnetwork_tpu.sim import engine, failures
 from p2pnetwork_tpu.sim import graph as G
 
@@ -107,7 +108,6 @@ def main():
     # How clustered is the overlay: unbiased wedge sampling (the BA hubs
     # make the exact [B, d, d] intersection path quadratic in hub degree;
     # the sampler is degree-free).
-    from p2pnetwork_tpu.models import transitivity_sample
     gcsr = g.with_source_csr()
     t_est = transitivity_sample(gcsr, jax.random.key(6), 1 << 16)
     print(f"transitivity_sample: global clustering ~ {t_est:.4f} "
@@ -125,7 +125,6 @@ def main():
 
     # Which peers the traffic actually routes through: sampled Brandes
     # betweenness (64 sources -> unbiased estimate of the full sum).
-    from p2pnetwork_tpu.models import betweenness_sample
     src = jax.random.choice(jax.random.key(8), n, (64,), replace=False)
     bc = np.asarray(betweenness_sample(g, src, normalized=True))
     top_bc = np.argsort(bc)[-5:][::-1]

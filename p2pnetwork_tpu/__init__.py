@@ -24,6 +24,7 @@ from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.causal import CausalNode
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
+from p2pnetwork_tpu.termination import TerminationNode
 
 __version__ = "0.3.0"
 
@@ -33,6 +34,7 @@ __all__ = [
     "CausalNode",
     "SecureNode",
     "SnapshotNode",
+    "TerminationNode",
     "NodeConfig",
     "SimConfig",
     "TopologyConfig",

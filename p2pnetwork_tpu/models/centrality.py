@@ -31,6 +31,14 @@ Works on any aggregation lowering; requires symmetric edges (the
 undirected contract the builders satisfy), documented rather than
 checked — asymmetric edge sets yield a directed-graph forward pass with
 a wrong reverse sweep.
+
+Numeric bound: path counts accumulate in f32, so ``sigma`` is exact
+only up to 2^24 paths and overflows to inf near 3.4e38 — lattice-like
+graphs reach astronomical shortest-path multiplicities at modest
+diameter (a grid has C(2k, k) paths at distance 2k), and past the
+overflow the reverse sweep turns inf into NaN. Small-world / scale-free
+overlays (this library's domain) have low multiplicity and are fine;
+for grid-like topologies check ``jnp.isfinite`` on the result.
 """
 
 from __future__ import annotations

@@ -1,0 +1,141 @@
+"""Dijkstra-Scholten termination detection over real loopback sockets.
+
+The oracle is the algorithm's claim itself: when the root's detection
+fires, every work message anywhere must already have been processed —
+checked with a TTL-ripple computation whose total work count is known in
+advance, so premature detection (firing while ripples are still in
+flight) shows up as a processed-count shortfall at detection time.
+"""
+
+from p2pnetwork_tpu import TerminationNode
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+class RippleNode(TerminationNode):
+    """Work = {"ttl": k}: process it, and while ttl > 0 forward a
+    decremented ripple to every peer. On a triangle, a root ripple of
+    TTL t spawns exactly 2^(t+1) - 1 work messages total."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.processed = 0
+
+    def work_message(self, node, comp_id, data):
+        self.processed += 1
+        if data["ttl"] > 0:
+            for peer in self.all_nodes:
+                self.send_work(peer, {"ttl": data["ttl"] - 1})
+
+
+def _triangle(cls=RippleNode):
+    a = cls(HOST, 0, id="A")
+    b = cls(HOST, 0, id="B")
+    c = cls(HOST, 0, id="C")
+    nodes = [a, b, c]
+    for n in nodes:
+        n.start()
+    assert a.connect_with_node(HOST, b.port)
+    assert b.connect_with_node(HOST, c.port)
+    assert c.connect_with_node(HOST, a.port)
+    assert wait_until(lambda: all(len(n.all_nodes) == 2 for n in nodes))
+    return nodes
+
+
+class TestTermination:
+    def test_no_work_terminates_immediately(self):
+        nodes = _triangle()
+        try:
+            # Root handler sends nothing (ttl 0): tree = root alone.
+            cid = nodes[0].start_diffusing({"ttl": 0})
+            assert nodes[0].wait_terminated(cid, timeout=5.0)
+            assert nodes[0].processed == 1
+        finally:
+            stop_all(nodes)
+
+    def test_detection_only_after_all_work_processed(self):
+        nodes = _triangle()
+        a = nodes[0]
+        try:
+            ttl = 6
+            expected = 2 ** (ttl + 1) - 1  # binary ripple tree on K3
+            done = []
+            orig = a.computation_terminated.__func__
+
+            def on_done(comp_id):
+                # Record the GLOBAL processed count at the instant of
+                # detection — the algorithm's whole claim.
+                done.append(sum(n.processed for n in nodes))
+                orig(a, comp_id)
+
+            a.computation_terminated = on_done
+            cid = a.start_diffusing({"ttl": ttl})
+            assert a.wait_terminated(cid, timeout=30.0), "never terminated"
+            assert done[0] == expected, (
+                f"terminated after {done[0]}/{expected} messages processed")
+            assert all(n.deficit(cid) == 0 for n in nodes)
+        finally:
+            stop_all(nodes)
+
+    def test_nonroot_detaches_and_reengages(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            cid = a.start_diffusing({"ttl": 2})
+            assert a.wait_terminated(cid, timeout=15.0)
+            # After global termination everyone detached.
+            assert all(n.deficit(cid) == 0 for n in nodes)
+            # A fresh computation under a new id runs cleanly on the same
+            # overlay (nodes re-engage from scratch).
+            cid2 = a.start_diffusing({"ttl": 2})
+            assert a.wait_terminated(cid2, timeout=15.0)
+        finally:
+            stop_all(nodes)
+
+    def test_concurrent_computations_tracked_independently(self):
+        nodes = _triangle()
+        a, b, c = nodes
+        try:
+            cid_a = a.start_diffusing({"ttl": 4})
+            cid_b = b.start_diffusing({"ttl": 4})
+            assert cid_a != cid_b
+            assert a.wait_terminated(cid_a, timeout=20.0)
+            assert b.wait_terminated(cid_b, timeout=20.0)
+        finally:
+            stop_all(nodes)
+
+    def test_duplicate_comp_id_rejected(self):
+        nodes = _triangle()
+        a = nodes[0]
+        try:
+            # Reusing a running id raises EAGERLY on the caller thread
+            # (a loop-side raise would vanish into asyncio's handler and
+            # the caller would mistake the old run's completion for the
+            # new one's); the first computation completes untouched.
+            a.start_diffusing({"ttl": 8}, comp_id="fixed")
+            import pytest as _pytest
+            with _pytest.raises(ValueError):
+                a.start_diffusing({"ttl": 1}, comp_id="fixed")
+            assert a.wait_terminated("fixed", timeout=30.0)
+            # Finished ids stay rejected until explicitly forgotten.
+            with _pytest.raises(ValueError):
+                a.start_diffusing({"ttl": 1}, comp_id="fixed")
+            a.forget_computation("fixed")
+            a.start_diffusing({"ttl": 1}, comp_id="fixed")
+            assert a.wait_terminated("fixed", timeout=15.0)
+        finally:
+            stop_all(nodes)
+
+    def test_plain_messages_bypass(self):
+        nodes = _triangle()
+        a, b = nodes[0], nodes[1]
+        try:
+            a.send_to_nodes("just a string")
+            assert wait_until(
+                lambda: b.message_count_recv >= 1
+                and nodes[2].message_count_recv >= 1)
+            # No computation state was created by plain traffic.
+            assert not a._comps and not b._comps
+        finally:
+            stop_all(nodes)
