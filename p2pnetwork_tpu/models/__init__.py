@@ -53,6 +53,7 @@ from p2pnetwork_tpu.models.triangles import (
     transitivity_sample,
     triangles_per_node,
 )
+from p2pnetwork_tpu.models.vivaldi import Vivaldi, VivaldiState
 from p2pnetwork_tpu.models.walk import RandomWalks, RandomWalksState
 
 __all__ = [
@@ -108,4 +109,6 @@ __all__ = [
     "SIRState",
     "SpanningTree",
     "SpanningTreeState",
+    "Vivaldi",
+    "VivaldiState",
 ]

@@ -1,0 +1,156 @@
+"""Vivaldi network coordinates — decentralized latency embedding, batched.
+
+The classic P2P answer (Dabek et al. 2004; shipped in Vuze/Azureus and
+the Serf/Consul memberlist) to "which replica is CLOSEST to me?"
+without O(N²) pings: every node keeps a Euclidean coordinate plus a
+non-Euclidean *height* (its access-link penalty), and each observed RTT
+acts as a spring pulling the pair toward coordinates whose predicted
+distance ``|xi − xj| + hi + hj`` matches the measurement. Reference
+users would hand-roll this over ``node_message`` ping/ack pairs
+[ref: README.md:20]; here one round is the whole population springing
+at once:
+
+- each live node draws one neighbor from its table (the shared
+  :func:`~p2pnetwork_tpu.models.base.draw_neighbor_slot` sampler — the
+  same draw Gossip and the failure detector use);
+- the "measured" RTT is the graph's edge weight for that link (build
+  latencies with ``from_edges(weights=...)``; unweighted graphs embed
+  hop distance), optionally jittered by ``noise`` to model measurement
+  error;
+- the adaptive-timestep rule from the paper: confidence weight
+  ``w = ei/(ei+ej)``, relative error of the sample, an EWMA of each
+  node's error estimate (``ce``), and step ``δ = cc·w`` scaling the
+  spring displacement — with the height update pulling both ends'
+  access penalties toward the residual.
+
+Deterministic given the PRNG key; dead nodes hold position (their error
+stays at the 1.0 ceiling, matching a peer that answers no pings).
+``stats['rmse']`` tracks embedding quality over the SAMPLED springs per
+round; converge with ``engine.run_until_converged(..., stat="rmse",
+threshold=...)`` sized to the latency scale, or run fixed rounds like
+the real systems do (they never stop springing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class VivaldiState:
+    coord: jax.Array  # f32[N_pad, dim] — Euclidean part
+    height: jax.Array  # f32[N_pad] — access-link penalty (>= 0)
+    ce: jax.Array  # f32[N_pad] — local error estimate in [0, 1]
+    round: jax.Array  # i32[]
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class Vivaldi:
+    """Height-vector Vivaldi over the neighbor table.
+
+    ``dim``: Euclidean dimensions (the paper found 2-3 + height ample);
+    ``cc``/``ce_gain``: the paper's c_c and c_e gains; ``noise``:
+    multiplicative RTT jitter amplitude (0 = exact measurements);
+    ``height_min``: the positive height floor (Serf's HeightMin) — the
+    height update scales by the current height, so an exact zero would
+    be absorbing and the access-link term could never learn; the floor
+    keeps it live. Size it well below the latency scale."""
+
+    dim: int = 2
+    cc: float = 0.25
+    ce_gain: float = 0.25
+    noise: float = 0.0
+    height_min: float = 1e-3
+
+    def init(self, graph: Graph, key: jax.Array) -> VivaldiState:
+        if graph.neighbors is None or not graph.neighbors_complete:
+            raise ValueError(
+                "Vivaldi needs the complete neighbor table "
+                "(build with from_edges(build_neighbor_table=True))")
+        n_pad = graph.n_nodes_padded
+        # Tiny random spread instead of the all-at-origin cold start (the
+        # paper's zero-start needs the random unit-vector escape hatch
+        # every round; a seeded spread reaches the same embeddings with
+        # one fewer special case in the batched update).
+        coord = 1e-3 * jax.random.normal(key, (n_pad, self.dim),
+                                         dtype=jnp.float32)
+        return VivaldiState(
+            coord=coord * graph.node_mask[:, None],
+            height=jnp.full(n_pad, self.height_min, dtype=jnp.float32),
+            ce=jnp.ones(n_pad, dtype=jnp.float32),
+            round=jnp.int32(0),
+        )
+
+    def predicted(self, state: VivaldiState, i, j) -> jax.Array:
+        """Predicted latency between node index arrays ``i`` and ``j``."""
+        d = jnp.linalg.norm(state.coord[i] - state.coord[j], axis=-1)
+        return d + state.height[i] + state.height[j]
+
+    def step(self, graph: Graph, state: VivaldiState, key: jax.Array):
+        k_pick, k_noise = jax.random.split(key)
+        slot, partner, has = base.draw_neighbor_slot(graph, k_pick)
+        active = has & graph.node_mask & graph.node_mask[partner]
+
+        # The sampled spring's measured RTT: the stored link weight
+        # (aligned neighbor_weight view), hop cost 1 when unweighted.
+        if graph.neighbor_weight is not None:
+            rtt = jnp.take_along_axis(graph.neighbor_weight,
+                                      slot[:, None], axis=1)[:, 0]
+        else:
+            rtt = jnp.ones(graph.n_nodes_padded, dtype=jnp.float32)
+        if self.noise > 0.0:
+            jitter = 1.0 + self.noise * jax.random.uniform(
+                k_noise, rtt.shape, minval=-1.0, maxval=1.0)
+            rtt = rtt * jitter
+
+        xi, xj = state.coord, state.coord[partner]
+        hi, hj = state.height, state.height[partner]
+        dvec = xi - xj
+        dist = jnp.linalg.norm(dvec, axis=-1)
+        pred = dist + hi + hj
+        # Unit vector; coincident points separate along a random axis is
+        # the paper's rule — the seeded init makes coincidence measure
+        # zero, so a safe-denominator is all that is needed.
+        unit = dvec / jnp.maximum(dist, 1e-9)[:, None]
+
+        w = state.ce / jnp.maximum(state.ce + state.ce[partner], 1e-9)
+        err = pred - rtt  # positive: we predict too far -> pull closer
+        rel_err = jnp.abs(err) / jnp.maximum(rtt, 1e-9)
+        delta = self.cc * w
+
+        # Spring displacement splits between the Euclidean part and the
+        # height (the height-vector force of the paper: both ends'
+        # penalties absorb a share of the residual).
+        move = (-delta * err)[:, None] * unit
+        coord = jnp.where(active[:, None],
+                          xi + move, xi)
+        height = jnp.where(
+            active,
+            jnp.maximum(hi - delta * err * (hi / jnp.maximum(pred, 1e-9)),
+                        self.height_min),
+            hi)
+        ce = jnp.where(
+            active,
+            jnp.clip(rel_err * (self.ce_gain * w)
+                     + state.ce * (1.0 - self.ce_gain * w), 0.0, 1.0),
+            state.ce)
+
+        new_state = VivaldiState(coord=coord, height=height, ce=ce,
+                                 round=state.round + 1)
+        n_act = jnp.maximum(jnp.sum(active), 1)
+        stats = {
+            "messages": jnp.sum(active),  # one ping/ack per sampled spring
+            "rmse": jnp.sqrt(jnp.sum(jnp.where(active, err * err, 0.0))
+                             / n_act),
+            "mean_rel_err": jnp.sum(jnp.where(active, rel_err, 0.0)) / n_act,
+            "mean_ce": jnp.sum(jnp.where(graph.node_mask, ce, 0.0))
+            / jnp.maximum(jnp.sum(graph.node_mask), 1),
+        }
+        return new_state, stats

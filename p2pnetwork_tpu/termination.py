@@ -69,6 +69,12 @@ class TerminationNode(Node):
         # Mutated only on the event loop.
         self._comps: Dict[str, _Comp] = {}
         self._active_comp: Optional[str] = None  # set while handler runs
+        # Root-side id ledger, reserved SYNCHRONOUSLY in start_diffusing:
+        # checking _comps alone races the posted closure that creates the
+        # entry (a second start_diffusing can sneak in before the loop
+        # runs the first), so the reservation must happen caller-side.
+        self._cids_used: set = set()
+        self._cid_lock = threading.Lock()
         # Local-completion events, creatable from ANY thread (setdefault
         # under the GIL): wait_terminated must work even before the
         # posted start_diffusing closure has created the comp entry.
@@ -104,14 +110,25 @@ class TerminationNode(Node):
         # Eager, caller-visible rejection: raised inside the posted
         # closure it would vanish into asyncio's exception handler and
         # the caller would mistake the OLD run's completion for this
-        # one's. _term_events doubles as the ledger of every id this
-        # node has ever run or engaged in (see wait_terminated).
-        if cid in self._comps or cid in self._term_events:
-            raise ValueError(f"computation id {cid!r} already used")
+        # one's. The reservation is synchronous (lock-guarded ledger) so
+        # two back-to-back calls cannot both pass before the loop runs.
+        with self._cid_lock:
+            # All three ledgers matter: _cids_used catches root-side
+            # reuse racing the posted closure; _comps catches an id this
+            # node is currently ENGAGED in as a non-root (rooting it too
+            # would clobber the engagement and orphan the real root's
+            # ack); _term_events catches an id we already detached from
+            # (its set event would make wait_terminated lie about the
+            # new run).
+            if (cid in self._cids_used or cid in self._comps
+                    or cid in self._term_events):
+                raise ValueError(f"computation id {cid!r} already used")
+            self._cids_used.add(cid)
 
         def _do():
             if cid in self._comps:
-                return  # racing duplicate post of the same id
+                return  # engaged via marker since the reservation — the
+                #         engagement wins; rooting would clobber it
             self._comps[cid] = _Comp(engager=None, is_root=True)
             self._run_handler(None, cid, data)
 
@@ -152,9 +169,9 @@ class TerminationNode(Node):
         """Block until this node DETACHES from ``comp_id`` — at the root,
         that is global termination — or ``timeout`` elapses (False).
 
-        Completed ids stay on record (that record is also what rejects
-        id reuse); a long-lived node launching unbounded computations
-        should :meth:`forget_computation` ids it is done asking about."""
+        Completed ids stay on record; a long-lived node launching
+        unbounded computations should :meth:`forget_computation` ids it
+        is done asking about (that also releases them for reuse)."""
         return self._term_events.setdefault(
             comp_id, threading.Event()).wait(timeout)
 
@@ -163,6 +180,8 @@ class TerminationNode(Node):
         allow the id's reuse). No-op while it is still running."""
         if comp_id not in self._comps:
             self._term_events.pop(comp_id, None)
+            with self._cid_lock:
+                self._cids_used.discard(comp_id)
 
     # ------------------------------------------------------ the machinery
 

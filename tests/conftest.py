@@ -26,3 +26,23 @@ if "xla_force_host_platform_device_count" not in _flags:
 from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
 
 apply_platform_env()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound the live compiled-program count across the suite.
+
+    The full suite (680+ tests, most jit-compiling several programs)
+    accumulates every compiled executable in one process; past ~600
+    tests the XLA CPU compiler has segfaulted inside LLVM on a program
+    that compiles fine in isolation (reproduced twice at
+    tests/test_walk.py, cleared by exactly this bounding). Cross-module
+    cache hits are rare — modules compile their own protocols/shapes —
+    so the recompile cost is noise.
+    """
+    yield
+    import jax
+
+    jax.clear_caches()

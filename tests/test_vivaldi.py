@@ -1,0 +1,160 @@
+"""Vivaldi coordinates: does the embedding actually predict latency?
+
+The oracle is the protocol's purpose — after springing, coordinate
+distance must predict the RTTs of links it trained on (and, more
+interestingly, of PAIRS IT NEVER SAMPLED TOGETHER, via the geometry) far
+better than at init. A planted 2-D metric gives ground truth: nodes on
+a grid, link latency = Euclidean ground distance, so the embedding can
+in principle be near-perfect."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models import Vivaldi  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _planted_grid(side=8, connect=2.6, long_links=3):
+    """Nodes on a side x side unit grid; edges between all pairs within
+    ground distance ``connect`` PLUS ``long_links`` random far partners
+    per node, all weighted by true ground distance. The long links
+    matter: with only short-range springs the embedding can satisfy
+    every sampled spring while globally FOLDED (the known Vivaldi
+    cold-start pathology); real deployments measure peers across all
+    RTT scales, which is what the extra links model."""
+    n = side * side
+    xs = np.array([(i % side, i // side) for i in range(n)], np.float32)
+    rng = np.random.default_rng(42)
+    pairs = set()
+    for i in range(n):
+        d = np.linalg.norm(xs - xs[i], axis=1)
+        for j in np.nonzero((d > 0) & (d <= connect))[0]:
+            pairs.add((min(i, int(j)), max(i, int(j))))
+        for j in rng.choice(n, size=long_links, replace=False):
+            if j != i:
+                pairs.add((min(i, int(j)), max(i, int(j))))
+    srcs = np.array([p for a, b in pairs for p in (a, b)], np.int32)
+    dsts = np.array([p for a, b in pairs for p in (b, a)], np.int32)
+    g = G.from_edges(srcs, dsts, n)
+    g = g.with_weights(
+        lambda s, r: jnp.sqrt(jnp.sum(
+            (jnp.asarray(xs)[s] - jnp.asarray(xs)[r]) ** 2, axis=-1)))
+    return g, xs
+
+
+def _pair_error(proto, st, g, xs, rng, k=300):
+    """Median relative error of predicted vs ground distance over random
+    CONNECTED-component pairs (the grid is connected)."""
+    n = xs.shape[0]
+    i = rng.integers(0, n, size=k)
+    j = rng.integers(0, n, size=k)
+    keep = i != j
+    i, j = i[keep], j[keep]
+    pred = np.asarray(proto.predicted(st, jnp.asarray(i), jnp.asarray(j)))
+    true = np.linalg.norm(xs[i] - xs[j], axis=1)
+    return float(np.median(np.abs(pred - true) / true))
+
+
+class TestVivaldi:
+    def test_embeds_a_planted_metric(self):
+        g, xs = _planted_grid()
+        proto = Vivaldi(dim=2)
+        st0 = proto.init(g, jax.random.key(0))
+        rng = np.random.default_rng(0)
+        err0 = _pair_error(proto, st0, g, xs, rng)
+        # The trajectory has a slow unfolding plateau (~rounds 100-700)
+        # before collapsing to a near-exact embedding; 1500 rounds is
+        # comfortably past it.
+        st, out = engine.run(g, proto, jax.random.key(1), 1500)
+        err = _pair_error(proto, st, g, xs, rng)
+        # Init coords are a 1e-3 blob: initial relative error ~ 1.
+        assert err0 > 0.5
+        assert err < 0.05, f"median relative error {err:.3f} after springing"
+        # Per-round sampled rmse fell accordingly.
+        assert float(np.asarray(out["rmse"])[-1]) < 0.2 * float(
+            np.asarray(out["rmse"])[0])
+
+    def test_predicts_unsampled_pairs(self):
+        # The whole point of coordinates: pairs far beyond any single
+        # link (ground distance >> connect radius) are predicted through
+        # the geometry.
+        g, xs = _planted_grid()
+        proto = Vivaldi(dim=2)
+        st, _ = engine.run(g, proto, jax.random.key(1), 1500)
+        rng = np.random.default_rng(1)
+        n = xs.shape[0]
+        i = rng.integers(0, n, size=500)
+        j = rng.integers(0, n, size=500)
+        far = np.linalg.norm(xs[i] - xs[j], axis=1) > 5.0  # >> connect=2.6
+        i, j = i[far], j[far]
+        pred = np.asarray(proto.predicted(st, jnp.asarray(i), jnp.asarray(j)))
+        true = np.linalg.norm(xs[i] - xs[j], axis=1)
+        assert float(np.median(np.abs(pred - true) / true)) < 0.05
+
+    def test_noise_tolerance(self):
+        g, xs = _planted_grid()
+        proto = Vivaldi(dim=2, noise=0.2)
+        st, _ = engine.run(g, proto, jax.random.key(1), 1500)
+        rng = np.random.default_rng(2)
+        assert _pair_error(proto, st, g, xs, rng) < 0.3
+
+    def test_error_estimate_drops(self):
+        g, _ = _planted_grid()
+        proto = Vivaldi(dim=2)
+        st, out = engine.run(g, proto, jax.random.key(1), 1500)
+        ce = np.asarray(out["mean_ce"])
+        assert ce[-1] < 0.05 and ce[-1] < 0.1 * ce[0]
+
+    def test_height_learns_access_penalties(self):
+        # Two "stub" nodes carry a +3.0 access-link penalty on every RTT
+        # (the non-Euclidean residual heights exist for). Regression for
+        # an absorbing-zero height update: with height multiplicative in
+        # itself, a 0.0 init could never learn — the positive floor
+        # (Serf's HeightMin) keeps the term live.
+        g, xs = _planted_grid()
+        pen = np.zeros(xs.shape[0], np.float32)
+        stubs = [10, 53]
+        pen[stubs] = 3.0
+        xj, pj = jnp.asarray(xs), jnp.asarray(pen)
+        g = g.with_weights(
+            lambda s, r: jnp.sqrt(jnp.sum((xj[s] - xj[r]) ** 2, axis=-1))
+            + pj[s] + pj[r])
+        proto = Vivaldi(dim=2)
+        st, _ = engine.run(g, proto, jax.random.key(1), 3000)
+        h = np.asarray(st.height)[:xs.shape[0]]
+        assert np.allclose(h[stubs], 3.0, atol=0.1), h[stubs]
+        assert float(np.delete(h, stubs).mean()) < 0.1
+        n = xs.shape[0]
+        i = np.arange(n)
+        j = (i + 17) % n
+        pred = np.asarray(proto.predicted(st, jnp.asarray(i), jnp.asarray(j)))
+        true = np.linalg.norm(xs[i] - xs[j], axis=1) + pen[i] + pen[j]
+        assert float(np.median(np.abs(pred - true) / true)) < 0.05
+
+    def test_dead_nodes_hold_position(self):
+        g, _ = _planted_grid()
+        dead = np.array([3, 17, 40])
+        g = failures.fail_nodes(g, dead)
+        proto = Vivaldi(dim=2)
+        st0 = proto.init(g, jax.random.key(0))
+        st, _ = engine.run(g, proto, jax.random.key(1), 100)
+        assert np.allclose(np.asarray(st.coord)[dead],
+                           np.asarray(st0.coord)[dead])
+        assert (np.asarray(st.ce)[dead] == 1.0).all()
+
+    def test_deterministic(self):
+        g, _ = _planted_grid(side=5)
+        proto = Vivaldi(dim=2)
+        st1, _ = engine.run(g, proto, jax.random.key(1), 50)
+        st2, _ = engine.run(g, proto, jax.random.key(1), 50)
+        assert (np.asarray(st1.coord) == np.asarray(st2.coord)).all()
+
+    def test_requires_neighbor_table(self):
+        g = G.watts_strogatz(32, 4, 0.1, seed=1,
+                             build_neighbor_table=False)
+        with pytest.raises(ValueError):
+            Vivaldi().init(g, jax.random.key(0))
