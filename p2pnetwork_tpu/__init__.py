@@ -22,6 +22,7 @@ from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyCon
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.causal import CausalNode
+from p2pnetwork_tpu.coordnode import CoordinateNode
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
 from p2pnetwork_tpu.termination import TerminationNode
@@ -32,6 +33,7 @@ __all__ = [
     "Node",
     "NodeConnection",
     "CausalNode",
+    "CoordinateNode",
     "SecureNode",
     "SnapshotNode",
     "TerminationNode",
