@@ -42,6 +42,7 @@ from p2pnetwork_tpu.models.labelprop import (
 from p2pnetwork_tpu.models.leader import LeaderElection, LeaderElectionState
 from p2pnetwork_tpu.models.mis import LubyMIS, LubyMISState
 from p2pnetwork_tpu.models.pagerank import PageRank, PageRankState
+from p2pnetwork_tpu.models.plumtree import Plumtree, PlumtreeState
 from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
 from p2pnetwork_tpu.models.routing import DistanceVector, DistanceVectorState
 from p2pnetwork_tpu.models.sir import SIR, SIRState
@@ -101,6 +102,8 @@ __all__ = [
     "LubyMISState",
     "PageRank",
     "PageRankState",
+    "Plumtree",
+    "PlumtreeState",
     "PushSum",
     "PushSumState",
     "RandomWalks",

@@ -1,0 +1,134 @@
+"""Plumtree: flood -> tree -> heal, each phase pinned by its invariant.
+
+Broadcast 1 floods (duplicates ~ E - N); broadcast 2 rides the pruned
+tree (exactly n_live - 1 messages, zero duplicates, full coverage — a
+spanning-arborescence check against the recorded eager set); after
+killing nodes, the next broadcast grafts lazy links and still covers
+every live node reachable in the residual graph."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import Plumtree  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _run_broadcasts(g, k, state=None, source=0):
+    p = Plumtree(source=source)
+    if state is None:
+        state = p.init(g, jax.random.key(0))
+    step = jax.jit(p.step)
+    outs = []
+    for _ in range(k):
+        state, stats = step(g, state, jax.random.key(0))
+        outs.append({k2: np.asarray(v) for k2, v in stats.items()})
+    return p, state, outs
+
+
+def _check_tree(g, state, source):
+    """The eager set restricted to live edges into reached nodes is a
+    spanning arborescence: every live node but the source has exactly
+    one eager live in-edge, and parents chain back to the source."""
+    s = np.asarray(g.senders)
+    r = np.asarray(g.receivers)
+    alive = np.asarray(g.node_mask)
+    em = (np.asarray(g.edge_mask) & np.asarray(state.eager)
+          & alive[s] & alive[r])
+    live_ids = np.nonzero(alive)[0]
+    indeg = np.zeros(g.n_nodes_padded, np.int32)
+    np.add.at(indeg, r[em], 1)
+    assert indeg[source] == 0
+    others = live_ids[live_ids != source]
+    assert (indeg[others] == 1).all(), "not a tree: in-degree != 1"
+    parent = np.full(g.n_nodes_padded, -1, np.int64)
+    parent[r[em]] = s[em]
+    for v in others:
+        seen, x = set(), int(v)
+        while x != source:
+            assert x not in seen, "cycle in eager set"
+            seen.add(x)
+            x = int(parent[x])
+            assert x >= 0, "orphaned node"
+
+
+class TestPlumtree:
+    def test_flood_then_tree(self):
+        g = G.watts_strogatz(500, 6, 0.1, seed=2)
+        n = 500
+        p, st, outs = _run_broadcasts(g, 3)
+        b1, b2, b3 = outs
+        # Broadcast 1: full flood — every live directed edge fires.
+        assert b1["coverage"] == pytest.approx(1.0)
+        assert b1["messages"] == g.n_edges
+        assert b1["duplicates"] > g.n_edges - n - 50
+        # Broadcast 2: the pruned tree — n-1 messages, zero duplicates.
+        assert b2["coverage"] == pytest.approx(1.0)
+        assert b2["messages"] == n - 1
+        assert b2["duplicates"] == 0
+        assert b2["eager_edges"] == n - 1
+        assert b2["grafts"] == 0
+        # Stable thereafter.
+        assert b3["messages"] == n - 1 and b3["duplicates"] == 0
+        _check_tree(g, st, 0)
+
+    def test_heal_after_failures(self):
+        g = G.watts_strogatz(400, 8, 0.2, seed=5)
+        p, st, outs = _run_broadcasts(g, 2)
+        # Kill 30 non-source nodes: tree links die with them.
+        rng = np.random.default_rng(0)
+        dead = rng.choice(np.arange(1, 400), size=30, replace=False)
+        gf = failures.fail_nodes(g, dead)
+        p2, st2, outs2 = _run_broadcasts(gf, 2, state=st)
+        h1, h2 = outs2
+        # The healing broadcast still reaches everyone (WS at degree 8
+        # stays connected under 30 losses) by grafting lazy links...
+        assert h1["coverage"] == pytest.approx(1.0)
+        assert h1["grafts"] > 0
+        # ...and the NEXT broadcast is a clean tree again.
+        n_live = 400 - len(dead)
+        assert h2["messages"] == n_live - 1
+        assert h2["duplicates"] == 0
+        _check_tree(gf, st2, 0)
+
+    def test_disconnected_component_unreachable(self):
+        # Two cliques, no bridge: the far clique can never be covered —
+        # grafting must give up instead of spinning.
+        half = 8
+        edges = []
+        for base in (0, half):
+            for i in range(half):
+                for j in range(i + 1, half):
+                    edges.append((base + i, base + j))
+        s = np.array([e[0] for e in edges] + [e[1] for e in edges],
+                     np.int32)
+        r = np.array([e[1] for e in edges] + [e[0] for e in edges],
+                     np.int32)
+        g = G.from_edges(s, r, 2 * half)
+        p, st, outs = _run_broadcasts(g, 2)
+        assert outs[0]["coverage"] == pytest.approx(0.5)
+        assert outs[1]["messages"] == half - 1
+
+    def test_dead_source_is_silent(self):
+        g = G.watts_strogatz(64, 4, 0.1, seed=1)
+        g = failures.fail_nodes(g, np.array([0]))
+        p, st, outs = _run_broadcasts(g, 1, source=0)
+        assert outs[0]["coverage"] == 0.0
+        assert outs[0]["messages"] == 0
+
+    def test_rejects_dynamic_edge_region(self):
+        from p2pnetwork_tpu.sim import topology
+        g = topology.with_capacity(
+            G.watts_strogatz(64, 4, 0.1, seed=1), extra_edges=4)
+        with pytest.raises(ValueError):
+            Plumtree().init(g, jax.random.key(0))
+
+    def test_engine_integration(self):
+        # Rides the ordinary engine scan like any protocol.
+        g = G.watts_strogatz(200, 4, 0.1, seed=3)
+        st, stats = engine.run(g, Plumtree(source=5), jax.random.key(0), 3)
+        msgs = np.asarray(stats["messages"])
+        assert msgs[0] == g.n_edges and msgs[1] == 199 and msgs[2] == 199
+        assert np.asarray(stats["coverage"])[-1] == pytest.approx(1.0)
