@@ -118,6 +118,51 @@ class TestPlumtree:
         assert outs[0]["coverage"] == 0.0
         assert outs[0]["messages"] == 0
 
+    def test_tree_graph_extraction(self):
+        # Flood over the extracted tree graph = the tree broadcast:
+        # same coverage, exactly n-1 deliveries, no duplicates possible.
+        from p2pnetwork_tpu.models import Flood
+
+        g = G.watts_strogatz(300, 6, 0.1, seed=7)
+        p, st, _ = _run_broadcasts(g, 2)
+        tg = p.tree_graph(g, st)
+        assert tg.n_edges == 299
+        stf, out = engine.run_until_coverage(
+            tg, Flood(source=0), jax.random.key(0), coverage_target=1.0)
+        assert float(out["coverage"]) == pytest.approx(1.0)
+        assert int(out["messages"]) == 299
+
+    def test_tree_graph_respects_dead_nodes(self):
+        g = G.watts_strogatz(200, 8, 0.2, seed=9)
+        p, st, _ = _run_broadcasts(g, 2)
+        dead = np.array([7, 50, 100])
+        gf = failures.fail_nodes(g, dead)
+        p2, st2, _ = _run_broadcasts(gf, 2, state=st)
+        tg = p2.tree_graph(gf, st2)
+        assert not np.asarray(tg.node_mask)[dead].any()
+        s = np.asarray(tg.senders)[np.asarray(tg.edge_mask)]
+        r = np.asarray(tg.receivers)[np.asarray(tg.edge_mask)]
+        assert not np.isin(s, dead).any() and not np.isin(r, dead).any()
+
+    def test_tree_graph_keeps_weights(self):
+        import jax.numpy as jnp
+
+        g = G.watts_strogatz(128, 4, 0.1, seed=11).with_weights(
+            lambda s, r: 1.0 + (jnp.minimum(s, r) % 7).astype(jnp.float32))
+        p, st, _ = _run_broadcasts(g, 2)
+        tg = p.tree_graph(g, st)
+        assert tg.edge_weight is not None
+        # Every extracted edge keeps its source-graph cost.
+        src_w = {}
+        s0 = np.asarray(g.senders); r0 = np.asarray(g.receivers)
+        w0 = np.asarray(g.edge_weight); em0 = np.asarray(g.edge_mask)
+        for a, b, w in zip(s0[em0], r0[em0], w0[em0]):
+            src_w[(int(a), int(b))] = float(w)
+        s1 = np.asarray(tg.senders); r1 = np.asarray(tg.receivers)
+        w1 = np.asarray(tg.edge_weight); em1 = np.asarray(tg.edge_mask)
+        for a, b, w in zip(s1[em1], r1[em1], w1[em1]):
+            assert src_w[(int(a), int(b))] == float(w)
+
     def test_rejects_dynamic_edge_region(self):
         from p2pnetwork_tpu.sim import topology
         g = topology.with_capacity(
