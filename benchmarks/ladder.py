@@ -271,6 +271,56 @@ def bench_discovery(n=1_000_000, walkers=4096):
     })
 
 
+def bench_plumtree(n=1_000_000):
+    """Broadcast-tree rung: Plumtree's self-optimization contrast at 1M —
+    the first broadcast floods every edge; the extracted tree
+    (models/plumtree.py tree_graph) then carries repeated broadcasts at
+    ~N messages. Emits the steady-state (extracted-tree) broadcast time."""
+    import jax
+
+    from p2pnetwork_tpu.models import Flood, Plumtree
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+
+    t0 = time.perf_counter()
+    g = G.watts_strogatz(n, 10, 0.1, seed=0, build_neighbor_table=False)
+    build_s = time.perf_counter() - t0
+    p = Plumtree(source=0)
+    st = p.init(g, jax.random.key(0))
+    st, stats0 = jax.jit(p.step)(g, st, jax.random.key(0))  # flood + prune
+    flood_msgs = int(stats0["messages"])
+    t0 = time.perf_counter()
+    # The tree's max in-degree is 1: its neighbor table is one column
+    # wide and the gather lowering is as cheap as aggregation gets.
+    tg = p.tree_graph(g, st, source_csr=True)
+    extract_s = time.perf_counter() - t0
+
+    def once():
+        _, out = engine.run_until_coverage(
+            tg, Flood(source=0), jax.random.key(0), coverage_target=1.0,
+            max_rounds=256)
+        return out
+
+    out = once()  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = once()
+        times.append(time.perf_counter() - t0)
+    emit({
+        "config": f"{n//1_000_000}M WS Plumtree broadcast tree "
+                  f"(single chip)",
+        "value": round(min(times), 3),
+        "unit": "s per steady-state broadcast over the extracted tree",
+        "rounds": int(out["rounds"]),
+        "messages": int(out["messages"]),
+        "flood_messages": flood_msgs,
+        "message_reduction": round(flood_msgs / int(out["messages"]), 1),
+        "extract_s": round(extract_s, 1),
+        "graph_build_s": round(build_s, 1),
+    })
+
+
 def bench_routing(n=1_000_000):
     """Weighted routing rung: latency-weighted distance-vector tables
     for the whole overlay (models/routing.py DistanceVector — one
@@ -489,6 +539,7 @@ def main():
     bench_flood_auto()
     bench_flood_ba()
     bench_discovery()
+    bench_plumtree()
     bench_routing()
     bench_flood_big(1_000_000, "1M WS seen-set flood (single chip)")
     if args.full:

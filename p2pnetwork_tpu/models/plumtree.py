@@ -96,27 +96,33 @@ class Plumtree:
         ``from_edges_kwargs`` to pick layouts."""
         import numpy as np
 
-        em = (np.asarray(graph.edge_mask) & np.asarray(state.eager)
-              & np.asarray(graph.node_mask)[np.asarray(graph.senders)]
-              & np.asarray(graph.node_mask)[np.asarray(graph.receivers)])
         from p2pnetwork_tpu.sim.graph import from_edges
 
+        if graph.dyn_senders is not None:
+            # Same refuse-rather-than-mislead rule as init: runtime
+            # links would silently vanish from the extracted tree.
+            raise ValueError(
+                "Plumtree does not track the dynamic edge region; "
+                "consolidate the graph first")
+        s = np.asarray(graph.senders)
+        r = np.asarray(graph.receivers)
+        alive = np.asarray(graph.node_mask)
+        em = (np.asarray(graph.edge_mask) & np.asarray(state.eager)
+              & alive[s] & alive[r])
         if graph.edge_weight is not None:
             # Carry link costs through the extraction (the same rule as
             # topology.consolidate): a weighted overlay's tree must not
             # silently decay to unit costs for weighted protocols.
             from_edges_kwargs.setdefault(
                 "weights", np.asarray(graph.edge_weight)[em])
-        g = from_edges(np.asarray(graph.senders)[em],
-                       np.asarray(graph.receivers)[em],
-                       graph.n_nodes, **from_edges_kwargs)
-        if graph.n_nodes_padded != g.n_nodes_padded:
-            raise ValueError(
-                "node padding changed across extraction — pass the same "
-                "node_pad_multiple as the source graph")
-        import dataclasses as _dc
-
-        return _dc.replace(g, node_mask=graph.node_mask & g.node_mask)
+        # Pad to the source graph's node extent: ids and masks then line
+        # up slot-for-slot whatever pad multiple the source was built
+        # with (n_nodes <= n_nodes_padded makes the round-up exact).
+        from_edges_kwargs.setdefault("node_pad_multiple",
+                                     graph.n_nodes_padded)
+        g = from_edges(s[em], r[em], graph.n_nodes, **from_edges_kwargs)
+        return dataclasses.replace(g,
+                                   node_mask=graph.node_mask & g.node_mask)
 
     def step(self, graph: Graph, state: PlumtreeState, key: jax.Array):
         n_pad = graph.n_nodes_padded
