@@ -120,6 +120,13 @@ class Plumtree:
         # with (n_nodes <= n_nodes_padded makes the round-up exact).
         from_edges_kwargs.setdefault("node_pad_multiple",
                                      graph.n_nodes_padded)
+        m = from_edges_kwargs["node_pad_multiple"]
+        if -(-graph.n_nodes // m) * m != graph.n_nodes_padded:
+            # A caller-supplied multiple that disagrees would only
+            # surface as a cryptic shape error after the full build.
+            raise ValueError(
+                f"node_pad_multiple={m} pads to a different node extent "
+                f"than the source graph's {graph.n_nodes_padded}")
         g = from_edges(s[em], r[em], graph.n_nodes, **from_edges_kwargs)
         return dataclasses.replace(g,
                                    node_mask=graph.node_mask & g.node_mask)

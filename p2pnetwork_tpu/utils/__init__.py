@@ -1,6 +1,9 @@
-"""Small shared utilities: node ids, debug printing, structured event log."""
+"""Small shared utilities: node ids, debug printing, structured event
+log, consistent hashing."""
 
+from p2pnetwork_tpu.utils.chash import HashRing, hash_keys, moved_fraction
 from p2pnetwork_tpu.utils.ids import generate_id
 from p2pnetwork_tpu.utils.logging import EventLog, EventRecord
 
-__all__ = ["generate_id", "EventLog", "EventRecord"]
+__all__ = ["generate_id", "EventLog", "EventRecord", "HashRing",
+           "hash_keys", "moved_fraction"]

@@ -1,0 +1,95 @@
+"""Consistent hashing: the two load-bearing properties (balance,
+minimal disruption) plus replica sets, determinism, and edge cases."""
+
+import numpy as np
+import pytest
+
+from p2pnetwork_tpu.utils import HashRing, hash_keys, moved_fraction
+
+
+def _ring(n=10, vnodes=128):
+    return HashRing([f"peer-{i}" for i in range(n)], vnodes=vnodes)
+
+
+class TestBalance:
+    def test_load_spreads_with_vnodes(self):
+        r = _ring(10, vnodes=256)
+        loads = list(r.load_fractions().values())
+        assert sum(loads) == pytest.approx(1.0)
+        # 10 peers -> 10% each; 256 vnodes keeps the spread tight-ish.
+        assert max(loads) < 0.2 and min(loads) > 0.03
+
+    def test_few_vnodes_skew_worse(self):
+        tight = max(_ring(10, vnodes=512).load_fractions().values())
+        loose = max(_ring(10, vnodes=1).load_fractions(seed=1).values())
+        assert tight < loose
+
+
+class TestDisruption:
+    def test_single_join_moves_about_one_nth(self):
+        r = _ring(10)
+        r2 = r.add("peer-new")
+        moved = moved_fraction(r, r2)
+        # The newcomer takes ~1/11 of the space; nothing else moves.
+        assert 0.02 < moved < 0.25
+        # And the moved keys all moved TO the newcomer.
+        rng = np.random.default_rng(3)
+        pos = rng.integers(0, 2**64 - 1, size=4096, dtype=np.uint64)
+        a, b = r.owners_at(pos), r2.owners_at(pos)
+        assert all(y == "peer-new" for x, y in zip(a, b) if x != y)
+
+    def test_single_leave_moves_only_its_slice(self):
+        r = _ring(10)
+        r2 = r.remove("peer-3")
+        rng = np.random.default_rng(4)
+        pos = rng.integers(0, 2**64 - 1, size=4096, dtype=np.uint64)
+        a, b = r.owners_at(pos), r2.owners_at(pos)
+        assert all(x == "peer-3" for x, y in zip(a, b) if x != y)
+
+    def test_modulo_hashing_contrast(self):
+        # The property modulo assignment lacks: adding one bucket to
+        # hash % n reassigns ~all keys; the ring moves ~1/n.
+        keys = [f"k{i}" for i in range(4096)]
+        pos = hash_keys(keys)
+        mod10 = pos % np.uint64(10)
+        mod11 = pos % np.uint64(11)
+        mod_moved = float(np.mean(mod10 != mod11))
+        ring_moved = moved_fraction(_ring(10), _ring(10).add("peer-new"))
+        assert mod_moved > 0.8
+        assert ring_moved < 0.25
+
+
+class TestLookups:
+    def test_deterministic_across_instances(self):
+        a, b = _ring(), _ring()
+        for k in ("alpha", b"raw-bytes", 12345):
+            assert a.owner(k) == b.owner(k)
+
+    def test_replica_sets_distinct_and_stable(self):
+        r = _ring(8)
+        reps = r.owners("some-key", k=3)
+        assert len(reps) == 3 and len(set(reps)) == 3
+        assert reps[0] == r.owner("some-key")
+        # k above the peer count: everyone, once.
+        assert sorted(r.owners("some-key", k=99)) == sorted(r.node_ids)
+
+    def test_zero_replicas_empty(self):
+        r = _ring(6)
+        assert r.owners("k", k=0) == []
+        assert r.owners("k", k=-2) == []
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(ValueError):
+            HashRing().owner("x")
+        with pytest.raises(ValueError):
+            HashRing(["a"], vnodes=0)
+
+    def test_add_remove_roundtrip(self):
+        r = _ring(6)
+        r2 = r.add("extra").remove("extra")
+        assert r2.node_ids == r.node_ids
+        assert moved_fraction(r, r2) == 0.0
+
+    def test_duplicate_ids_collapse(self):
+        r = HashRing(["a", "b", "a"], vnodes=16)
+        assert r.node_ids == ("a", "b")
