@@ -28,6 +28,7 @@ from p2pnetwork_tpu.models.components import (
 )
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
+from p2pnetwork_tpu.models.hits import HITS, HITSState
 from p2pnetwork_tpu.models.hopdist import (
     HopDistance,
     HopDistanceState,
@@ -90,6 +91,8 @@ __all__ = [
     "FloodState",
     "Gossip",
     "GossipState",
+    "HITS",
+    "HITSState",
     "HopDistance",
     "HopDistanceState",
     "KCore",

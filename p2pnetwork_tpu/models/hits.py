@@ -1,0 +1,120 @@
+"""HITS — hubs and authorities, PageRank's directional companion.
+
+*Who aggregates (hubs) and who is aggregated (authorities)?* Kleinberg's
+mutual-reinforcement pair: a good hub points at good authorities, a good
+authority is pointed at by good hubs. On a symmetric overlay the two
+coincide with eigenvector centrality; the distinction earns its keep on
+DIRECTED views — e.g. a ``from_edges`` graph of who-initiated-connection
+-to-whom, where hubs are the active dialers and authorities the
+well-known rendezvous peers. Another offline-dump analysis [ref:
+p2pnetwork/node.py:75-78] turned into a protocol behind the
+models/base.py seam.
+
+One synchronous round is the textbook double power step with L2
+normalization:
+
+    a'[v] = Σ_{u→v} h[u]        (authority: in-edge sum of hub scores)
+    h'[v] = Σ_{v→u} a'[u]       (hub: out-edge sum of new authorities)
+
+The hub update sums over OUT-edges: ``h'[u] = Σ_e [s_e = u] a'[r_e]``
+is a segment sum keyed by SENDER, which the receiver-sorted edge layout
+does not directly provide. When the graph carries the source-CSR view
+(``from_edges(source_csr=True)``), its sender-sorted edge permutation
+turns the hub sum into the same sorted-segment reduction as the
+authority side; otherwise an unsorted scatter-add does it — both exact,
+the CSR path bandwidth-friendly. Runtime (dynamic-region) links fold
+into both directions.
+
+Converge with ``engine.run_until_converged(..., stat="residual",
+threshold=...)``; deterministic, no RNG. Dead nodes hold score 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HITSState:
+    hub: jax.Array  # f32[N_pad] — L2-normalized over live nodes
+    authority: jax.Array  # f32[N_pad]
+    residual: jax.Array  # f32[] — L1 change of both vectors last round
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class HITS:
+    """Kleinberg's hubs/authorities by alternating power iteration."""
+
+    method: str = "auto"
+
+    def init(self, graph: Graph, key: jax.Array) -> HITSState:
+        mask_f = graph.node_mask.astype(jnp.float32)
+        n = jnp.maximum(jnp.sum(mask_f), 1.0)
+        v = mask_f / jnp.sqrt(n)  # unit L2 over live nodes
+        return HITSState(hub=v, authority=v,
+                         residual=jnp.float32(jnp.inf))
+
+    def _out_sum(self, graph: Graph, signal: jax.Array) -> jax.Array:
+        """Per-node sum of ``signal`` over OUT-neighbors:
+        ``out[u] = sum(signal[r_e], e: s_e = u)``."""
+        s, r = graph.senders, graph.receivers
+        live = graph.edge_mask & graph.node_mask[s] & graph.node_mask[r]
+        vals = jnp.where(live, signal[r], 0.0)
+        if graph.src_eid is not None:
+            # Source-CSR: reorder edge slots sender-sorted, then the
+            # same sorted-segment reduction as the receiver side. Slots
+            # past src_offsets[-1] are PADDING whose sentinel (e_pad - 1)
+            # can name a LIVE edge when the edge count is an exact pad
+            # multiple (graph.py _build_source_csr docstring) — mask
+            # them or a live edge's contribution double-counts.
+            order = graph.src_eid
+            slot_ok = (jnp.arange(order.shape[0], dtype=jnp.int32)
+                       < graph.src_offsets[-1])
+            out = jax.ops.segment_sum(
+                jnp.where(slot_ok, vals[order], 0.0),
+                jnp.where(slot_ok, s[order], graph.n_nodes_padded),
+                num_segments=graph.n_nodes_padded,
+                indices_are_sorted=True)
+        else:
+            out = (jnp.zeros(graph.n_nodes_padded, jnp.float32)
+                   .at[jnp.where(live, s, graph.n_nodes_padded)]
+                   .add(vals, mode="drop"))
+        if graph.dyn_senders is not None:
+            dlive = (graph.dyn_mask & graph.node_mask[graph.dyn_senders]
+                     & graph.node_mask[graph.dyn_receivers])
+            out = out.at[jnp.where(dlive, graph.dyn_senders,
+                                   graph.n_nodes_padded)].add(
+                jnp.where(dlive, signal[graph.dyn_receivers], 0.0),
+                mode="drop")
+        return out * graph.node_mask
+
+    def step(self, graph: Graph, state: HITSState, key: jax.Array):
+        mask = graph.node_mask
+
+        def _norm(x):
+            return x / jnp.maximum(jnp.sqrt(jnp.sum(x * x)), 1e-30)
+
+        authority = _norm(segment.propagate_sum(graph, state.hub,
+                                                self.method))
+        hub = _norm(self._out_sum(graph, authority))
+        authority = authority * mask
+        hub = hub * mask
+        residual = (jnp.sum(jnp.abs(hub - state.hub))
+                    + jnp.sum(jnp.abs(authority - state.authority)))
+        new_state = HITSState(hub=hub, authority=authority,
+                              residual=residual)
+        stats = {
+            # Both sweeps touch every live link, dynamic region included
+            # (frontier_messages counts through the dyn-aware degrees).
+            "messages": 2 * segment.frontier_messages(graph,
+                                                      graph.node_mask),
+            "residual": residual,
+        }
+        return new_state, stats

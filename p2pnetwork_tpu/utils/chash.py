@@ -97,13 +97,18 @@ class HashRing:
         """The peer owning one key."""
         return self.owners_at(hash_keys([key]))[0]
 
-    def owners_at(self, positions: np.ndarray) -> List[str]:
-        """Owning peer per u64 ring position (vectorized)."""
+    def owner_indices_at(self, positions: np.ndarray) -> np.ndarray:
+        """Index into ``node_ids`` per u64 ring position — the fully
+        vectorized bulk path (no Python-object materialization)."""
         if not self.node_ids:
             raise ValueError("empty ring")
         idx = np.searchsorted(self._points, positions, side="left")
         idx = np.where(idx == len(self._points), 0, idx)  # ring wrap
-        return [self.node_ids[i] for i in self._owner_idx[idx]]
+        return self._owner_idx[idx]
+
+    def owners_at(self, positions: np.ndarray) -> List[str]:
+        """Owning peer id per u64 ring position."""
+        return [self.node_ids[i] for i in self.owner_indices_at(positions)]
 
     def owners(self, key, k: int = 1) -> List[str]:
         """The first ``k`` DISTINCT peers clockwise from the key — the
@@ -130,11 +135,10 @@ class HashRing:
         """Sampled fraction of key space owned per peer."""
         rng = np.random.default_rng(seed)
         pos = rng.integers(0, int(_SPACE), size=sample, dtype=np.uint64)
-        owners = self.owners_at(pos)
-        counts = {nid: 0 for nid in self.node_ids}
-        for o in owners:
-            counts[o] += 1
-        return {nid: c / sample for nid, c in counts.items()}
+        idx = self.owner_indices_at(pos)
+        counts = np.bincount(idx, minlength=len(self.node_ids))
+        return {nid: int(c) / sample
+                for nid, c in zip(self.node_ids, counts)}
 
 
 def moved_fraction(before: HashRing, after: HashRing,
@@ -144,6 +148,11 @@ def moved_fraction(before: HashRing, after: HashRing,
     single join/leave, against ~1 for modulo hashing)."""
     rng = np.random.default_rng(seed)
     pos = rng.integers(0, int(_SPACE), size=sample, dtype=np.uint64)
-    a = before.owners_at(pos)
-    b = after.owners_at(pos)
-    return sum(1 for x, y in zip(a, b) if x != y) / sample
+    # Owner INDICES are ring-local (the id lists differ); resolve to id
+    # strings through one vectorized fancy-index per ring and compare
+    # as arrays — no per-sample Python loop.
+    a = np.asarray(before.node_ids, dtype=object)[
+        before.owner_indices_at(pos)]
+    b = np.asarray(after.node_ids, dtype=object)[
+        after.owner_indices_at(pos)]
+    return float(np.mean(a != b))

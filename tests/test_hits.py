@@ -1,0 +1,123 @@
+"""HITS vs the networkx oracle on directed and undirected graphs, CSR /
+scatter lowering agreement, dynamic links, and dead-node masking."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import networkx as nx  # noqa: E402
+
+from p2pnetwork_tpu.models import HITS  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures, topology  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _nx_hits(g):
+    s = np.asarray(g.senders)
+    r = np.asarray(g.receivers)
+    em = (np.asarray(g.edge_mask)
+          & np.asarray(g.node_mask)[s] & np.asarray(g.node_mask)[r])
+    H = nx.DiGraph()
+    H.add_nodes_from(np.nonzero(np.asarray(g.node_mask))[0].tolist())
+    H.add_edges_from(zip(s[em].tolist(), r[em].tolist()))
+    if g.dyn_senders is not None:
+        dm = np.asarray(g.dyn_mask)
+        H.add_edges_from(zip(np.asarray(g.dyn_senders)[dm].tolist(),
+                             np.asarray(g.dyn_receivers)[dm].tolist()))
+    hubs, auths = nx.hits(H, max_iter=1000, tol=1e-12)
+    h = np.zeros(g.n_nodes_padded)
+    a = np.zeros(g.n_nodes_padded)
+    for v, x in hubs.items():
+        h[v] = x
+    for v, x in auths.items():
+        a[v] = x
+    return h, a
+
+
+def _run(g, rounds=200):
+    p = HITS()
+    st, out = engine.run_until_converged(
+        g, p, jax.random.key(0), stat="residual", threshold=1e-6,
+        max_rounds=rounds)
+    return p, st, out
+
+
+def _compare(g):
+    p, st, _ = _run(g)
+    h_nx, a_nx = _nx_hits(g)
+    # networkx normalizes to sum=1; ours is L2 — compare shapes.
+    for got, want in ((np.asarray(st.hub), h_nx),
+                      (np.asarray(st.authority), a_nx)):
+        gs, ws = got.sum(), want.sum()
+        if ws > 0:
+            np.testing.assert_allclose(got / max(gs, 1e-30),
+                                       want / ws, atol=2e-4)
+
+
+class TestHITS:
+    def test_directed_star(self):
+        # Dialers 1..5 all point at rendezvous node 0: node 0 is the
+        # sole authority, the dialers are the hubs.
+        s = np.arange(1, 6, dtype=np.int32)
+        r = np.zeros(5, dtype=np.int32)
+        g = G.from_edges(s, r, 6, build_neighbor_table=True)
+        p, st, _ = _run(g)
+        a = np.asarray(st.authority)
+        h = np.asarray(st.hub)
+        assert a[0] == pytest.approx(1.0, abs=1e-5)
+        assert np.allclose(a[1:6], 0.0, atol=1e-6)
+        assert np.allclose(h[1:6], h[1], atol=1e-6) and h[1] > 0.4
+        assert h[0] == pytest.approx(0.0, abs=1e-6)
+
+    @pytest.mark.parametrize("build", [
+        lambda: G.watts_strogatz(64, 4, 0.2, seed=3),
+        lambda: G.erdos_renyi(48, 0.1, seed=5),
+    ])
+    def test_matches_networkx(self, build):
+        _compare(build())
+
+    def test_directed_random_matches_networkx(self):
+        rng = np.random.default_rng(0)
+        s = rng.integers(0, 40, size=200).astype(np.int32)
+        r = rng.integers(0, 40, size=200).astype(np.int32)
+        keep = s != r
+        # Dedup directed pairs: nx.DiGraph collapses multi-edges while
+        # from_edges keeps every slot (and HITS would weight them).
+        pairs = sorted(set(zip(s[keep].tolist(), r[keep].tolist())))
+        s = np.array([p[0] for p in pairs], np.int32)
+        r = np.array([p[1] for p in pairs], np.int32)
+        g = G.from_edges(s, r, 40)
+        _compare(g)
+
+    def test_csr_and_scatter_lowerings_agree(self):
+        g0 = G.watts_strogatz(96, 4, 0.2, seed=7)
+        g1 = G.watts_strogatz(96, 4, 0.2, seed=7, source_csr=True)
+        _, st0, _ = _run(g0)
+        _, st1, _ = _run(g1)
+        np.testing.assert_allclose(np.asarray(st0.hub),
+                                   np.asarray(st1.hub), atol=1e-6)
+
+    def test_csr_padding_sentinel_masked(self):
+        # Regression: with the edge count an exact pad multiple, the
+        # source-CSR padding slots all name edge e_pad-1 — a LIVE edge.
+        # Unmasked, its contribution double-counts in the hub sum.
+        g = G.watts_strogatz(96, 4, 0.2, seed=7)  # 384 = 3*128 edges
+        assert g.n_edges == g.n_edges_padded
+        gf = failures.fail_nodes(g, np.array([11]))
+        _, st_plain, _ = _run(gf)
+        _, st_csr, _ = _run(gf.with_source_csr())
+        np.testing.assert_allclose(np.asarray(st_csr.hub),
+                                   np.asarray(st_plain.hub), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(st_csr.authority),
+                                   np.asarray(st_plain.authority),
+                                   atol=1e-6)
+
+    def test_dead_nodes_and_dynamic_links(self):
+        g = G.watts_strogatz(48, 4, 0.2, seed=9)
+        g = failures.fail_nodes(g, np.array([5, 17]))
+        g = topology.with_capacity(g, extra_edges=4)
+        g = topology.connect(g, [2, 30], [30, 2])
+        _compare(g)
+        p, st, _ = _run(g)
+        assert np.asarray(st.hub)[[5, 17]].sum() == 0.0
+        assert np.asarray(st.authority)[[5, 17]].sum() == 0.0
