@@ -27,7 +27,7 @@ from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
 from p2pnetwork_tpu.termination import TerminationNode
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "Node",

@@ -34,6 +34,7 @@ def test_examples_discovered():
         "epidemic_with_failures.py",
         "secure_node_demo.py",
         "snapshot_application.py",
+        "coordination_stack.py",
     ):
         assert required in EXAMPLES, f"missing example: {required}"
 
