@@ -34,6 +34,29 @@ def stop_all(nodes) -> None:
             n.join(timeout=10.0)
 
 
+def run_auto_parity(g, protocol, rounds, key_seed=0):
+    """Shared recipe of the per-protocol GSPMD auto-parity tests: run
+    ``protocol`` over the full-device ring mesh on the auto path and on
+    the single-device engine with the same key, returning both final
+    states for the caller's field assertions. Skips on a single device.
+    Imports lazily so the sockets-only tests keep importing this module
+    without jax."""
+    import jax
+    import pytest
+
+    from p2pnetwork_tpu.parallel import auto
+    from p2pnetwork_tpu.parallel import mesh as M
+    from p2pnetwork_tpu.sim import engine
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = M.ring_mesh(len(jax.devices()))
+    ga = auto.shard_graph_auto(g, mesh)
+    st_a, _ = auto.run_auto(ga, protocol, jax.random.key(key_seed), rounds)
+    st_r, _ = engine.run(g, protocol, jax.random.key(key_seed), rounds)
+    return st_a, st_r
+
+
 class EventRecorder:
     """Callback that records (event, connected_id, data) tuples in order."""
 

@@ -162,18 +162,10 @@ class TestBoruvka:
     def test_auto_path_parity(self):
         # GSPMD auto-sharded run is bit-identical to the engine (the
         # scatter-min phases partition like any other reduction).
-        from p2pnetwork_tpu.parallel import auto
-        from p2pnetwork_tpu.parallel import mesh as M
+        from tests.helpers import run_auto_parity
 
-        n_dev = len(jax.devices())
-        if n_dev < 2:
-            pytest.skip("needs a multi-device mesh")
-        g = _ws_weighted(n=128, seed=13)
-        mesh = M.ring_mesh(n_dev)
-        ga = auto.shard_graph_auto(g, mesh)
-        p = Boruvka()
-        st_a, _ = auto.run_auto(ga, p, jax.random.key(0), 10)
-        st_r, _ = engine.run(g, p, jax.random.key(0), 10)
+        st_a, st_r = run_auto_parity(_ws_weighted(n=128, seed=13),
+                                     Boruvka(), 10)
         assert (np.asarray(st_a.comp) == np.asarray(st_r.comp)).all()
         assert (np.asarray(st_a.mst_edge) == np.asarray(st_r.mst_edge)).all()
 

@@ -119,6 +119,17 @@ class TestBracha:
         assert float(p.coverage(g, st)) == pytest.approx(1.0)
         assert int(out["rounds"]) <= 6
 
+    def test_auto_path_parity(self):
+        # Integer delivery state: exact GSPMD auto parity (the quorum
+        # counts are indicator propagate_sums, exact in any partition).
+        from tests.helpers import run_auto_parity
+
+        p = Bracha(source=0, f=2, byzantine=(3, 5), method="segment")
+        st_a, st_r = run_auto_parity(G.complete(16), p, 8)
+        assert (np.asarray(st_a.value) == np.asarray(st_r.value)).all()
+        assert (np.asarray(st_a.echo_sent)
+                == np.asarray(st_r.echo_sent)).all()
+
     def test_rejects_bad_params(self):
         with pytest.raises(ValueError):
             Bracha(source_value=2)

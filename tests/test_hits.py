@@ -97,6 +97,18 @@ class TestHITS:
         np.testing.assert_allclose(np.asarray(st0.hub),
                                    np.asarray(st1.hub), atol=1e-6)
 
+    def test_auto_path_parity(self):
+        # GSPMD auto-sharded run matches the engine (f32 tolerance: the
+        # normalized sums reassociate under partitioning).
+        from tests.helpers import run_auto_parity
+
+        st_a, st_r = run_auto_parity(
+            G.watts_strogatz(256, 4, 0.2, seed=1), HITS(method="segment"), 8)
+        np.testing.assert_allclose(np.asarray(st_a.hub),
+                                   np.asarray(st_r.hub), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(st_a.authority),
+                                   np.asarray(st_r.authority), atol=1e-6)
+
     def test_csr_padding_sentinel_masked(self):
         # Regression: with the edge count an exact pad multiple, the
         # source-CSR padding slots all name edge e_pad-1 — a LIVE edge.

@@ -110,3 +110,12 @@ class TestLabelPropagation:
                              build_neighbor_table=False)
         with pytest.raises(ValueError):
             LabelPropagation().init(g, jax.random.key(0))
+
+    def test_auto_path_parity(self):
+        # Integer labels: GSPMD auto parity is exact (the vmapped
+        # sorted-row mode partitions over the node axis).
+        from tests.helpers import run_auto_parity
+
+        st_a, st_r = run_auto_parity(
+            G.watts_strogatz(256, 4, 0.2, seed=1), LabelPropagation(), 16)
+        assert (np.asarray(st_a.label) == np.asarray(st_r.label)).all()
