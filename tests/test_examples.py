@@ -35,6 +35,7 @@ def test_examples_discovered():
         "secure_node_demo.py",
         "snapshot_application.py",
         "coordination_stack.py",
+        "weighted_backbone.py",
     ):
         assert required in EXAMPLES, f"missing example: {required}"
 
