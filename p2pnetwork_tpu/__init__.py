@@ -25,6 +25,7 @@ from p2pnetwork_tpu.causal import CausalNode
 from p2pnetwork_tpu.coordnode import CoordinateNode
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
+from p2pnetwork_tpu.sync import SyncNode
 from p2pnetwork_tpu.termination import TerminationNode
 
 __version__ = "0.4.0"
@@ -36,6 +37,7 @@ __all__ = [
     "CoordinateNode",
     "SecureNode",
     "SnapshotNode",
+    "SyncNode",
     "TerminationNode",
     "NodeConfig",
     "SimConfig",

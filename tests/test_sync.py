@@ -1,0 +1,172 @@
+"""Merkle set reconciliation: convergence to the union, the O(diff·log)
+message bound that is the technique's whole point, deterministic
+conflict resolution, and session edges."""
+
+from p2pnetwork_tpu import SyncNode
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+def _pair():
+    a = SyncNode(HOST, 0, id="A")
+    b = SyncNode(HOST, 0, id="B")
+    for n in (a, b):
+        n.start()
+    assert a.connect_with_node(HOST, b.port)
+    assert wait_until(lambda: len(a.all_nodes) == 1
+                      and len(b.all_nodes) == 1)
+    return a, b
+
+
+def _fill(node, items):
+    for k, v in items:
+        node.put(k, v)
+    assert wait_until(lambda: all(node.get(k) is not None
+                                  for k, _ in items))
+
+
+def _sync(a, b, timeout=15.0):
+    a.sync_with(a.all_nodes[0])
+    assert a.wait_synced("B", timeout=timeout), "initiator never quiesced"
+    assert b.wait_synced("A", timeout=timeout), "responder never quiesced"
+
+
+class TestConvergence:
+    def test_disjoint_stores_union(self):
+        a, b = _pair()
+        try:
+            _fill(a, [(f"a{i}", f"v{i}") for i in range(40)])
+            _fill(b, [(f"b{i}", f"w{i}") for i in range(40)])
+            _sync(a, b)
+            assert a.store == b.store
+            assert len(a.store) == 80
+        finally:
+            stop_all([a, b])
+
+    def test_identical_stores_one_round_trip(self):
+        a, b = _pair()
+        try:
+            items = [(f"k{i}", f"v{i}") for i in range(50)]
+            _fill(a, items)
+            _fill(b, items)
+            before = a.sync_messages_sent + b.sync_messages_sent
+            _sync(a, b)
+            moved = (a.sync_messages_sent + b.sync_messages_sent) - before
+            assert a.store == b.store
+            assert moved == 2, f"identical stores moved {moved} messages"
+        finally:
+            stop_all([a, b])
+
+    def test_small_diff_moves_few_messages(self):
+        # The headline property: 1 differing item over a 500-item store
+        # costs O(log n) messages, nowhere near 500.
+        a, b = _pair()
+        try:
+            items = [(f"key-{i}", f"val-{i}") for i in range(500)]
+            _fill(a, items)
+            _fill(b, items)
+            _fill(a, [("only-on-a", "x")])
+            before = a.sync_messages_sent + b.sync_messages_sent
+            _sync(a, b)
+            moved = (a.sync_messages_sent + b.sync_messages_sent) - before
+            assert b.get("only-on-a") == "x"
+            assert a.store == b.store
+            assert moved < 40, f"1-item diff moved {moved} messages"
+        finally:
+            stop_all([a, b])
+
+    def test_conflict_resolves_deterministically_both_sides(self):
+        a, b = _pair()
+        try:
+            _fill(a, [("k", "apple")])
+            _fill(b, [("k", "banana")])
+            _sync(a, b)
+            # Greater serialized value wins on BOTH replicas.
+            assert a.get("k") == b.get("k") == "banana"
+        finally:
+            stop_all([a, b])
+
+    def test_local_put_obeys_convergence_rule(self):
+        a, b = _pair()
+        try:
+            _fill(a, [("k", "zzz")])
+            a.put("k", "aaa")  # smaller: must not regress the value
+            _fill(a, [("probe", "1")])  # fence: puts are ordered
+            assert a.get("k") == "zzz"
+        finally:
+            stop_all([a, b])
+
+
+class TestSessionEdges:
+    def test_resync_after_new_writes(self):
+        a, b = _pair()
+        try:
+            _fill(a, [("k1", "v1")])
+            _sync(a, b)
+            _fill(b, [("k2", "v2")])
+            _sync(a, b)
+            assert a.store == b.store == {"k1": "v1", "k2": "v2"}
+        finally:
+            stop_all([a, b])
+
+    def test_either_side_may_initiate(self):
+        a, b = _pair()
+        try:
+            _fill(a, [("x", "1")])
+            _fill(b, [("y", "2")])
+            b.sync_with(b.all_nodes[0])
+            assert b.wait_synced("A", timeout=15.0)
+            assert a.wait_synced("B", timeout=15.0)
+            assert a.store == b.store == {"x": "1", "y": "2"}
+        finally:
+            stop_all([a, b])
+
+    def test_simultaneous_mutual_initiation(self):
+        a, b = _pair()
+        try:
+            _fill(a, [(f"a{i}", "1") for i in range(30)])
+            _fill(b, [(f"b{i}", "2") for i in range(30)])
+            a.sync_with(a.all_nodes[0])
+            b.sync_with(b.all_nodes[0])
+            assert a.wait_synced("B", timeout=15.0)
+            assert b.wait_synced("A", timeout=15.0)
+            assert a.store == b.store and len(a.store) == 60
+        finally:
+            stop_all([a, b])
+
+    def test_dead_peer_releases_waiter(self):
+        a, b = _pair()
+        try:
+            _fill(a, [(f"k{i}", "v") for i in range(20)])
+            # Kill B the instant the session starts: A must not block
+            # for the whole timeout.
+            a.sync_with(a.all_nodes[0])
+            b.stop()
+            b.join(timeout=10.0)
+            assert a.wait_synced("B", timeout=10.0), \
+                "waiter not released by peer death"
+        finally:
+            stop_all([a, b])
+
+    def test_plain_traffic_bypasses(self):
+        seen = []
+
+        class App(SyncNode):
+            def node_message(self, node, data):
+                if isinstance(data, dict) and any(
+                        k.startswith("_ms_") for k in data):
+                    return super().node_message(node, data)
+                seen.append(data)
+
+        a = App(HOST, 0, id="A")
+        b = App(HOST, 0, id="B")
+        for n in (a, b):
+            n.start()
+        try:
+            assert a.connect_with_node(HOST, b.port)
+            assert wait_until(lambda: len(b.all_nodes) == 1)
+            a.send_to_nodes({"hello": "world"})
+            assert wait_until(lambda: {"hello": "world"} in seen)
+        finally:
+            stop_all([a, b])
