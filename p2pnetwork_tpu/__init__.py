@@ -23,6 +23,13 @@ from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.causal import CausalNode
 from p2pnetwork_tpu.coordnode import CoordinateNode
+from p2pnetwork_tpu.crdt import (
+    CRDTNode,
+    GCounter,
+    LWWRegister,
+    ORSet,
+    PNCounter,
+)
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
 from p2pnetwork_tpu.sync import SyncNode
@@ -35,6 +42,11 @@ __all__ = [
     "NodeConnection",
     "CausalNode",
     "CoordinateNode",
+    "CRDTNode",
+    "GCounter",
+    "PNCounter",
+    "LWWRegister",
+    "ORSet",
     "SecureNode",
     "SnapshotNode",
     "SyncNode",

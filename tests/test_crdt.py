@@ -1,0 +1,191 @@
+"""CRDTs: the merge algebra (commutativity, associativity, idempotence —
+the convergence theorem's premises), type semantics (add-wins OR-set,
+deterministic LWW ties), wire round-trips, and live multi-node
+convergence under concurrent writes."""
+
+import itertools
+
+import pytest
+
+from p2pnetwork_tpu import (CRDTNode, GCounter, LWWRegister, ORSet,
+                            PNCounter)
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+def _sample_gcounters():
+    a = GCounter()
+    a.increment("A", 3)
+    b = GCounter()
+    b.increment("A", 1)
+    b.increment("B", 5)
+    c = GCounter()
+    c.increment("C", 2)
+    return a, b, c
+
+
+class TestMergeAlgebra:
+    def test_gcounter_laws(self):
+        a, b, c = _sample_gcounters()
+        assert a.merge(b).counts == b.merge(a).counts
+        assert a.merge(b.merge(c)).counts == a.merge(b).merge(c).counts
+        assert a.merge(a).counts == a.counts
+        # max semantics: A's tallies don't add across replicas' views.
+        assert a.merge(b).value == 3 + 5
+
+    def test_orset_laws(self):
+        a = ORSet()
+        a.add("A", "x")
+        a.add("A", "y")
+        b = ORSet()
+        b.add("B", "x")
+        b.remove("x")  # tombstones only B's own observed tag
+        c = ORSet()
+        c.add("C", "z")
+        for u, v in itertools.permutations((a, b, c), 2):
+            assert u.merge(v).elements() == v.merge(u).elements()
+        assert a.merge(b.merge(c)).elements() \
+            == a.merge(b).merge(c).elements()
+        assert a.merge(a).elements() == a.elements()
+
+    def test_lww_merge_total_order(self):
+        a = LWWRegister("old", 1.0, "A")
+        b = LWWRegister("new", 2.0, "B")
+        assert a.merge(b).value == b.merge(a).value == "new"
+        # Equal timestamps: replica id breaks the tie identically on
+        # both sides.
+        c = LWWRegister("from-A", 5.0, "A")
+        d = LWWRegister("from-B", 5.0, "B")
+        assert c.merge(d).value == d.merge(c).value == "from-B"
+
+
+class TestSemantics:
+    def test_pncounter(self):
+        p = PNCounter()
+        p.increment("A", 10)
+        p.decrement("A", 3)
+        q = PNCounter()
+        q.decrement("B", 2)
+        assert p.merge(q).value == 5
+        with pytest.raises(ValueError):
+            p.increment("A", -1)
+
+    def test_orset_add_wins(self):
+        # A removes x having seen only its own tag; concurrently B
+        # re-adds x. The merge keeps x — add-wins.
+        a = ORSet()
+        a.add("A", "x")
+        b = a.merge(ORSet())  # b observed A's add
+        a.remove("x")
+        b.add("B", "x")
+        assert "x" in a.merge(b)
+        assert "x" in b.merge(a)
+
+    def test_orset_observed_remove(self):
+        a = ORSet()
+        a.add("A", "x")
+        b = a.merge(ORSet())
+        b.remove("x")  # b observed the add, so the remove covers it
+        assert "x" not in a.merge(b)
+
+    def test_wire_roundtrips(self):
+        g = GCounter({"A": 2})
+        assert GCounter.from_dict(g.to_dict()).counts == g.counts
+        p = PNCounter()
+        p.increment("A")
+        p.decrement("B", 4)
+        assert PNCounter.from_dict(p.to_dict()).value == p.value
+        r = LWWRegister("v", 3.5, "A")
+        r2 = LWWRegister.from_dict(r.to_dict())
+        assert (r2.value, r2.ts, r2.replica) == ("v", 3.5, "A")
+        s = ORSet()
+        s.add("A", "x")
+        s.add("B", "y")
+        s.remove("y")
+        s2 = ORSet.from_dict(s.to_dict())
+        assert s2.elements() == {"x"}
+        assert s2.tombs == s.tombs and s2._next == s._next
+
+
+class TestLiveConvergence:
+    def _triangle(self):
+        nodes = [CRDTNode(HOST, 0, id=i) for i in "ABC"]
+        for n in nodes:
+            n.start()
+        for i in range(3):
+            for j in range(i + 1, 3):
+                nodes[i].connect_with_node(HOST, nodes[j].port)
+        assert wait_until(lambda: all(len(n.all_nodes) == 2
+                                      for n in nodes))
+        return nodes
+
+    def test_concurrent_counters_converge(self):
+        nodes = self._triangle()
+        a, b, c = nodes
+        try:
+            for n, k in ((a, 5), (b, 3), (c, 9)):
+                n.mutate("hits", "pncounter",
+                         lambda cr, n=n, k=k: cr.increment(n.id, k))
+            assert wait_until(
+                lambda: all(n.counter("hits").value == 17 for n in nodes),
+                timeout=10.0), [n.counter("hits").value for n in nodes]
+        finally:
+            stop_all(nodes)
+
+    def test_orset_concurrent_membership(self):
+        nodes = self._triangle()
+        a, b, c = nodes
+        try:
+            a.mutate("room", "orset", lambda s: s.add("A", "alice"))
+            b.mutate("room", "orset", lambda s: s.add("B", "bob"))
+            assert wait_until(
+                lambda: all(n.set_("room").elements()
+                            == {"alice", "bob"} for n in nodes))
+            c.mutate("room", "orset", lambda s: s.remove("alice"))
+            assert wait_until(
+                lambda: all(n.set_("room").elements() == {"bob"}
+                            for n in nodes))
+        finally:
+            stop_all(nodes)
+
+    def test_late_joiner_catches_up(self):
+        nodes = self._triangle()
+        a, b, c = nodes
+        d = CRDTNode(HOST, 0, id="D")
+        try:
+            a.mutate("cfg", "lww", lambda r: r.set("A", "v1", ts=1.0))
+            assert wait_until(
+                lambda: b.register("cfg").value == "v1")
+            d.start()
+            assert d.connect_with_node(HOST, a.port)
+            assert wait_until(lambda: len(a.all_nodes) == 3)
+            a.sync_all()
+            assert wait_until(lambda: d.register("cfg").value == "v1")
+        finally:
+            stop_all(nodes + [d])
+
+    def test_kind_mismatch_rejected(self):
+        a = CRDTNode(HOST, 0, id="A")
+        try:
+            a.start()
+            a.mutate("x", "pncounter", lambda c: c.increment("A"))
+            with pytest.raises(TypeError):
+                a.set_("x")
+        finally:
+            stop_all([a])
+
+    def test_mutation_error_reraised(self):
+        # Regression: a raising fn used to vanish into asyncio's handler
+        # and mutate() timed out blaming "never ran".
+        a = CRDTNode(HOST, 0, id="A")
+        try:
+            a.start()
+            with pytest.raises(ValueError):
+                a.mutate("c", "gcounter",
+                         lambda g: g.increment("A", -1), timeout=5.0)
+            # The gcounter accessor reads what update("gcounter") hosts.
+            a.mutate("c", "gcounter", lambda g: g.increment("A", 7))
+            assert a.gcounter("c").value == 7
+        finally:
+            stop_all([a])
