@@ -36,6 +36,7 @@ def test_examples_discovered():
         "snapshot_application.py",
         "coordination_stack.py",
         "weighted_backbone.py",
+        "crdt_application.py",
     ):
         assert required in EXAMPLES, f"missing example: {required}"
 
