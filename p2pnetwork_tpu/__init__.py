@@ -30,6 +30,7 @@ from p2pnetwork_tpu.crdt import (
     ORSet,
     PNCounter,
 )
+from p2pnetwork_tpu.phi import PhiAccrualNode
 from p2pnetwork_tpu.securenode import SecureNode
 from p2pnetwork_tpu.snapshot import SnapshotNode
 from p2pnetwork_tpu.sync import SyncNode
@@ -47,6 +48,7 @@ __all__ = [
     "PNCounter",
     "LWWRegister",
     "ORSet",
+    "PhiAccrualNode",
     "SecureNode",
     "SnapshotNode",
     "SyncNode",

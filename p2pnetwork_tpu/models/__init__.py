@@ -16,7 +16,10 @@ from p2pnetwork_tpu.models.base import Protocol
 from p2pnetwork_tpu.models.bipartite import BipartiteCheck, BipartiteCheckState
 from p2pnetwork_tpu.models.boruvka import Boruvka, BoruvkaState
 from p2pnetwork_tpu.models.bracha import Bracha, BrachaState
-from p2pnetwork_tpu.models.centrality import betweenness_sample
+from p2pnetwork_tpu.models.centrality import (
+    betweenness_sample,
+    closeness_sample,
+)
 from p2pnetwork_tpu.models.coloring import color_via_mis
 from p2pnetwork_tpu.models.detector import (
     FailureDetector,
@@ -61,6 +64,7 @@ from p2pnetwork_tpu.models.walk import RandomWalks, RandomWalksState
 __all__ = [
     "Protocol",
     "betweenness_sample",
+    "closeness_sample",
     "color_via_mis",
     "count_triangles",
     "diameter_bounds",

@@ -50,6 +50,60 @@ from p2pnetwork_tpu.ops import segment
 from p2pnetwork_tpu.sim.graph import Graph
 
 
+def closeness_sample(graph: Graph, sources, method: str = "auto",
+                     harmonic: bool = True,
+                     normalized: bool = False) -> jax.Array:
+    """Closeness centrality ``f32[N_pad]`` from BFS waves over
+    ``sources`` — *which peers are nearest to everyone?* (replica
+    placement's other half, beside :func:`betweenness_sample`'s relay
+    question).
+
+    On the symmetric edge sets the builders produce, ``d(s, v) =
+    d(v, s)``, so accumulating each sampled source's distance field
+    gives every node's distances TO the sample. ``harmonic=True``
+    (default) sums ``1/d`` — Boldi–Vigna harmonic centrality, finite
+    and meaningful on disconnected graphs where classic closeness
+    degenerates; ``harmonic=False`` returns ``reached / sum(d)`` over
+    the sampled sources (classic closeness restricted to reached
+    pairs). ``normalized=True`` rescales the harmonic sum by
+    ``n_live / S_live`` (live sources only in the divisor, like
+    :func:`betweenness_sample`) — the unbiased full-graph estimate.
+    Exact when ``sources`` is every live node. Deterministic."""
+    if normalized and not harmonic:
+        raise ValueError(
+            "normalized=True is defined for the harmonic estimator only "
+            "(classic closeness has no unbiased sampled rescale here)")
+    from p2pnetwork_tpu.models.hopdist import bfs_distances
+
+    sources = jnp.asarray(sources, dtype=jnp.int32)
+    n_pad = graph.n_nodes_padded
+
+    def one_source(carry, src):
+        inv_sum, d_sum, reach = carry
+        alive_src = graph.node_mask[src]
+        d = bfs_distances(graph, src, method)
+        hit = (d > 0) & alive_src  # excludes the source itself
+        df = d.astype(jnp.float32)
+        inv_sum = inv_sum + jnp.where(hit, 1.0 / jnp.maximum(df, 1.0), 0.0)
+        d_sum = d_sum + jnp.where(hit, df, 0.0)
+        reach = reach + hit.astype(jnp.float32)
+        return (inv_sum, d_sum, reach), None
+
+    zeros = jnp.zeros(n_pad, jnp.float32)
+    (inv_sum, d_sum, reach), _ = jax.lax.scan(
+        one_source, (zeros, zeros, zeros), sources)
+    if harmonic:
+        out = inv_sum
+        if normalized:
+            n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
+            s_live = jnp.maximum(jnp.sum(graph.node_mask[sources]), 1)
+            out = out * (n_live.astype(jnp.float32)
+                         / s_live.astype(jnp.float32))
+    else:
+        out = jnp.where(d_sum > 0, reach / jnp.maximum(d_sum, 1.0), 0.0)
+    return out * graph.node_mask
+
+
 def betweenness_sample(graph: Graph, sources, method: str = "auto",
                        normalized: bool = False) -> jax.Array:
     """Accumulated Brandes dependencies ``f32[N_pad]`` over ``sources``.

@@ -75,6 +75,30 @@ class HopDistance:
 
 
 @functools.partial(jax.jit, static_argnames=("method",))
+def bfs_distances(graph: Graph, src, method: str = "auto") -> jax.Array:
+    """Single-source BFS distance field ``i32[N_pad]`` (-1 unreached),
+    run as one device-side ``while_loop`` — THE masked wave shared by
+    :func:`eccentricities` and models/centrality.py's closeness (one
+    implementation, so a masking fix lands on all of them)."""
+    n_pad = graph.n_nodes_padded
+    seed = jnp.zeros(n_pad, dtype=bool).at[src].set(True)
+    seed = seed & graph.node_mask
+    dist0 = jnp.where(seed, 0, -1).astype(jnp.int32)
+
+    def cond(carry):
+        _, frontier, _ = carry
+        return jnp.any(frontier)
+
+    def body(carry):
+        dist, frontier, rnd = carry
+        delivered = segment.propagate_or(graph, frontier, method)
+        new = delivered & (dist < 0) & graph.node_mask
+        return jnp.where(new, rnd + 1, dist), new, rnd + 1
+
+    dist, _, _ = jax.lax.while_loop(cond, body, (dist0, seed, jnp.int32(0)))
+    return dist
+
+
 def eccentricities(graph: Graph, sources: jax.Array,
                    method: str = "auto"):
     """Batched exact eccentricities: one full BFS per source, run as
@@ -88,25 +112,9 @@ def eccentricities(graph: Graph, sources: jax.Array,
     run, for the multi-source sweeps diameter estimation wants.
     """
     sources = jnp.asarray(sources, dtype=jnp.int32)
-    n_pad = graph.n_nodes_padded
 
     def one(src):
-        seed = jnp.zeros(n_pad, dtype=bool).at[src].set(True)
-        seed = seed & graph.node_mask
-        dist0 = jnp.where(seed, 0, -1).astype(jnp.int32)
-
-        def cond(carry):
-            _, frontier, _ = carry
-            return jnp.any(frontier)
-
-        def body(carry):
-            dist, frontier, rnd = carry
-            delivered = segment.propagate_or(graph, frontier, method)
-            new = delivered & (dist < 0) & graph.node_mask
-            return jnp.where(new, rnd + 1, dist), new, rnd + 1
-
-        dist, _, _ = jax.lax.while_loop(cond, body,
-                                        (dist0, seed, jnp.int32(0)))
+        dist = bfs_distances(graph, src, method)
         reached = (dist >= 0) & graph.node_mask
         return jnp.max(dist), jnp.sum(reached, dtype=jnp.int32)
 

@@ -1,0 +1,168 @@
+"""Phi-accrual failure detection over the sockets backend.
+
+The reference's only liveness signal is TCP noticing a dead socket —
+up to its 10-second timeout late, and silent about DEGRADING peers
+[ref: p2pnetwork/nodeconnection.py:47, node.py:97]. The modern answer
+(Hayashibara et al. 2004; Cassandra's and Akka's detector) replaces the
+binary alive/dead verdict with a CONTINUOUS suspicion level: learn each
+peer's heartbeat inter-arrival distribution, and report
+
+    phi(peer) = -log10( P(a heartbeat would take this long) )
+
+so phi 1 means "this gap happens 1 in 10 times", phi 8 "1 in 10^8 —
+it's gone". The threshold becomes an application policy knob (how many
+false positives per true detection you'll pay), and a peer on a slow
+link EARNS a wider distribution instead of flapping a fixed timeout.
+
+:class:`PhiAccrualNode`:
+
+- :meth:`tick` broadcasts one heartbeat (app-chosen cadence, like
+  CoordinateNode's pings); inbound heartbeats update the per-peer
+  inter-arrival window (mean/variance over the last ``window``
+  arrivals);
+- :meth:`phi` reads the current suspicion for a peer;
+  :meth:`suspected` applies a threshold; :meth:`suspicion_levels`
+  snapshots every peer;
+- the sim backend's :class:`~p2pnetwork_tpu.models.detector.
+  FailureDetector` is the batched counterpart (ping/ack with a count
+  threshold); this is the wall-clock, per-connection form.
+
+The estimator is the logistic normal-tail approximation (as deployed in
+Akka — it never underflows, so phi grows smoothly however long the
+silence) with a standard-deviation floor of ``max(min_std, 0.1·mean)``:
+a perfectly regular heartbeat stream must not estimate sigma ~ 0 and
+alarm on one scheduler jitter. Heartbeats are consumed by the detector
+and never reach ``node_message`` subclass traffic.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from p2pnetwork_tpu.node import Node
+from p2pnetwork_tpu.nodeconnection import NodeConnection
+
+HB_KEY = "_phi_hb"
+
+
+class _ArrivalWindow:
+    """Inter-arrival statistics over the last ``window`` heartbeats."""
+
+    __slots__ = ("intervals", "last")
+
+    def __init__(self, window: int):
+        self.intervals: deque = deque(maxlen=window)
+        self.last: Optional[float] = None
+
+    def record(self, now: float) -> None:
+        if self.last is not None:
+            self.intervals.append(now - self.last)
+        self.last = now
+
+    def mean_std(self, min_std: float):
+        if not self.intervals:
+            return None
+        m = sum(self.intervals) / len(self.intervals)
+        var = sum((x - m) ** 2 for x in self.intervals) / len(self.intervals)
+        # The floor is RELATIVE to the cadence as well as absolute: a
+        # perfectly regular 1 Hz stream must not estimate sigma ~ 0 and
+        # saturate suspicion one jitter past the mean.
+        return m, max(math.sqrt(var), 0.1 * m, min_std)
+
+
+def _phi_from(elapsed: float, mean: float, std: float) -> float:
+    """-log10 of the upper-tail probability of a gap >= elapsed, via the
+    logistic approximation of the normal CDF (Hayashibara's estimator as
+    deployed in Akka): p = e / (1 + e) with e = exp(-z (1.5976 +
+    0.070566 z^2)). Unlike erfc it never underflows — for large z the
+    log-tail continues analytically, so phi keeps growing smoothly with
+    the silence instead of clipping at a floor."""
+    z = (elapsed - mean) / std
+    a = z * (1.5976 + 0.070566 * z * z)
+    if a < -30.0:
+        return 0.0  # gap far below the mean: p ~ 1
+    if a > 30.0:
+        return a / math.log(10.0)  # p ~ e^-a, exactly the log tail
+    e = math.exp(-a)
+    return -math.log10(e / (1.0 + e))
+
+
+class PhiAccrualNode(Node):
+    """A :class:`Node` with adaptive, continuous peer suspicion."""
+
+    def __init__(self, *args, window: int = 100, min_std: float = 0.01,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.window = window
+        self.min_std = min_std
+        self._arrivals: Dict[str, _ArrivalWindow] = {}
+        # Heartbeats append on the event loop while phi()/suspected()
+        # read from monitoring threads; an unguarded deque iteration
+        # mid-append raises "deque mutated during iteration".
+        self._phi_lock = threading.Lock()
+
+    # ------------------------------------------------------------ app API
+
+    def tick(self) -> None:
+        """Broadcast one heartbeat to every peer (thread-safe). Call at
+        the cadence your deployment chooses; the detector learns it."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            raise RuntimeError("node is not running — call start() first")
+        loop.call_soon_threadsafe(
+            lambda: self.send_to_nodes({HB_KEY: 1}))
+
+    def phi(self, peer_id: str, now: Optional[float] = None) -> float:
+        """Current suspicion of ``peer_id``: 0.0 while the stream is
+        healthy (or still warming up — no verdict without data),
+        climbing without bound as the silence stretches."""
+        with self._phi_lock:
+            w = self._arrivals.get(peer_id)
+            if w is None or w.last is None:
+                return 0.0
+            stats = w.mean_std(self.min_std)
+            last = w.last
+        if stats is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return _phi_from(now - last, *stats)
+
+    def suspected(self, peer_id: str, threshold: float = 8.0,
+                  now: Optional[float] = None) -> bool:
+        """Suspicion policy: phi above ``threshold`` (8 ~ a gap this
+        long happens less than 1 in 10^8 heartbeats)."""
+        return self.phi(peer_id, now) > threshold
+
+    def suspicion_levels(self) -> Dict[str, float]:
+        """Snapshot of phi for every peer that has ever heartbeated."""
+        now = time.monotonic()
+        with self._phi_lock:
+            peers = list(self._arrivals)
+        return {pid: self.phi(pid, now) for pid in peers}
+
+    # ------------------------------------------------------ interception
+
+    def _record_heartbeat(self, peer_id: str,
+                          now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._phi_lock:
+            self._arrivals.setdefault(
+                peer_id, _ArrivalWindow(self.window)).record(now)
+
+    def node_message(self, node: NodeConnection, data) -> None:
+        if isinstance(data, dict) and HB_KEY in data:
+            self._record_heartbeat(node.id)
+            return
+        super().node_message(node, data)
+
+    def node_disconnected(self, node: NodeConnection) -> None:
+        # TCP already rendered its verdict: drop the window so a
+        # reconnecting peer starts a fresh estimate instead of being
+        # judged against its pre-crash rhythm.
+        with self._phi_lock:
+            self._arrivals.pop(node.id, None)
+        super().node_disconnected(node)

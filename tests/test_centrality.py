@@ -86,6 +86,64 @@ class TestBetweennessExact:
         np.testing.assert_allclose(with_dead, without, rtol=1e-6)
 
 
+class TestCloseness:
+    def test_harmonic_matches_networkx(self):
+        from p2pnetwork_tpu.models import closeness_sample
+
+        for build in (lambda: G.watts_strogatz(60, 4, 0.2, seed=3),
+                      lambda: G.erdos_renyi(48, 0.12, seed=5)):
+            g = build()
+            src = np.nonzero(np.asarray(g.node_mask))[0].astype(np.int32)
+            got = np.asarray(closeness_sample(g, src))
+            H = _nx_graph(g)
+            want = np.zeros(g.n_nodes_padded)
+            for v, x in nx.harmonic_centrality(H).items():
+                want[v] = x
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_harmonic_disconnected_finite(self):
+        from p2pnetwork_tpu.models import closeness_sample
+
+        # Two components: harmonic centrality stays finite and only
+        # counts reachable pairs.
+        s = np.array([0, 1, 2, 3], dtype=np.int32)
+        r = np.array([1, 0, 3, 2], dtype=np.int32)
+        g = G.from_edges(s, r, 4)
+        src = np.arange(4, dtype=np.int32)
+        got = np.asarray(closeness_sample(g, src))
+        assert np.allclose(got[:4], 1.0)  # one neighbor at distance 1
+
+    def test_classic_star(self):
+        from p2pnetwork_tpu.models import closeness_sample
+
+        # K_{1,5}: hub at distance 1 from all; leaves at 1 + 4*2.
+        s = np.array([0] * 5 + list(range(1, 6)), dtype=np.int32)
+        r = np.array(list(range(1, 6)) + [0] * 5, dtype=np.int32)
+        g = G.from_edges(s, r, 6)
+        src = np.arange(6, dtype=np.int32)
+        got = np.asarray(closeness_sample(g, src, harmonic=False))
+        assert got[0] == pytest.approx(5 / 5)  # hub: 5 reached / dist 5
+        assert got[1] == pytest.approx(5 / 9)  # leaf: 5 reached / dist 9
+
+    def test_sampled_estimator_full_sample_exact(self):
+        from p2pnetwork_tpu.models import closeness_sample
+
+        g = G.erdos_renyi(40, 0.15, seed=2)
+        src = np.nonzero(np.asarray(g.node_mask))[0].astype(np.int32)
+        est = np.asarray(closeness_sample(g, src, normalized=True))
+        exact = np.asarray(closeness_sample(g, src))
+        np.testing.assert_allclose(est, exact, rtol=1e-5)
+
+    def test_dead_nodes_zero(self):
+        from p2pnetwork_tpu.models import closeness_sample
+
+        g = G.watts_strogatz(40, 4, 0.2, seed=7)
+        g = failures.fail_nodes(g, np.array([5, 11]))
+        src = np.nonzero(np.asarray(g.node_mask))[0].astype(np.int32)
+        got = np.asarray(closeness_sample(g, src))
+        assert got[5] == got[11] == 0.0
+
+
 class TestBetweennessSampled:
     def test_normalized_estimator_unbiased_at_full_sample(self):
         g = G.erdos_renyi(40, 0.15, seed=2)

@@ -1,0 +1,120 @@
+"""Phi-accrual detector: the estimator driven with synthetic clocks
+(deterministic — phi's monotonic growth in silence, adaptation to slow
+cadences, the min-std floor), plus a live two-node heartbeat check."""
+
+from p2pnetwork_tpu import PhiAccrualNode
+from tests.helpers import stop_all, wait_until
+
+HOST = "127.0.0.1"
+
+
+def _node(**kw):
+    return PhiAccrualNode(HOST, 0, id="me", **kw)
+
+
+def _feed(n, peer, times):
+    for t in times:
+        n._record_heartbeat(peer, now=t)
+
+
+class TestEstimator:
+    def test_no_data_no_verdict(self):
+        n = _node()
+        assert n.phi("ghost") == 0.0
+        assert not n.suspected("ghost")
+
+    def test_phi_grows_with_silence(self):
+        n = _node()
+        _feed(n, "p", [i * 1.0 for i in range(20)])  # 1 Hz heartbeat
+        last = 19.0
+        phis = [n.phi("p", now=last + dt) for dt in (0.5, 2.0, 5.0, 10.0)]
+        assert all(a < b for a, b in zip(phis, phis[1:])), phis
+        assert phis[0] < 1.0  # a normal gap is unsuspicious
+        assert phis[-1] > 8.0  # 10 missed beats is a verdict
+
+    def test_adapts_to_slow_cadence(self):
+        # A 5-second heartbeat peer must NOT be suspected at a 6-second
+        # gap that would damn a 1-second peer.
+        fast, slow = _node(), _node()
+        _feed(fast, "p", [i * 1.0 for i in range(20)])
+        _feed(slow, "p", [i * 5.0 for i in range(20)])
+        gap = 6.0
+        assert fast.phi("p", now=19.0 + gap) > 8.0
+        assert slow.phi("p", now=95.0 + gap) < 2.0
+
+    def test_jittery_peer_earns_tolerance(self):
+        # Variance widens the distribution: the same absolute gap is
+        # less damning for a jittery stream.
+        steady, jittery = _node(), _node()
+        _feed(steady, "p", [i * 1.0 for i in range(30)])
+        ts, t = [], 0.0
+        for i in range(30):
+            t += 0.4 if i % 2 == 0 else 1.6  # mean 1.0, high variance
+            ts.append(t)
+        _feed(jittery, "p", ts)
+        gap = 3.0
+        assert steady.phi("p", now=29.0 + gap) \
+            > jittery.phi("p", now=ts[-1] + gap)
+
+    def test_min_std_floor_prevents_hair_trigger(self):
+        # Perfectly regular arrivals would estimate std 0 and alarm on
+        # any jitter; the floor keeps a small gap unsuspicious.
+        n = _node(min_std=0.05)
+        _feed(n, "p", [i * 1.0 for i in range(50)])
+        assert n.phi("p", now=49.0 + 1.05) < 4.0
+
+    def test_window_bounds_memory(self):
+        n = _node(window=10)
+        _feed(n, "p", [i * 1.0 for i in range(100)])
+        assert len(n._arrivals["p"].intervals) == 10
+
+
+class TestLive:
+    def test_heartbeats_keep_phi_low_then_silence_raises_it(self):
+        import time
+
+        a = PhiAccrualNode(HOST, 0, id="A", min_std=0.05)
+        b = PhiAccrualNode(HOST, 0, id="B", min_std=0.05)
+        nodes = [a, b]
+        try:
+            for n in nodes:
+                n.start()
+            assert a.connect_with_node(HOST, b.port)
+            assert wait_until(lambda: len(a.all_nodes) == 1
+                              and len(b.all_nodes) == 1)
+            for _ in range(30):
+                a.tick()
+                b.tick()
+                time.sleep(0.02)
+            assert wait_until(lambda: "A" in b._arrivals
+                              and len(b._arrivals["A"].intervals) >= 10)
+            assert b.phi("A") < 8.0
+            # A goes silent (no more ticks): suspicion must climb.
+            assert wait_until(lambda: b.phi("A") > 8.0, timeout=10.0), \
+                b.phi("A")
+            assert b.suspected("A")
+        finally:
+            stop_all(nodes)
+
+    def test_heartbeats_invisible_to_app(self):
+        seen = []
+
+        class App(PhiAccrualNode):
+            def node_message(self, node, data):
+                if isinstance(data, dict) and "_phi_hb" in data:
+                    return super().node_message(node, data)
+                seen.append(data)
+
+        a = App(HOST, 0, id="A")
+        b = App(HOST, 0, id="B")
+        try:
+            for n in (a, b):
+                n.start()
+            assert a.connect_with_node(HOST, b.port)
+            assert wait_until(lambda: len(b.all_nodes) == 1)
+            a.tick()
+            a.send_to_nodes("app traffic")
+            assert wait_until(lambda: "app traffic" in seen)
+            assert seen == ["app traffic"]
+        finally:
+            stop_all([a, b])
