@@ -1,15 +1,16 @@
 """Sim backend demo: overlay-health analytics as compiled protocols.
 
-Nine questions reference users answer by hand-instrumenting callbacks
+Ten questions reference users answer by hand-instrumenting callbacks
 [ref: README.md:20] — who matters (PageRank), how far is everyone
 (HopDistance / BFS), what's the network-wide average (PushSum), who
 coordinates (LeaderElection), is the network partitioned and how badly
 (ConnectedComponents, after node failures), can peers be 2-colored into
 roles (BipartiteCheck), how clustered is the overlay
-(transitivity_sample), which peers form the resilient core (KCore), and
-which peers the shortest paths route through (betweenness_sample) — each
-runs here as a batched protocol over the whole population in one
-compiled scan (clustering and betweenness as one-shot device queries).
+(transitivity_sample), which peers form the resilient core (KCore),
+which peers the shortest paths route through (betweenness_sample), and
+which peers are nearest to everyone (closeness_sample) — each runs here
+as a batched protocol over the whole population in one compiled scan
+(clustering and the centralities as one-shot device queries).
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -24,7 +25,7 @@ import numpy as np
 from p2pnetwork_tpu.models import (BipartiteCheck, ConnectedComponents,
                                    HopDistance, KCore, LeaderElection,
                                    PageRank, PushSum, betweenness_sample,
-                                   transitivity_sample)
+                                   closeness_sample, transitivity_sample)
 from p2pnetwork_tpu.sim import engine, failures
 from p2pnetwork_tpu.sim import graph as G
 
@@ -130,6 +131,13 @@ def main():
     top_bc = np.argsort(bc)[-5:][::-1]
     print("betweenness (sampled): top-5 relays:",
           ", ".join(f"node {i} ({bc[i]:.0f})" for i in top_bc))
+
+    # And which peers are NEAREST to everyone (placement, not relaying):
+    # harmonic closeness over the same sampled sources.
+    cc = np.asarray(closeness_sample(g, src, normalized=True))
+    top_cc = np.argsort(cc)[-5:][::-1]
+    print("closeness (sampled): top-5 best-placed:",
+          ", ".join(f"node {i} ({cc[i]:.0f})" for i in top_cc))
 
 
 if __name__ == "__main__":
