@@ -290,7 +290,13 @@ class CRDTNode(Node):
             raise RuntimeError("node is not running — call start() first")
 
         def _do():
-            for name, crdt in self._crdts.items():
+            # Snapshot under the lock: accessors (gcounter/counter/
+            # register/set_) create-on-miss from foreign threads, and a
+            # concurrent insert during this loop would raise "dictionary
+            # changed size during iteration".
+            with self._crdt_lock:
+                items = list(self._crdts.items())
+            for name, crdt in items:
                 self._broadcast(name, crdt)
 
         loop.call_soon_threadsafe(_do)

@@ -60,7 +60,7 @@ class BrachaState:
     value: jax.Array  # i32[N_pad] — delivered value; -1 undelivered/Byzantine
     round: jax.Array  # i32[]
     # Round-invariant propagations, paid once at init instead of per step:
-    byz_in: jax.Array  # f32[N_pad] — Byzantine in-neighbor count (+self)
+    byz_in: jax.Array  # f32[N_pad] — Byzantine in-neighbor count
     from_src: jax.Array  # bool[N_pad] — broadcaster reaches this node (+self)
 
 

@@ -99,6 +99,7 @@ def bfs_distances(graph: Graph, src, method: str = "auto") -> jax.Array:
     return dist
 
 
+@functools.partial(jax.jit, static_argnames=("method",))
 def eccentricities(graph: Graph, sources: jax.Array,
                    method: str = "auto"):
     """Batched exact eccentricities: one full BFS per source, run as

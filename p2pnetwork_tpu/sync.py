@@ -72,6 +72,10 @@ class SyncNode(Node):
         self.sync_messages_sent = 0
         self._sync_events: Dict[str, threading.Event] = {}
         self._walk_pending: Dict[str, int] = {}  # peer id -> open requests
+        #: peer id -> root hash from an ``_ms_root`` that arrived while
+        #: our walk with that peer was still mid-flight; consumed by
+        #: :meth:`_quiesce` to start a follow-up walk.
+        self._pending_root: Dict[str, str] = {}
 
     # ------------------------------------------------------------ app API
 
@@ -163,6 +167,19 @@ class SyncNode(Node):
         self.send_to_node(n, payload)
 
     def _quiesce(self, n: NodeConnection, notify_peer: bool) -> None:
+        # A fresh initiation from this peer landed while our walk was
+        # mid-flight (see node_message's _ms_root branch): the active
+        # walk may have passed subtrees BEFORE the peer put the items
+        # that prompted its initiation, so releasing the peer's wait now
+        # could leave the stores unequal. Run one follow-up walk first;
+        # its quiesce releases both sides (or consumes yet another
+        # queued root — each follow-up consumes exactly one, so this
+        # terminates once initiations stop).
+        pending = self._pending_root.pop(n.id, None)
+        if pending is not None and pending != self._subtree_hash(""):
+            self._bump(n, +1)
+            self._send(n, {"_ms_tree": ""})
+            return
         if notify_peer:
             self._send(n, {"_ms_done": True})
         self._sync_events.setdefault(n.id, threading.Event()).set()
@@ -213,10 +230,13 @@ class SyncNode(Node):
         if "_ms_root" in data:
             # Session start (we are the responder / walker). If OUR walk
             # with this peer is already mid-flight (simultaneous mutual
-            # initiation), join it instead of resetting its accounting —
-            # the active walk converges both replicas and its final
-            # ``done`` satisfies the peer's wait too.
+            # initiation or re-initiation), don't reset its accounting —
+            # queue the root instead: the active walk may already have
+            # passed subtrees the peer mutated after it visited them, so
+            # _quiesce runs a follow-up walk before releasing the
+            # peer's wait (tests/test_sync.py::test_reinitiation_mid_walk).
             if self._walk_pending.get(node.id, 0) > 0:
+                self._pending_root[node.id] = data["_ms_root"]
                 return
             self._sync_events.setdefault(node.id,
                                          threading.Event()).clear()
@@ -260,6 +280,7 @@ class SyncNode(Node):
         # can check the peer's liveness before trusting the cut.
         if node.id in self._walk_pending:
             self._walk_pending[node.id] = 0
+        self._pending_root.pop(node.id, None)
         ev = self._sync_events.get(node.id)
         if ev is not None and not ev.is_set():
             ev.set()

@@ -170,3 +170,64 @@ class TestSessionEdges:
             assert wait_until(lambda: {"hello": "world"} in seen)
         finally:
             stop_all([a, b])
+
+
+class TestReinitiationMidWalk:
+    def test_reinitiation_mid_walk_still_converges(self):
+        """A fresh ``_ms_root`` landing while our walk with that peer is
+        mid-flight must not be dropped: the active walk may already have
+        passed the subtree the peer just mutated, so the responder queues
+        the root and runs a follow-up walk before releasing anyone
+        (sync.py ``_pending_root``).
+
+        Deterministic injection: A answers B's first ``_ms_pull`` only
+        AFTER putting a fresh item into a bucket the walk has already
+        skipped (both sides held identical items there, so its hashes
+        matched) and re-initiating.  Without the queued-root follow-up,
+        B's walk completes on stale hashes and never learns the item.
+        """
+        from p2pnetwork_tpu import sync as sync_mod
+
+        def key_in_bucket(digit, tag):
+            i = 0
+            while True:
+                k = f"{tag}-{i}"
+                if sync_mod._key_digest(k).startswith(digit):
+                    return k
+                i += 1
+
+        injected = {"done": False}
+
+        class InjectingNode(SyncNode):
+            def node_message(self, node, data):
+                if (isinstance(data, dict) and "_ms_pull" in data
+                        and not injected["done"]):
+                    injected["done"] = True
+                    # Runs on the event loop, interleaved mid-walk:
+                    # mutate an already-compared bucket, re-initiate.
+                    self._put_local(key_in_bucket("0", "late"), "LATE")
+                    self._send(node, {"_ms_root": self._subtree_hash("")})
+                return super().node_message(node, data)
+
+        a = InjectingNode(HOST, 0, id="A")
+        b = SyncNode(HOST, 0, id="B")
+        for n in (a, b):
+            n.start()
+        try:
+            assert a.connect_with_node(HOST, b.port)
+            assert wait_until(lambda: len(a.all_nodes) == 1
+                              and len(b.all_nodes) == 1)
+            # Bucket "0": identical on both sides -> hashes match at the
+            # root descent, skipped.  Bucket "f": A-only -> B pulls it,
+            # which triggers the injection.
+            shared = [(key_in_bucket("0", f"s{i}"), "v") for i in range(3)]
+            _fill(a, shared)
+            _fill(b, shared)
+            _fill(a, [(key_in_bucket("f", "only-a"), "x")])
+            _sync(a, b, timeout=20.0)
+            assert injected["done"], "injection point never hit"
+            assert b.get(key_in_bucket("0", "late")) == "LATE", \
+                "queued re-initiation was dropped: stores diverged"
+            assert a.store == b.store
+        finally:
+            stop_all([a, b])
