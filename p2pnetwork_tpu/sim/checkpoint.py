@@ -403,9 +403,13 @@ def load_graph(path: str):
         meta = json.loads(bytes(data["__meta__"]).decode())
         if meta.get("version") != 1:
             raise ValueError(f"unknown graph file version: {meta.get('version')}")
+        # Absent arrays were None at save time and must load back as None
+        # explicitly: neighbors/neighbor_mask have no dataclass default, so
+        # omitting them raises for any graph saved with
+        # build_neighbor_table=False (the 10M bench config).
         fields: Dict[str, Any] = {
-            name: jnp.asarray(data[name])
-            for name in _GRAPH_ARRAYS if name in data.files
+            name: jnp.asarray(data[name]) if name in data.files else None
+            for name in _GRAPH_ARRAYS
         }
         blocked = None
         if "blocked_src" in data.files:
