@@ -97,8 +97,11 @@ def _layout_fingerprint():
     import hashlib
 
     h = hashlib.blake2b(digest_size=6)
-    for rel in ("p2pnetwork_tpu/sim/graph.py", "p2pnetwork_tpu/ops/blocked.py",
-                "p2pnetwork_tpu/ops/diag.py",
+    # bench.py itself is in the set: the cache NAME only carries n, so an
+    # edit to a build call's other kwargs (k, p, layout flags) must also
+    # invalidate.
+    for rel in ("bench.py", "p2pnetwork_tpu/sim/graph.py",
+                "p2pnetwork_tpu/ops/blocked.py", "p2pnetwork_tpu/ops/diag.py",
                 "p2pnetwork_tpu/sim/checkpoint.py"):
         with open(os.path.join(_HERE, rel), "rb") as f:
             h.update(f.read())

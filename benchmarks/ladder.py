@@ -259,14 +259,39 @@ def bench_discovery(n=1_000_000, walkers=4096):
     t0 = time.perf_counter()
     out = once()
     secs = time.perf_counter() - t0
+
+    # The crawl is rounds-bound (~1700 rounds at a per-iteration floor set
+    # by while_loop dispatch, not bandwidth): batching T walk rounds per
+    # iteration (engine steps_per_round — bit-exact vs T=1, pinned by
+    # tests/test_walk.py::TestBatchedSteps) amortizes that floor.
+    def once_batched(T):
+        _, o = engine.run_until_coverage(
+            g, proto, jax.random.key(0), coverage_target=0.99,
+            max_rounds=8192, steps_per_round=T,
+        )
+        return o
+
+    best_T, best_secs, out_b = 1, secs, out
+    for T in (8, 16, 32):
+        ob = once_batched(T)  # warm (fresh compile per T)
+        t0 = time.perf_counter()
+        ob = once_batched(T)
+        sb = time.perf_counter() - t0
+        if sb < best_secs:
+            best_T, best_secs, out_b = T, sb, ob
+    assert out_b["rounds"] == out["rounds"], "batched walk not bit-exact"
+    assert out_b["messages"] == out["messages"]
+
     emit({
         "config": f"{n//1_000_000}M WS overlay discovery, "
                   f"{walkers}-walker cohort (single chip)",
-        "value": round(secs, 3),
+        "value": round(best_secs, 3),
         "unit": "s to 99% of the overlay visited",
-        "rounds": int(out["rounds"]),
-        "messages": int(out["messages"]),
-        "rounds_per_s": round(int(out["rounds"]) / secs, 1),
+        "steps_per_round": best_T,
+        "unbatched_s": round(secs, 3),
+        "rounds": int(out_b["rounds"]),
+        "messages": int(out_b["messages"]),
+        "rounds_per_s": round(int(out_b["rounds"]) / best_secs, 1),
         "graph_build_s": round(build_s, 1),
     })
 

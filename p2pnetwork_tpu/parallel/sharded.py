@@ -3105,7 +3105,7 @@ def _walk_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     return jax.jit(fn)
 
 
-def _ring_cov_walk(axis_name, S, block, W, span, restart_p,
+def _ring_cov_walk(axis_name, S, block, W, span, restart_p, steps_per_round,
                    coverage_target, max_rounds,
                    bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                    node_mask, csr_pos, csr_offsets,
@@ -3118,9 +3118,12 @@ def _ring_cov_walk(axis_name, S, block, W, span, restart_p,
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
     )
 
+    def keep_going(covered, rounds):
+        return (covered / n_live < coverage_target) & (rounds < max_rounds)
+
     def cond(carry):
         _, _, _, rounds, covered, _, _ = carry
-        return (covered / n_live < coverage_target) & (rounds < max_rounds)
+        return keep_going(covered, rounds)
 
     def body(carry):
         pos, visited, kd, rounds, _, hi, lo = carry
@@ -3133,13 +3136,41 @@ def _ring_cov_walk(axis_name, S, block, W, span, restart_p,
         return (pos, visited, jax.random.key_data(k), rounds + 1, covered,
                 hi, lo)
 
+    def batched_body(carry):
+        # T sub-steps per while iteration, amortizing the per-iteration
+        # floor (dispatch + the ring's collectives dominate a walker
+        # round, not bandwidth). Bit-exact vs T=1 exactly as in
+        # engine._stat_while: each sub-step re-checks the predicate and
+        # freezes pos/visited/rounds/messages once it fails; the key
+        # chain advances unconditionally but frozen draws are discarded
+        # and the loop exits at the next cond check.
+        def substep(c, _):
+            pos, visited, kd, rounds, covered, hi, lo = c
+            live = keep_going(covered, rounds)
+            k, sub = jax.random.split(jax.random.wrap_key_data(kd))
+            npos, nvisited, moved, _, ncov = one_round(
+                pos, start0, alive_start, visited, sub
+            )
+            pos = jnp.where(live, npos, pos)
+            visited = jnp.where(live, nvisited, visited)
+            covered = jnp.where(live, ncov, covered)
+            hi, lo = accum.add(
+                (hi, lo), jnp.where(live, jnp.sum(moved), 0))
+            rounds = jnp.where(live, rounds + 1, rounds)
+            return (pos, visited, jax.random.key_data(k), rounds, covered,
+                    hi, lo), None
+
+        carry, _ = jax.lax.scan(substep, carry, None,
+                                length=steps_per_round)
+        return carry
+
     covered0 = jax.lax.psum(
         jnp.sum((visited0[0] & node_mask_b).astype(jnp.int32)), axis_name
     )
     init = (pos0, visited0[0], key_data, jnp.int32(0), covered0,
             *accum.zero())
     pos, visited, _, rounds, covered, hi, lo = jax.lax.while_loop(
-        cond, body, init
+        cond, body if steps_per_round == 1 else batched_body, init
     )
     return pos, visited[None], accum.pack_summary(
         rounds, covered / n_live, (hi, lo)
@@ -3148,9 +3179,10 @@ def _ring_cov_walk(axis_name, S, block, W, span, restart_p,
 
 @functools.lru_cache(maxsize=64)
 def _walk_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                 max_rounds: int, W: int, span: int, restart_p: float):
+                 max_rounds: int, W: int, span: int, restart_p: float,
+                 steps_per_round: int = 1):
     body = functools.partial(_ring_cov_walk, axis_name, S, block, W, span,
-                             restart_p)
+                             restart_p, steps_per_round)
     spec = P(axis_name)
     fn = jax.shard_map(
         lambda target, *args: body(target, max_rounds, *args),
@@ -3237,6 +3269,7 @@ def walk_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
                         key: jax.Array, *,
                         coverage_target: float = 0.99,
                         max_rounds: int = 1024,
+                        steps_per_round: int = 1,
                         axis_name: str = DEFAULT_AXIS, state0=None,
                         return_state: bool = False):
     """Walk until the cohort has visited ``coverage_target`` of the live
@@ -3245,16 +3278,24 @@ def walk_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     the overlay). Same identity-keyed draws as :func:`walk`, so the
     trajectory is bit-identical to the engine loop's for any shard count.
 
+    ``steps_per_round=T`` batches T walk rounds per while-loop iteration
+    (bit-exact vs T=1, same contract as ``engine.run_until_coverage``) —
+    the crawl is rounds-bound at a per-iteration floor set by dispatch
+    and the ring's collectives, which T amortizes.
+
     Returns ``(visited, dict(rounds, coverage, messages))``; with
     ``return_state=True``, ``((pos, start, visited), dict)``.
     """
     _walk_require_csr(sg)
+    if steps_per_round < 1:
+        raise ValueError(
+            f"steps_per_round must be >= 1, got {steps_per_round}")
     S, block = sg.n_shards, sg.block
     common, pos0, start0, alive_start, visited0 = _walk_call(
         sg, protocol, state0)
     fn = _walk_cov_fn(mesh, axis_name, S, block, max_rounds,
                       protocol.n_walkers, max(sg.csr_span, 1),
-                      float(protocol.restart_p))
+                      float(protocol.restart_p), int(steps_per_round))
     pos, visited, packed = fn(
         jnp.float32(coverage_target), *common, pos0, start0, alive_start,
         visited0, jax.random.key_data(key),

@@ -52,6 +52,7 @@ def run_until_coverage(
     *,
     coverage_target: float = 0.99,
     max_rounds: int = 1024,
+    steps_per_round: int = 1,
 ):
     """Run until ``stats['coverage'] >= coverage_target`` (or max_rounds).
 
@@ -69,6 +70,7 @@ def run_until_coverage(
     state, packed = _coverage_with_init(
         graph, protocol, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
+        steps_per_round=steps_per_round,
     )
     return state, _unpack_summary(packed)
 
@@ -81,6 +83,7 @@ def run_until_coverage_from(
     *,
     coverage_target: float = 0.99,
     max_rounds: int = 1024,
+    steps_per_round: int = 1,
 ):
     """Run-to-coverage continuing from an existing ``state0`` (resume path).
 
@@ -99,6 +102,7 @@ def run_until_coverage_from(
     state, packed = _coverage_loop(
         graph, protocol, state0, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
+        steps_per_round=steps_per_round,
     )
     return state, _unpack_summary(packed)
 
@@ -117,6 +121,7 @@ def run_until_converged(
     threshold: float,
     max_rounds: int = 1024,
     state0=None,
+    steps_per_round: int = 1,
 ):
     """Run until the scalar ``stats[stat]`` drops BELOW ``threshold`` — the
     run-to-coverage loop's sibling for convergence-style protocols
@@ -134,7 +139,7 @@ def run_until_converged(
     _require_stats(graph, protocol, state0, key, (stat, "messages"))
     state, packed = _converged_loop(
         graph, protocol, state0, key, stat=stat, threshold=threshold,
-        max_rounds=max_rounds,
+        max_rounds=max_rounds, steps_per_round=steps_per_round,
     )
     out = _unpack_summary(packed)
     out["value"] = out.pop("coverage")  # pack_summary's f32 slot, reused
@@ -142,15 +147,16 @@ def run_until_converged(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("protocol", "stat", "max_rounds"))
+                   static_argnames=("protocol", "stat", "max_rounds",
+                                    "steps_per_round"))
 def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
-                    max_rounds):
+                    max_rounds, steps_per_round=1):
     if state0 is None:
         state0 = protocol.init(graph, key)
     return _stat_while(
         graph, protocol, state0, key, stat=stat,
         keep_going=lambda v, r: (v >= threshold) & (r < max_rounds),
-        value0=jnp.float32(jnp.inf),
+        value0=jnp.float32(jnp.inf), steps_per_round=steps_per_round,
     )
 
 
@@ -188,12 +194,28 @@ def _require_stats(graph, protocol, state0, key, required) -> None:
         )
 
 
-def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0):
+def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
+                steps_per_round=1):
     """The shared device-side early-exit loop: run protocol rounds while
     ``keep_going(stats[stat], rounds)`` holds, accumulating messages in the
     two-limb counter and returning the packed one-transfer summary. Both
     run-to-coverage and run-to-convergence are this loop with a different
-    predicate and seed value."""
+    predicate and seed value.
+
+    ``steps_per_round=T`` batches T protocol steps into each while-loop
+    iteration as a ``lax.scan`` — rounds-bound protocols (the walker
+    cohort runs thousands of rounds at a per-iteration floor set by
+    while_loop dispatch, not bandwidth) amortize that floor T-fold.
+    BIT-EXACT vs T=1 by construction, not approximately: each sub-step
+    re-evaluates ``keep_going`` and applies the protocol step only while
+    it holds (a crossed target freezes state/rounds/messages for the
+    remainder of the super-step), and the sub-step RNG chain is the same
+    ``k, sub = split(k)`` sequence the T=1 body walks. The only cost is
+    up to T-1 discarded trailing step computations in the final
+    super-step."""
+    T = int(steps_per_round)
+    if T < 1:
+        raise ValueError(f"steps_per_round must be >= 1, got {T}")
 
     def cond(carry):
         _, _, rounds, value, _, _ = carry
@@ -206,12 +228,36 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0):
         hi, lo = accum.add((hi, lo), stats["messages"])
         return (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo)
 
+    def batched_body(carry):
+        def substep(c, _):
+            state, k, rounds, value, hi, lo = c
+            live = keep_going(value, rounds)
+            # k advances unconditionally: the while carry never exposes
+            # it, and frozen sub-steps discard everything drawn from it,
+            # so the chain the APPLIED steps see matches T=1 exactly.
+            k, sub = jax.random.split(k)
+            new_state, stats = protocol.step(graph, state, sub)
+            state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(live, new, old), new_state, state)
+            hi, lo = accum.add(
+                (hi, lo),
+                jnp.where(live, stats["messages"],
+                          jnp.zeros_like(stats["messages"])))
+            rounds = jnp.where(live, rounds + 1, rounds)
+            value = jnp.where(live, jnp.float32(stats[stat]), value)
+            return (state, k, rounds, value, hi, lo), None
+
+        carry, _ = jax.lax.scan(substep, carry, None, length=T)
+        return carry
+
     init = (state0, key, jnp.int32(0), value0, *accum.zero())
-    state, _, rounds, value, hi, lo = jax.lax.while_loop(cond, body, init)
+    state, _, rounds, value, hi, lo = jax.lax.while_loop(
+        cond, body if T == 1 else batched_body, init)
     return state, _pack_summary(rounds, value, (hi, lo))
 
 
-def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
+def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds,
+                   steps_per_round=1):
     cov0 = (
         jnp.float32(protocol.coverage(graph, state0))
         if hasattr(protocol, "coverage")
@@ -220,20 +266,23 @@ def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds):
     return _stat_while(
         graph, protocol, state0, key, stat="coverage",
         keep_going=lambda v, r: (v < coverage_target) & (r < max_rounds),
-        value0=cov0,
+        value0=cov0, steps_per_round=steps_per_round,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
-def _coverage_with_init(graph, protocol, key, *, coverage_target, max_rounds):
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds",
+                                             "steps_per_round"))
+def _coverage_with_init(graph, protocol, key, *, coverage_target, max_rounds,
+                        steps_per_round=1):
     """init + loop in one XLA program (the fresh-run entry pays zero eager
     dispatches — protocol.init's scatter and the seed coverage all trace)."""
     return _coverage_body(graph, protocol, protocol.init(graph, key), key,
-                          coverage_target, max_rounds)
+                          coverage_target, max_rounds, steps_per_round)
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds"))
+@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds",
+                                             "steps_per_round"))
 def _coverage_loop(graph, protocol, state0, key, *, coverage_target,
-                   max_rounds):
+                   max_rounds, steps_per_round=1):
     return _coverage_body(graph, protocol, state0, key, coverage_target,
-                          max_rounds)
+                          max_rounds, steps_per_round)

@@ -242,6 +242,28 @@ class TestShardedWalk:
         np.testing.assert_array_equal(np.asarray(visited).reshape(-1),
                                       np.asarray(ref_state.visited))
 
+    @pytest.mark.parametrize("T", [3, 8])
+    def test_coverage_loop_batched_bitexact(self, T):
+        # steps_per_round on the ring: same T=1 oracle contract as the
+        # engine loop, same trajectory across shard counts.
+        from p2pnetwork_tpu.parallel import mesh as M, sharded
+
+        g = G.watts_strogatz(512, 8, 0.3, seed=2, source_csr=True)
+        proto = RandomWalks(n_walkers=64)
+        ref_state, ref_out = engine.run_until_coverage(
+            g, proto, jax.random.key(3), coverage_target=0.9,
+            max_rounds=512,
+        )
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh, source_csr=True)
+        visited, out = sharded.walk_until_coverage(
+            sg, mesh, proto, jax.random.key(3), coverage_target=0.9,
+            max_rounds=512, steps_per_round=T,
+        )
+        assert out == ref_out
+        np.testing.assert_array_equal(np.asarray(visited).reshape(-1),
+                                      np.asarray(ref_state.visited))
+
     def test_churn_and_dynamic_links_parity(self):
         from p2pnetwork_tpu.parallel import mesh as M, sharded
         from p2pnetwork_tpu.sim import failures as F
@@ -297,3 +319,71 @@ class TestShardedWalk:
         with pytest.raises(ValueError, match="source_csr"):
             sharded.walk(sg, mesh, RandomWalks(n_walkers=4),
                          jax.random.key(0), 3)
+
+
+class TestBatchedSteps:
+    """steps_per_round=T batches T protocol steps per while-loop iteration
+    (engine._stat_while) to amortize the per-iteration dispatch floor on
+    rounds-bound runs. The contract is BIT-exactness vs T=1 — sub-steps
+    re-check the predicate and freeze once it fails — so every T, even ones
+    that do not divide the round count, must reproduce the oracle run."""
+
+    @pytest.mark.parametrize("T", [2, 3, 7, 16])
+    def test_walk_coverage_bitexact_vs_T1(self, T):
+        g = G.watts_strogatz(512, 4, 0.2, seed=3, source_csr=True)
+        proto = RandomWalks(n_walkers=8)
+        key = jax.random.key(5)
+        s1, o1 = engine.run_until_coverage(
+            g, proto, key, coverage_target=0.95, max_rounds=512)
+        sT, oT = engine.run_until_coverage(
+            g, proto, key, coverage_target=0.95, max_rounds=512,
+            steps_per_round=T)
+        assert o1 == oT, f"summary diverged at T={T}: {o1} vs {oT}"
+        assert (np.asarray(s1.pos) == np.asarray(sT.pos)).all()
+        assert (np.asarray(s1.visited) == np.asarray(sT.visited)).all()
+
+    @pytest.mark.parametrize("T", [2, 5])
+    def test_flood_coverage_bitexact_vs_T1(self, T):
+        from p2pnetwork_tpu.models.flood import Flood
+
+        g = G.watts_strogatz(256, 4, 0.1, seed=0)
+        key = jax.random.key(0)
+        s1, o1 = engine.run_until_coverage(
+            g, Flood(source=0), key, coverage_target=0.99, max_rounds=64)
+        sT, oT = engine.run_until_coverage(
+            g, Flood(source=0), key, coverage_target=0.99, max_rounds=64,
+            steps_per_round=T)
+        assert o1 == oT
+        assert (np.asarray(s1.seen) == np.asarray(sT.seen)).all()
+
+    def test_max_rounds_respected_within_superstep(self):
+        # max_rounds that is not a multiple of T: the frozen sub-steps
+        # must not let the round counter sail past the cap.
+        g = G.watts_strogatz(256, 4, 0.1, seed=1, source_csr=True)
+        proto = RandomWalks(n_walkers=2)  # cannot reach 99% in 5 rounds
+        _, out = engine.run_until_coverage(
+            g, proto, jax.random.key(0), coverage_target=0.99, max_rounds=5,
+            steps_per_round=4)
+        assert out["rounds"] == 5
+
+    @pytest.mark.parametrize("T", [3])
+    def test_converged_loop_bitexact_vs_T1(self, T):
+        from p2pnetwork_tpu.models.pushsum import PushSum
+
+        g = G.watts_strogatz(128, 4, 0.1, seed=2)
+        key = jax.random.key(1)
+        s1, o1 = engine.run_until_converged(
+            g, PushSum(), key, stat="variance", threshold=1e-3, max_rounds=256)
+        sT, oT = engine.run_until_converged(
+            g, PushSum(), key, stat="variance", threshold=1e-3, max_rounds=256,
+            steps_per_round=T)
+        assert o1 == oT
+        assert (np.asarray(s1.s) == np.asarray(sT.s)).all()
+
+    def test_rejects_bad_T(self):
+        g = G.watts_strogatz(64, 4, 0.1, seed=0)
+        from p2pnetwork_tpu.models.flood import Flood
+
+        with pytest.raises(ValueError, match="steps_per_round"):
+            engine.run_until_coverage(g, Flood(source=0), jax.random.key(0),
+                                      steps_per_round=0)
