@@ -102,6 +102,7 @@ def _layout_fingerprint():
     # invalidate.
     for rel in ("bench.py", "p2pnetwork_tpu/sim/graph.py",
                 "p2pnetwork_tpu/ops/blocked.py", "p2pnetwork_tpu/ops/diag.py",
+                "p2pnetwork_tpu/ops/skew.py",
                 "p2pnetwork_tpu/sim/checkpoint.py"):
         with open(os.path.join(_HERE, rel), "rb") as f:
             h.update(f.read())

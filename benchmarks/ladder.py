@@ -161,12 +161,14 @@ def bench_sir_1m():
 
 
 def bench_flood_big(n, label, adaptive_k=1024, *, make_graph=None,
-                    method="hybrid", extra_fields=None):
+                    method="hybrid", compare_methods=(), extra_fields=None):
     """Dense-vs-adaptive flood rung: one warm + one timed coverage run per
     protocol. ``make_graph`` swaps the topology (default 1M-family WS),
-    ``method`` the dense lowering, ``extra_fields(g)`` appends per-graph
-    facts to the emitted record — one harness for every flood rung, so a
-    timing-protocol fix lands on all of them at once."""
+    ``method`` the dense lowering (``compare_methods`` adds rival dense
+    lowerings — each is timed, the fastest drives the adaptive run and
+    every time lands in the record), ``extra_fields(g)`` appends
+    per-graph facts to the emitted record — one harness for every flood
+    rung, so a timing-protocol fix lands on all of them at once."""
     import jax
 
     from p2pnetwork_tpu.models import AdaptiveFlood, Flood
@@ -191,13 +193,20 @@ def bench_flood_big(n, label, adaptive_k=1024, *, make_graph=None,
                                            max_rounds=64)
         return time.perf_counter() - t0, out
 
-    dense_s, _ = run(Flood(source=0, method=method))
+    dense_times = {}
+    for meth in (method, *compare_methods):
+        dense_times[meth], _ = run(Flood(source=0, method=meth))
+    method = min(dense_times, key=dense_times.get)
+    dense_s = dense_times[method]
     secs, out = run(AdaptiveFlood(source=0, method=method, k=adaptive_k))
     emit({
         "config": label,
         "value": round(secs, 4),
         "unit": f"s to 99% coverage (adaptive-{adaptive_k}; "
                 f"dense {method} {dense_s:.3f}s)",
+        **({"dense_times_s": {m: round(s, 4)
+                              for m, s in dense_times.items()}}
+           if compare_methods else {}),
         "rounds": int(out["rounds"]),
         "messages": int(out["messages"]),
         "msgs_per_sec_per_chip": round(int(out["messages"]) / secs, 1),
@@ -213,21 +222,46 @@ def bench_flood_ba(n=100_000, m=4, adaptive_k=1024):
     hub degrees are identical), under the flood workload. Round 4's
     work-item chunking budgets sparse
     rounds by out-edge mass, so the hub-skewed degree distribution gets
-    the adaptive win too (it was excluded before; VERDICT r3 #2)."""
+    the adaptive win too (it was excluded before; VERDICT r3 #2).
+
+    Dense lowerings raced per rung: sorted segment (the r4 answer —
+    measured 0.118 s vs hybrid 0.41 s / pallas 2.17 s / padded gather
+    3.97 s on this topology) vs the two-level skew table (ops/skew.py,
+    VERDICT r4 #2) whose cost model predicts ~2x under segment."""
     bench_flood_big(
         n,
         f"{n//1000}K BA (m={m}) seen-set flood, hub-tolerant adaptive "
         f"(single chip)",
         adaptive_k,
         make_graph=lambda G: G.barabasi_albert(
-            n, m, seed=0, build_neighbor_table=False, source_csr=True),
-        # Sorted segment reductions are the right lowering for skewed
-        # degrees: the hub widens every padded row/bucket of the other
-        # layouts (measured on chip, 4-round flood: segment 0.118 s vs
-        # hybrid 0.41 s, pallas 2.17 s, padded gather 3.97 s) — the same
-        # waste bound ops/segment.py's "auto" now applies.
+            n, m, seed=0, build_neighbor_table=False, source_csr=True,
+            skew_table=True),
         method="segment",
-        extra_fields=lambda g: {"max_out_degree": max(1, g.max_out_span)},
+        compare_methods=("skew",),
+        extra_fields=lambda g: {"max_out_degree": max(1, g.max_out_span),
+                                "skew_width": g.skew.width,
+                                "skew_rows": g.skew.n_rows},
+    )
+
+
+def bench_flood_ba_1m(n=1_000_000, m=5, adaptive_k=2048):
+    """The 1M-node scale-free rung (VERDICT r4 #2): ~10M directed edges
+    under a power-law degree distribution — the realistic overlay shape
+    at the north-star scale, where the hub machinery must prove itself
+    end-to-end."""
+    bench_flood_big(
+        n,
+        f"1M BA (m={m}) seen-set flood, hub-tolerant adaptive "
+        f"(single chip)",
+        adaptive_k,
+        make_graph=lambda G: G.barabasi_albert(
+            n, m, seed=0, build_neighbor_table=False, source_csr=True,
+            skew_table=True),
+        method="segment",
+        compare_methods=("skew",),
+        extra_fields=lambda g: {"max_out_degree": max(1, g.max_out_span),
+                                "skew_width": g.skew.width,
+                                "skew_rows": g.skew.n_rows},
     )
 
 
@@ -563,6 +597,7 @@ def main():
     bench_flood_sharded_ring()
     bench_flood_auto()
     bench_flood_ba()
+    bench_flood_ba_1m()
     bench_discovery()
     bench_plumtree()
     bench_routing()

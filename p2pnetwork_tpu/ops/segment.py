@@ -50,6 +50,26 @@ def _gather_ok(graph: Graph) -> bool:
     return slots <= _GATHER_WASTE_BOUND * max(graph.n_edges, 1)
 
 
+def _auto_method(graph: Graph) -> str:
+    """``auto``'s routing: the plain table while its waste is bounded; the
+    two-level skew table when the graph carries one (its waste is bounded
+    by construction — attaching it signals a degree-skewed family, where
+    it beats segment's per-edge constant); segment otherwise."""
+    if _gather_ok(graph):
+        return "gather"
+    if graph.skew is not None:
+        return "skew"
+    return "segment"
+
+
+def _require_skew(graph: Graph) -> None:
+    if graph.skew is None:
+        raise ValueError(
+            "method='skew' requires the two-level neighbor table — build "
+            "with from_edges(skew_table=True) or graph.with_skew_table()"
+        )
+
+
 def _require_complete_table(graph: Graph) -> None:
     if graph.neighbors is None:
         raise ValueError("method='gather' requires a graph with a neighbor table")
@@ -94,11 +114,17 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
                                      dyn_receivers=None, dyn_mask=None)
         return propagate_or(static, signal, method) | _dynamic_or(graph, signal)
     if method == "auto":
-        method = "gather" if _gather_ok(graph) else "segment"
+        method = _auto_method(graph)
     if method == "gather":
         _require_complete_table(graph)
         vals = signal[graph.neighbors] & graph.neighbor_mask
         return jnp.any(vals, axis=1) & graph.node_mask
+    if method == "skew":
+        from p2pnetwork_tpu.ops import skew as SK
+
+        _require_skew(graph)
+        return SK.or_skew(graph.skew, signal,
+                          graph.n_nodes_padded) & graph.node_mask
     if method in ("blocked", "pallas"):
         from p2pnetwork_tpu.ops import blocked as B
         from p2pnetwork_tpu.ops import pallas_edge as PK
@@ -142,11 +168,17 @@ def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto",
         return (propagate_sum(static, signal, method, exact)
                 + _dynamic_sum(graph, signal))
     if method == "auto":
-        method = "gather" if _gather_ok(graph) else "segment"
+        method = _auto_method(graph)
     if method == "gather":
         _require_complete_table(graph)
         vals = signal[graph.neighbors] * graph.neighbor_mask.astype(signal.dtype)
         return jnp.sum(vals, axis=1) * graph.node_mask.astype(signal.dtype)
+    if method == "skew":
+        from p2pnetwork_tpu.ops import skew as SK
+
+        _require_skew(graph)
+        agg = SK.sum_skew(graph.skew, signal, graph.n_nodes_padded)
+        return agg * graph.node_mask.astype(signal.dtype)
     if method in ("blocked", "pallas"):
         from p2pnetwork_tpu.ops import blocked as B
         from p2pnetwork_tpu.ops import pallas_edge as PK
@@ -216,12 +248,17 @@ def propagate_max(graph: Graph, signal: jax.Array,
         return jnp.maximum(propagate_max(static, signal, method),
                            _dynamic_max(graph, signal))
     if method == "auto":
-        method = "gather" if _gather_ok(graph) else "segment"
+        method = _auto_method(graph)
     if method == "gather":
         _require_complete_table(graph)
         vals = jnp.where(graph.neighbor_mask, signal[graph.neighbors],
                          neutral)
         agg = jnp.max(vals, axis=1)
+    elif method == "skew":
+        from p2pnetwork_tpu.ops import skew as SK
+
+        _require_skew(graph)
+        agg = SK.max_skew(graph.skew, signal, graph.n_nodes_padded, neutral)
     elif method == "segment":
         contrib = jnp.where(graph.edge_mask, signal[graph.senders], neutral)
         agg = jax.ops.segment_max(
@@ -232,8 +269,9 @@ def propagate_max(graph: Graph, signal: jax.Array,
         )
     else:
         raise ValueError(
-            f"propagate_max supports method 'segment' or 'gather', got "
-            f"{method!r} (max does not ride the one-hot-matmul lowerings)"
+            f"propagate_max supports method 'segment', 'gather' or 'skew', "
+            f"got {method!r} (max does not ride the one-hot-matmul "
+            f"lowerings)"
         )
     return jnp.where(graph.node_mask, agg, neutral)
 
@@ -277,9 +315,11 @@ def propagate_min_plus(graph: Graph, dist: jax.Array,
                            _dynamic_min_plus(graph, dist))
     weighted = graph.edge_weight is not None
     if method == "auto":
-        gather_fits = _gather_ok(graph) and (
-            not weighted or graph.neighbor_weight is not None)
-        method = "gather" if gather_fits else "segment"
+        method = _auto_method(graph)
+        if method == "gather" and weighted and graph.neighbor_weight is None:
+            method = "segment"
+        if method == "skew" and weighted and graph.skew.weight is None:
+            method = "segment"
     if method == "gather":
         _require_complete_table(graph)
         if weighted and graph.neighbor_weight is None:
@@ -292,6 +332,18 @@ def propagate_min_plus(graph: Graph, dist: jax.Array,
         vals = jnp.where(graph.neighbor_mask, dist[graph.neighbors] + w,
                          jnp.inf)
         agg = jnp.min(vals, axis=1)
+    elif method == "skew":
+        from p2pnetwork_tpu.ops import skew as SK
+
+        _require_skew(graph)
+        if weighted and graph.skew.weight is None:
+            raise ValueError(
+                "method='skew' on a weighted graph needs the aligned "
+                "weight view — build via from_edges(weights=..., "
+                "skew_table=True) or Graph.with_weights, or use "
+                "method='segment'"
+            )
+        agg = SK.min_plus_skew(graph.skew, dist, graph.n_nodes_padded)
     elif method == "segment":
         w = graph.edge_weight if weighted else 1.0
         contrib = jnp.where(graph.edge_mask, dist[graph.senders] + w,
@@ -304,8 +356,8 @@ def propagate_min_plus(graph: Graph, dist: jax.Array,
         )
     else:
         raise ValueError(
-            f"propagate_min_plus supports method 'segment' or 'gather', "
-            f"got {method!r} (min does not ride the one-hot-matmul "
+            f"propagate_min_plus supports method 'segment', 'gather' or "
+            f"'skew', got {method!r} (min does not ride the one-hot-matmul "
             f"lowerings)"
         )
     return jnp.where(graph.node_mask, agg, jnp.inf)

@@ -1,0 +1,285 @@
+"""Two-level (skew-split) neighbor table — hub-proof gather aggregation.
+
+The padded neighbor table ``[N, max_degree]`` is the fast aggregation
+layout for quasi-regular graphs, but its gather cost is per padded SLOT
+(~8 cycles/element on the TPU — BENCH.md "gather floor"), and the width is
+set by the single largest in-degree: one Barabási–Albert hub at degree
+~1400 widens every row, measured at 178× padding waste on 100K BA, where
+the sorted-segment lowering wins 33×. Segment, though, pays its own
+per-edge constant (~33 cycles measured) on EVERY edge — it is the right
+floor for the hub's edges and the wrong one for the quasi-regular mass.
+
+This module splits the difference structurally. Rows are **virtual**: a
+node of in-degree ``d`` owns ``ceil(d / W)`` rows of a FIXED width ``W``
+(the two-level representation VERDICT r4 names): a quasi-regular node is
+one row, a hub is many. The aggregation is then
+
+1. gather + reduce each virtual row — ``[R, W]`` slots at the gather
+   floor, where ``R·W ≈ E · (small constant)`` by construction, whatever
+   the degree distribution (the hub cannot widen anyone else's row);
+2. combine virtual rows into their owners with a sorted segment
+   reduction over ``R ≈ N`` elements — the segment constant paid per
+   ROW, not per edge.
+
+Cost model (the constants measured on-chip, BENCH.md): ``8·R·W + 33·R``
+cycles vs segment's ``33·E`` — ``pick_width`` minimizes it over candidate
+widths from the build-time degree histogram. On 1M BA (m=5, ~10M directed
+edges) the model predicts ~2× over segment; on quasi-regular families the
+plain table/hybrid layouts stay preferable and ``auto`` keeps choosing
+them.
+
+Rows inherit the receiver-sorted COO order, so ``owner`` is
+non-decreasing (``indices_are_sorted=True`` holds) and each row covers a
+contiguous edge range ``[start, start + W)`` — which is what lets runtime
+edge failures re-mask the table exactly, device-side, with no rebuild
+(sim/failures.py).
+
+The reference has no analog: its per-peer neighbor state is a Python list
+of socket threads, and "aggregation" is a sequential send loop
+[ref: p2pnetwork/node.py:110-112].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Candidate virtual-row widths: sublane-multiple sizes from "hub chunk"
+#: down to "half a vreg lane tile".
+WIDTH_CANDIDATES = (8, 16, 32, 64, 128)
+
+#: Measured per-slot gather cost and per-element sorted-segment cost, in
+#: TPU cycles (BENCH.md "gather floor" + the BA segment measurement) —
+#: only their RATIO matters to the width choice.
+_GATHER_CYCLES_PER_SLOT = 8.0
+_SEGMENT_CYCLES_PER_ELEM = 33.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SkewTable:
+    """Virtual-row (two-level) incoming-neighbor table.
+
+    ``src``/``mask`` are ``[R_pad, W]``: the sending node per slot and the
+    validity mask. ``owner[r]`` is the receiving node whose in-edges row
+    ``r`` holds (non-decreasing; padding rows own ``n_pad - 1`` with an
+    all-False mask). ``start[r]`` is the row's first slot as an offset
+    into the receiver-sorted COO edge arrays — the slot->edge map that
+    makes exact runtime edge re-masking possible. ``weight`` is the
+    aligned per-slot cost view on weighted graphs (None otherwise).
+    """
+
+    src: jax.Array  # i32[R_pad, W]
+    mask: jax.Array  # bool[R_pad, W]
+    owner: jax.Array  # i32[R_pad], non-decreasing
+    start: jax.Array  # i32[R_pad]
+    weight: Optional[jax.Array] = None  # f32[R_pad, W]
+
+    @property
+    def n_rows(self) -> int:
+        return self.src.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.src.shape[1]
+
+    @property
+    def n_slots(self) -> int:
+        return self.src.shape[0] * self.src.shape[1]
+
+    def edge_slots(self, e_pad: int) -> jax.Array:
+        """``[R_pad, W]`` COO edge id per slot — THE slot->edge map (row
+        ``r``'s slot ``s`` is edge ``start[r] + s``; rows inherit the
+        receiver-sorted order). Clipped in-bounds for padding slots,
+        whose masks are False. The single definition both the
+        edge-liveness re-mask and the aligned-weight rebuild use — they
+        must never disagree."""
+        return jnp.minimum(
+            self.start[:, None] + jnp.arange(self.width)[None, :], e_pad - 1
+        )
+
+
+def pick_width(in_degrees: np.ndarray,
+               candidates=WIDTH_CANDIDATES) -> int:
+    """Choose the virtual-row width minimizing the modeled round cost
+    ``gather·slots(W) + segment·rows(W)`` over the build-time degree
+    histogram. Small widths waste fewer slots on low-degree rows but pay
+    the per-row combine on more rows; hubs are indifferent (their slot
+    count is ~d either way)."""
+    d = np.asarray(in_degrees, dtype=np.int64)
+    d = d[d > 0]
+    if d.size == 0:
+        return candidates[0]
+    best_w, best_cost = candidates[0], np.inf
+    for w in candidates:
+        rows = (d + w - 1) // w
+        cost = (_GATHER_CYCLES_PER_SLOT * float(rows.sum()) * w
+                + _SEGMENT_CYCLES_PER_ELEM * float(rows.sum()))
+        if cost < best_cost:
+            best_w, best_cost = w, cost
+    return best_w
+
+
+def build_skew_from_arrays(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    n_pad: int,
+    e_pad: int,
+    width: int = 0,
+    weights: Optional[np.ndarray] = None,
+    row_pad_multiple: int = 8,
+) -> SkewTable:
+    """Build the table host-side from the receiver-sorted BUILD-time edge
+    list (the unpadded prefix of the COO arrays — padding slots enter no
+    row; runtime liveness is a re-mask, not a rebuild).
+
+    ``width=0`` picks via :func:`pick_width`. ``e_pad`` seeds the padding
+    rows' ``start`` with an in-bounds sentinel.
+    """
+    from p2pnetwork_tpu.sim.graph import _padded_row_fill
+
+    senders = np.asarray(senders, dtype=np.int32)
+    receivers = np.asarray(receivers, dtype=np.int32)
+    e = senders.size
+    counts = np.bincount(receivers, minlength=n_pad).astype(np.int64) \
+        if e else np.zeros(n_pad, dtype=np.int64)
+    if width <= 0:
+        width = pick_width(counts)
+
+    rows_per = (counts + width - 1) // width  # zero-degree nodes: no row
+    r_total = int(rows_per.sum())
+    r_pad = max(
+        ((r_total + row_pad_multiple - 1) // row_pad_multiple)
+        * row_pad_multiple,
+        row_pad_multiple,
+    )
+
+    owner = np.full(r_pad, n_pad - 1, dtype=np.int32)
+    start = np.full(r_pad, e_pad - 1, dtype=np.int32)
+    src = np.zeros((r_pad, width), dtype=np.int32)
+    mask = np.zeros((r_pad, width), dtype=bool)
+    weight = None
+    if weights is not None:
+        weight = np.zeros((r_pad, width), dtype=np.float32)
+
+    if r_total:
+        node_ids = np.nonzero(rows_per)[0]
+        node_starts = np.concatenate(
+            ([0], np.cumsum(counts))).astype(np.int64)[:-1]
+        own = np.repeat(node_ids, rows_per[node_ids]).astype(np.int32)
+        # Slice index within each node's row group: 0..rows_per-1.
+        grp = np.cumsum(rows_per[node_ids]) - rows_per[node_ids]
+        j = np.arange(r_total, dtype=np.int64) - np.repeat(
+            grp, rows_per[node_ids])
+        row_start = node_starts[own] + j * width
+        row_count = np.minimum(width, counts[own] - j * width)
+        take, valid = _padded_row_fill(row_start, row_count, width)
+        take_safe = np.minimum(take, max(e - 1, 0))
+        pool = senders if e else np.zeros(1, dtype=np.int32)
+        owner[:r_total] = own
+        start[:r_total] = row_start.astype(np.int32)
+        src[:r_total] = np.where(valid, pool[take_safe], 0)
+        mask[:r_total] = valid
+        if weights is not None:
+            wpool = (np.asarray(weights, dtype=np.float32)
+                     if e else np.zeros(1, dtype=np.float32))
+            weight[:r_total] = np.where(valid, wpool[take_safe], 0.0)
+
+    return SkewTable(
+        src=jnp.asarray(src),
+        mask=jnp.asarray(mask),
+        owner=jnp.asarray(owner),
+        start=jnp.asarray(start),
+        weight=None if weight is None else jnp.asarray(weight),
+    )
+
+
+def build_skew(graph, width: int = 0) -> SkewTable:
+    """Build from a :class:`~p2pnetwork_tpu.sim.graph.Graph` (pulls the
+    edge arrays to host; prefer ``from_edges(skew_table=True)`` at
+    construction for large graphs). Uses BUILD-time edges (the unpadded
+    prefix), matching the neighbor-table contract: runtime failures
+    re-mask, they do not rebuild."""
+    e = graph.n_edges
+    w = (None if graph.edge_weight is None
+         else np.asarray(graph.edge_weight)[:e])
+    return build_skew_from_arrays(
+        np.asarray(graph.senders)[:e],
+        np.asarray(graph.receivers)[:e],
+        graph.n_nodes_padded,
+        graph.n_edges_padded,
+        width=width,
+        weights=w,
+    )
+
+
+# ------------------------------------------------------------- lowerings
+#
+# All four follow the same two-level shape: per-row gather + axis-1
+# reduce, then a sorted segment combine over owners. Padding rows own
+# n_pad-1 with all-False masks, so they contribute the operation's
+# neutral; dead/ownerless nodes are re-masked by the caller's node_mask
+# (propagate_* in ops/segment.py applies it).
+
+
+def or_skew(t: SkewTable, signal: jax.Array, n_pad: int) -> jax.Array:
+    vals = signal[t.src] & t.mask
+    part = jnp.any(vals, axis=1).astype(jnp.int32)
+    agg = jax.ops.segment_max(
+        part, t.owner, num_segments=n_pad, indices_are_sorted=True
+    )
+    return agg > 0
+
+
+def sum_skew(t: SkewTable, signal: jax.Array, n_pad: int) -> jax.Array:
+    vals = signal[t.src] * t.mask.astype(signal.dtype)
+    part = jnp.sum(vals, axis=1)
+    return jax.ops.segment_sum(
+        part, t.owner, num_segments=n_pad, indices_are_sorted=True
+    )
+
+
+def max_skew(t: SkewTable, signal: jax.Array, n_pad: int,
+             neutral: jax.Array) -> jax.Array:
+    vals = jnp.where(t.mask, signal[t.src], neutral)
+    part = jnp.max(vals, axis=1)
+    return jax.ops.segment_max(
+        part, t.owner, num_segments=n_pad, indices_are_sorted=True
+    )
+
+
+def min_plus_skew(t: SkewTable, dist: jax.Array, n_pad: int) -> jax.Array:
+    w = t.weight if t.weight is not None else 1.0
+    vals = jnp.where(t.mask, dist[t.src] + w, jnp.inf)
+    part = jnp.min(vals, axis=1)
+    return jax.ops.segment_min(
+        part, t.owner, num_segments=n_pad, indices_are_sorted=True
+    )
+
+
+# ------------------------------------------------------- liveness remask
+
+
+def remask_nodes(t: Optional[SkewTable],
+                 node_alive: jax.Array) -> Optional[SkewTable]:
+    """Node-liveness re-mask (sim/failures.py contract): a slot survives
+    iff its sender and its row's owner are both alive."""
+    if t is None:
+        return None
+    mask = t.mask & node_alive[t.src] & node_alive[t.owner][:, None]
+    return dataclasses.replace(t, mask=mask)
+
+
+def remask_edges(t: Optional[SkewTable], edge_mask: jax.Array,
+                 e_pad: int) -> Optional[SkewTable]:
+    """Edge-liveness re-mask: row ``r``'s slot ``s`` is COO edge
+    ``start[r] + s`` (rows inherit the receiver-sorted order), so the
+    edge mask gathers straight into the table — exact, device-side."""
+    if t is None:
+        return None
+    return dataclasses.replace(
+        t, mask=t.mask & edge_mask[t.edge_slots(e_pad)])

@@ -99,6 +99,27 @@ def shard_graph_auto(graph: Graph, mesh: Mesh,
             remainder=put_blocked(hybrid.remainder),
         )
 
+    def put_skew(skew):
+        # Virtual rows are owner-sorted (node order), so sharding the row
+        # axis keeps each shard's rows aligned with the shard owning
+        # their receiver nodes; only the (node-extent) signal gather and
+        # the owner-segment combine cross shards. Row padding is a
+        # multiple of 8, not 128 — replicate when it does not divide
+        # (tiny graphs, odd meshes), same contract as put_blocked.
+        if skew is None:
+            return None
+        div = skew.src.shape[0] % axis_size == 0
+        rspec = NamedSharding(mesh, P(axis_name) if div else P())
+        return dataclasses.replace(
+            skew,
+            src=jax.device_put(skew.src, rspec),
+            mask=jax.device_put(skew.mask, rspec),
+            owner=jax.device_put(skew.owner, rspec),
+            start=jax.device_put(skew.start, rspec),
+            weight=(None if skew.weight is None
+                    else jax.device_put(skew.weight, rspec)),
+        )
+
     return dataclasses.replace(
         graph,
         senders=put(graph.senders),
@@ -113,6 +134,7 @@ def shard_graph_auto(graph: Graph, mesh: Mesh,
         neighbor_weight=put(graph.neighbor_weight),
         blocked=put_blocked(graph.blocked),
         hybrid=put_hybrid(graph.hybrid),
+        skew=put_skew(graph.skew),
     )
 
 

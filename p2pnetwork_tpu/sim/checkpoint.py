@@ -361,6 +361,14 @@ def save_graph(path: str, graph) -> None:
             jax.device_get(graph.blocked.local_dst))
         payload["blocked_mask"] = np.asarray(
             jax.device_get(graph.blocked.mask))
+    if graph.skew is not None:
+        payload["skew_src"] = np.asarray(jax.device_get(graph.skew.src))
+        payload["skew_mask"] = np.asarray(jax.device_get(graph.skew.mask))
+        payload["skew_owner"] = np.asarray(jax.device_get(graph.skew.owner))
+        payload["skew_start"] = np.asarray(jax.device_get(graph.skew.start))
+        if graph.skew.weight is not None:
+            payload["skew_weight"] = np.asarray(
+                jax.device_get(graph.skew.weight))
     if graph.hybrid is not None:
         meta["hybrid_offsets"] = list(graph.hybrid.offsets)
         meta["hybrid_n"] = graph.hybrid.n
@@ -419,6 +427,18 @@ def load_graph(path: str):
                 mask=jnp.asarray(data["blocked_mask"]),
                 block=int(meta["blocked_block"]),
             )
+        skew = None
+        if "skew_src" in data.files:
+            from p2pnetwork_tpu.ops.skew import SkewTable
+
+            skew = SkewTable(
+                src=jnp.asarray(data["skew_src"]),
+                mask=jnp.asarray(data["skew_mask"]),
+                owner=jnp.asarray(data["skew_owner"]),
+                start=jnp.asarray(data["skew_start"]),
+                weight=(jnp.asarray(data["skew_weight"])
+                        if "skew_weight" in data.files else None),
+            )
         hybrid = None
         if "hybrid_masks" in data.files:
             rem = None
@@ -443,5 +463,6 @@ def load_graph(path: str):
             max_out_span=int(meta["max_out_span"]),
             blocked=blocked,
             hybrid=hybrid,
+            skew=skew,
             **fields,
         )

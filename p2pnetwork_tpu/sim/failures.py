@@ -92,6 +92,14 @@ def _remask_hybrid(hybrid, node_alive: jax.Array):
     )
 
 
+def _remask_skew_nodes(skew, node_alive: jax.Array):
+    if skew is None:
+        return None
+    from p2pnetwork_tpu.ops import skew as SK
+
+    return SK.remask_nodes(skew, node_alive)
+
+
 def with_node_liveness(graph: Graph, node_alive: jax.Array) -> Graph:
     """Apply a liveness mask (bool[N_pad]; False = failed) to ``graph``.
 
@@ -129,6 +137,7 @@ def with_node_liveness(graph: Graph, node_alive: jax.Array) -> Graph:
         neighbor_mask=neighbor_mask,
         blocked=_remask_blocked(graph.blocked, node_mask),
         hybrid=_remask_hybrid(graph.hybrid, node_mask),
+        skew=_remask_skew_nodes(graph.skew, node_mask),
     )
 
 
@@ -191,6 +200,13 @@ def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
             # gone, so the table cannot be re-masked exactly.
             neighbors = None
             neighbor_mask = None
+    skew = graph.skew
+    if skew is not None:
+        # The two-level table keeps its slot->edge map (SkewTable.start),
+        # so edge cuts re-mask it exactly, device-side.
+        from p2pnetwork_tpu.ops import skew as SK
+
+        skew = SK.remask_edges(skew, edge_mask, graph.n_edges_padded)
     return dataclasses.replace(
         graph,
         edge_mask=edge_mask,
@@ -198,6 +214,7 @@ def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
         out_degree=out_degree,
         neighbors=neighbors,
         neighbor_mask=neighbor_mask,
+        skew=skew,
     )
 
 

@@ -104,6 +104,10 @@ class Graph:
     # Optional diagonal+remainder representation (ops/diag.py) feeding the
     # gather-free "hybrid" aggregation path; attach via with_hybrid().
     hybrid: Optional[object] = None
+    # Optional two-level (virtual-row) neighbor table (ops/skew.py) feeding
+    # the hub-proof "skew" aggregation path for degree-skewed families;
+    # attach via with_skew_table() or from_edges(skew_table=True).
+    skew: Optional[object] = None
     # Dynamic edge region (sim/topology.py): unsorted COO slots for links
     # added at runtime; folded into every aggregation method.
     dyn_senders: Optional[jax.Array] = None  # i32[K]
@@ -224,7 +228,28 @@ class Graph:
             nw = jnp.asarray(np.where(
                 valid, wh[np.minimum(take, max(self.n_edges - 1, 0))], 0.0
             ).astype(np.float32))
-        return dataclasses.replace(self, edge_weight=w, neighbor_weight=nw)
+        sk = self.skew
+        if sk is not None:
+            # The virtual rows keep their COO slot map (SkewTable
+            # .edge_slots), so the aligned weight view is one gather.
+            sk = dataclasses.replace(
+                sk,
+                weight=jnp.where(
+                    sk.mask, w[sk.edge_slots(self.n_edges_padded)], 0.0))
+        return dataclasses.replace(self, edge_weight=w, neighbor_weight=nw,
+                                   skew=sk)
+
+    def with_skew_table(self, width: int = 0) -> "Graph":
+        """Return a copy carrying the two-level (virtual-row) neighbor
+        table used by the ``"skew"`` aggregation method — fixed-width row
+        slices a hub cannot widen, combined by a per-row sorted segment
+        reduction (ops/skew.py). ``width=0`` picks the width from the
+        degree histogram. Pulls edge arrays to host; prefer
+        ``from_edges(skew_table=True)`` at construction for large
+        graphs."""
+        from p2pnetwork_tpu.ops.skew import build_skew
+
+        return dataclasses.replace(self, skew=build_skew(self, width))
 
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
@@ -274,6 +299,8 @@ def from_edges(
     max_degree: Optional[int] = None,
     blocked: bool = False,
     hybrid: bool = False,
+    skew_table: bool = False,
+    skew_width: int = 0,
     source_csr: bool = False,
     weights=None,
 ) -> Graph:
@@ -384,7 +411,7 @@ def from_edges(
             neighbor_weight = np.where(valid, wpool[take_safe], 0.0).astype(
                 np.float32)
 
-    blocked_rep = hybrid_rep = None
+    blocked_rep = hybrid_rep = skew_rep = None
     if blocked:
         from p2pnetwork_tpu.ops.blocked import build_blocked_from_arrays
 
@@ -393,6 +420,13 @@ def from_edges(
         from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
 
         hybrid_rep = build_hybrid_from_arrays(senders, receivers, n_nodes, n_pad)
+    if skew_table:
+        from p2pnetwork_tpu.ops.skew import build_skew_from_arrays
+
+        skew_rep = build_skew_from_arrays(
+            senders, receivers, n_pad, e_pad, width=skew_width,
+            weights=weights,
+        )
 
     src_eid = src_offsets = None
     max_out_span = 0
@@ -418,6 +452,7 @@ def from_edges(
         max_in_span=max_in_span,
         blocked=blocked_rep,
         hybrid=hybrid_rep,
+        skew=skew_rep,
         src_eid=src_eid,
         src_offsets=src_offsets,
         max_out_span=max_out_span,

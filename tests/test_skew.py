@@ -1,0 +1,245 @@
+"""Two-level (skew-split) neighbor table — ops/skew.py.
+
+The hub-proof aggregation layout VERDICT r4 asked for: fixed-width
+virtual rows a hub cannot widen, combined by a sorted per-row segment
+reduction. Oracle everywhere is the ``segment`` lowering (exact for
+or/max/min on any graph; sum parity is tested on exactly-representable
+values, the same contract the MXU lowerings document).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.ops import segment, skew  # noqa: E402
+from p2pnetwork_tpu.sim import failures, graph as G  # noqa: E402
+
+
+def _ba(n=2000, m=4, **kw):
+    return G.barabasi_albert(n, m, seed=0, skew_table=True, **kw)
+
+
+def _signals(g, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random(g.n_nodes_padded) < 0.3)
+
+
+class TestBuild:
+    def test_structure_invariants(self):
+        g = _ba()
+        t = g.skew
+        assert t is not None
+        owner = np.asarray(t.owner)
+        assert (np.diff(owner) >= 0).all(), "owner must be non-decreasing"
+        # Mask slot count == build edge count (every edge exactly once).
+        assert int(np.asarray(t.mask).sum()) == g.n_edges
+        # Padding rows own the padding node with empty masks.
+        live_rows = int(
+            (np.asarray(t.mask).any(axis=1)).sum())
+        assert (owner[live_rows:] == g.n_nodes_padded - 1).all()
+        # A hub of degree d owns ceil(d/W) rows.
+        deg = np.asarray(g.in_degree)
+        hub = int(deg.argmax())
+        w = t.width
+        assert (owner == hub).sum() == -(-int(deg[hub]) // w)
+
+    def test_waste_is_bounded_on_hub_graphs(self):
+        g = _ba()
+        t = g.skew
+        # The whole point: the plain table's waste here is huge (one hub
+        # widens every row); the two-level table stays under ~2.2x + the
+        # one-row-per-node floor, whatever the skew.
+        plain = G.barabasi_albert(2000, 4, seed=0)
+        plain_waste = (plain.neighbors.shape[0] * plain.neighbors.shape[1]
+                       / plain.n_edges)
+        wasted = t.n_slots / g.n_edges
+        assert plain_waste > 10
+        assert wasted < plain_waste / 4
+        # Structural bound: slots <= E + (rows * (W-1)) is trivially true;
+        # assert the chosen width keeps rows near N (one per node).
+        assert t.n_rows < 2 * g.n_nodes_padded
+
+    def test_pick_width_prefers_small_on_low_degree(self):
+        assert skew.pick_width(np.full(1000, 6)) == 8
+        # Uniform degree-128 rows: W=128 wastes nothing and minimizes rows.
+        assert skew.pick_width(np.full(1000, 128)) == 128
+
+    def test_empty_graph(self):
+        g = G.from_edges([], [], 4, skew_table=True)
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool)
+        out = segment.propagate_or(g, sig, "skew")
+        assert not bool(out.any())
+
+
+class TestParityWithSegment:
+    @pytest.mark.parametrize("maker", [
+        lambda: _ba(),
+        lambda: G.watts_strogatz(1024, 6, 0.2, seed=1, skew_table=True),
+        lambda: G.erdos_renyi(777, 0.01, seed=2, skew_table=True),
+    ])
+    def test_or_parity(self, maker):
+        g = maker()
+        sig = _signals(g)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_or(g, sig, "skew")),
+            np.asarray(segment.propagate_or(g, sig, "segment")))
+
+    def test_or_parity_star_hub(self):
+        # The adversarial shape: one node receives from everyone.
+        n = 500
+        src = np.arange(1, n)
+        g = G.from_edges(np.concatenate([src, np.zeros(n - 1, np.int32)]),
+                         np.concatenate([np.zeros(n - 1, np.int32), src]),
+                         n, skew_table=True)
+        sig = _signals(g, seed=3)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_or(g, sig, "skew")),
+            np.asarray(segment.propagate_or(g, sig, "segment")))
+
+    def test_sum_parity_exact_values(self):
+        g = _ba()
+        rng = np.random.default_rng(4)
+        sig = jnp.asarray(
+            rng.integers(0, 7, g.n_nodes_padded).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_sum(g, sig, "skew")),
+            np.asarray(segment.propagate_sum(g, sig, "segment")))
+
+    def test_max_parity(self):
+        g = _ba()
+        rng = np.random.default_rng(5)
+        sig = jnp.asarray(rng.integers(-50, 50, g.n_nodes_padded)
+                          .astype(np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_max(g, sig, "skew")),
+            np.asarray(segment.propagate_max(g, sig, "segment")))
+
+    def test_min_plus_parity_weighted(self):
+        n, m = 1200, 3
+        base = G.barabasi_albert(n, m, seed=6)
+        e = base.n_edges
+        rng = np.random.default_rng(7)
+        s = np.asarray(base.senders)[:e]
+        r = np.asarray(base.receivers)[:e]
+        w = rng.uniform(0.5, 3.0, e).astype(np.float32)
+        g = G.from_edges(s, r, n, skew_table=True, weights=w)
+        dist = jnp.where(jnp.arange(g.n_nodes_padded) == 0, 0.0, jnp.inf)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_min_plus(g, dist, "skew")),
+            np.asarray(segment.propagate_min_plus(g, dist, "segment")))
+
+    def test_with_weights_builds_aligned_view(self):
+        g = _ba()
+        gw = g.with_weights(lambda s, r: 1.0 + (s % 3).astype(np.float32))
+        assert gw.skew.weight is not None
+        dist = jnp.where(jnp.arange(g.n_nodes_padded) == 5, 0.0, jnp.inf)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_min_plus(gw, dist, "skew")),
+            np.asarray(segment.propagate_min_plus(gw, dist, "segment")))
+
+
+class TestAutoRouting:
+    def test_auto_uses_skew_on_hub_graphs(self):
+        g = _ba()
+        assert segment._auto_method(g) == "skew"
+
+    def test_auto_keeps_gather_on_quasi_regular(self):
+        g = G.watts_strogatz(1024, 6, 0.1, seed=0, skew_table=True)
+        assert segment._auto_method(g) == "gather"
+
+    def test_auto_segment_without_any_table(self):
+        g = G.barabasi_albert(2000, 4, seed=0, build_neighbor_table=False)
+        assert segment._auto_method(g) == "segment"
+
+
+class TestLiveness:
+    def test_node_failures_remask(self):
+        g = _ba()
+        deg = np.asarray(g.in_degree)
+        hub = int(deg.argmax())
+        gf = failures.fail_nodes(g, [hub, 17, 400])
+        sig = _signals(g, seed=8)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_or(gf, sig, "skew")),
+            np.asarray(segment.propagate_or(gf, sig, "segment")))
+
+    def test_edge_failures_remask_exactly(self):
+        g = _ba()
+        rng = np.random.default_rng(9)
+        cut = rng.choice(g.n_edges, size=200, replace=False)
+        gf = failures.fail_edges(g, cut)
+        sig = _signals(g, seed=10)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_or(gf, sig, "skew")),
+            np.asarray(segment.propagate_or(gf, sig, "segment")))
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_max(
+                gf, sig.astype(jnp.int32), "skew")),
+            np.asarray(segment.propagate_max(
+                gf, sig.astype(jnp.int32), "segment")))
+
+    def test_dynamic_edges_fold_in(self):
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(_ba(), extra_edges=8)
+        g = topology.connect(g, [3], [1999])
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[3].set(True)
+        out = segment.propagate_or(g, sig, "skew")
+        assert bool(out[1999])
+
+
+class TestProtocolsAndPersistence:
+    def test_adaptive_flood_dense_skew_bitexact(self):
+        from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = G.barabasi_albert(3000, 4, seed=0, skew_table=True,
+                              source_csr=True)
+        key = jax.random.key(0)
+        s_ref, o_ref = engine.run_until_coverage(
+            g, Flood(source=0, method="segment"), key,
+            coverage_target=0.99, max_rounds=64)
+        s_sk, o_sk = engine.run_until_coverage(
+            g, AdaptiveFlood(source=0, method="skew", k=64), key,
+            coverage_target=0.99, max_rounds=64)
+        assert o_sk == o_ref
+        np.testing.assert_array_equal(np.asarray(s_sk.seen),
+                                      np.asarray(s_ref.seen))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from p2pnetwork_tpu.sim import checkpoint as ckpt
+
+        g = _ba(n=1500)
+        p = str(tmp_path / "g.npz")
+        ckpt.save_graph(p, g)
+        g2 = ckpt.load_graph(p)
+        assert g2.skew is not None
+        assert g2.skew.width == g.skew.width
+        sig = _signals(g, seed=11)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_or(g2, sig, "skew")),
+            np.asarray(segment.propagate_or(g, sig, "skew")))
+
+
+class TestAutoPath:
+    def test_gspmd_auto_skew_parity_8dev(self):
+        # The multi-chip story: shard_graph_auto places the virtual rows
+        # along the mesh (owner-sorted rows align with their receiver
+        # shard) and GSPMD partitions the same engine program; results
+        # must equal the unsharded engine exactly.
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.parallel import auto, mesh as M
+        from p2pnetwork_tpu.sim import engine
+
+        g = G.barabasi_albert(4096, 4, seed=0, skew_table=True)
+        mesh = M.ring_mesh(8)
+        ga = auto.shard_graph_auto(g, mesh)
+        assert ga.skew is not None
+        proto = Flood(source=0, method="skew")
+        st_a, _ = auto.run_auto(ga, proto, jax.random.key(1), 5)
+        st_r, _ = engine.run(g, proto, jax.random.key(1), 5)
+        np.testing.assert_array_equal(np.asarray(st_a.seen),
+                                      np.asarray(st_r.seen))
