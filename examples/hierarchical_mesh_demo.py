@@ -1,0 +1,88 @@
+"""The hierarchical (multi-slice) scaling story: ICI within a slice, DCN
+across slices — demonstrated on an emulated 2-host x 4-chip mesh.
+
+SURVEY section 5 designates this layout as the 10M+ path. Two idioms,
+both runnable on the suite's virtual 8-device CPU platform (run with
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``):
+
+1. **ICI-major sharded ring** (parallel/sharded.py over
+   ``hierarchical_ring_mesh``): shards hop rank -> rank+1, so the two
+   host-boundary hops are the ONLY DCN traffic — per_host-1 of every
+   per_host hops ride ICI. The demo lowers the real flood program and
+   counts the hops per class from the compiled HLO.
+
+2. **GSPMD auto on the 2-D (dcn, ici) mesh** (parallel/auto.py +
+   ``multihost.mesh_2d``): node/edge axes shard over ``ici``. The CPU
+   emulation gives XLA no DCN cost model, so it spreads partial work
+   across the whole pool — the guarantee that keeps the auto path
+   DCN-sane at scale is payload SIZE, not placement: every collective
+   is node-extent, and the module's total cross-DCN bytes fit in one
+   node-extent array (O(N), never the O(E) of an edge re-shard). The
+   demo classifies every collective by axis and prints the byte split.
+
+The placement facts printed here are pinned as assertions in
+tests/test_mesh2d_comm.py. The reference has no distributed runtime at
+all — its scaling unit is one Python thread per socket
+[ref: p2pnetwork/node.py:77-79] — so this layer has no counterpart to
+cite beyond the transport it replaces.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+N_HOSTS, PER_HOST = 2, 4
+
+
+def ring_story():
+    # The counting loops live in tests/test_mesh2d_comm.py — the same
+    # code that PINS these facts as assertions, so demo and test cannot
+    # drift apart.
+    from tests.test_mesh2d_comm import lower_ring_flood_hlo, ring_hop_classes
+
+    ici, dcn, _ = ring_hop_classes(lower_ring_flood_hlo())
+    print(f"ring: {ici} ICI hops, {dcn} DCN hops across the compiled "
+          f"program ({dcn / max(ici + dcn, 1):.0%} of hops cross slices)")
+
+
+def mesh2d_story():
+    from p2pnetwork_tpu.models import Flood
+    from p2pnetwork_tpu.parallel import auto, multihost
+    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import graph as G
+    from tests.test_mesh2d_comm import classify_collective_bytes
+
+    g = G.watts_strogatz(4096, 6, 0.2, seed=0)
+    mesh = multihost.mesh_2d(hosts=N_HOSTS)
+    gs = auto.shard_graph_auto(g, mesh, axis_name="ici")
+    st, _ = auto.run_auto(gs, Flood(source=0, method="segment"),
+                          jax.random.key(0), 6)
+    ref, _ = engine.run(g, Flood(source=0, method="segment"),
+                        jax.random.key(0), 6)
+    assert (np.asarray(st.seen) == np.asarray(ref.seen)).all()
+
+    hlo = engine.run.lower(gs, Flood(source=0, method="segment"),
+                           jax.random.key(0), 6).compile().as_text()
+    ici_b, dcn_b = classify_collective_bytes(hlo)
+    print(f"mesh_2d auto: {ici_b} bytes of collectives inside ICI rows, "
+          f"{dcn_b} bytes crossing DCN "
+          f"(DCN carries {dcn_b / max(ici_b + dcn_b, 1):.0%}) — "
+          f"results bit-equal to the single-device engine")
+
+
+if __name__ == "__main__":
+    print(f"emulated layout: {N_HOSTS} hosts x {PER_HOST} chips "
+          f"over {len(jax.devices())} virtual devices")
+    ring_story()
+    mesh2d_story()

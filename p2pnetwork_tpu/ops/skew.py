@@ -201,13 +201,15 @@ def build_skew_from_arrays(
 def build_skew(graph, width: int = 0) -> SkewTable:
     """Build from a :class:`~p2pnetwork_tpu.sim.graph.Graph` (pulls the
     edge arrays to host; prefer ``from_edges(skew_table=True)`` at
-    construction for large graphs). Uses BUILD-time edges (the unpadded
-    prefix), matching the neighbor-table contract: runtime failures
-    re-mask, they do not rebuild."""
+    construction for large graphs). Rows cover the BUILD-time edge
+    prefix — the slot->edge map failures re-mask instead of rebuilding —
+    and the graph's CURRENT ``edge_mask`` is applied immediately, so a
+    table attached after failures does not resurrect dead edges (the
+    mask covers dead endpoints too: node failures re-mask edge_mask)."""
     e = graph.n_edges
     w = (None if graph.edge_weight is None
          else np.asarray(graph.edge_weight)[:e])
-    return build_skew_from_arrays(
+    t = build_skew_from_arrays(
         np.asarray(graph.senders)[:e],
         np.asarray(graph.receivers)[:e],
         graph.n_nodes_padded,
@@ -215,6 +217,7 @@ def build_skew(graph, width: int = 0) -> SkewTable:
         width=width,
         weights=w,
     )
+    return remask_edges(t, graph.edge_mask, graph.n_edges_padded)
 
 
 # ------------------------------------------------------------- lowerings

@@ -90,13 +90,20 @@ def hierarchical_ring_mesh(
 def mesh_2d(
     axis_names: tuple = ("dcn", "ici"),
     devices: Optional[Sequence[jax.Device]] = None,
+    hosts: Optional[int] = None,
 ) -> Mesh:
     """A ``[hosts, chips_per_host]`` mesh: leading axis crosses DCN, trailing
     axis stays inside a host's ICI domain. For the auto-sharded path: put
     the node/edge axes on ``ici`` and keep ``dcn`` for replication or
-    independent runs (parameter sweeps)."""
+    independent runs (parameter sweeps).
+
+    ``hosts`` overrides the process-derived host count — the way a
+    single-process virtual-device job emulates a multi-slice layout
+    (e.g. 2x4 over 8 CPU devices) so the per-axis collective placement
+    is testable without real DCN (tests/test_mesh2d_comm.py)."""
     devs = _devices_host_major(devices)
-    n_hosts = max(len({d.process_index for d in devs}), 1)
+    n_hosts = (hosts if hosts is not None
+               else max(len({d.process_index for d in devs}), 1))
     per_host = len(devs) // n_hosts
     if n_hosts * per_host != len(devs):
         raise ValueError(

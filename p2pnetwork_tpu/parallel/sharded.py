@@ -712,6 +712,20 @@ def _scatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     return jax.jit(fn)
 
 
+def _host_fetch(x) -> np.ndarray:
+    """Host copy of a possibly multi-process-sharded array.
+
+    ``np.asarray`` on an array whose shards live on OTHER processes is an
+    error by design; the cross-process case all-gathers first (every
+    process calls this at the same program point — connect's host-side
+    orchestration is SPMD like everything else)."""
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def connect(sg: ShardedGraph, senders, receivers, *,
             undirected: bool = True) -> ShardedGraph:
     """Add links between global node ids at runtime (sharded mirror of
@@ -749,7 +763,7 @@ def connect(sg: ShardedGraph, senders, receivers, *,
 
     # Dead endpoints reject the link (sim/topology.connect parity — the
     # reference's connect to a crashed peer fails [ref: node.py:173-176]).
-    alive = np.asarray(sg.node_mask).reshape(-1)
+    alive = _host_fetch(sg.node_mask).reshape(-1)
     keep &= alive[s] & alive[r]
 
     # Drop pairs that already exist — each shard probes the exact bucket
@@ -770,7 +784,7 @@ def connect(sg: ShardedGraph, senders, receivers, *,
 
     d, t, sl, rl = d[keep], t[keep], sl[keep], rl[keep]
     # Free-slot allocation per bucket (host-side; dyn_mask is S*S*K bools).
-    dmask = np.array(sg.dyn_mask)  # mutable copy
+    dmask = _host_fetch(sg.dyn_mask).copy()  # mutable copy
     slots = np.empty(d.size, np.int32)
     for i in range(d.size):
         free = np.nonzero(~dmask[d[i], t[i]])[0]

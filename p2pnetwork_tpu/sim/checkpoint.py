@@ -253,7 +253,10 @@ def save_orbax(path: str, state: Any, key: jax.Array, round_index: int,
 
     payload = {
         "state": state,
-        "key_data": jax.random.key_data(key),
+        # Host numpy, not a device array: a single-device jax.Array is
+        # "host-local" to orbax in a multi-process job and refuses to
+        # serialize; the key is tiny and identical on every process.
+        "key_data": np.asarray(jax.random.key_data(key)),
         "round_index": np.int64(round_index),
         "message_count": np.int64(message_count),
     }

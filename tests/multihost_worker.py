@@ -2,15 +2,26 @@
 
 Launched (twice) by tests/test_multihost.py: each process brings up
 ``jax.distributed`` over a loopback coordinator, builds the hierarchical
-ring mesh spanning both processes' CPU devices, runs a sharded flood over
-it, and cross-checks rounds/messages/coverage against the single-device
-engine oracle computed locally. Prints one MULTIHOST_OK line on success.
+ring mesh spanning both processes' CPU devices, and runs a PHASE SUITE
+across it — sharded flood, gossip (exact-RNG), a churn step (node
+failures + a runtime link) under the run-to-coverage loop, and an
+orbax checkpoint save/restore whose restored arrays land back sharded
+over the 2-process mesh — each cross-checked against the single-device
+engine oracle computed locally. Prints one ``MULTIHOST_PHASE <name> OK``
+line per phase and a final MULTIHOST_OK summary line on success.
+
+Cross-process comparison note: in a multi-process job, shards of a
+mesh-sharded array live on different PROCESSES, so ``np.asarray`` on one
+is an error by design — every value check here either reads a replicated
+summary scalar or runs the comparison device-side under ``jit`` (all
+processes execute the same program) and reads the replicated boolean.
 
 Usage: python tests/multihost_worker.py <process_id> <coordinator_port>
 (env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=N)
 """
 
 import os
+import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -20,7 +31,20 @@ from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
 apply_platform_env()
 
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
+
+
+def _phase(name: str) -> None:
+    print(f"MULTIHOST_PHASE {name} OK", flush=True)
+
+
+@jax.jit
+def _all_equal(sharded_flat, replicated) -> jax.Array:
+    """Device-side equality between a mesh-sharded array and a locally
+    computed replicated oracle; the output is a replicated scalar every
+    process can read."""
+    return jnp.all(sharded_flat.reshape(-1) == replicated.reshape(-1))
 
 
 def main() -> int:
@@ -42,13 +66,15 @@ def main() -> int:
     procs = [d.process_index for d in mesh.devices.flat]
     assert procs == sorted(procs), f"ring not host-major: {procs}"
 
-    from p2pnetwork_tpu.models import Flood
+    from p2pnetwork_tpu.models import Flood, Gossip
     from p2pnetwork_tpu.parallel import sharded
-    from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.sim import engine, failures, topology
     from p2pnetwork_tpu.sim import graph as G
 
     g = G.watts_strogatz(512, 6, 0.2, seed=0)
     sg = sharded.shard_graph(g, mesh)
+
+    # ---- Phase 1: flood to coverage, summary parity with the engine.
     seen, out = sharded.flood_until_coverage(
         sg, mesh, source=0, coverage_target=0.99
     )
@@ -58,6 +84,61 @@ def main() -> int:
     assert out["rounds"] == ref["rounds"], (out, ref)
     assert out["messages"] == ref["messages"], (out, ref)
     assert abs(out["coverage"] - ref["coverage"]) < 1e-6
+    _phase("flood")
+
+    # ---- Phase 2: gossip averaging, exact-RNG value parity (the sharded
+    # partner draws are keyed by edge identity, so the distributed values
+    # must equal the engine's bit for bit).
+    rounds = 5
+    gp = Gossip(alpha=0.5)
+    vals, _ = sharded.gossip(sg, mesh, gp, jax.random.key(1), rounds,
+                             exact_rng=True)
+    ref_g, _ = engine.run(g, gp, jax.random.key(1), rounds)
+    ok = _all_equal(vals, jnp.asarray(np.asarray(ref_g.values)))
+    assert bool(ok), "sharded gossip diverged from the engine across processes"
+    _phase("gossip")
+
+    # ---- Phase 3: churn — fail nodes, add a runtime bridge, rerun the
+    # coverage while_loop on the damaged overlay; summaries must match the
+    # engine's run over an identically churned graph.
+    fail_ids = [3, g.n_nodes // 2]
+    sgc = sharded.with_capacity(sharded.fail_nodes(sg, fail_ids), 8)
+    sgc = sharded.connect(sgc, [1], [g.n_nodes - 2])
+    gc = topology.connect(
+        topology.with_capacity(failures.fail_nodes(g, fail_ids),
+                               extra_edges=8),
+        [1], [g.n_nodes - 2],
+    )
+    _, out_c = sharded.flood_until_coverage(sgc, mesh, source=0,
+                                            coverage_target=0.9)
+    _, ref_c = engine.run_until_coverage(gc, Flood(source=0),
+                                         jax.random.key(0),
+                                         coverage_target=0.9)
+    assert out_c["rounds"] == ref_c["rounds"], (out_c, ref_c)
+    assert out_c["messages"] == ref_c["messages"], (out_c, ref_c)
+    _phase("churn")
+
+    # ---- Phase 4: orbax checkpoint roundtrip ACROSS the process pair —
+    # both processes save collectively, restore against a mesh-sharded
+    # template, and verify the restored array still spans both processes'
+    # devices with identical contents.
+    from p2pnetwork_tpu.sim import checkpoint as ckpt
+
+    ckpt_dir = os.path.join("/tmp", f"mh_ckpt_{port}")
+    try:
+        ckpt.save_orbax(ckpt_dir, {"vals": vals}, jax.random.key(2), rounds)
+        restored, _, rnd, _ = ckpt.load_orbax(ckpt_dir, {"vals": vals})
+        assert rnd == rounds
+        assert restored["vals"].sharding.device_set == vals.sharding.device_set
+        assert {d.process_index
+                for d in restored["vals"].sharding.device_set} == {0, 1}, \
+            "restored array no longer spans both processes"
+        assert bool(_all_equal(restored["vals"],
+                               jnp.asarray(np.asarray(ref_g.values))))
+    finally:
+        if pid == 0:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    _phase("checkpoint")
 
     # 2-D DCN x ICI mesh builds over the same job.
     m2 = multihost.mesh_2d()
@@ -65,7 +146,8 @@ def main() -> int:
     assert {d.process_index for d in m2.devices[0]} == {0}
 
     print(f"MULTIHOST_OK pid={pid} rounds={out['rounds']} "
-          f"messages={out['messages']}", flush=True)
+          f"messages={out['messages']} phases=flood,gossip,churn,checkpoint",
+          flush=True)
     return 0
 
 

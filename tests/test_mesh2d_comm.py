@@ -1,0 +1,206 @@
+"""Per-axis collective placement on the hierarchical (DCN x ICI) meshes.
+
+SURVEY section 5 names ICI-within-slice / DCN-across-slices as the
+designated 10M+ scaling path; these tests make that claim EVIDENCE on an
+emulated 2-host x 4-chip layout over the suite's 8 virtual CPU devices
+(mesh_2d(hosts=2) — axis semantics, not wire speed, are under test):
+
+- the ICI-major sharded RING: each round's collective-permute hops are
+  rank -> rank+1, so exactly ``n_hosts`` of the ``S`` hop pairs cross a
+  host boundary (the DCN hops) and the other ``S - n_hosts`` stay inside
+  a host's ICI domain — the structural property that makes the
+  hierarchical ring's DCN bill 1/per_host of its hop traffic;
+- the GSPMD auto path on the 2-D mesh with node/edge axes on ``ici``:
+  decoded replica groups + permute pairs bound the cross-DCN bytes of
+  the whole compiled module to one node-extent array — O(N) where an
+  edge-extent re-shard would be O(E). (The emulated mesh gives XLA no
+  DCN cost model, so it spreads partial work across the pool; explicit
+  hierarchical placement is the ring path's job, pinned above.)
+
+Both decoders handle XLA's iota replica-group form
+(``[G,S]<=[dims]T(perm)``) and the literal form (``{{0,1},{2,3}}``).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from p2pnetwork_tpu.models import Flood  # noqa: E402
+from p2pnetwork_tpu.parallel import auto, multihost, sharded  # noqa: E402
+from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+from tests.test_auto_comm import _collectives, _LINE  # noqa: E402
+
+N_HOSTS, PER_HOST = 2, 4
+
+_IOTA = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_LITERAL = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_PAIRS = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
+
+
+def _decode_groups(line):
+    """Replica groups of one HLO collective line as a list of tuples."""
+    m = _IOTA.search(line)
+    if m:
+        ng, gs = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        perm = ([int(d) for d in m.group(4).split(",")]
+                if m.group(4) else list(range(len(dims))))
+        devs = np.arange(int(np.prod(dims))).reshape(dims).transpose(perm)
+        return [tuple(g) for g in devs.reshape(ng, gs)]
+    m = _LITERAL.search(line)
+    if m:
+        return [tuple(int(x) for x in grp.split(",") if x.strip())
+                for grp in m.group(1).strip("{}").split("},{")]
+    return []
+
+
+def _host_of(device_id: int) -> int:
+    return device_id // PER_HOST
+
+
+def _crosses_host(group) -> bool:
+    return len({_host_of(d) for d in group}) > 1
+
+
+def _permute_pairs(line):
+    """source->target pairs of one collective-permute HLO line."""
+    m = _PAIRS.search(line)
+    if not m:
+        return []
+    return [tuple(int(x) for x in p.split(","))
+            for p in m.group(1).strip("{}").split("},{")]
+
+
+def classify_collective_bytes(hlo: str):
+    """``(ici_bytes, dcn_bytes)`` over every collective in the module —
+    replica-group collectives classified by decoded groups,
+    collective-permutes by their source->target pairs (permutes carry no
+    replica_groups, and skipping them would blind the DCN budget to
+    cross-host permute traffic). Shared by the placement tests and
+    examples/hierarchical_mesh_demo.py so the printed facts and the
+    pinned assertions cannot drift."""
+    ici = dcn = 0
+    for ln in hlo.splitlines():
+        if not _LINE.search(ln):
+            continue
+        groups = _decode_groups(ln)
+        pairs = _permute_pairs(ln)
+        if not groups and not pairs:
+            continue
+        nbytes = sum(c[3] for c in _collectives(ln))
+        crossing = (any(_crosses_host(g) for g in groups)
+                    or any(_host_of(a) != _host_of(b) for a, b in pairs))
+        if crossing:
+            dcn += nbytes
+        else:
+            ici += nbytes
+    return ici, dcn
+
+
+def ring_hop_classes(hlo: str):
+    """``(ici_hops, dcn_hops, permute_pair_lists)`` over every
+    collective-permute of a compiled ring program."""
+    ici = dcn = 0
+    per_permute = []
+    for ln in hlo.splitlines():
+        if "collective-permute" not in ln:
+            continue
+        pairs = _permute_pairs(ln)
+        if not pairs:
+            continue
+        per_permute.append(pairs)
+        for a, b in pairs:
+            if _host_of(a) == _host_of(b):
+                ici += 1
+            else:
+                dcn += 1
+    return ici, dcn, per_permute
+
+
+def lower_ring_flood_hlo(n=1024, rounds=3):
+    """Compile the real sharded ring flood over the 8-device ring mesh
+    and return its HLO text (shared with the demo)."""
+    g = G.watts_strogatz(n, 6, 0.2, seed=0)
+    mesh = M.ring_mesh(8)
+    sg = sharded.shard_graph(g, mesh)
+    fn = sharded._flood_fn(mesh, mesh.axis_names[0], sg.n_shards,
+                           sg.block, rounds, sg.diag_pieces, sg.mxu_block)
+    seen0 = sharded._flood_seed(sg, 0)
+    return fn.lower(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, *sharded._dyn_or_empty(sg),
+        *sharded._mxu_or_empty(sg), sharded._diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, seen0, seen0,
+    ).compile().as_text()
+
+
+class TestRingHopPlacement:
+    def test_permute_hops_cross_dcn_exactly_n_hosts_times(self):
+        # The ICI-major ring: lower the real sharded flood program and
+        # read every collective-permute's source->target pairs.
+        hlo = lower_ring_flood_hlo()
+        ici, dcn, per_permute = ring_hop_classes(hlo)
+        assert per_permute, "ring program lowered without collective-permutes"
+        S = 8
+        for pairs in per_permute:
+            # Every hop is rank -> rank+1 (mod S): the ring structure.
+            assert sorted(pairs) == sorted(
+                [(i, (i + 1) % S) for i in range(S)]), pairs
+        # Exactly one boundary hop per host per permute: DCN carries
+        # 1/per_host of the ring's hop traffic, ICI the rest.
+        assert dcn == N_HOSTS * len(per_permute), (ici, dcn)
+        assert ici == (S - N_HOSTS) * len(per_permute)
+
+
+class TestMesh2dAutoPlacement:
+    def _hlo(self, protocol, n=4096, rounds=5):
+        g = G.watts_strogatz(n, 6, 0.2, seed=0)
+        mesh = multihost.mesh_2d(hosts=N_HOSTS)
+        assert mesh.devices.shape == (N_HOSTS, PER_HOST)
+        gs = auto.shard_graph_auto(g, mesh, axis_name="ici")
+        return g, engine.run.lower(
+            gs, protocol, jax.random.key(0), rounds).compile().as_text()
+
+    def test_dcn_traffic_bounded_by_node_extent(self):
+        # Honest form of the hierarchy claim for the AUTO path: the CPU
+        # emulation gives XLA no DCN cost model, so it freely spreads
+        # partial work across the whole pool (measured: cross-host bytes
+        # roughly match in-row bytes on this program — the explicit ring
+        # path, not auto, is where placement is controlled, see
+        # TestRingHopPlacement). What the auto path DOES guarantee, and
+        # what keeps it DCN-sane at scale: the protocol's collectives are
+        # node-extent, so the total cross-DCN bytes of the compiled
+        # module stay within one node-extent array — O(N), never the
+        # O(E) an edge-extent re-shard would cost.
+        g, hlo = self._hlo(Flood(source=0, method="segment"))
+        ici_bytes, dcn_bytes = classify_collective_bytes(hlo)
+        assert ici_bytes > 0, "nothing placed on the ICI axis"
+        assert dcn_bytes <= g.n_nodes_padded * 4, (
+            f"DCN carries {dcn_bytes} bytes — more than one node-extent "
+            f"array ({g.n_nodes_padded * 4})")
+
+    def test_results_match_engine_on_2d_mesh(self):
+        g = G.watts_strogatz(2048, 6, 0.2, seed=0)
+        mesh = multihost.mesh_2d(hosts=N_HOSTS)
+        gs = auto.shard_graph_auto(g, mesh, axis_name="ici")
+        st_a, _ = auto.run_auto(gs, Flood(source=0, method="segment"),
+                                jax.random.key(0), 6)
+        st_r, _ = engine.run(g, Flood(source=0, method="segment"),
+                             jax.random.key(0), 6)
+        np.testing.assert_array_equal(np.asarray(st_a.seen),
+                                      np.asarray(st_r.seen))
+
+    def test_collectives_never_exceed_node_extent(self):
+        g, hlo = self._hlo(Flood(source=0, method="segment"))
+        colls = _collectives(hlo)
+        assert colls
+        for op, dtype, shape, nbytes in colls:
+            assert nbytes <= g.n_nodes_padded * 4, (
+                f"{op} moves {nbytes} bytes — edge-extent traffic on the "
+                f"2-D mesh")

@@ -56,12 +56,14 @@ def test_mesh_2d_auto_run():
     assert (np.asarray(state.seen) == np.asarray(ref.seen)).all()
 
 
-def test_two_process_distributed_flood():
+def test_two_process_distributed_protocol_suite():
     """The REAL multi-process path: two OS processes rendezvous through
     jax.distributed (loopback coordinator, gloo CPU collectives), build
-    the hierarchical ring mesh spanning both processes' devices, run a
-    sharded flood across it, and each cross-checks against the engine
-    oracle (tests/multihost_worker.py)."""
+    the hierarchical ring mesh spanning both processes' devices, and run
+    the phase suite across it — flood, exact-RNG gossip, a churn step
+    (failures + runtime link) under run-to-coverage, and an orbax
+    checkpoint saved AND restored collectively by both processes — each
+    cross-checked against the engine oracle (tests/multihost_worker.py)."""
     import os
     import pathlib
     import re
@@ -107,6 +109,9 @@ def test_two_process_distributed_flood():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"MULTIHOST_OK pid={pid}" in out, out[-3000:]
+        for phase in ("flood", "gossip", "churn", "checkpoint"):
+            assert f"MULTIHOST_PHASE {phase} OK" in out, \
+                f"worker {pid} missing phase {phase}:\n{out[-3000:]}"
     # Both controllers computed the same replicated summary.
     summaries = [
         re.search(r"MULTIHOST_OK pid=\d (.*)$", out, re.M).group(1)

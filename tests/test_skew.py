@@ -243,3 +243,17 @@ class TestAutoPath:
         st_r, _ = engine.run(g, proto, jax.random.key(1), 5)
         np.testing.assert_array_equal(np.asarray(st_a.seen),
                                       np.asarray(st_r.seen))
+
+
+class TestPostFailureAttach:
+    def test_with_skew_table_after_failures_respects_masks(self):
+        # Regression: a table attached AFTER edge/node failures must not
+        # resurrect dead edges (build applies the current edge_mask).
+        g = failures.fail_edges(
+            G.barabasi_albert(300, 3, seed=0), list(range(50)))
+        g = failures.fail_nodes(g, [7])
+        g = g.with_skew_table()
+        ones = jnp.ones(g.n_nodes_padded, dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(segment.propagate_sum(g, ones, "skew")),
+            np.asarray(segment.propagate_sum(g, ones, "segment")))
