@@ -594,6 +594,7 @@ def main():
     if args.full:
         bench_flood_big(10_000_000, "10M WS seen-set flood (single chip)",
                         adaptive_k=2048)
+        bench_plumtree(10_000_000)
     return 0
 
 

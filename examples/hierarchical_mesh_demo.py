@@ -46,12 +46,13 @@ N_HOSTS, PER_HOST = 2, 4
 
 
 def ring_story():
-    # The counting loops live in tests/test_mesh2d_comm.py — the same
-    # code that PINS these facts as assertions, so demo and test cannot
-    # drift apart.
-    from tests.test_mesh2d_comm import lower_ring_flood_hlo, ring_hop_classes
+    # The counting loops live in the library (parallel/commviz.py) — the
+    # same code tests/test_mesh2d_comm.py PINS as assertions, so demo and
+    # test cannot drift apart.
+    from p2pnetwork_tpu.parallel import commviz
 
-    ici, dcn, _ = ring_hop_classes(lower_ring_flood_hlo())
+    ici, dcn, _ = commviz.ring_hop_classes(
+        commviz.lower_ring_flood_hlo(), lambda d: d // PER_HOST)
     print(f"ring: {ici} ICI hops, {dcn} DCN hops across the compiled "
           f"program ({dcn / max(ici + dcn, 1):.0%} of hops cross slices)")
 
@@ -60,8 +61,8 @@ def mesh2d_story():
     from p2pnetwork_tpu.models import Flood
     from p2pnetwork_tpu.parallel import auto, multihost
     from p2pnetwork_tpu.sim import engine
+    from p2pnetwork_tpu.parallel import commviz
     from p2pnetwork_tpu.sim import graph as G
-    from tests.test_mesh2d_comm import classify_collective_bytes
 
     g = G.watts_strogatz(4096, 6, 0.2, seed=0)
     mesh = multihost.mesh_2d(hosts=N_HOSTS)
@@ -74,7 +75,8 @@ def mesh2d_story():
 
     hlo = engine.run.lower(gs, Flood(source=0, method="segment"),
                            jax.random.key(0), 6).compile().as_text()
-    ici_b, dcn_b = classify_collective_bytes(hlo)
+    ici_b, dcn_b = commviz.classify_collective_bytes(
+        hlo, lambda d: d // PER_HOST)
     print(f"mesh_2d auto: {ici_b} bytes of collectives inside ICI rows, "
           f"{dcn_b} bytes crossing DCN "
           f"(DCN carries {dcn_b / max(ici_b + dcn_b, 1):.0%}) — "

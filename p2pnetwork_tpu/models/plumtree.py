@@ -54,6 +54,20 @@ from p2pnetwork_tpu.models import base
 from p2pnetwork_tpu.sim.graph import Graph
 
 
+def _eager_mask(graph: Graph, eager: jax.Array) -> jax.Array:
+    """Live eager edges, computed device-side (tree_graph's compaction
+    must not pull the E-slot arrays to host just to mask them)."""
+    s, r = graph.senders, graph.receivers
+    return graph.edge_mask & eager & graph.node_mask[s] & graph.node_mask[r]
+
+
+def _compact_edges(graph: Graph, idx: jax.Array) -> jax.Array:
+    """``[2, K]`` (senders, receivers) at ``idx`` — one stacked gather,
+    one device->host transfer for the caller."""
+    return jnp.stack([jnp.take(graph.senders, idx),
+                      jnp.take(graph.receivers, idx)])
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class PlumtreeState:
@@ -91,8 +105,15 @@ class Plumtree:
         fast layouts); once the tree is stable, the cheap repeated
         broadcast is Flood over THIS graph — same ~N−1 edges, but padded
         to ~N slots instead of E (measured 3.8 s → 0.13 s per 1M-node
-        broadcast; see BENCH.md). Host-side (pulls the masks back), like
-        every graph build; pass ``source_csr=True`` etc. through
+        broadcast; see BENCH.md).
+
+        The eager-edge COMPACTION runs device-side (mask, count, one
+        ``nonzero``), so only the ~N surviving tree edges ever cross
+        device->host — not the full E-slot edge arrays, which on a
+        tunneled backend were the extraction's real cost (~120 MB at 1M
+        nodes vs ~8 MB compacted). The host then only sorts/pads ~N
+        edges (``from_edges`` rides the native radix path,
+        native/graphcore.cpp). Pass ``source_csr=True`` etc. through
         ``from_edges_kwargs`` to pick layouts."""
         import numpy as np
 
@@ -104,17 +125,18 @@ class Plumtree:
             raise ValueError(
                 "Plumtree does not track the dynamic edge region; "
                 "consolidate the graph first")
-        s = np.asarray(graph.senders)
-        r = np.asarray(graph.receivers)
-        alive = np.asarray(graph.node_mask)
-        em = (np.asarray(graph.edge_mask) & np.asarray(state.eager)
-              & alive[s] & alive[r])
+        em = _eager_mask(graph, state.eager)
+        count = int(jnp.sum(em))
+        idx = jnp.nonzero(em, size=max(count, 1), fill_value=0)[0]
+        picked = np.asarray(_compact_edges(graph, idx))[:, :count]
+        s, r = picked[0], picked[1]
         if graph.edge_weight is not None:
             # Carry link costs through the extraction (the same rule as
             # topology.consolidate): a weighted overlay's tree must not
             # silently decay to unit costs for weighted protocols.
             from_edges_kwargs.setdefault(
-                "weights", np.asarray(graph.edge_weight)[em])
+                "weights",
+                np.asarray(jnp.take(graph.edge_weight, idx))[:count])
         # Pad to the source graph's node extent: ids and masks then line
         # up slot-for-slot whatever pad multiple the source was built
         # with (n_nodes <= n_nodes_padded makes the round-up exact).
@@ -127,7 +149,7 @@ class Plumtree:
             raise ValueError(
                 f"node_pad_multiple={m} pads to a different node extent "
                 f"than the source graph's {graph.n_nodes_padded}")
-        g = from_edges(s[em], r[em], graph.n_nodes, **from_edges_kwargs)
+        g = from_edges(s, r, graph.n_nodes, **from_edges_kwargs)
         return dataclasses.replace(g,
                                    node_mask=graph.node_mask & g.node_mask)
 

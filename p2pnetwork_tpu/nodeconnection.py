@@ -101,8 +101,18 @@ class NodeConnection:
         return compressed
 
     def decompress(self, compressed: bytes) -> bytes:
-        """Decompress a tagged payload [ref: nodeconnection.py:84-105]."""
-        return wire.decompress(compressed)
+        """Decompress a tagged payload [ref: nodeconnection.py:84-105].
+
+        The node's receive-buffer bound doubles as the decompression
+        OUTPUT bound: a frame small enough to pass the framing decoder
+        must not be allowed to expand past what the node would ever have
+        accepted on the wire (amplification-bomb containment the
+        reference lacks). A blob past the bound raises
+        ``wire.DecompressionBombError``, which the recv loop counts as a
+        receive error and drops — never a partial expansion, never
+        compressed bytes delivered as if they were the message."""
+        return wire.decompress(compressed,
+                               max_output=self.main_node.config.max_recv_buffer)
 
     def parse_packet(self, packet: bytes) -> Union[str, dict, bytes]:
         """Decode one de-framed packet [ref: nodeconnection.py:167-184].
@@ -260,11 +270,14 @@ class NodeConnection:
                         try:
                             node.node_message(self, self.parse_packet(packet))
                         except Exception as e:
-                            # A crashing user handler must not kill the
-                            # transport (in the reference it kills the recv
-                            # thread without cleanup).
+                            # Neither a crashing user handler nor a bad
+                            # frame (DecompressionBombError included) may
+                            # kill the transport (in the reference either
+                            # kills the recv thread without cleanup); the
+                            # frame is dropped and counted.
                             node.message_count_rerr += 1
-                            node.debug_print(f"node_message handler raised: {e!r}")
+                            node.debug_print(
+                                f"parse/handler error, frame dropped: {e!r}")
                 except wire.FrameOverflowError as e:
                     node.message_count_rerr += 1
                     node.debug_print(f"NodeConnection: {e}")

@@ -1,0 +1,152 @@
+"""Collective-placement diagnostics: parse compiled HLO, classify traffic.
+
+The multi-chip claims this framework makes — node-extent-only payloads on
+the GSPMD auto path, ICI-confined bulk traffic with DCN as a bounded
+remainder, the ring's 1/per_host boundary-hop structure — are properties
+of COMPILED programs, so the evidence lives in HLO text. This module is
+the one parser both the test suite (tests/test_auto_comm.py,
+tests/test_mesh2d_comm.py) and the shipped diagnostics/examples
+(examples/hierarchical_mesh_demo.py) use, so the pinned assertions and
+the printed numbers cannot drift apart.
+
+Handles XLA's iota replica-group form (``[G,S]<=[dims]T(perm)``), the
+literal form (``{{0,1},{2,3}}``), variadic/async collectives, and
+collective-permutes (which carry ``source_target_pairs`` instead of
+replica groups — skipping them would blind any DCN budget to cross-host
+permute traffic).
+
+The reference has nothing comparable to diagnose — its transport is one
+blocking socket per peer [ref: p2pnetwork/nodeconnection.py:38-44].
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+                "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+                "u64": 8}
+
+# Matches the full (possibly tuple/variadic) result type of a collective —
+# XLA's collective combiner fuses ops into variadic forms like
+#   (s32[], s32[], f32[4096]) all-reduce(...)
+# and async pairs use the -start suffix; both must stay visible here or an
+# edge-extent payload could hide inside a fused/async op.
+COLLECTIVE_LINE = re.compile(
+    r"=\s+(.+?)\s+"
+    r"(all-gather|all-reduce|all-to-all|collective-permute|reduce-scatter)"
+    r"(?:-start)?\("
+)
+_SHAPE = re.compile(r"(\w+)\[([0-9,]*)\]")
+_IOTA = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_LITERAL = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_PAIRS = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
+
+
+def collectives(hlo_text: str) -> List[Tuple[str, str, tuple, int]]:
+    """``[(op, dtype, shape, bytes)]`` — one entry per tensor component of
+    every collective in the module, tuple results flattened."""
+    out = []
+    for type_str, op in COLLECTIVE_LINE.findall(hlo_text):
+        for dtype, shape in _SHAPE.findall(type_str):
+            if dtype not in _DTYPE_BYTES:
+                continue  # e.g. token types
+            dims = [int(d) for d in shape.split(",") if d] or [1]
+            out.append((op, dtype, tuple(dims),
+                        int(np.prod(dims)) * _DTYPE_BYTES[dtype]))
+    return out
+
+
+def decode_groups(line: str) -> List[tuple]:
+    """Replica groups of one HLO collective line as a list of tuples."""
+    m = _IOTA.search(line)
+    if m:
+        ng, gs = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        perm = ([int(d) for d in m.group(4).split(",")]
+                if m.group(4) else list(range(len(dims))))
+        devs = np.arange(int(np.prod(dims))).reshape(dims).transpose(perm)
+        return [tuple(int(x) for x in g) for g in devs.reshape(ng, gs)]
+    m = _LITERAL.search(line)
+    if m:
+        return [tuple(int(x) for x in grp.split(",") if x.strip())
+                for grp in m.group(1).strip("{}").split("},{")]
+    return []
+
+
+def permute_pairs(line: str) -> List[Tuple[int, int]]:
+    """source->target pairs of one collective-permute HLO line."""
+    m = _PAIRS.search(line)
+    if not m:
+        return []
+    return [tuple(int(x) for x in p.split(","))
+            for p in m.group(1).strip("{}").split("},{")]
+
+
+def classify_collective_bytes(hlo: str,
+                              host_of: Callable[[int], int]) -> Tuple[int, int]:
+    """``(within_host_bytes, cross_host_bytes)`` over every collective in
+    the module — replica-group collectives classified by decoded groups,
+    collective-permutes by their source->target pairs. ``host_of`` maps a
+    linearized device id to its host/slice index."""
+    within = cross = 0
+    for ln in hlo.splitlines():
+        if not COLLECTIVE_LINE.search(ln):
+            continue
+        groups = decode_groups(ln)
+        pairs = permute_pairs(ln)
+        if not groups and not pairs:
+            continue
+        nbytes = sum(c[3] for c in collectives(ln))
+        crossing = (any(len({host_of(d) for d in g}) > 1 for g in groups)
+                    or any(host_of(a) != host_of(b) for a, b in pairs))
+        if crossing:
+            cross += nbytes
+        else:
+            within += nbytes
+    return within, cross
+
+
+def ring_hop_classes(hlo: str, host_of: Callable[[int], int]):
+    """``(within_hops, cross_hops, permute_pair_lists)`` over every
+    collective-permute of a compiled ring program."""
+    within = cross = 0
+    per_permute = []
+    for ln in hlo.splitlines():
+        if "collective-permute" not in ln:
+            continue
+        pairs = permute_pairs(ln)
+        if not pairs:
+            continue
+        per_permute.append(pairs)
+        for a, b in pairs:
+            if host_of(a) == host_of(b):
+                within += 1
+            else:
+                cross += 1
+    return within, cross, per_permute
+
+
+def lower_ring_flood_hlo(n: int = 1024, n_devices: int = 8,
+                         rounds: int = 3) -> str:
+    """Compile the real sharded ring flood over an ``n_devices`` ring mesh
+    and return its HLO text — the program whose hop placement
+    :func:`ring_hop_classes` reads."""
+    from p2pnetwork_tpu.parallel import mesh as M, sharded
+    from p2pnetwork_tpu.sim import graph as G
+
+    g = G.watts_strogatz(n, 6, 0.2, seed=0)
+    mesh = M.ring_mesh(n_devices)
+    sg = sharded.shard_graph(g, mesh)
+    fn = sharded._flood_fn(mesh, mesh.axis_names[0], sg.n_shards,
+                           sg.block, rounds, sg.diag_pieces, sg.mxu_block)
+    seen0 = sharded._flood_seed(sg, 0)
+    return fn.lower(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, *sharded._dyn_or_empty(sg),
+        *sharded._mxu_or_empty(sg), sharded._diag_masks_or_empty(sg),
+        sg.node_mask, sg.out_degree, seen0, seen0,
+    ).compile().as_text()

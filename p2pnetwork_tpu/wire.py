@@ -44,7 +44,7 @@ import bz2
 import json
 import lzma
 import zlib
-from typing import Iterator, Union
+from typing import Iterator, Optional, Union
 
 Payload = Union[str, dict, list, bytes]
 
@@ -87,7 +87,50 @@ def compress(raw: bytes, algorithm: str) -> bytes:
     return base64.b64encode(fn(raw) + tag)
 
 
-def decompress(blob: bytes) -> bytes:
+class DecompressionBombError(ValueError):
+    """Decompressed output would exceed the caller's ``max_output`` bound.
+
+    PROPAGATES out of :func:`decompress` (unlike codec failures, which
+    fall back to the as-is contract): the caller asked for the bound, so
+    containment must be observable — the sockets recv path catches it as
+    a receive error (rerr) and drops the frame rather than delivering
+    either a partial expansion or compressed bytes masquerading as the
+    message."""
+
+
+def _bounded_decompress(data: bytes, max_output: int, make,
+                        multistream: bool) -> bytes:
+    """Decompress with a hard output bound via incremental decompressors.
+
+    Semantics parity with the unbounded stdlib functions: bz2/lzma
+    concatenate multiple streams (``multistream=True``), zlib returns the
+    first stream and ignores trailing bytes. A stream that ends before
+    its end-of-stream marker raises EOFError — the same
+    codec-failure class the unbounded path raises, so the caller's as-is
+    fallback applies; only genuinely over-bound output raises
+    :class:`DecompressionBombError`."""
+    if max_output <= 0:
+        # zlib's max_length=0 means UNLIMITED (bz2/lzma's means "0 bytes"):
+        # a zero/negative bound must contain, not silently disable.
+        raise DecompressionBombError(
+            f"max_output must be positive, got {max_output}")
+    out = b""
+    while True:
+        d = make()
+        budget = max_output - len(out)
+        chunk = d.decompress(data, max(budget, 0))
+        out += chunk
+        if not d.eof:
+            if len(out) >= max_output:
+                raise DecompressionBombError(
+                    f"decompressed output exceeds {max_output} bytes")
+            raise EOFError("compressed stream ended before end-of-stream")
+        data = d.unused_data
+        if not multistream or not data:
+            return out
+
+
+def decompress(blob: bytes, max_output: Optional[int] = None) -> bytes:
     """Base64-decode ``blob`` and decompress according to its tag suffix.
 
     Mirrors the reference's tag sniffing [ref: nodeconnection.py:92-99]: an
@@ -97,6 +140,17 @@ def decompress(blob: bytes) -> bytes:
     marker raises out of packet parsing [ref bug: nodeconnection.py:91];
     here bytes that aren't base64 at all come back unchanged, honoring the
     as-is contract.
+
+    ``max_output`` bounds the DECOMPRESSED size — without it a ~100 KB
+    frame (well inside any receive-buffer bound) can expand to gigabytes
+    on the receiving host, an amplification the reference inherits
+    unbounded [ref: nodeconnection.py:84-105] and the frame-size bound
+    cannot see. Exceeding the bound raises
+    :class:`DecompressionBombError` — observable, unlike codec failures,
+    because silently delivering the compressed bytes as if they were the
+    message would be indistinguishable from a real payload. ``None``
+    keeps the historical unbounded behavior; the sockets backend passes
+    its receive-buffer bound here (nodeconnection.py ``decompress``).
     """
     try:
         data = base64.b64decode(blob)
@@ -104,11 +158,22 @@ def decompress(blob: bytes) -> bytes:
         return blob
     try:
         if data[-4:] == b"zlib":
-            return zlib.decompress(data[:-4])
+            if max_output is None:
+                return zlib.decompress(data[:-4])
+            return _bounded_decompress(data[:-4], max_output,
+                                       zlib.decompressobj, False)
         if data[-5:] == b"bzip2":
-            return bz2.decompress(data[:-5])
+            if max_output is None:
+                return bz2.decompress(data[:-5])
+            return _bounded_decompress(data[:-5], max_output,
+                                       bz2.BZ2Decompressor, True)
         if data[-4:] == b"lzma":
-            return lzma.decompress(data[:-4])
+            if max_output is None:
+                return lzma.decompress(data[:-4])
+            return _bounded_decompress(data[:-4], max_output,
+                                       lzma.LZMADecompressor, True)
+    except DecompressionBombError:
+        raise
     except Exception:
         pass
     return data

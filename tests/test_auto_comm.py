@@ -10,7 +10,6 @@ node-extent, never edge-extent, and collectives must exist at all (the
 program is genuinely partitioned, not silently replicated).
 """
 
-import re
 
 import numpy as np
 import pytest
@@ -23,35 +22,13 @@ from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
 from p2pnetwork_tpu.sim import engine  # noqa: E402
 from p2pnetwork_tpu.sim import graph as G  # noqa: E402
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
-                "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
-                "u64": 8}
-
-# Matches the full (possibly tuple/variadic) result type of a collective —
-# XLA's collective combiner fuses ops into variadic forms like
-#   (s32[], s32[], f32[4096]) all-reduce(...)
-# and async pairs use the -start suffix; both must stay visible here or an
-# edge-extent payload could hide inside a fused/async op.
-_LINE = re.compile(
-    r"=\s+(.+?)\s+"
-    r"(all-gather|all-reduce|all-to-all|collective-permute|reduce-scatter)"
-    r"(?:-start)?\("
+# The parser lives in the library (p2pnetwork_tpu/parallel/commviz.py)
+# so the shipped diagnostics and these assertions share one definition;
+# the aliases keep this module's historical names.
+from p2pnetwork_tpu.parallel.commviz import (  # noqa: E402
+    COLLECTIVE_LINE as _LINE,
+    collectives as _collectives,
 )
-_SHAPE = re.compile(r"(\w+)\[([0-9,]*)\]")
-
-
-def _collectives(hlo_text):
-    """[(op, dtype, shape, bytes)] — one entry per tensor component of
-    every collective in the module, tuple results flattened."""
-    out = []
-    for type_str, op in _LINE.findall(hlo_text):
-        for dtype, shape in _SHAPE.findall(type_str):
-            if dtype not in _DTYPE_BYTES:
-                continue  # e.g. token types
-            dims = [int(d) for d in shape.split(",") if d] or [1]
-            out.append((op, dtype, tuple(dims),
-                        int(np.prod(dims)) * _DTYPE_BYTES[dtype]))
-    return out
 
 
 def test_parser_sees_variadic_and_async_collectives():

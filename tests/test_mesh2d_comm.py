@@ -21,123 +21,33 @@ Both decoders handle XLA's iota replica-group form
 (``[G,S]<=[dims]T(perm)``) and the literal form (``{{0,1},{2,3}}``).
 """
 
-import re
-
 import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
 
 from p2pnetwork_tpu.models import Flood  # noqa: E402
-from p2pnetwork_tpu.parallel import auto, multihost, sharded  # noqa: E402
-from p2pnetwork_tpu.parallel import mesh as M  # noqa: E402
+from p2pnetwork_tpu.parallel import auto, commviz, multihost  # noqa: E402
 from p2pnetwork_tpu.sim import engine  # noqa: E402
 from p2pnetwork_tpu.sim import graph as G  # noqa: E402
 
-from tests.test_auto_comm import _collectives, _LINE  # noqa: E402
-
 N_HOSTS, PER_HOST = 2, 4
-
-_IOTA = re.compile(
-    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
-_LITERAL = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
-_PAIRS = re.compile(r"source_target_pairs=\{([^}]*(?:\},\{[^}]*)*)\}")
-
-
-def _decode_groups(line):
-    """Replica groups of one HLO collective line as a list of tuples."""
-    m = _IOTA.search(line)
-    if m:
-        ng, gs = int(m.group(1)), int(m.group(2))
-        dims = [int(d) for d in m.group(3).split(",")]
-        perm = ([int(d) for d in m.group(4).split(",")]
-                if m.group(4) else list(range(len(dims))))
-        devs = np.arange(int(np.prod(dims))).reshape(dims).transpose(perm)
-        return [tuple(g) for g in devs.reshape(ng, gs)]
-    m = _LITERAL.search(line)
-    if m:
-        return [tuple(int(x) for x in grp.split(",") if x.strip())
-                for grp in m.group(1).strip("{}").split("},{")]
-    return []
 
 
 def _host_of(device_id: int) -> int:
     return device_id // PER_HOST
 
 
-def _crosses_host(group) -> bool:
-    return len({_host_of(d) for d in group}) > 1
+def classify_collective_bytes(hlo):
+    """(ici_bytes, dcn_bytes) under this module's emulated 2x4 layout."""
+    return commviz.classify_collective_bytes(hlo, _host_of)
 
 
-def _permute_pairs(line):
-    """source->target pairs of one collective-permute HLO line."""
-    m = _PAIRS.search(line)
-    if not m:
-        return []
-    return [tuple(int(x) for x in p.split(","))
-            for p in m.group(1).strip("{}").split("},{")]
+def ring_hop_classes(hlo):
+    return commviz.ring_hop_classes(hlo, _host_of)
 
 
-def classify_collective_bytes(hlo: str):
-    """``(ici_bytes, dcn_bytes)`` over every collective in the module —
-    replica-group collectives classified by decoded groups,
-    collective-permutes by their source->target pairs (permutes carry no
-    replica_groups, and skipping them would blind the DCN budget to
-    cross-host permute traffic). Shared by the placement tests and
-    examples/hierarchical_mesh_demo.py so the printed facts and the
-    pinned assertions cannot drift."""
-    ici = dcn = 0
-    for ln in hlo.splitlines():
-        if not _LINE.search(ln):
-            continue
-        groups = _decode_groups(ln)
-        pairs = _permute_pairs(ln)
-        if not groups and not pairs:
-            continue
-        nbytes = sum(c[3] for c in _collectives(ln))
-        crossing = (any(_crosses_host(g) for g in groups)
-                    or any(_host_of(a) != _host_of(b) for a, b in pairs))
-        if crossing:
-            dcn += nbytes
-        else:
-            ici += nbytes
-    return ici, dcn
-
-
-def ring_hop_classes(hlo: str):
-    """``(ici_hops, dcn_hops, permute_pair_lists)`` over every
-    collective-permute of a compiled ring program."""
-    ici = dcn = 0
-    per_permute = []
-    for ln in hlo.splitlines():
-        if "collective-permute" not in ln:
-            continue
-        pairs = _permute_pairs(ln)
-        if not pairs:
-            continue
-        per_permute.append(pairs)
-        for a, b in pairs:
-            if _host_of(a) == _host_of(b):
-                ici += 1
-            else:
-                dcn += 1
-    return ici, dcn, per_permute
-
-
-def lower_ring_flood_hlo(n=1024, rounds=3):
-    """Compile the real sharded ring flood over the 8-device ring mesh
-    and return its HLO text (shared with the demo)."""
-    g = G.watts_strogatz(n, 6, 0.2, seed=0)
-    mesh = M.ring_mesh(8)
-    sg = sharded.shard_graph(g, mesh)
-    fn = sharded._flood_fn(mesh, mesh.axis_names[0], sg.n_shards,
-                           sg.block, rounds, sg.diag_pieces, sg.mxu_block)
-    seen0 = sharded._flood_seed(sg, 0)
-    return fn.lower(
-        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, *sharded._dyn_or_empty(sg),
-        *sharded._mxu_or_empty(sg), sharded._diag_masks_or_empty(sg),
-        sg.node_mask, sg.out_degree, seen0, seen0,
-    ).compile().as_text()
+lower_ring_flood_hlo = commviz.lower_ring_flood_hlo
 
 
 class TestRingHopPlacement:
@@ -198,7 +108,7 @@ class TestMesh2dAutoPlacement:
 
     def test_collectives_never_exceed_node_extent(self):
         g, hlo = self._hlo(Flood(source=0, method="segment"))
-        colls = _collectives(hlo)
+        colls = commviz.collectives(hlo)
         assert colls
         for op, dtype, shape, nbytes in colls:
             assert nbytes <= g.n_nodes_padded * 4, (

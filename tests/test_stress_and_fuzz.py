@@ -155,3 +155,262 @@ class TestDecoderFuzz:
         # headers) — proves the bound is live, not decorative.
         if framing == "length":
             assert overflows >= 1
+
+
+class TestCompressionFuzz:
+    """The codec surface under hostile input: truncation, corruption, bogus
+    tags, and decompression bombs — none may crash, and the bomb must be
+    CONTAINED (wire.decompress max_output; the reference inherits this
+    amplification unbounded [ref: nodeconnection.py:84-105])."""
+
+    @pytest.mark.parametrize("alg", ["zlib", "bzip2", "lzma"])
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_roundtrip_random_binary(self, alg, seed):
+        rng = random.Random(seed)
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 5000)))
+        packet = wire.compress(raw, alg) + wire.COMPR_CHAR
+        assert wire.parse_packet(packet) == wire.decode_payload(raw)
+
+    @pytest.mark.parametrize("alg", ["zlib", "bzip2", "lzma"])
+    @pytest.mark.parametrize("seed", [1, 9])
+    def test_truncated_blob_never_raises(self, alg, seed):
+        rng = random.Random(seed)
+        raw = bytes(rng.randrange(256) for _ in range(2000))
+        blob = wire.compress(raw, alg)
+        for _ in range(40):
+            cut = blob[: rng.randrange(0, len(blob))]
+            out = wire.decompress(cut)  # must not raise, must return bytes
+            assert isinstance(out, bytes)
+
+    @pytest.mark.parametrize("alg", ["zlib", "bzip2", "lzma"])
+    def test_corrupt_middle_byte_never_raises(self, alg):
+        rng = random.Random(5)
+        raw = bytes(rng.randrange(256) for _ in range(2000))
+        blob = bytearray(wire.compress(raw, alg))
+        for _ in range(40):
+            i = rng.randrange(len(blob))
+            mutated = bytes(blob[:i]) + bytes([rng.randrange(256)]) + bytes(
+                blob[i + 1:])
+            out = wire.decompress(mutated)
+            assert isinstance(out, bytes)
+
+    def test_unknown_tag_returns_decoded_as_is(self):
+        import base64
+
+        data = b"payload-with-bogus-tag" + b"zstd"
+        assert wire.decompress(base64.b64encode(data)) == data
+
+    def test_bomb_raises_observable_error(self):
+        # ~100 KB of wire bytes expanding to 256 MB: with the bound the
+        # caller gets DecompressionBombError — observable containment,
+        # never the expansion and never compressed bytes masquerading as
+        # the message.
+        import base64
+        import zlib as _z
+
+        bomb_raw_len = 256 * 1024 * 1024
+        blob = base64.b64encode(
+            _z.compress(b"\x00" * bomb_raw_len, 9) + b"zlib")
+        assert len(blob) < 1024 * 1024, "bomb not compact enough to matter"
+        with pytest.raises(wire.DecompressionBombError):
+            wire.decompress(blob, max_output=1024 * 1024)
+        # And without a bound the historical behavior stands.
+        full = wire.decompress(blob)
+        assert len(full) == bomb_raw_len
+
+    @pytest.mark.parametrize("alg,stitched", [
+        ("bzip2", True),  # bz2/lzma concatenate streams (stdlib parity)
+        ("lzma", True),
+        ("zlib", False),  # zlib returns the first stream, ignores the rest
+    ])
+    def test_bounded_multistream_parity(self, alg, stitched):
+        # Bounded decompression must not silently truncate concatenated
+        # streams: parity with the unbounded stdlib semantics per codec.
+        import base64
+        import bz2 as _b
+        import lzma as _l
+        import zlib as _z
+
+        mod = {"bzip2": _b, "lzma": _l, "zlib": _z}[alg]
+        tag = {"bzip2": b"bzip2", "lzma": b"lzma", "zlib": b"zlib"}[alg]
+        blob = base64.b64encode(
+            mod.compress(b"AAA") + mod.compress(b"BBB") + tag)
+        want = b"AAABBB" if stitched else b"AAA"
+        assert wire.decompress(blob) == want
+        assert wire.decompress(blob, max_output=1 << 20) == want
+
+    @pytest.mark.parametrize("alg", ["zlib", "bzip2", "lzma"])
+    def test_bounded_truncation_is_codec_failure_not_bomb(self, alg):
+        # A stream cut short is corruption: the as-is contract applies
+        # (no raise), exactly like the unbounded path.
+        import base64
+
+        raw = bytes(range(256)) * 64
+        full = base64.b64decode(wire.compress(raw, alg))
+        cut = base64.b64encode(full[: len(full) // 2])
+        out = wire.decompress(cut, max_output=1 << 20)
+        assert isinstance(out, bytes)
+
+    @pytest.mark.parametrize("alg", ["zlib", "bzip2", "lzma"])
+    def test_bound_does_not_reject_legitimate_payloads(self, alg):
+        raw = bytes(range(256)) * 1000  # 256 KB, compressible but honest
+        blob = wire.compress(raw, alg)
+        assert wire.decompress(blob, max_output=len(raw)) == raw
+
+    def test_node_recv_path_drops_bomb_frame(self):
+        # End-to-end: a peer ships a zlib bomb through a real socket; the
+        # receiving node must DROP the frame (counted as a receive
+        # error), never allocate the expansion or deliver compressed
+        # bytes as a message, and the link must survive.
+        import base64
+        import zlib as _z
+
+        from tests.helpers import EventRecorder
+
+        rec = EventRecorder()
+        a = Node("127.0.0.1", 0, id="A")
+        b = Node("127.0.0.1", 0, callback=rec, id="B")
+        for n in (a, b):
+            n.start()
+        try:
+            assert a.connect_with_node("127.0.0.1", b.port)
+            assert wait_until(lambda: len(b.nodes_inbound) == 1)
+            bomb = base64.b64encode(
+                _z.compress(b"\x00" * (200 * 1024 * 1024), 9) + b"zlib")
+            conn = a.nodes_outbound[0]
+            a._loop.call_soon_threadsafe(
+                conn._write, bomb + wire.COMPR_CHAR + wire.EOT_CHAR)
+            assert wait_until(lambda: b.message_count_rerr >= 1,
+                              timeout=10.0), "bomb frame not counted rerr"
+            assert rec.messages() == [], "bomb frame was delivered"
+            # The link survives: normal traffic still flows.
+            a.send_to_nodes("still-alive")
+            assert wait_until(lambda: "still-alive" in rec.messages(),
+                              timeout=10.0)
+        finally:
+            stop_all([a, b])
+
+
+class TestSocketsRaces:
+    """Concurrent send/stop and hostile peers on the real sockets backend —
+    the verify-skill probes, pinned as tests."""
+
+    def test_concurrent_senders_with_midstream_stop(self):
+        import threading
+
+        got = []
+
+        class Sink(Node):
+            def node_message(self, node, data):
+                got.append(data)
+
+        a = Node("127.0.0.1", 0, id="A")
+        b = Sink("127.0.0.1", 0, id="B")
+        for n in (a, b):
+            n.start()
+        try:
+            assert a.connect_with_node("127.0.0.1", b.port)
+            assert wait_until(lambda: len(b.nodes_inbound) == 1)
+
+            stop_evt = threading.Event()
+
+            def blast(t):
+                i = 0
+                while not stop_evt.is_set():
+                    try:
+                        a.send_to_nodes(f"t{t}-{i}")
+                    except Exception:
+                        return  # node stopping underneath us is fine
+                    i += 1
+
+            threads = [threading.Thread(target=blast, args=(t,))
+                       for t in range(4)]
+            for th in threads:
+                th.start()
+            assert wait_until(lambda: len(got) > 200, timeout=15.0)
+            # Stop the RECEIVER mid-stream, then the senders.
+            b.stop()
+            b.join(timeout=15.0)
+            assert not b.is_alive(), "receiver failed to stop under load"
+            stop_evt.set()
+            for th in threads:
+                th.join(timeout=10.0)
+                assert not th.is_alive(), "sender thread wedged"
+            a.stop()
+            a.join(timeout=15.0)
+            assert not a.is_alive(), "sender node failed to stop"
+        finally:
+            stop_evt.set()
+            stop_all([a, b])
+
+    def test_raw_junk_peer_does_not_wedge_accept_path(self):
+        import socket as pysocket
+
+        rng = random.Random(2)
+        n = Node("127.0.0.1", 0, id="N")
+        n.start()
+        try:
+            # No handshake, binary junk with stray EOTs, abrupt close.
+            for _ in range(3):
+                s = pysocket.create_connection(("127.0.0.1", n.port),
+                                               timeout=5)
+                s.sendall(bytes(rng.randrange(256) for _ in range(3000))
+                          + wire.EOT_CHAR * 3)
+                s.close()
+            # A legitimate peer can still connect afterwards.
+            peer = Node("127.0.0.1", 0, id="P")
+            peer.start()
+            try:
+                assert peer.connect_with_node("127.0.0.1", n.port)
+                assert wait_until(lambda: len(n.nodes_inbound) >= 1,
+                                  timeout=10.0)
+            finally:
+                stop_all([peer])
+        finally:
+            stop_all([n])
+
+    def test_invalid_compression_frames_survive_and_count(self):
+        rec = []
+
+        class Sink(Node):
+            def node_message(self, node, data):
+                rec.append(data)
+
+        a = Node("127.0.0.1", 0, id="A")
+        b = Sink("127.0.0.1", 0, id="B")
+        for n in (a, b):
+            n.start()
+        try:
+            assert a.connect_with_node("127.0.0.1", b.port)
+            assert wait_until(lambda: len(b.nodes_inbound) == 1)
+            conn = a.nodes_outbound[0]
+            # Invalid base64 with the COMPR marker: parses as-is (bytes
+            # back unchanged), must not kill the link.
+            junk = b"!!!not-base64!!!" + wire.COMPR_CHAR + wire.EOT_CHAR
+            a._loop.call_soon_threadsafe(conn._write, junk)
+            a.send_to_nodes("after-junk")
+            assert wait_until(lambda: "after-junk" in rec, timeout=10.0)
+        finally:
+            stop_all([a, b])
+
+    def test_send_after_stop_is_a_clean_noop(self):
+        # The post-stop contract: sends neither crash nor wedge — the
+        # connection layer logs "node is not running" and returns (the
+        # reference would raise from a dead socket instead).
+        a = Node("127.0.0.1", 0, id="A")
+        a.start()
+        a.stop()
+        a.join(timeout=10.0)
+        assert not a.is_alive()
+        a.send_to_nodes("too late")  # must not raise
+        assert a.message_count_send == 0
+
+
+def test_nonpositive_bound_contains_rather_than_disables():
+    # zlib's max_length=0 means unlimited — a zero/negative bound must
+    # never silently bypass containment (it raises for every codec).
+    for alg in ("zlib", "bzip2", "lzma"):
+        blob = wire.compress(b"x" * 10000, alg)
+        for bound in (0, -5):
+            with pytest.raises(wire.DecompressionBombError):
+                wire.decompress(blob, max_output=bound)
