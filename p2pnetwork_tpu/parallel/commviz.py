@@ -22,7 +22,7 @@ blocking socket per peer [ref: p2pnetwork/nodeconnection.py:38-44].
 from __future__ import annotations
 
 import re
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Tuple
 
 import numpy as np
 
