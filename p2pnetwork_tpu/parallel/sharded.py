@@ -2043,8 +2043,62 @@ def pagerank(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
     )
 
 
+def _freeze_while(state0, value0, one_step, keep_going,
+                 steps_per_round: int):
+    """The shared device-side early-exit loop for the ring's run-to-*
+    measurements, with optional T-batched iterations.
+
+    ``one_step(state) -> (state, value, messages)`` is one protocol
+    round; the loop runs while ``keep_going(value, rounds)`` holds,
+    accumulating messages in the two-limb counter. ``steps_per_round=T``
+    batches T rounds per while iteration as a ``lax.scan``, each
+    sub-step re-checking the predicate and freezing the WHOLE carry once
+    it fails — bit-exact vs T=1 by construction (the engine's
+    ``_stat_while`` contract; rounds-bound runs amortize the
+    per-iteration dispatch/collective floor T-fold). The freeze masks
+    every state leaf; a leaf whose post-exit value is semantically dead
+    (e.g. the walker's chained key data) freezes harmlessly, because a
+    frozen sub-step implies the next ``cond`` is False.
+
+    Returns ``(state, rounds, value, (hi, lo))`` — callers pack their
+    own summaries.
+    """
+
+    def cond(carry):
+        _, rounds, value, _, _ = carry
+        return keep_going(value, rounds)
+
+    def body(carry):
+        state, rounds, _, hi, lo = carry
+        state, value, msgs = one_step(state)
+        hi, lo = accum.add((hi, lo), msgs)
+        return (state, rounds + 1, value, hi, lo)
+
+    def batched_body(carry):
+        def substep(c, _):
+            state, rounds, value, hi, lo = c
+            live = keep_going(value, rounds)
+            nstate, nvalue, msgs = one_step(state)
+            state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(live, new, old), nstate, state)
+            hi, lo = accum.add(
+                (hi, lo), jnp.where(live, msgs, jnp.zeros_like(msgs)))
+            rounds = jnp.where(live, rounds + 1, rounds)
+            value = jnp.where(live, nvalue, value)
+            return (state, rounds, value, hi, lo), None
+
+        carry, _ = jax.lax.scan(substep, carry, None,
+                                length=steps_per_round)
+        return carry
+
+    init = (state0, jnp.int32(0), value0, *accum.zero())
+    state, rounds, value, hi, lo = jax.lax.while_loop(
+        cond, body if steps_per_round == 1 else batched_body, init)
+    return state, rounds, value, (hi, lo)
+
+
 def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
-                            tol, max_rounds,
+                            steps_per_round, tol, max_rounds,
                             bkt_src, bkt_dst, bkt_mask,
                             dyn_src, dyn_dst, dyn_mask,
                             mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2052,7 +2106,8 @@ def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
                             ranks0, damping, one_minus_damping):
     """Per-shard body: power iteration until the L1 residual drops below
     ``tol`` — engine.run_until_converged's measurement on the multi-chip
-    path, with the packed single-transfer summary."""
+    path, with the packed single-transfer summary. ``steps_per_round``
+    batches iterations per while step (bit-exact vs 1; _freeze_while)."""
     one_round = _make_pagerank_round(
         axis_name, S, block, pieces, mxu_block,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
@@ -2060,26 +2115,22 @@ def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
         node_mask, out_degree, damping, one_minus_damping,
     )
 
-    def cond(carry):
-        _, rounds, residual, _, _ = carry
-        return (residual >= tol) & (rounds < max_rounds)
-
-    def body(carry):
-        ranks, rounds, _, hi, lo = carry
+    def one_step(ranks):
         ranks, stats = one_round(ranks)
-        hi, lo = accum.add((hi, lo), stats["messages"])
-        return ranks, rounds + 1, stats["residual"], hi, lo
+        return ranks, stats["residual"], stats["messages"]
 
-    init = (ranks0[0], jnp.int32(0), jnp.float32(jnp.inf), *accum.zero())
-    ranks, rounds, residual, hi, lo = jax.lax.while_loop(cond, body, init)
+    ranks, rounds, residual, (hi, lo) = _freeze_while(
+        ranks0[0], jnp.float32(jnp.inf), one_step,
+        lambda v, r: (v >= tol) & (r < max_rounds), steps_per_round)
     return ranks[None], accum.pack_summary(rounds, residual, (hi, lo))
 
 
 @functools.lru_cache(maxsize=64)
 def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                          max_rounds: int, pieces=(), mxu_block: int = 128):
+                          max_rounds: int, pieces=(), mxu_block: int = 128,
+                          steps_per_round: int = 1):
     body = functools.partial(_ring_residual_pagerank, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = jax.shard_map(
@@ -2093,6 +2144,7 @@ def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 
 def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
                             tol: float = 1e-6, max_rounds: int = 1024,
+                            steps_per_round: int = 1,
                             axis_name: str = DEFAULT_AXIS, ranks0=None):
     """Run PageRank until the L1 residual drops below ``tol`` — the
     convergence measurement (engine.run_until_converged with
@@ -2100,10 +2152,14 @@ def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
     ``(ranks [S, block] f32, dict(rounds, value, messages))`` with
     ``value`` the final residual and ``messages`` an exact Python int."""
     S, block = sg.n_shards, sg.block
+    if steps_per_round < 1:
+        raise ValueError(
+            f"steps_per_round must be >= 1, got {steps_per_round}")
     if ranks0 is None:
         ranks0 = init_state(sg, protocol, None)
     fn = _pagerank_residual_fn(mesh, axis_name, S, block, max_rounds,
-                               sg.diag_pieces, sg.mxu_block)
+                               sg.diag_pieces, sg.mxu_block,
+                               int(steps_per_round))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     ranks, packed = fn(
@@ -2278,40 +2334,39 @@ def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
 
 
 def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block,
-                           tol, max_rounds,
+                           steps_per_round, tol, max_rounds,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks,
                            node_mask, out_degree, s0, w0):
     """Per-shard body: push-sum until the estimate variance drops below
     ``tol`` — engine.run_until_converged's measurement on the multi-chip
-    path, with the packed single-transfer summary."""
+    path, with the packed single-transfer summary. ``steps_per_round``
+    batches rounds per while step (bit-exact vs 1; _freeze_while —
+    push-sum's ring rounds are deterministic, no key chain)."""
     one_round = _make_pushsum_round(
         axis_name, S, block, pieces, mxu_block,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
     )
 
-    def cond(carry):
-        _, _, rounds, var, _, _ = carry
-        return (var >= tol) & (rounds < max_rounds)
-
-    def body(carry):
-        s, w, rounds, _, hi, lo = carry
+    def one_step(state):
+        s, w = state
         s, w, stats = one_round(s, w)
-        hi, lo = accum.add((hi, lo), stats["messages"])
-        return s, w, rounds + 1, stats["variance"], hi, lo
+        return (s, w), stats["variance"], stats["messages"]
 
-    init = (s0[0], w0[0], jnp.int32(0), jnp.float32(jnp.inf), *accum.zero())
-    s, w, rounds, var, hi, lo = jax.lax.while_loop(cond, body, init)
+    (s, w), rounds, var, (hi, lo) = _freeze_while(
+        (s0[0], w0[0]), jnp.float32(jnp.inf), one_step,
+        lambda v, r: (v >= tol) & (r < max_rounds), steps_per_round)
     return s[None], w[None], accum.pack_summary(rounds, var, (hi, lo))
 
 
 @functools.lru_cache(maxsize=64)
 def _pushsum_variance_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                         max_rounds: int, pieces=(), mxu_block: int = 128):
+                         max_rounds: int, pieces=(), mxu_block: int = 128,
+                         steps_per_round: int = 1):
     body = functools.partial(_ring_variance_pushsum, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = jax.shard_map(
@@ -2326,17 +2381,24 @@ def _pushsum_variance_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 def pushsum_until_variance(sg: ShardedGraph, mesh: Mesh, protocol,
                            key: jax.Array, *,
                            tol: float = 1e-9, max_rounds: int = 1024,
+                           steps_per_round: int = 1,
                            axis_name: str = DEFAULT_AXIS, state0=None):
     """Run push-sum until the estimate variance drops below ``tol`` — the
     consensus-reached measurement (engine.run_until_converged with
     stat="variance"), multi-chip. Returns ``((s, w), dict(rounds, value,
-    messages))`` with ``value`` the final variance."""
+    messages))`` with ``value`` the final variance. ``steps_per_round``
+    batches rounds per while iteration (bit-exact vs 1 — the same freeze
+    contract as the engine loops)."""
     S, block = sg.n_shards, sg.block
+    if steps_per_round < 1:
+        raise ValueError(
+            f"steps_per_round must be >= 1, got {steps_per_round}")
     if state0 is None:
         state0 = init_state(sg, protocol, key)
     s0, w0 = state0
     fn = _pushsum_variance_fn(mesh, axis_name, S, block, max_rounds,
-                              sg.diag_pieces, sg.mxu_block)
+                              sg.diag_pieces, sg.mxu_block,
+                              int(steps_per_round))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     s, w, packed = fn(
@@ -3132,60 +3194,23 @@ def _ring_cov_walk(axis_name, S, block, W, span, restart_p, steps_per_round,
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
     )
 
-    def keep_going(covered, rounds):
-        return (covered / n_live < coverage_target) & (rounds < max_rounds)
-
-    def cond(carry):
-        _, _, _, rounds, covered, _, _ = carry
-        return keep_going(covered, rounds)
-
-    def body(carry):
-        pos, visited, kd, rounds, _, hi, lo = carry
+    def one_step(state):
+        pos, visited, kd = state
         # Chained split, mirroring engine._stat_while round for round.
         k, sub = jax.random.split(jax.random.wrap_key_data(kd))
         pos, visited, moved, _, covered = one_round(
             pos, start0, alive_start, visited, sub
         )
-        hi, lo = accum.add((hi, lo), jnp.sum(moved))
-        return (pos, visited, jax.random.key_data(k), rounds + 1, covered,
-                hi, lo)
-
-    def batched_body(carry):
-        # T sub-steps per while iteration, amortizing the per-iteration
-        # floor (dispatch + the ring's collectives dominate a walker
-        # round, not bandwidth). Bit-exact vs T=1 exactly as in
-        # engine._stat_while: each sub-step re-checks the predicate and
-        # freezes pos/visited/rounds/messages once it fails; the key
-        # chain advances unconditionally but frozen draws are discarded
-        # and the loop exits at the next cond check.
-        def substep(c, _):
-            pos, visited, kd, rounds, covered, hi, lo = c
-            live = keep_going(covered, rounds)
-            k, sub = jax.random.split(jax.random.wrap_key_data(kd))
-            npos, nvisited, moved, _, ncov = one_round(
-                pos, start0, alive_start, visited, sub
-            )
-            pos = jnp.where(live, npos, pos)
-            visited = jnp.where(live, nvisited, visited)
-            covered = jnp.where(live, ncov, covered)
-            hi, lo = accum.add(
-                (hi, lo), jnp.where(live, jnp.sum(moved), 0))
-            rounds = jnp.where(live, rounds + 1, rounds)
-            return (pos, visited, jax.random.key_data(k), rounds, covered,
-                    hi, lo), None
-
-        carry, _ = jax.lax.scan(substep, carry, None,
-                                length=steps_per_round)
-        return carry
+        return (pos, visited, jax.random.key_data(k)), covered, \
+            jnp.sum(moved)
 
     covered0 = jax.lax.psum(
         jnp.sum((visited0[0] & node_mask_b).astype(jnp.int32)), axis_name
     )
-    init = (pos0, visited0[0], key_data, jnp.int32(0), covered0,
-            *accum.zero())
-    pos, visited, _, rounds, covered, hi, lo = jax.lax.while_loop(
-        cond, body if steps_per_round == 1 else batched_body, init
-    )
+    (pos, visited, _), rounds, covered, (hi, lo) = _freeze_while(
+        (pos0, visited0[0], key_data), covered0, one_step,
+        lambda cov, r: (cov / n_live < coverage_target) & (r < max_rounds),
+        steps_per_round)
     return pos, visited[None], accum.pack_summary(
         rounds, covered / n_live, (hi, lo)
     )

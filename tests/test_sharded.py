@@ -1187,6 +1187,43 @@ class TestShardedPageRankResidual:
         )
 
 
+class TestShardedConvergenceBatched:
+    """steps_per_round on the sharded convergence loops: T rounds per
+    while iteration, bit-exact vs T=1 (the engine-loop freeze contract —
+    deterministic rounds, so state, rounds, value, and messages must all
+    agree exactly)."""
+
+    @pytest.mark.parametrize("T", [3, 8])
+    def test_pagerank_residual_bitexact(self, T):
+        from p2pnetwork_tpu.models import PageRank
+
+        g = G.barabasi_albert(1024, 3, seed=0)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        r1, o1 = sharded.pagerank_until_residual(
+            sg, mesh, PageRank(), tol=1e-5)
+        rT, oT = sharded.pagerank_until_residual(
+            sg, mesh, PageRank(), tol=1e-5, steps_per_round=T)
+        assert o1 == oT
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(rT))
+
+    @pytest.mark.parametrize("T", [4])
+    def test_pushsum_variance_bitexact(self, T):
+        from p2pnetwork_tpu.models import PushSum
+
+        g = G.watts_strogatz(1024, 8, 0.1, seed=0)
+        mesh = M.ring_mesh(8)
+        sg = sharded.shard_graph(g, mesh)
+        key = jax.random.key(4)
+        (s1, w1), o1 = sharded.pushsum_until_variance(
+            sg, mesh, PushSum(), key, tol=1e-9)
+        (sT, wT), oT = sharded.pushsum_until_variance(
+            sg, mesh, PushSum(), key, tol=1e-9, steps_per_round=T)
+        assert o1 == oT
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(sT))
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(wT))
+
+
 class TestShardedPushSumVariance:
     @pytest.mark.parametrize("n_shards", [1, 8])
     def test_matches_engine_loop(self, n_shards):
