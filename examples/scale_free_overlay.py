@@ -1,0 +1,103 @@
+"""A scale-free (Barabási–Albert) overlay end to end: the hub problem and
+the lowerings that solve it.
+
+Real P2P overlays are degree-skewed — preferential attachment gives a few
+supernodes thousands of links (the reference's users meet this the moment
+they crawl a real network [ref: README.md:20]). Skew poisons the padded
+neighbor-table layout: ONE hub widens EVERY row, measured at 178x padding
+waste on 100K BA (BENCH.md "gather floor"). This demo shows the framework
+handling it structurally:
+
+- the graph builds with ``skew_table=True``: the two-level virtual-row
+  layout (ops/skew.py) keeps padding waste ~1.3x whatever the skew, and
+  ``method="auto"`` routes aggregation through it;
+- ``AdaptiveFlood`` budgets its sparse rounds by out-edge MASS in
+  fixed-width work items, so a waking hub is charged for its whole row
+  (chunked) instead of widening every item's gather;
+- the protocol sweep — flood, gossip, k-core, walker discovery — runs
+  unchanged: lowerings are a graph property, not a protocol rewrite.
+
+Run: ``JAX_PLATFORMS=cpu python examples/scale_free_overlay.py``
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from p2pnetwork_tpu.utils.jax_env import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from p2pnetwork_tpu.models import (AdaptiveFlood, Flood, Gossip,  # noqa: E402
+                                   KCore, RandomWalks)
+from p2pnetwork_tpu.ops import segment  # noqa: E402
+from p2pnetwork_tpu.sim import engine  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+N, M = 50_000, 4
+
+
+def main():
+    g = G.barabasi_albert(N, M, seed=0, skew_table=True, source_csr=True)
+    deg = np.asarray(g.in_degree)
+    print(f"{N} nodes, {g.n_edges} directed edges; max degree "
+          f"{int(deg.max())} vs median {int(np.median(deg[:N]))} — "
+          f"that one hub would pad the plain table "
+          f"{int(deg.max()) / max(np.median(deg[:N]), 1):.0f}x wide")
+    t = g.skew
+    print(f"two-level table: width {t.width}, {t.n_rows} virtual rows, "
+          f"{t.n_slots / g.n_edges:.2f}x padding waste "
+          f"(auto routes to: {segment._auto_method(g)!r})")
+
+    key = jax.random.key(0)
+
+    # Flood: the canonical protocol, bit-identical dense/adaptive.
+    _, out = engine.run_until_coverage(
+        g, AdaptiveFlood(source=0, method="auto", k=1024), key,
+        coverage_target=0.99)
+    _, ref = engine.run_until_coverage(
+        g, Flood(source=0, method="segment"), key, coverage_target=0.99)
+    assert out == ref, "adaptive flood diverged from the dense oracle"
+    print(f"flood: 99% of the overlay in {int(out['rounds'])} rounds, "
+          f"{int(out['messages'])} messages (hubs make it FAST — compare "
+          f"a quasi-regular overlay's ~11 rounds at this size)")
+
+    # Gossip averaging: hubs mix aggressively.
+    _, gout = engine.run_until_converged(
+        g, Gossip(alpha=0.5), key, stat="variance", threshold=1e-6,
+        max_rounds=256)
+    print(f"gossip: value variance to 1e-6 in {int(gout['rounds'])} rounds")
+
+    # k-core: preferential attachment has degeneracy exactly m — every
+    # node entered with m links, so the m-core is the whole overlay and
+    # the (m+1)-core peels to nothing. Hubs don't deepen the core.
+    cores = {}
+    for k in (M, M + 1):
+        st, _ = engine.run_until_converged(
+            g, KCore(k=k, method="auto"), key, stat="removed",
+            threshold=1, max_rounds=256)
+        cores[k] = int(np.asarray(st.in_core)[:N].sum())
+    print(f"k-core: the {M}-core holds {cores[M]}/{N} nodes "
+          f"(everything but the under-attached seed), the {M + 1}-core "
+          f"is empty ({cores[M + 1]}) — BA's degeneracy is exactly m, "
+          f"hubs notwithstanding")
+
+    # Discovery: a walker cohort maps the overlay; batched super-steps
+    # amortize the rounds-bound crawl's per-iteration floor, bit-exactly.
+    _, wout = engine.run_until_coverage(
+        g, RandomWalks(n_walkers=512), key, coverage_target=0.9,
+        max_rounds=4096, steps_per_round=16)
+    print(f"discovery: 512 walkers visit 90% of the overlay in "
+          f"{int(wout['rounds'])} rounds "
+          f"({int(wout['messages'])} hops; hubs are crossroads — "
+          f"most walks route through them)")
+
+
+if __name__ == "__main__":
+    main()
