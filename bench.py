@@ -356,7 +356,11 @@ def _backend_alive(window_s=None, probe_timeout_s=None):
     (useful to shrink in tests). Returns None when healthy, else the last
     error string."""
     if window_s is None:
-        window_s = int(os.environ.get("BENCH_BACKEND_WINDOW_S", "1500"))
+        # 40 min: the r4 driver tolerated a 25+ min probe window, and with
+        # the graph cache prebuilt the measuring stages need only ~3 min
+        # of healthy tunnel after it — a longer window is all upside for
+        # the revives-mid-window case this environment has shown.
+        window_s = int(os.environ.get("BENCH_BACKEND_WINDOW_S", "2400"))
     if probe_timeout_s is None:
         probe_timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     deadline = time.monotonic() + window_s
