@@ -389,6 +389,13 @@ def main():
         "unit": "s",
         "vs_baseline": 0.0,
     }
+    # Provisional record FIRST: if the caller kills this process mid
+    # probe-window (a driver budget shorter than the window), the last
+    # stdout JSON line is still parseable instead of absent. Every later
+    # print supersedes it.
+    print(json.dumps({**record, "error": "killed while probing backend "
+                      "(provisional record; superseded by later lines)"}),
+          flush=True)
     err = _backend_alive()
     if err is not None:
         record["error"] = err
@@ -396,6 +403,12 @@ def main():
         print(json.dumps(record))
         return 1
 
+    # Probe passed: supersede the provisional line so a kill from here on
+    # is attributed to the measuring stage, not a tunnel outage that
+    # never happened.
+    print(json.dumps({**record, "error": "backend probe passed; killed "
+                      "during measuring stage (provisional record; "
+                      "superseded by later lines)"}), flush=True)
     stage_timeout = int(os.environ.get("BENCH_STAGE_TIMEOUT_S", "900"))
     r1m = _stage_in_child("1m", stage_timeout)
     if "error" in r1m:

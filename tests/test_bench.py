@@ -59,11 +59,19 @@ class TestOrchestration:
     def test_emits_headline_before_and_after_scale_stage(self, first_run):
         _, r, recs = first_run
         assert r.returncode == 0, r.stderr[-2000:]
-        # Two JSON lines: the 1M-only record the moment it is measured,
-        # then the merged record with scale_10M. The driver parses the
-        # LAST line; a mid-10M wedge leaves the first as that line.
-        assert len(recs) == 2
-        early, merged = recs
+        # Four JSON lines: two provisional null records (one before the
+        # backend probe, one after it passes — so a caller killing the
+        # process at ANY point finds a parseable last line whose error
+        # names the phase that was running), the 1M-only record the
+        # moment it is measured, then the merged record with scale_10M.
+        # The driver parses the LAST line; a mid-10M wedge leaves the 1M
+        # record as that line.
+        assert len(recs) == 4
+        prov_probe, prov_measure, early, merged = recs
+        assert prov_probe["value"] is None
+        assert "probing" in prov_probe["error"]
+        assert prov_measure["value"] is None
+        assert "measuring" in prov_measure["error"]
         assert early["value"] is not None and early["value"] > 0
         assert "scale_10M" not in early
         assert merged["value"] == early["value"]
@@ -76,8 +84,8 @@ class TestOrchestration:
         names = os.listdir(cache)
         assert any(n.startswith("ws_n2000") for n in names)
         assert any(n.startswith("ws_n3000") for n in names)
-        assert recs[1]["graph_cached"] is False
-        assert recs[1]["scale_10M"]["graph_cached"] is False
+        assert recs[-1]["graph_cached"] is False
+        assert recs[-1]["scale_10M"]["graph_cached"] is False
 
     def test_second_run_loads_from_cache(self, first_run):
         cache, _, _ = first_run
