@@ -387,3 +387,36 @@ class TestBatchedSteps:
         with pytest.raises(ValueError, match="steps_per_round"):
             engine.run_until_coverage(g, Flood(source=0), jax.random.key(0),
                                       steps_per_round=0)
+
+    @pytest.mark.parametrize("T", [4])
+    def test_adaptive_flood_on_hub_graph_bitexact(self, T):
+        # Batched super-steps compose with the adaptive wave machinery on
+        # a degree-skewed graph (hub rows chunk into work items).
+        from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood
+
+        g = G.barabasi_albert(2048, 4, seed=2, source_csr=True,
+                              skew_table=True)
+        key = jax.random.key(0)
+        proto = AdaptiveFlood(source=0, method="auto", k=128)
+        s1, o1 = engine.run_until_coverage(
+            g, proto, key, coverage_target=0.99, max_rounds=64)
+        sT, oT = engine.run_until_coverage(
+            g, proto, key, coverage_target=0.99, max_rounds=64,
+            steps_per_round=T)
+        assert o1 == oT
+        assert (np.asarray(s1.seen) == np.asarray(sT.seen)).all()
+
+    def test_resume_path_bitexact(self):
+        # run_until_coverage_from with batching: resuming a half-done
+        # crawl must land exactly where the unbatched resume does.
+        g = G.watts_strogatz(512, 4, 0.2, seed=5, source_csr=True)
+        proto = RandomWalks(n_walkers=8)
+        key = jax.random.key(9)
+        mid, _ = engine.run(g, proto, key, 40)
+        s1, o1 = engine.run_until_coverage_from(
+            g, proto, mid, key, coverage_target=0.9, max_rounds=512)
+        sT, oT = engine.run_until_coverage_from(
+            g, proto, mid, key, coverage_target=0.9, max_rounds=512,
+            steps_per_round=8)
+        assert o1 == oT
+        assert (np.asarray(s1.visited) == np.asarray(sT.visited)).all()
