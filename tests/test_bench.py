@@ -160,3 +160,23 @@ class TestHangContainment:
         last = recs[-1]
         assert last["value"] is None
         assert "probe" in last["error"] or "backend" in last["error"]
+
+
+class TestPrebuild:
+    def test_prebuild_populates_cache_for_measuring_runs(self, tmp_path):
+        # --stage prebuild builds + caches both graphs without measuring;
+        # a later measuring run must find them (graph_cached: true).
+        r = subprocess.run([sys.executable, BENCH, "--stage", "prebuild"],
+                           env=_env(tmp_path), capture_output=True,
+                           text=True, timeout=600, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        last = json.loads(
+            [ln for ln in r.stdout.splitlines() if ln.strip()][-1])
+        assert last == {"prebuilt": True}
+        names = os.listdir(tmp_path)
+        assert any(n.startswith("ws_n2000") for n in names)
+        assert any(n.startswith("ws_n3000") for n in names)
+        r2, recs = _run(tmp_path)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert recs[-1]["graph_cached"] is True
+        assert recs[-1]["scale_10M"]["graph_cached"] is True

@@ -114,3 +114,40 @@ class TestMesh2dAutoPlacement:
             assert nbytes <= g.n_nodes_padded * 4, (
                 f"{op} moves {nbytes} bytes — edge-extent traffic on the "
                 f"2-D mesh")
+
+
+class TestDecoderUnits:
+    def test_iota_form_with_transpose(self):
+        line = ("%ar = pred[64]{0} all-reduce(%x), channel_id=1, "
+                "replica_groups=[4,2]<=[2,4]T(1,0), to_apply=%add")
+        assert commviz.decode_groups(line) == [
+            (0, 4), (1, 5), (2, 6), (3, 7)]
+
+    def test_iota_form_identity_perm(self):
+        line = ("%ag = pred[64]{0} all-gather(%x), channel_id=2, "
+                "replica_groups=[2,4]<=[8], dimensions={0}")
+        assert commviz.decode_groups(line) == [
+            (0, 1, 2, 3), (4, 5, 6, 7)]
+
+    def test_literal_form(self):
+        line = ("%ar = f32[8]{0} all-reduce(%x), channel_id=3, "
+                "replica_groups={{0,2},{1,3}}, to_apply=%add")
+        assert commviz.decode_groups(line) == [(0, 2), (1, 3)]
+
+    def test_permute_pairs(self):
+        line = ("%cp = s32[16]{0} collective-permute(%x), channel_id=4, "
+                "source_target_pairs={{0,1},{1,2},{2,0}}")
+        assert commviz.permute_pairs(line) == [(0, 1), (1, 2), (2, 0)]
+
+    def test_classify_counts_permute_bytes(self):
+        # The regression the shared helper exists for: permutes carry no
+        # replica_groups, and skipping them would blind the cross-host
+        # budget to permute traffic.
+        hlo = ("%cp = s32[256]{0} collective-permute(%x), channel_id=1, "
+               "source_target_pairs={{0,4}}\n"
+               "%ar = pred[128]{0} all-reduce(%y), channel_id=2, "
+               "replica_groups=[2,4]<=[8], to_apply=%add\n")
+        within, cross = commviz.classify_collective_bytes(
+            hlo, lambda d: d // 4)
+        assert cross == 256 * 4  # the permute crosses hosts
+        assert within == 128  # the in-row reduce
