@@ -3,7 +3,9 @@ the convergence theorem's premises), type semantics (add-wins OR-set,
 deterministic LWW ties), wire round-trips, and live multi-node
 convergence under concurrent writes."""
 
+import functools
 import itertools
+import random
 
 import pytest
 
@@ -189,3 +191,77 @@ class TestLiveConvergence:
             assert a.gcounter("c").value == 7
         finally:
             stop_all([a])
+
+
+class TestRandomizedConvergence:
+    """Property fuzz: random op sequences on independent replicas, merged
+    in every order (pairwise chains and random shuffles) — the
+    convergence theorem says the final state must not depend on merge
+    order or duplication. Seeded, so failures replay."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 7])
+    def test_pncounter_any_merge_order(self, seed):
+        rng = random.Random(seed)
+        replicas = []
+        for r in range(4):
+            c = PNCounter()
+            for _ in range(rng.randrange(1, 20)):
+                if rng.random() < 0.6:
+                    c.increment(f"r{r}", rng.randrange(1, 9))
+                else:
+                    c.decrement(f"r{r}", rng.randrange(1, 9))
+            replicas.append(c)
+
+        def fold(order):
+            acc = PNCounter()
+            for i in order:
+                acc = acc.merge(replicas[i])
+                if rng.random() < 0.3:  # duplicate deliveries are free
+                    acc = acc.merge(replicas[i])
+            return acc.value
+
+        values = {fold(list(p))
+                  for p in itertools.permutations(range(4))}
+        assert len(values) == 1, f"merge order changed the value: {values}"
+
+    @pytest.mark.parametrize("seed", [2, 5, 11])
+    def test_orset_any_merge_order(self, seed):
+        rng = random.Random(seed)
+        replicas = []
+        for r in range(3):
+            s = ORSet()
+            for _ in range(rng.randrange(2, 25)):
+                e = f"e{rng.randrange(8)}"
+                if rng.random() < 0.7:
+                    s.add(f"r{r}", e)
+                else:
+                    s.remove(e)  # observed-remove: only locally seen tags
+            replicas.append(s)
+
+        def fold(order):
+            acc = ORSet()
+            for i in order:
+                acc = acc.merge(replicas[i])
+            return frozenset(acc.elements())
+
+        results = {fold(list(p))
+                   for p in itertools.permutations(range(3))}
+        assert len(results) == 1, f"merge order changed membership: {results}"
+
+    @pytest.mark.parametrize("seed", [3, 9])
+    def test_lww_register_any_merge_order(self, seed):
+        rng = random.Random(seed)
+        replicas = []
+        for r in range(4):
+            reg = LWWRegister()
+            for i in range(rng.randrange(1, 6)):
+                reg.set(f"r{r}", f"v{r}-{i}", ts=rng.randrange(100))
+            replicas.append(reg)
+        def fold(order):
+            acc = functools.reduce(lambda x, y: x.merge(y),
+                                   (replicas[i] for i in order))
+            return tuple(sorted(acc.to_dict().items(), key=str))
+
+        results = {fold(list(p))
+                   for p in itertools.permutations(range(4))}
+        assert len(results) == 1

@@ -2,6 +2,10 @@
 message bound that is the technique's whole point, deterministic
 conflict resolution, and session edges."""
 
+import random
+
+import pytest
+
 from p2pnetwork_tpu import SyncNode
 from tests.helpers import stop_all, wait_until
 
@@ -229,5 +233,31 @@ class TestReinitiationMidWalk:
             assert b.get(key_in_bucket("0", "late")) == "LATE", \
                 "queued re-initiation was dropped: stores diverged"
             assert a.store == b.store
+        finally:
+            stop_all([a, b])
+
+
+class TestRandomizedConvergence:
+    @pytest.mark.parametrize("seed", [0, 4, 13])
+    def test_random_stores_converge_to_union_max(self, seed):
+        """Property fuzz: random overlapping stores with conflicting
+        values; after one session both stores must equal the element-wise
+        max of the union — whatever the diff shape (seeded; failures
+        replay)."""
+        rng = random.Random(seed)
+        a, b = _pair()
+        try:
+            keys = [f"k{rng.randrange(60)}" for _ in range(80)]
+            items_a = {k: f"v{rng.randrange(100):03d}"
+                       for k in rng.sample(keys, rng.randrange(10, 40))}
+            items_b = {k: f"v{rng.randrange(100):03d}"
+                       for k in rng.sample(keys, rng.randrange(10, 40))}
+            _fill(a, list(items_a.items()))
+            _fill(b, list(items_b.items()))
+            want = dict(items_a)
+            for k, v in items_b.items():
+                want[k] = max(want.get(k, v), v)
+            _sync(a, b, timeout=20.0)
+            assert a.store == b.store == want
         finally:
             stop_all([a, b])
