@@ -1,18 +1,25 @@
 # Runnable encodings of the project's standard invocations (tox.ini holds
 # the same recipes for environments with tox installed; this image bakes
 # in make but not tox). `make test` reproduces the full suite exactly as
-# CI/judging runs it.
+# CI/judging runs it (-m "not slow", matching the tier-1 verify; run
+# `pytest tests/ -q -m slow` for the excluded long-running set).
 
 PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test examples bench dryrun
+.PHONY: test examples bench dryrun telemetry-check
 
 test:
-	$(TEST_ENV) $(PY) -m pytest tests/ -q
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
 
 examples:
 	$(TEST_ENV) $(PY) -m pytest tests/test_examples.py -q
+
+# Telemetry plane: the dedicated test subset plus a ~5 s live sockets demo
+# that scrapes its own Prometheus endpoint over HTTP (tox env "telemetry").
+telemetry-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_telemetry.py -q
+	$(TEST_ENV) $(PY) examples/telemetry_demo.py
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

@@ -28,7 +28,15 @@ Graph construction is the dominant host-side cost (≈16 s at 1M, ≈49 s at
 ``sim/checkpoint.py`` ``save_graph``/``load_graph`` under ``bench_cache/``
 and reloaded on later runs, shrinking the healthy-tunnel window a
 successful bench needs. ``BENCH_CACHE=0`` disables; a corrupt/missing
-cache file silently falls back to a fresh build.
+cache file falls back to a fresh build, reported as a structured
+``bench_cache_miss`` warning event (stderr JSONL, telemetry-schema) plus
+a ``bench_cache_miss_total{reason=...}`` counter — never swallowed.
+
+Telemetry (telemetry/): each measuring stage writes a per-stage artifact —
+``BENCH_TELEMETRY.json`` for the 1M headline stage (``BENCH_TELEMETRY_10M
+.json`` for the scale row; override dir via BENCH_TELEMETRY_DIR) — carrying
+graph-build / cache / compile / run / transfer timings and the full
+registry snapshot. The last-line headline JSON record is unchanged.
 
 Reference anchor: the reference implementation moves one message per peer per
 10 ms poll tick per Python thread [ref: p2pnetwork/nodeconnection.py:220];
@@ -46,8 +54,21 @@ import traceback
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
 
+from p2pnetwork_tpu import telemetry  # noqa: E402 — stdlib-only, no jax
+
+
+def _warn_event(name: str, **data) -> None:
+    """Structured warning on stderr in the shared telemetry JSONL schema
+    (export.event_record) — greppable by the driver, parseable by tools,
+    and mirrored as a counter by the callers that need one."""
+    rec = telemetry.event_record(name, time.time(), data=data)
+    print("# WARN " + json.dumps(rec), file=sys.stderr, flush=True)
+
 
 def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int = 5):
+    """Returns ``(best_seconds, last_out, timing)`` where ``timing`` splits
+    the wall clock into the warmup (compile-carrying) call and the measured
+    reps — the per-stage attribution BENCH_TELEMETRY.json reports."""
     import jax
 
     from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood
@@ -73,13 +94,17 @@ def time_flood(graph, method: str, *, target: float, max_rounds: int, reps: int 
         )
         return out
 
+    t0 = time.perf_counter()
     out = once()  # compile + warm up
+    warmup_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = once()
         times.append(time.perf_counter() - t0)
-    return min(times), out
+    timing = {"warmup_s": round(warmup_s, 4),
+              "measure_s": round(sum(times), 4), "reps": reps}
+    return min(times), out, timing
 
 
 # --------------------------------------------------------------- graph cache
@@ -116,10 +141,17 @@ def _cached_graph(name: str, build):
     (missing file, version skew, truncated write) falls back to a fresh
     build — the cache can only ever make the bench faster, never wrong:
     topology is seed-determined, so cached and rebuilt graphs are
-    identical arrays.
+    identical arrays. Every fallback is REPORTED: a structured
+    ``bench_cache_miss`` warning event on stderr plus a
+    ``bench_cache_miss_total{reason=missing|corrupt|disabled}`` counter —
+    a driver round quietly paying a 49 s rebuild is a diagnosis, not noise.
     """
     from p2pnetwork_tpu.sim import checkpoint as ckpt
 
+    misses = telemetry.default_registry().counter(
+        "bench_cache_miss_total",
+        "Graph-cache misses by cause; every miss costs a full rebuild.",
+        ("reason",))
     path = os.path.join(_cache_dir(), f"{name}_{_layout_fingerprint()}.npz")
     enabled = os.environ.get("BENCH_CACHE", "1") != "0"
     if enabled and os.path.exists(path):
@@ -131,8 +163,16 @@ def _cached_graph(name: str, build):
                   file=sys.stderr, flush=True)
             return g, dt, True
         except Exception as e:
-            print(f"# {name}: cache load failed ({type(e).__name__}: {e}); "
-                  f"rebuilding", file=sys.stderr, flush=True)
+            misses.labels(reason="corrupt").inc()
+            _warn_event("bench_cache_miss", reason="corrupt", graph=name,
+                        path=path, error=f"{type(e).__name__}: {e}")
+    elif enabled:
+        misses.labels(reason="missing").inc()
+        _warn_event("bench_cache_miss", reason="missing", graph=name,
+                    path=path)
+    else:
+        misses.labels(reason="disabled").inc()
+        _warn_event("bench_cache_miss", reason="disabled", graph=name)
     t0 = time.perf_counter()
     g = build()
     dt = time.perf_counter() - t0
@@ -173,6 +213,8 @@ def _graph_spec_10m():
 
 
 def bench_1m(record):
+    """Fills ``record`` (the headline JSON, format pinned by the driver)
+    and returns the per-stage telemetry dict BENCH_TELEMETRY.json carries."""
     import jax
 
     n, name, build = _graph_spec_1m()
@@ -181,14 +223,17 @@ def bench_1m(record):
 
     methods = ["pallas", "hybrid", "adaptive-1024", "adaptive-2048"]
     results = {}
+    per_method = {}
     for m in methods:
         try:
-            secs, out = time_flood(g, m, target=target, max_rounds=64)
+            secs, out, timing = time_flood(g, m, target=target, max_rounds=64)
             results[m] = (secs, out)
+            per_method[m] = {"best_s": round(secs, 6), **timing}
             print(f"# 1M {m}: {secs*1000:.1f} ms, rounds={int(out['rounds'])}, "
                   f"coverage={float(out['coverage']):.4f}, "
                   f"messages={int(out['messages'])}", file=sys.stderr, flush=True)
         except Exception as e:  # a path failing must not sink the bench
+            per_method[m] = {"error": f"{type(e).__name__}: {e}"}
             print(f"# 1M {m}: failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
@@ -212,14 +257,16 @@ def bench_1m(record):
         "n_nodes": n,
         "n_edges": g.n_edges,
     })
+    return {"graph_build_s": round(build_s, 4), "cache_hit": cached,
+            "per_method": per_method}
 
 
 def bench_10m():
     """The scale row: 10M nodes / ~100M directed edges on ONE chip."""
     n, name, build = _graph_spec_10m()
     g, build_s, cached = _cached_graph(name, build)
-    secs, out = time_flood(g, "adaptive-2048", target=0.99, max_rounds=64,
-                           reps=3)
+    secs, out, timing = time_flood(g, "adaptive-2048", target=0.99,
+                                   max_rounds=64, reps=3)
     msgs = int(out["messages"])
     print(f"# 10M adaptive-2048: {secs:.3f} s, rounds={int(out['rounds'])}, "
           f"coverage={float(out['coverage']):.4f}, messages={msgs}",
@@ -235,7 +282,62 @@ def bench_10m():
         "graph_cached": cached,
         "n_nodes": n,
         "n_edges": g.n_edges,
+    }, {"graph_build_s": round(build_s, 4), "cache_hit": cached,
+        "per_method": {"adaptive-2048": {"best_s": round(secs, 6), **timing}}}
+
+
+def _telemetry_path(stage: str) -> str:
+    base = os.environ.get("BENCH_TELEMETRY_DIR", _HERE)
+    suffix = "" if stage == "1m" else f"_{stage.upper()}"
+    return os.path.join(base, f"BENCH_TELEMETRY{suffix}.json")
+
+
+def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
+    """The per-stage telemetry artifact: where the time and bytes of one
+    measuring stage went — graph build vs cache, compile (jax.monitoring
+    lowering hooks; warmup wall as the fallback when hooks are absent),
+    measured run, device->host transfer — plus the full registry snapshot.
+    ``graph_build_s`` / ``warmup_s`` / ``run_s`` are disjoint wall-clock
+    attributions summing (with untracked host overhead) to
+    ``stage_wall_s``; ``compile_s`` and ``transfer_s``/``transfer_bytes``
+    are finer-grained attributions INSIDE the warmup/run phases, not
+    additional siblings.
+    Written next to the headline (BENCH_TELEMETRY.json for the 1M stage);
+    failure to write must not sink a measured bench."""
+    from p2pnetwork_tpu.telemetry import jaxhooks
+
+    reg = telemetry.default_registry()
+    compile_s = jaxhooks.compile_seconds(reg)
+    per_method = {k: v for k, v in tel.get("per_method", {}).items()
+                  if isinstance(v, dict)}
+    warmup_s = sum(m.get("warmup_s", 0.0) for m in per_method.values())
+    run_s = sum(m.get("measure_s", 0.0) for m in per_method.values())
+    artifact = {
+        "schema": "bench-telemetry-v1",
+        "stage": stage,
+        "stage_wall_s": round(stage_wall_s, 4),
+        "stages": {
+            "graph_build_s": tel.get("graph_build_s", 0.0),
+            "cache_hit": tel.get("cache_hit", False),
+            "compile_s": round(compile_s if compile_s > 0 else warmup_s, 4),
+            "compile_count": int(jaxhooks.compile_count(reg)),
+            "warmup_s": round(warmup_s, 4),
+            "run_s": round(run_s, 4),
+            "transfer_s": round(reg.value("sim_transfer_seconds_total"), 6),
+            "transfer_bytes": int(reg.value("sim_transfer_bytes_total")),
+        },
+        "per_method": tel.get("per_method", {}),
+        "metrics": reg.snapshot(),
     }
+    path = _telemetry_path(stage)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"# stage {stage}: telemetry written to {path}",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        _warn_event("bench_telemetry_write_failed", path=path,
+                    error=f"{type(e).__name__}: {e}")
 
 
 def _run_stage(stage: str) -> int:
@@ -246,13 +348,21 @@ def _run_stage(stage: str) -> int:
         from p2pnetwork_tpu.utils.jax_env import apply_platform_env
 
         apply_platform_env()
+        from p2pnetwork_tpu.telemetry import jaxhooks
+
+        jaxhooks.install()  # compile accounting for the whole stage
         if stage == "1m":
             record = {}
-            bench_1m(record)
+            t0 = time.perf_counter()
+            tel = bench_1m(record)
+            _write_stage_telemetry(stage, tel, time.perf_counter() - t0)
             print(json.dumps(record))
             return 0
         if stage == "10m":
-            print(json.dumps(bench_10m()))
+            t0 = time.perf_counter()
+            rec, tel = bench_10m()
+            _write_stage_telemetry(stage, tel, time.perf_counter() - t0)
+            print(json.dumps(rec))
             return 0
         if stage == "prebuild":
             # Populate the graph cache without measuring — run once on a

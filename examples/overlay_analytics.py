@@ -11,6 +11,12 @@ which peers the shortest paths route through (betweenness_sample), and
 which peers are nearest to everyone (closeness_sample) — each runs here
 as a batched protocol over the whole population in one compiled scan
 (clustering and the centralities as one-shot device queries).
+
+Every run-to-* loop also reports through the unified telemetry plane
+(p2pnetwork_tpu/telemetry): the closing section reads the registry
+SNAPSHOT — rounds/messages/wall-time per loop kind, injected failures,
+jit compile wall time — the same numbers a live deployment would scrape
+from the Prometheus endpoint (telemetry.MetricsServer).
 Run: ``python examples/overlay_analytics.py`` (CPU ok; TPU if available).
 """
 
@@ -22,6 +28,7 @@ sys.path.insert(0, ".")
 import jax
 import numpy as np
 
+from p2pnetwork_tpu import telemetry
 from p2pnetwork_tpu.models import (BipartiteCheck, ConnectedComponents,
                                    HopDistance, KCore, LeaderElection,
                                    PageRank, PushSum, betweenness_sample,
@@ -138,6 +145,23 @@ def main():
     top_cc = np.argsort(cc)[-5:][::-1]
     print("closeness (sampled): top-5 best-placed:",
           ", ".join(f"node {i} ({cc[i]:.0f})" for i in top_cc))
+
+    # What did all of that cost? The registry snapshot is the in-process
+    # face of the telemetry plane (the Prometheus endpoint serves the same
+    # families to a scraper — see GETTING_STARTED.md "Observability").
+    snap = telemetry.default_registry().snapshot()
+    print("\ntelemetry snapshot:")
+    for fam in ("sim_runs_total", "sim_rounds_total", "sim_messages_total"):
+        for s in snap.get(fam, {}).get("samples", []):
+            print(f"  {fam}{s['labels']}: {s['value']:.0f}")
+    for s in snap.get("sim_injected_failures_total", {}).get("samples", []):
+        print(f"  sim_injected_failures_total{s['labels']}: {s['value']:.0f}")
+    for s in snap.get("sim_run_seconds", {}).get("samples", []):
+        print(f"  sim_run_seconds{s['labels']}: "
+              f"count={s['count']} sum={s['sum']:.3f}s")
+    compile_s = telemetry.default_registry().value(
+        "jax_compile_seconds_total", stage="backend_compile")
+    print(f"  jax backend-compile wall: {compile_s:.2f}s")
 
 
 if __name__ == "__main__":

@@ -15,9 +15,13 @@ Two backends behind one extension API (SURVEY.md section 7):
 
 The sim subpackages import JAX; this root module does not, so the sockets
 backend works standalone.
+
+Both backends report into one telemetry plane (`p2pnetwork_tpu.telemetry`):
+a zero-dep metrics registry (counters / gauges / histograms) with JSONL and
+Prometheus exporters — see GETTING_STARTED.md "Observability".
 """
 
-from p2pnetwork_tpu import wire
+from p2pnetwork_tpu import telemetry, wire
 from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
@@ -57,6 +61,7 @@ __all__ = [
     "SimConfig",
     "TopologyConfig",
     "MeshConfig",
+    "telemetry",
     "wire",
     "__version__",
 ]

@@ -30,6 +30,7 @@ import socket
 import threading
 from typing import Callable, List, Optional, Union
 
+from p2pnetwork_tpu import telemetry
 from p2pnetwork_tpu.config import NodeConfig
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.utils import EventLog, generate_id
@@ -54,7 +55,8 @@ class Node(threading.Thread):
 
     def __init__(self, host: str, port: int, id: Optional[str] = None,
                  callback: Optional[Callable] = None, max_connections: int = 0,
-                 config: Optional[NodeConfig] = None):
+                 config: Optional[NodeConfig] = None,
+                 registry: Optional[telemetry.Registry] = None):
         super().__init__(name=f"Node({host}:{port})", daemon=True)
         self.host = host
         self.port = port
@@ -83,6 +85,45 @@ class Node(threading.Thread):
         # Structured event history (addition; SURVEY.md section 5 "Metrics").
         self.event_log = EventLog()
 
+        # Telemetry plane (telemetry/): same registry across every node in
+        # the process unless one is injected per node. The legacy
+        # message_count_* ints stay authoritative for parity; _record_*
+        # below keeps them and these families in lockstep.
+        self.telemetry = registry if registry is not None \
+            else telemetry.default_registry()
+        t = self.telemetry
+        self._m_sent = t.counter(
+            "p2p_messages_sent_total", "Messages queued for send, per node.",
+            ("node",)).labels(self.id)
+        self._m_recv = t.counter(
+            "p2p_messages_received_total",
+            "Frames received and delivered upward, per node.",
+            ("node",)).labels(self.id)
+        self._m_rerr = t.counter(
+            "p2p_recv_errors_total",
+            "Send/receive/parse errors (the reference's message_count_rerr, "
+            "live here).", ("node",)).labels(self.id)
+        self._m_bytes_sent = t.counter(
+            "p2p_bytes_sent_total", "Framed bytes written, per peer.",
+            ("node", "peer"))
+        self._m_bytes_recv = t.counter(
+            "p2p_bytes_received_total", "Raw bytes read, per peer.",
+            ("node", "peer"))
+        self._m_handle = t.histogram(
+            "p2p_message_handle_seconds",
+            "Per-message latency from frame decode through the "
+            "node_message handler.", ("node",)).labels(self.id)
+        self._m_conns = t.gauge(
+            "p2p_connections", "Currently connected peers, by direction.",
+            ("node", "direction"))
+        self._m_reconnects = t.counter(
+            "p2p_reconnect_attempts_total",
+            "Reconnect attempts against registered dropped peers.",
+            ("node",)).labels(self.id)
+        self._m_events = t.counter(
+            "p2p_events_total", "Framework events fired, by event name.",
+            ("node", "event"))
+
         # Bind now so errors surface in the constructor [ref: node.py:92-98].
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -101,6 +142,25 @@ class Node(threading.Thread):
         self._stop_event: Optional[asyncio.Event] = None
         # NOT named _started: threading.Thread owns that attribute.
         self._ready = threading.Event()
+
+    # ------------------------------------------------------------ telemetry
+
+    def _record_send(self) -> None:
+        """Bump the send counter — legacy int and telemetry family together."""
+        self.message_count_send += 1
+        self._m_sent.inc()
+
+    def _record_recv(self) -> None:
+        self.message_count_recv += 1
+        self._m_recv.inc()
+
+    def _record_rerr(self) -> None:
+        self.message_count_rerr += 1
+        self._m_rerr.inc()
+
+    def _update_conn_gauges(self) -> None:
+        self._m_conns.labels(self.id, "inbound").set(len(self.nodes_inbound))
+        self._m_conns.labels(self.id, "outbound").set(len(self.nodes_outbound))
 
     # ------------------------------------------------------------- registry
 
@@ -237,9 +297,10 @@ class Node(threading.Thread):
             )
             conn.start()
             self.nodes_inbound.append(conn)
+            self._update_conn_gauges()
             self.inbound_node_connected(conn)
         except Exception as e:
-            self.message_count_rerr += 1
+            self._record_rerr()
             try:
                 writer.close()
             except Exception:
@@ -327,6 +388,7 @@ class Node(threading.Thread):
             conn = self.create_new_connection((reader, writer), connected_node_id, host, port)
             conn.start()
             self.nodes_outbound.append(conn)
+            self._update_conn_gauges()
             self.outbound_node_connected(conn)
 
             # Reconnect registration [ref: node.py:165-169]; single "trials"
@@ -344,7 +406,7 @@ class Node(threading.Thread):
                     writer.close()
                 except Exception:
                     pass
-            self.message_count_rerr += 1
+            self._record_rerr()
             self.debug_print(f"connect_with_node: Could not connect with node. ({error})")
             self.outbound_node_connection_error(error)
             return False
@@ -382,7 +444,7 @@ class Node(threading.Thread):
         """Unicast ``data`` to peer ``n`` [ref: node.py:114-120].
 
         Counter-then-membership-check order preserved [ref: node.py:116-117]."""
-        self.message_count_send += 1
+        self._record_send()
         if n in self.all_nodes:
             n.send(data, compression=compression)
         else:
@@ -416,6 +478,7 @@ class Node(threading.Thread):
                 self.debug_print(f"reconnect_nodes: Node {host}:{port} still running!")
                 continue
             entry["trials"] += 1
+            self._m_reconnects.inc()
             if self.node_reconnection_error(host, port, entry["trials"]):
                 await self.connect_with_node_async(host, port)
             else:
@@ -451,6 +514,7 @@ class Node(threading.Thread):
     def _dispatch(self, event: str, connected_node, data) -> None:
         peer_id = getattr(connected_node, "id", None)
         self.event_log.record(event, peer_id, data)
+        self._m_events.labels(self.id, event).inc()
         if self.callback is not None:
             self.callback(event, self, connected_node, data)
 
@@ -480,9 +544,11 @@ class Node(threading.Thread):
         self.debug_print(f"node_disconnected: {node.id}")
         if node in self.nodes_inbound:
             self.nodes_inbound.remove(node)
+            self._update_conn_gauges()
             self.inbound_node_disconnected(node)
         if node in self.nodes_outbound:
             self.nodes_outbound.remove(node)
+            self._update_conn_gauges()
             self.outbound_node_disconnected(node)
 
     def inbound_node_disconnected(self, node: NodeConnection) -> None:
@@ -512,6 +578,7 @@ class Node(threading.Thread):
         connected-node argument here [ref: node.py:352]."""
         self.debug_print("node is requested to stop!")
         self.event_log.record("node_request_to_stop", None, {})
+        self._m_events.labels(self.id, "node_request_to_stop").inc()
         if self.callback is not None:
             self.callback("node_request_to_stop", self, {}, {})
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Any, Optional, Tuple, Union
 
 from p2pnetwork_tpu import wire
@@ -75,6 +76,14 @@ class NodeConnection:
         # Set when the transport is known bad (send failure / backpressure
         # trip): stop() then force-aborts instead of draining gracefully.
         self._abort = False
+
+        # Per-peer byte accounting (telemetry/): children resolved once per
+        # connection, not per frame — .labels() is a dict lookup under a
+        # lock and this is the transport hot path.
+        self._m_bytes_sent = main_node._m_bytes_sent.labels(
+            main_node.id, self.id)
+        self._m_bytes_recv = main_node._m_bytes_recv.labels(
+            main_node.id, self.id)
 
         self.main_node.debug_print(
             f"NodeConnection.send: Started with client ({self.id}) '{self.host}:{self.port}'"
@@ -160,14 +169,14 @@ class NodeConnection:
             return
         except Exception as e:
             self.main_node.debug_print(f"nodeconnection send: Error encoding data: {e}")
-            self.main_node.message_count_rerr += 1
+            self.main_node._record_rerr()
             return
         if compression == "none":
             payload, is_compressed = raw, False
         else:
             blob = self.compress(raw, compression)
             if blob is None:
-                self.main_node.message_count_rerr += 1
+                self.main_node._record_rerr()
                 return
             payload, is_compressed = blob, True
         try:
@@ -175,7 +184,7 @@ class NodeConnection:
                                     compressed=is_compressed)
         except ValueError as e:  # e.g. body beyond the 4-byte length prefix
             self.main_node.debug_print(f"nodeconnection send: {e}")
-            self.main_node.message_count_rerr += 1
+            self.main_node._record_rerr()
             return
 
         loop = self.main_node._loop
@@ -205,6 +214,7 @@ class NodeConnection:
             return
         try:
             self.writer.write(frame)
+            self._m_bytes_sent.inc(len(frame))
             # Backpressure bound: the reference's blocking sendall stalled the
             # sender when the peer stopped reading; asyncio buffers instead.
             # A peer that falls further behind than max_send_buffer is treated
@@ -218,7 +228,7 @@ class NodeConnection:
                 )
         except Exception as e:
             self.main_node.debug_print(f"nodeconnection send: Error sending data to node: {e}")
-            self.main_node.message_count_rerr += 1
+            self.main_node._record_rerr()
             # Failed transports don't drain: a graceful close would wait on
             # the (possibly never-read) buffer forever, wedging the recv
             # task. Mark for force-abort, then apply the "issue #19"
@@ -264,22 +274,25 @@ class NodeConnection:
                 chunk = await self.reader.read(node.config.recv_chunk)
                 if not chunk:  # EOF — peer closed
                     break
+                self._m_bytes_recv.inc(len(chunk))
                 try:
                     for packet in self._decoder.feed(chunk):
-                        node.message_count_recv += 1  # [ref: nodeconnection.py:215]
+                        node._record_recv()  # [ref: nodeconnection.py:215]
+                        t0 = time.perf_counter()
                         try:
                             node.node_message(self, self.parse_packet(packet))
+                            node._m_handle.observe(time.perf_counter() - t0)
                         except Exception as e:
                             # Neither a crashing user handler nor a bad
                             # frame (DecompressionBombError included) may
                             # kill the transport (in the reference either
                             # kills the recv thread without cleanup); the
                             # frame is dropped and counted.
-                            node.message_count_rerr += 1
+                            node._record_rerr()
                             node.debug_print(
                                 f"parse/handler error, frame dropped: {e!r}")
                 except wire.FrameOverflowError as e:
-                    node.message_count_rerr += 1
+                    node._record_rerr()
                     node.debug_print(f"NodeConnection: {e}")
                     break
         except asyncio.CancelledError:

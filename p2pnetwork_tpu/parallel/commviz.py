@@ -111,6 +111,30 @@ def classify_collective_bytes(hlo: str,
     return within, cross
 
 
+def record_traffic(hlo: str, host_of: Callable[[int], int], *,
+                   program: str = "default",
+                   registry=None) -> Tuple[int, int]:
+    """Classify ``hlo``'s collective traffic and publish it as gauges in
+    the telemetry registry: ``comm_collective_bytes{program, placement}``
+    with ``placement="within_host"`` (ICI-confined on a TPU slice) and
+    ``"cross_host"`` (the DCN remainder). Returns the same
+    ``(within, cross)`` tuple as :func:`classify_collective_bytes`, so
+    diagnostics can keep their printed numbers and the registry's budget
+    gauges from drifting apart — one classification, two consumers."""
+    from p2pnetwork_tpu import telemetry
+
+    within, cross = classify_collective_bytes(hlo, host_of)
+    reg = registry or telemetry.default_registry()
+    g = reg.gauge(
+        "comm_collective_bytes",
+        "Collective payload bytes of a compiled program by interconnect "
+        "placement (within_host ~ ICI budget, cross_host ~ DCN budget).",
+        ("program", "placement"))
+    g.labels(program, "within_host").set(within)
+    g.labels(program, "cross_host").set(cross)
+    return within, cross
+
+
 def ring_hop_classes(hlo: str, host_of: Callable[[int], int]):
     """``(within_hops, cross_hops, permute_pair_lists)`` over every
     collective-permute of a compiled ring program."""

@@ -104,6 +104,15 @@ class PhiAccrualNode(Node):
         # read from monitoring threads; an unguarded deque iteration
         # mid-append raises "deque mutated during iteration".
         self._phi_lock = threading.Lock()
+        self._m_phi = self.telemetry.gauge(
+            "p2p_phi_suspicion",
+            "Phi-accrual suspicion level per peer (refreshed on "
+            "suspicion_levels/phi reads; 0 = healthy or no verdict).",
+            ("node", "peer"))
+        self._m_heartbeats = self.telemetry.counter(
+            "p2p_heartbeats_received_total",
+            "Inbound phi-accrual heartbeats consumed by the detector.",
+            ("node",)).labels(self.id)
 
     # ------------------------------------------------------------ app API
 
@@ -129,7 +138,9 @@ class PhiAccrualNode(Node):
         if stats is None:
             return 0.0
         now = time.monotonic() if now is None else now
-        return _phi_from(now - last, *stats)
+        value = _phi_from(now - last, *stats)
+        self._m_phi.labels(self.id, peer_id).set(value)
+        return value
 
     def suspected(self, peer_id: str, threshold: float = 8.0,
                   now: Optional[float] = None) -> bool:
@@ -152,6 +163,7 @@ class PhiAccrualNode(Node):
         with self._phi_lock:
             self._arrivals.setdefault(
                 peer_id, _ArrivalWindow(self.window)).record(now)
+        self._m_heartbeats.inc()
 
     def node_message(self, node: NodeConnection, data) -> None:
         if isinstance(data, dict) and HB_KEY in data:
@@ -165,4 +177,7 @@ class PhiAccrualNode(Node):
         # judged against its pre-crash rhythm.
         with self._phi_lock:
             self._arrivals.pop(node.id, None)
+        # Prune (not zero) the gauge: a departed peer must not leave a
+        # forever-sample behind — under churn that cardinality only grows.
+        self._m_phi.remove(self.id, node.id)
         super().node_disconnected(node)

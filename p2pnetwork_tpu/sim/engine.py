@@ -10,12 +10,66 @@ host round-trips (the north-star benchmark loop).
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
+from p2pnetwork_tpu import telemetry
 from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.telemetry import jaxhooks
 from p2pnetwork_tpu.utils import accum
+
+# Compile/recompile accounting rides jax.monitoring's lowering-duration
+# events into the default registry (jax_compiles_total /
+# jax_compile_seconds_total{stage}) — a run-to-* loop whose shapes churn
+# shows up as a climbing compile count, not just mysterious wall time.
+jaxhooks.install()
+
+
+def _record_run_summary(loop: str, wall_s: float, transfer_s: float,
+                        transfer_bytes: int, out: dict) -> None:
+    """Bridge one host-side run summary into the registry post-transfer.
+
+    The compiled loops are pure device programs — the only host hooks are
+    their entry and the packed-summary transfer, so that is where the
+    telemetry plane observes the sim backend."""
+    reg = telemetry.default_registry()
+    reg.counter("sim_runs_total", "Completed run-to-* loop invocations.",
+                ("loop",)).labels(loop).inc()
+    reg.counter("sim_rounds_total", "Protocol rounds executed on device.",
+                ("loop",)).labels(loop).inc(float(out["rounds"]))
+    reg.counter("sim_messages_total",
+                "Messages moved by protocol rounds (exact two-limb totals).",
+                ("loop",)).labels(loop).inc(float(out["messages"]))
+    reg.histogram("sim_run_seconds",
+                  "Wall seconds per run-to-* invocation (dispatch through "
+                  "summary transfer).", ("loop",)).labels(loop).observe(wall_s)
+    reg.counter("sim_transfer_seconds_total",
+                "Seconds blocked on device->host summary transfers (includes "
+                "waiting out the device program on async backends)."
+                ).inc(transfer_s)
+    reg.counter("sim_transfer_bytes_total",
+                "Bytes moved by device->host summary transfers."
+                ).inc(transfer_bytes)
+    if loop.startswith("coverage") and "coverage" in out:
+        # (the converged loop reuses the packed f32 slot for its stat, so
+        # its summary also carries a "coverage" key — not a coverage)
+        reg.gauge("sim_last_coverage", "Coverage reached by the most recent "
+                  "run-to-coverage loop.", ("loop",)).labels(loop).set(
+                      float(out["coverage"]))
+
+
+def _timed_summary(loop: str, t0: float, state, packed):
+    """Unpack the packed one-transfer summary, timing the transfer, and
+    record the whole invocation into the registry."""
+    t1 = time.perf_counter()
+    out = _unpack_summary(packed)
+    t2 = time.perf_counter()
+    nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                 for leaf in jax.tree_util.tree_leaves(packed))
+    _record_run_summary(loop, t2 - t0, t2 - t1, nbytes, out)
+    return state, out
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "rounds"))
@@ -67,12 +121,13 @@ def run_until_coverage(
     (e.g. models.flood.Flood).
     """
     _require_stats(graph, protocol, None, key, ("coverage", "messages"))
+    t0 = time.perf_counter()
     state, packed = _coverage_with_init(
         graph, protocol, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
         steps_per_round=steps_per_round,
     )
-    return state, _unpack_summary(packed)
+    return _timed_summary("coverage", t0, state, packed)
 
 
 def run_until_coverage_from(
@@ -99,12 +154,13 @@ def run_until_coverage_from(
     milliseconds.
     """
     _require_stats(graph, protocol, state0, key, ("coverage", "messages"))
+    t0 = time.perf_counter()
     state, packed = _coverage_loop(
         graph, protocol, state0, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
         steps_per_round=steps_per_round,
     )
-    return state, _unpack_summary(packed)
+    return _timed_summary("coverage_from", t0, state, packed)
 
 
 # One-transfer run summaries, shared with the sharded coverage loops.
@@ -137,11 +193,12 @@ def run_until_converged(
     an unreachable threshold runs to ``max_rounds`` — size it to the
     population, or watch ``value`` in the summary."""
     _require_stats(graph, protocol, state0, key, (stat, "messages"))
+    t0 = time.perf_counter()
     state, packed = _converged_loop(
         graph, protocol, state0, key, stat=stat, threshold=threshold,
         max_rounds=max_rounds, steps_per_round=steps_per_round,
     )
-    out = _unpack_summary(packed)
+    state, out = _timed_summary("converged", t0, state, packed)
     out["value"] = out.pop("coverage")  # pack_summary's f32 slot, reused
     return state, out
 

@@ -27,7 +27,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2pnetwork_tpu import telemetry
 from p2pnetwork_tpu.sim.graph import Graph
+
+
+def _count_injected(kind: str, ids=None) -> None:
+    """Injected failures are experiment inputs; counting them in the same
+    registry as the protocol's own metrics lets a churn run report "N
+    failures injected, coverage held at X" from one snapshot. For the
+    deterministic APIs the increment is the entity count; for traced ids or
+    the random_* draws (whose realized count lives on device) it is the
+    injection-call count, under a distinct ``<kind>_draw`` label."""
+    n = 1
+    if ids is not None:
+        try:
+            n = int(np.asarray(ids).size)
+        except Exception:
+            n = 1  # traced ids: count the injection, not the entities
+    telemetry.default_registry().counter(
+        "sim_injected_failures_total",
+        "Failures injected into sim graphs, by kind (entity counts for "
+        "deterministic kinds, draw counts for *_draw).",
+        ("kind",)).labels(kind).inc(n)
 
 
 def _check_ids_in_range(ids, bound: int, what: str) -> None:
@@ -145,6 +166,7 @@ def fail_nodes(graph: Graph, node_ids) -> Graph:
     """Fail-stop the given node ids (crashed peers: they neither send nor
     receive; their edges die with them)."""
     _check_ids_in_range(node_ids, graph.n_nodes_padded, "node")
+    _count_injected("node", node_ids)
     ids = jnp.asarray(node_ids, dtype=jnp.int32)
     alive = jnp.ones(graph.n_nodes_padded, dtype=bool).at[ids].set(False)
     return with_node_liveness(graph, alive)
@@ -160,6 +182,7 @@ def mark_unresponsive(graph: Graph, node_ids) -> Graph:
     which models the loss consistently (a mark-only graph still counts
     the dead peer's table slots as live links)."""
     _check_ids_in_range(node_ids, graph.n_nodes_padded, "node")
+    _count_injected("node_unresponsive", node_ids)
     ids = jnp.asarray(node_ids, dtype=jnp.int32)
     node_mask = graph.node_mask.at[ids].set(False)
     return dataclasses.replace(graph, node_mask=node_mask)
@@ -221,6 +244,7 @@ def with_edge_liveness(graph: Graph, edge_alive: jax.Array) -> Graph:
 def fail_edges(graph: Graph, edge_ids) -> Graph:
     """Cut specific links (indices into the edge arrays)."""
     _check_ids_in_range(edge_ids, graph.n_edges_padded, "edge")
+    _count_injected("edge", edge_ids)
     ids = jnp.asarray(edge_ids, dtype=jnp.int32)
     alive = jnp.ones(graph.n_edges_padded, dtype=bool).at[ids].set(False)
     return with_edge_liveness(graph, alive)
@@ -229,6 +253,7 @@ def fail_edges(graph: Graph, edge_ids) -> Graph:
 def random_node_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
     """Fail each live node independently with probability ``frac`` —
     the churn model for coverage-under-failure experiments."""
+    _count_injected("node_draw")
     alive = ~(
         jax.random.bernoulli(key, frac, (graph.n_nodes_padded,))
         & graph.node_mask
@@ -238,5 +263,6 @@ def random_node_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
 
 def random_edge_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
     """Cut each live directed edge independently with probability ``frac``."""
+    _count_injected("edge_draw")
     cut = jax.random.bernoulli(key, frac, (graph.n_edges_padded,))
     return with_edge_liveness(graph, ~cut)

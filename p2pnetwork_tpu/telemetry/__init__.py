@@ -1,0 +1,30 @@
+"""Unified telemetry: one metrics registry across sockets, sim, and bench.
+
+- :mod:`~p2pnetwork_tpu.telemetry.registry` — counters, gauges, exponential-
+  bucket histograms; thread-safe, zero-dep; :func:`default_registry` is the
+  process-wide plane every instrumentation site reports to.
+- :mod:`~p2pnetwork_tpu.telemetry.export` — Prometheus text exposition and
+  the shared JSONL schema (metric samples and EventLog events interleave).
+- :mod:`~p2pnetwork_tpu.telemetry.httpd` — ``/metrics`` scrape endpoint on
+  a stdlib HTTP server.
+- :mod:`~p2pnetwork_tpu.telemetry.jaxhooks` — jit compile count / wall-time
+  bridged from ``jax.monitoring`` (gated: works without jax installed).
+"""
+
+from p2pnetwork_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, Registry,
+    DEFAULT_LATENCY_BUCKETS, DEFAULT_SIZE_BUCKETS,
+    default_registry, set_default_registry, exponential_buckets,
+)
+from p2pnetwork_tpu.telemetry.export import (
+    event_record, metric_records, to_prometheus, write_jsonl,
+)
+from p2pnetwork_tpu.telemetry.httpd import MetricsServer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "DEFAULT_LATENCY_BUCKETS", "DEFAULT_SIZE_BUCKETS",
+    "default_registry", "set_default_registry", "exponential_buckets",
+    "event_record", "metric_records", "to_prometheus", "write_jsonl",
+    "MetricsServer",
+]

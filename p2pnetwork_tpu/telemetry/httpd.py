@@ -1,0 +1,97 @@
+"""Live scrape endpoint: a stdlib HTTP server exposing the registry.
+
+``GET /metrics`` serves Prometheus text exposition (what a Prometheus
+scraper or ``curl`` reads); ``GET /metrics.json`` serves the registry
+snapshot as JSON for ad-hoc tooling. Zero dependencies —
+``http.server.ThreadingHTTPServer`` on one daemon thread — so a live
+sockets deployment can be watched without installing anything
+(GETTING_STARTED.md "Observability").
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Optional
+
+from p2pnetwork_tpu.telemetry.registry import Registry, default_registry
+from p2pnetwork_tpu.telemetry import export
+
+__all__ = ["MetricsServer"]
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    registry: Registry  # stamped onto the subclass by MetricsServer
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = export.to_prometheus(self.registry).encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            body = json.dumps(self.registry.snapshot()).encode("utf-8")
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes must not spam stdout
+        pass
+
+
+class MetricsServer:
+    """Serve ``registry`` over HTTP on a background daemon thread.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` after
+    :meth:`start`). Usable as a context manager::
+
+        with MetricsServer(port=0) as srv:
+            print(f"curl http://127.0.0.1:{srv.port}/metrics")
+    """
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or default_registry()
+        self.host = host
+        self.port = port
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        handler = type("BoundHandler", (_Handler,),
+                       {"registry": self.registry})
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"MetricsServer({self.host}:{self.port})", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

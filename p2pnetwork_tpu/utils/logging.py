@@ -5,6 +5,12 @@ counters [ref: p2pnetwork/node.py:64-67, :80-83] (SURVEY.md section 5
 "Metrics"). We keep the counters (same names, on ``Node``) and add a bounded
 structured event log so tests and applications can assert on event history
 instead of parsing stdout.
+
+``EventLog`` is one face of the unified telemetry plane (telemetry/):
+:meth:`EventLog.to_jsonl` exports history in the shared JSONL schema
+(``telemetry.export.event_record`` — ``type: "event"`` lines that interleave
+with metric samples in one stream), and ``Node`` mirrors every recorded
+event into the registry's ``p2p_events_total`` family.
 """
 
 from __future__ import annotations
@@ -13,7 +19,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Any, Deque, List, Optional
+from typing import IO, Any, Deque, List, Optional, Union
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,3 +57,15 @@ class EventLog:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+
+    def to_jsonl(self, sink: Union[str, IO]) -> int:
+        """Append the history to ``sink`` (path or file object), one line
+        per event in the shared telemetry JSONL schema — the same envelope
+        ``telemetry.export.write_jsonl`` gives metric samples, so socket
+        events and metrics land in one stream a single parser reads.
+        Returns the number of lines written."""
+        from p2pnetwork_tpu.telemetry import export
+
+        return export.write_records(
+            (export.event_record(e.event, e.timestamp, e.peer_id, e.data)
+             for e in self.snapshot()), sink)

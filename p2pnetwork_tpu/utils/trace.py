@@ -29,6 +29,9 @@ from typing import IO, Iterator, Optional, Union
 import jax
 import numpy as np
 
+from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu.telemetry import jaxhooks
+
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
@@ -68,8 +71,18 @@ def run_traced(
     ``sink`` is a path or file object, each record is also written as one
     JSON line. ``profile_dir`` additionally captures an XLA profile of the
     compiled run.
+
+    The summary line reports through the telemetry registry (telemetry/):
+    ``compile_seconds`` is the backend-compile wall time this run triggered
+    (delta of ``jax_compile_seconds_total`` — 0.0 on a cache hit, and when
+    jax.monitoring is unavailable), ``device_transfer_bytes`` the size of
+    the stats history brought back to host.
     """
     from p2pnetwork_tpu.sim import engine
+
+    reg = telemetry.default_registry()
+    hooks_on = jaxhooks.install()  # None-subscription: follows the default
+    compile_s0 = jaxhooks.compile_seconds(reg) if hooks_on else 0.0
 
     ctx = profile(profile_dir) if profile_dir else contextlib.nullcontext()
     t0 = time.perf_counter()
@@ -78,8 +91,14 @@ def run_traced(
             state, stats = engine.run(graph, protocol, key, rounds)
             jax.block_until_ready(stats)
     wall_s = time.perf_counter() - t0
+    compile_s = (jaxhooks.compile_seconds(reg) - compile_s0) if hooks_on \
+        else 0.0
 
     host_stats = {k: np.asarray(v) for k, v in stats.items()}
+    transfer_bytes = int(sum(v.nbytes for v in host_stats.values()))
+    reg.counter(
+        "sim_transfer_bytes_total",
+        "Bytes moved by device->host summary transfers.").inc(transfer_bytes)
     records = []
     for i in range(rounds):
         rec = {"label": label, "round": i}
@@ -91,6 +110,8 @@ def run_traced(
         "summary": True,
         "rounds": rounds,
         "wall_s": wall_s,
+        "compile_seconds": compile_s,
+        "device_transfer_bytes": transfer_bytes,
         "n_nodes": graph.n_nodes,
         "n_edges": graph.n_edges,
     }

@@ -1,0 +1,542 @@
+"""The unified telemetry plane: registry types, both exporters, the
+Prometheus endpoint, and the sockets/sim/parallel instrumentation.
+
+One registry across every backend is the subsystem's whole point, so the
+tests here cross layers deliberately: real TCP nodes and compiled sim
+runs both land in the same snapshot, the text exposition a scraper sees
+is validated line-by-line, and the JSONL schema is pinned as the shared
+envelope EventLog events and metric samples ride together.
+"""
+
+import io
+import json
+import math
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu.utils import EventLog
+
+
+@pytest.fixture
+def reg():
+    """A fresh registry swapped in as the process default, restored after —
+    instrumentation sites resolve the default at call time, so every module
+    under test reports here without plumbing."""
+    fresh = telemetry.Registry()
+    prev = telemetry.set_default_registry(fresh)
+    yield fresh
+    telemetry.set_default_registry(prev)
+
+
+# --------------------------------------------------------------- registry
+
+
+class TestRegistryTypes:
+    def test_counter_monotone(self, reg):
+        c = reg.counter("events_total", "help text")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_bidirectional(self, reg):
+        g = reg.gauge("queue_depth")
+        g.set(10)
+        g.dec(3)
+        g.inc()
+        assert g.value == 8
+
+    def test_histogram_exponential_buckets(self, reg):
+        h = reg.histogram("lat_seconds",
+                          buckets=telemetry.exponential_buckets(0.001, 10, 3))
+        assert h.buckets == (0.001, 0.01, 0.1)
+        for v in (0.0005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.sum == pytest.approx(5.0555)
+        cum = h._anon().cumulative()
+        assert cum == [(0.001, 1), (0.01, 2), (0.1, 3), (math.inf, 4)]
+
+    def test_labels_create_independent_children(self, reg):
+        c = reg.counter("sent_total", "", ("node", "peer"))
+        c.labels("a", "b").inc(5)
+        c.labels(node="a", peer="c").inc()
+        assert reg.value("sent_total", node="a", peer="b") == 5
+        assert reg.value("sent_total", node="a", peer="c") == 1
+        assert reg.value("sent_total", node="x", peer="y") == 0
+
+    def test_label_arity_and_names_enforced(self, reg):
+        c = reg.counter("c_total", "", ("node",))
+        with pytest.raises(ValueError):
+            c.labels("a", "b")
+        with pytest.raises(ValueError):
+            c.labels(peer="a")
+        with pytest.raises(ValueError):
+            c.inc()  # labeled metric needs .labels() first
+
+    def test_get_or_create_is_idempotent_but_type_safe(self, reg):
+        c1 = reg.counter("x_total")
+        assert reg.counter("x_total") is c1
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+        with pytest.raises(ValueError):
+            reg.counter("x_total", labelnames=("other",))
+
+    def test_invalid_metric_names_rejected(self, reg):
+        for bad in ("", "has space", "has-dash", "1leading"):
+            with pytest.raises(ValueError):
+                reg.counter(bad)
+
+    def test_thread_safety_under_contention(self, reg):
+        c = reg.counter("hits_total")
+        h = reg.histogram("obs", buckets=(1.0,))
+
+        def work():
+            for _ in range(2000):
+                c.inc()
+                h.observe(0.5)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 16000
+        assert h.count == 16000
+
+    def test_snapshot_shape(self, reg):
+        reg.counter("a_total", "ha").inc(2)
+        reg.histogram("h", buckets=(1.0, 2.0)).observe(1.5)
+        snap = reg.snapshot()
+        assert snap["a_total"]["type"] == "counter"
+        assert snap["a_total"]["samples"] == [{"labels": {}, "value": 2.0}]
+        hsamp = snap["h"]["samples"][0]
+        assert hsamp["count"] == 1 and hsamp["sum"] == 1.5
+        assert hsamp["buckets"]["+Inf"] == 1
+
+    def test_value_with_partial_or_unknown_labels_is_zero(self, reg):
+        reg.counter("c_total", "", ("node", "peer")).labels("a", "b").inc()
+        assert reg.value("c_total", node="a") == 0.0       # partial
+        assert reg.value("c_total", nope="a") == 0.0       # unknown name
+        assert reg.value("c_total") == 0.0                 # no labels
+        assert reg.value("c_total", node="a", peer="b") == 1.0
+
+    def test_remove_prunes_child(self, reg):
+        g = reg.gauge("phi", "", ("peer",))
+        g.labels("x").set(3)
+        g.labels("y").set(4)
+        g.remove("x")
+        g.remove("never-existed")  # no-op
+        assert reg.value("phi", peer="x") == 0.0
+        assert reg.value("phi", peer="y") == 4.0
+        assert len(g.children()) == 1
+        with pytest.raises(ValueError):
+            g.remove(wrong_name="x")
+
+    def test_default_registry_swap(self):
+        fresh = telemetry.Registry()
+        prev = telemetry.set_default_registry(fresh)
+        try:
+            assert telemetry.default_registry() is fresh
+        finally:
+            telemetry.set_default_registry(prev)
+        assert telemetry.default_registry() is prev
+
+
+# --------------------------------------------------------------- exporters
+
+
+#: One sample line of text exposition: name{labels} value
+_SAMPLE_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
+
+
+def _assert_valid_exposition(text):
+    families = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            name, kind = line.split()[2:4]
+            assert kind in ("counter", "gauge", "histogram")
+            families.add(name)
+        elif line.startswith("# HELP "):
+            continue
+        else:
+            assert _SAMPLE_LINE.match(line), f"bad exposition line: {line!r}"
+    return families
+
+
+class TestExporters:
+    def test_prometheus_text_exposition(self, reg):
+        reg.counter("msgs_total", "messages", ("node",)).labels("a").inc(3)
+        reg.gauge("depth").set(-2.5)
+        reg.histogram("lat", "latency", buckets=(0.1, 1.0)).observe(0.5)
+        text = telemetry.to_prometheus(reg)
+        families = _assert_valid_exposition(text)
+        assert families == {"msgs_total", "depth", "lat"}
+        assert 'msgs_total{node="a"} 3\n' in text
+        assert "depth -2.5" in text
+        assert 'lat_bucket{le="0.1"} 0' in text
+        assert 'lat_bucket{le="1"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 1' in text
+        assert "lat_sum 0.5" in text
+        assert "lat_count 1" in text
+
+    def test_prometheus_label_escaping(self, reg):
+        reg.counter("c_total", "", ("p",)).labels('we"ird\\pa\nth').inc()
+        text = telemetry.to_prometheus(reg)
+        assert r'p="we\"ird\\pa\nth"' in text
+
+    def test_jsonl_stream_roundtrips(self, reg):
+        reg.counter("c_total", "", ("k",)).labels("v").inc(7)
+        reg.histogram("h", buckets=(1.0,)).observe(2.0)
+        buf = io.StringIO()
+        n = telemetry.write_jsonl(reg, buf)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert n == len(lines) == 2
+        counter = next(r for r in lines if r["type"] == "counter")
+        assert counter["name"] == "c_total"
+        assert counter["labels"] == {"k": "v"}
+        assert counter["value"] == 7
+        hist = next(r for r in lines if r["type"] == "histogram")
+        assert hist["count"] == 1 and hist["sum"] == 2.0
+        assert hist["buckets"]["+Inf"] == 1
+
+    def test_jsonl_to_path_appends(self, reg, tmp_path):
+        reg.counter("c_total").inc()
+        path = tmp_path / "metrics.jsonl"
+        telemetry.write_jsonl(reg, str(path))
+        telemetry.write_jsonl(reg, str(path))
+        assert len(path.read_text().splitlines()) == 2
+
+
+# ------------------------------------------------------ eventlog schema fold
+
+
+class TestEventLogJsonl:
+    def test_round_trip_through_telemetry_schema(self):
+        log = EventLog()
+        log.record("node_message", "peer-1", {"k": 1})
+        log.record("outbound_node_connected", "peer-2")
+        log.record("inbound_node_connection_error", None,
+                   {"exception": ValueError("boom")})
+        buf = io.StringIO()
+        assert log.to_jsonl(buf) == 3
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        originals = log.snapshot()
+        for rec, orig in zip(recs, originals):
+            assert rec["type"] == "event"
+            assert rec["name"] == orig.event
+            assert rec["ts"] == orig.timestamp
+            if orig.peer_id is None:
+                assert rec["labels"] == {}
+            else:
+                assert rec["labels"] == {"peer": orig.peer_id}
+        assert recs[0]["data"] == {"k": 1}
+        # non-JSON data (the exception) must ride as its repr, not crash
+        assert "ValueError" in recs[2]["data"]["exception"] \
+            if isinstance(recs[2]["data"], dict) else "ValueError" in recs[2]["data"]
+
+    def test_clear_empties_history(self):
+        log = EventLog()
+        log.record("e")
+        log.clear()
+        assert log.count() == 0
+        assert log.to_jsonl(io.StringIO()) == 0
+
+    def test_events_and_metrics_share_one_stream(self, reg):
+        reg.counter("c_total").inc()
+        log = EventLog()
+        log.record("node_message", "p")
+        buf = io.StringIO()
+        telemetry.write_jsonl(reg, buf)
+        log.to_jsonl(buf)
+        kinds = {json.loads(ln)["type"] for ln in buf.getvalue().splitlines()}
+        assert kinds == {"counter", "event"}
+
+
+# ------------------------------------------------------------ sockets plane
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestSocketsInstrumentation:
+    def test_node_traffic_lands_in_registry(self, reg):
+        from p2pnetwork_tpu.node import Node
+
+        a = Node("127.0.0.1", 0, id="ta")
+        b = Node("127.0.0.1", 0, id="tb")
+        try:
+            a.start()
+            b.start()
+            assert a.telemetry is reg  # default registry resolved at init
+            a.connect_with_node("127.0.0.1", b.port)
+            assert _wait_until(lambda: len(b.nodes_inbound) == 1)
+            a.send_to_nodes({"x": 1})
+            b.send_to_nodes("pong")
+            assert _wait_until(
+                lambda: reg.value("p2p_messages_received_total", node="ta") >= 1
+                and reg.value("p2p_messages_received_total", node="tb") >= 1)
+
+            assert reg.value("p2p_messages_sent_total", node="ta") == \
+                a.message_count_send == 1
+            assert reg.value("p2p_bytes_sent_total", node="ta", peer="tb") > 0
+            assert reg.value("p2p_bytes_received_total", node="tb", peer="ta") > 0
+            # handle-latency histogram saw each delivered message
+            h = reg.get("p2p_message_handle_seconds")
+            assert h.labels("tb").count >= 1
+            assert reg.value("p2p_connections", node="ta",
+                             direction="outbound") == 1
+            assert reg.value("p2p_connections", node="tb",
+                             direction="inbound") == 1
+            assert reg.value("p2p_events_total", node="ta",
+                             event="outbound_node_connected") == 1
+        finally:
+            a.stop()
+            b.stop()
+            a.join(timeout=10)
+            b.join(timeout=10)
+        # teardown zeroes the gauges and counts disconnect events
+        assert reg.value("p2p_connections", node="tb",
+                         direction="inbound") == 0
+
+    def test_recv_error_counter_mirrors_legacy_int(self, reg):
+        from p2pnetwork_tpu.node import Node
+
+        a = Node("127.0.0.1", 0, id="ea")
+        b = Node("127.0.0.1", 0, id="eb")
+
+        def crash(event, main, conn, data):
+            if event == "node_message":
+                raise RuntimeError("handler bug")
+
+        b.callback = crash
+        try:
+            a.start()
+            b.start()
+            a.connect_with_node("127.0.0.1", b.port)
+            assert _wait_until(lambda: len(b.nodes_inbound) == 1)
+            a.send_to_nodes("boom")
+            assert _wait_until(lambda: b.message_count_rerr >= 1)
+            assert reg.value("p2p_recv_errors_total", node="eb") == \
+                b.message_count_rerr
+        finally:
+            a.stop()
+            b.stop()
+            a.join(timeout=10)
+            b.join(timeout=10)
+
+    def test_phi_suspicion_gauge(self, reg):
+        from p2pnetwork_tpu.phi import PhiAccrualNode
+
+        n = PhiAccrualNode("127.0.0.1", 0, id="phi-node")
+        try:
+            # Feed the estimator directly (unit-level: no real peer needed).
+            t0 = 100.0
+            for i in range(10):
+                n._record_heartbeat("peer-x", t0 + i * 1.0)
+            phi = n.phi("peer-x", now=t0 + 9 + 30.0)  # long silence
+            assert phi > 1.0
+            assert reg.value("p2p_phi_suspicion", node="phi-node",
+                             peer="peer-x") == pytest.approx(phi)
+            assert reg.value("p2p_heartbeats_received_total",
+                             node="phi-node") == 10
+            # a departed peer's gauge sample is PRUNED, not zeroed — under
+            # churn a forever-growing sample set would be the leak
+            class _Gone:
+                id = "peer-x"
+            n.node_disconnected(_Gone())
+            m = reg.get("p2p_phi_suspicion")
+            assert all(c.labels != ("phi-node", "peer-x")
+                       for c in m.children())
+        finally:
+            n.sock.close()
+
+
+# ---------------------------------------------------------------- sim plane
+
+
+jax = pytest.importorskip("jax")
+
+
+class TestSimInstrumentation:
+    def test_run_until_coverage_bridges_summary(self, reg):
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.sim import engine
+        from p2pnetwork_tpu.sim import graph as G
+
+        g = G.watts_strogatz(400, 4, 0.1, seed=0)
+        state, out = engine.run_until_coverage(
+            g, Flood(source=0), jax.random.key(0), coverage_target=0.99,
+            max_rounds=64)
+        assert reg.value("sim_runs_total", loop="coverage") == 1
+        assert reg.value("sim_rounds_total", loop="coverage") == out["rounds"]
+        assert reg.value("sim_messages_total",
+                         loop="coverage") == out["messages"]
+        assert reg.value("sim_last_coverage",
+                         loop="coverage") == pytest.approx(out["coverage"])
+        assert reg.value("sim_transfer_bytes_total") > 0
+        h = reg.get("sim_run_seconds")
+        assert h is not None and h.labels("coverage").count == 1
+        assert h.labels("coverage").sum > 0
+
+    def test_converged_loop_reports_without_coverage_gauge(self, reg):
+        from p2pnetwork_tpu.models import LeaderElection
+        from p2pnetwork_tpu.sim import engine
+        from p2pnetwork_tpu.sim import graph as G
+
+        g = G.watts_strogatz(200, 4, 0.1, seed=1)
+        engine.run_until_converged(
+            g, LeaderElection(), jax.random.key(2), stat="changed",
+            threshold=1, max_rounds=64)
+        assert reg.value("sim_runs_total", loop="converged") == 1
+        # the converged loop's packed f32 slot is its stat, not a coverage
+        assert reg.get("sim_last_coverage") is None or \
+            not reg.get("sim_last_coverage")._children.get(("converged",))
+
+    def test_injected_failures_counted(self, reg):
+        from p2pnetwork_tpu.sim import failures
+        from p2pnetwork_tpu.sim import graph as G
+
+        g = G.watts_strogatz(100, 4, 0.0, seed=0)
+        failures.fail_nodes(g, [1, 2, 3])
+        failures.fail_edges(g, [0])
+        failures.random_node_failures(g, jax.random.key(0), 0.1)
+        assert reg.value("sim_injected_failures_total", kind="node") == 3
+        assert reg.value("sim_injected_failures_total", kind="edge") == 1
+        assert reg.value("sim_injected_failures_total", kind="node_draw") == 1
+
+    def test_compile_hooks_count_backend_compiles(self, reg):
+        from p2pnetwork_tpu.telemetry import jaxhooks
+
+        if not jaxhooks.install():
+            pytest.skip("jax.monitoring unavailable")
+        before = jaxhooks.compile_count(reg)
+        # a fresh lambda object is always a jit-cache miss -> compiles
+        jax.jit(lambda x: x * 2 + 1)(jax.numpy.arange(7))
+        assert jaxhooks.compile_count(reg) >= before + 1
+        assert jaxhooks.compile_seconds(reg) > 0
+
+
+# ----------------------------------------------------- parallel (commviz)
+
+
+class TestCommvizRegistryBridge:
+    # Synthetic HLO exercising the collective-permute branch — the
+    # source_target_pairs form named a blind-spot risk in the module
+    # docstring: permutes carry no replica_groups, so skipping them would
+    # blind the DCN budget to cross-host permute traffic.
+    HLO = "\n".join([
+        "  %cp1 = f32[1024]{0} collective-permute(%x), "
+        "source_target_pairs={{0,1},{2,3}}",                   # within-host
+        "  %cp2 = f32[256]{0} collective-permute-start(%y), "
+        "source_target_pairs={{1,2},{3,0}}",                   # cross-host
+        "  %ar = f32[128]{0} all-reduce(%z), replica_groups={{0,1},{2,3}}",
+    ])
+
+    @staticmethod
+    def _host_of(d):
+        return d // 2  # devices 0,1 on host 0; 2,3 on host 1
+
+    def test_permute_pairs_parsed(self):
+        from p2pnetwork_tpu.parallel import commviz
+
+        line = self.HLO.splitlines()[0]
+        assert commviz.permute_pairs(line) == [(0, 1), (2, 3)]
+
+    def test_classification_covers_permutes(self):
+        from p2pnetwork_tpu.parallel import commviz
+
+        within, cross = commviz.classify_collective_bytes(
+            self.HLO, self._host_of)
+        # cp1 (4096 B) and the all-reduce (512 B) stay on-host; the async
+        # cp2 (1024 B) crosses hosts 0<->1.
+        assert within == 4096 + 512
+        assert cross == 1024
+
+    def test_record_traffic_feeds_registry_gauges(self, reg):
+        from p2pnetwork_tpu.parallel import commviz
+
+        within, cross = commviz.record_traffic(
+            self.HLO, self._host_of, program="ring_flood")
+        assert (within, cross) == (4608, 1024)
+        assert reg.value("comm_collective_bytes", program="ring_flood",
+                         placement="within_host") == 4608
+        assert reg.value("comm_collective_bytes", program="ring_flood",
+                         placement="cross_host") == 1024
+        # re-recording the same program overwrites (gauge), not accumulates
+        commviz.record_traffic(self.HLO, self._host_of, program="ring_flood")
+        assert reg.value("comm_collective_bytes", program="ring_flood",
+                         placement="cross_host") == 1024
+
+
+# ------------------------------------------------------------ the endpoint
+
+
+class TestPrometheusEndpoint:
+    def test_endpoint_serves_at_least_8_families(self, reg):
+        from p2pnetwork_tpu.models import Flood
+        from p2pnetwork_tpu.node import Node
+        from p2pnetwork_tpu.sim import engine, failures
+        from p2pnetwork_tpu.sim import graph as G
+
+        # Populate the plane from BOTH backends, as a real process would.
+        a = Node("127.0.0.1", 0, id="pa")
+        b = Node("127.0.0.1", 0, id="pb")
+        try:
+            a.start()
+            b.start()
+            a.connect_with_node("127.0.0.1", b.port)
+            assert _wait_until(lambda: len(b.nodes_inbound) == 1)
+            a.send_to_nodes({"ping": 1})
+            assert _wait_until(
+                lambda: reg.value("p2p_messages_received_total", node="pb") >= 1)
+            # identical shapes/statics to TestSimInstrumentation's run
+            # -> jit cache hit, no second compile
+            g = G.watts_strogatz(400, 4, 0.1, seed=0)
+            engine.run_until_coverage(g, Flood(source=0), jax.random.key(0),
+                                      coverage_target=0.99, max_rounds=64)
+            failures.fail_nodes(g, [5])
+
+            with telemetry.MetricsServer(reg, port=0) as srv:
+                body = urllib.request.urlopen(srv.url, timeout=5) \
+                    .read().decode()
+                jbody = json.loads(
+                    urllib.request.urlopen(srv.url + ".json", timeout=5)
+                    .read().decode())
+        finally:
+            a.stop()
+            b.stop()
+            a.join(timeout=10)
+            b.join(timeout=10)
+
+        families = _assert_valid_exposition(body)
+        expected = {
+            "p2p_messages_sent_total", "p2p_messages_received_total",
+            "p2p_bytes_sent_total", "p2p_bytes_received_total",
+            "p2p_message_handle_seconds", "p2p_connections",
+            "sim_runs_total", "sim_rounds_total", "sim_messages_total",
+            "sim_injected_failures_total",
+        }
+        assert expected <= families
+        assert len(families) >= 8
+        assert set(jbody) == set(reg.snapshot())
+
+    def test_unknown_path_is_404(self, reg):
+        with telemetry.MetricsServer(reg, port=0) as srv:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+            assert e.value.code == 404

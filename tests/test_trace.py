@@ -47,6 +47,46 @@ def test_jsonl_sink(tmp_path, graph):
     assert lines[-1]["wall_s"] > 0
 
 
+def test_summary_schema_pinned(graph):
+    """The summary line is a consumed artifact (BENCH.md tooling, ad-hoc
+    jq) — its key set is pinned, telemetry-sourced fields included."""
+    import io
+
+    buf = io.StringIO()
+    trace.run_traced(graph, Flood(source=0), jax.random.key(0), 2, sink=buf,
+                     label="pin")
+    summary = json.loads(buf.getvalue().splitlines()[-1])
+    assert set(summary) == {"label", "summary", "rounds", "wall_s",
+                            "compile_seconds", "device_transfer_bytes",
+                            "n_nodes", "n_edges"}
+    # stats history: 2 rounds x (coverage, messages, frontier) float32s
+    assert summary["device_transfer_bytes"] == 2 * 3 * 4
+    assert summary["compile_seconds"] >= 0.0
+
+
+def test_compile_seconds_sourced_from_registry():
+    """A run that triggers fresh XLA compilation attributes its compile
+    wall time in the summary (jax.monitoring -> registry delta); a warm
+    rerun attributes ~none. A fresh graph SHAPE forces the cold compile
+    without clearing the module's jit caches."""
+    from p2pnetwork_tpu.telemetry import jaxhooks
+
+    if not jaxhooks.install():
+        pytest.skip("jax.monitoring unavailable")
+    import io
+
+    g = G.watts_strogatz(123, 4, 0.1, seed=3)  # unseen shape -> compiles
+    buf = io.StringIO()
+    trace.run_traced(g, Flood(source=0), jax.random.key(0), 2, sink=buf)
+    first = json.loads(buf.getvalue().splitlines()[-1])
+    assert first["compile_seconds"] > 0
+
+    buf = io.StringIO()  # warm cache: no fresh compile attributed
+    trace.run_traced(g, Flood(source=0), jax.random.key(0), 2, sink=buf)
+    warm = json.loads(buf.getvalue().splitlines()[-1])
+    assert warm["compile_seconds"] < first["compile_seconds"] / 10
+
+
 def test_sink_accepts_file_object(graph):
     import io
 
