@@ -7,7 +7,7 @@
 PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test examples bench dryrun telemetry-check
+.PHONY: test examples bench dryrun telemetry-check chaos-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -20,6 +20,13 @@ examples:
 telemetry-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_telemetry.py -q
 	$(TEST_ENV) $(PY) examples/telemetry_demo.py
+
+# Chaos plane: the full chaos test subset — slow-marked partition-heal soak
+# included — plus the reconnect/quarantine recovery tests and a live 4-node
+# demo walking the fault menu (tox env "chaos").
+chaos-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_chaos.py tests/test_phi.py -q
+	$(TEST_ENV) $(PY) examples/chaos_demo.py
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

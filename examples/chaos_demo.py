@@ -1,0 +1,147 @@
+"""Seeded chaos on a live 4-node overlay, end to end in ~15 seconds.
+
+Four real TCP nodes form a self-healing ring (``reconnect=True`` links with
+exponential backoff), all attached to one seeded ``ChaosPlane``. The script
+then walks the fault menu:
+
+1. frame drops (seeded, deterministic schedule) under live traffic,
+2. added latency,
+3. a partition into {A, B} | {C, D} — a rumor flooded on one side must NOT
+   cross,
+4. heal — the reconnect machinery re-bridges the ring and the rumor
+   reconverges on all four nodes,
+
+and closes with the telemetry story: every injected fault and every
+recovery step is visible in ONE registry snapshot
+(``chaos_injected_failures_total``, ``chaos_active_faults``,
+``p2p_reconnect_attempts_total``, ``p2p_reconnect_next_retry_seconds``).
+
+Run: ``python examples/chaos_demo.py`` (no jax required). This is the demo
+``make chaos-check`` runs.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from p2pnetwork_tpu import Node, NodeConfig, telemetry
+from p2pnetwork_tpu.chaos import ChaosPlane
+
+HOST = "127.0.0.1"
+SEED = 42
+
+
+class RumorNode(Node):
+    """Flood-with-dedup gossip: rumors spread on message receipt and full
+    state is exchanged whenever a connection (re-)establishes."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.rumors = set()
+
+    def add_rumor(self, rumor):
+        self.rumors.add(rumor)
+        self.send_to_nodes({"rumors": sorted(self.rumors)})
+
+    def node_message(self, conn, data):
+        if isinstance(data, dict) and "rumors" in data:
+            new = set(data["rumors"]) - self.rumors
+            if new:
+                self.rumors |= new
+                self.send_to_nodes({"rumors": sorted(self.rumors)})
+            return
+        super().node_message(conn, data)
+
+    def outbound_node_connected(self, conn):
+        super().outbound_node_connected(conn)
+        if self.rumors:
+            self.send_to_node(conn, {"rumors": sorted(self.rumors)})
+
+    def inbound_node_connected(self, conn):
+        super().inbound_node_connected(conn)
+        if self.rumors:
+            self.send_to_node(conn, {"rumors": sorted(self.rumors)})
+
+
+def wait_for(predicate, timeout=15.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main():
+    reg = telemetry.default_registry()
+    plane = ChaosPlane(seed=SEED)
+    cfg = dict(reconnect_interval=0.05, reconnect_backoff_base=0.1,
+               reconnect_backoff_max=0.5)
+    names = ["A", "B", "C", "D"]
+    nodes = [RumorNode(HOST, 0, id=n, config=NodeConfig(**cfg)) for n in names]
+    plane.attach(*nodes)
+    for n in nodes:
+        n.start()
+    for i, n in enumerate(nodes):
+        assert n.connect_with_node(HOST, nodes[(i + 1) % 4].port, reconnect=True)
+    wait_for(lambda: all(len(n.all_nodes) >= 2 for n in nodes), what="ring up")
+    print(f"ring up: {' -> '.join(names)} -> A   (seed {SEED})")
+
+    # 1. Seeded frame drops under live traffic.
+    plane.drop_frames(0.3)
+    for i in range(40):
+        nodes[0].send_to_node(nodes[0].nodes_outbound[0], {"seq": i})
+    wait_for(lambda: reg.value("chaos_injected_failures_total", kind="drop") > 0,
+             what="a dropped frame")
+    time.sleep(0.3)
+    dropped = int(reg.value("chaos_injected_failures_total", kind="drop"))
+    print(f"frame drops: {dropped}/40 frames eaten "
+          f"(re-run: the same {dropped} — the schedule is seeded)")
+    plane.drop_frames(0.0)
+
+    # 2. Added latency.
+    plane.add_latency(0.15)
+    t0 = time.monotonic()
+    before = nodes[1].message_count_recv
+    nodes[0].send_to_node(nodes[0].nodes_outbound[0], "slow boat")
+    wait_for(lambda: nodes[1].message_count_recv > before, what="delayed frame")
+    print(f"added latency: one frame took {time.monotonic() - t0:.2f}s "
+          f"(injected 0.15s)")
+    plane.add_latency(0.0)
+
+    # 3. Partition {A,B} | {C,D}: a rumor cannot cross.
+    plane.partition([["A", "B"], ["C", "D"]])
+    nodes[0].add_rumor("split-brain")
+    wait_for(lambda: "split-brain" in nodes[1].rumors, what="rumor in group 0")
+    time.sleep(0.5)
+    assert "split-brain" not in nodes[2].rumors
+    assert "split-brain" not in nodes[3].rumors
+    print("partition: rumor reached A,B — C,D blind, as injected")
+
+    # 4. Heal: reconnect backoff re-bridges, gossip reconverges.
+    plane.heal_partition()
+    wait_for(lambda: all("split-brain" in n.rumors for n in nodes),
+             what="overlay reconvergence")
+    print("heal: overlay re-bridged itself, rumor on all 4 nodes")
+
+    snap = reg.snapshot()
+    injected = {s["labels"]["kind"]: int(s["value"])
+                for s in snap["chaos_injected_failures_total"]["samples"]}
+    reconnects = int(sum(s["value"] for s in
+                         snap["p2p_reconnect_attempts_total"]["samples"]))
+    print(f"telemetry: injected={injected}, reconnect attempts={reconnects}")
+    for family in ("chaos_injected_failures_total", "chaos_active_faults",
+                   "p2p_reconnect_attempts_total",
+                   "p2p_reconnect_next_retry_seconds"):
+        assert family in snap, family
+
+    for n in nodes:
+        n.stop()
+    for n in nodes:
+        n.join(timeout=10)
+    print("chaos demo OK")
+
+
+if __name__ == "__main__":
+    main()

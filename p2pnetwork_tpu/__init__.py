@@ -19,9 +19,15 @@ backend works standalone.
 Both backends report into one telemetry plane (`p2pnetwork_tpu.telemetry`):
 a zero-dep metrics registry (counters / gauges / histograms) with JSONL and
 Prometheus exporters — see GETTING_STARTED.md "Observability".
+
+Failure is an injectable input on both backends too: the sim flips
+device-side masks (`sim/failures.py`), the sockets backend has a seeded
+chaos plane (`p2pnetwork_tpu.chaos`) mirroring the same API name-for-name —
+see GETTING_STARTED.md "Fault injection & chaos".
 """
 
-from p2pnetwork_tpu import telemetry, wire
+from p2pnetwork_tpu import chaos, telemetry, wire
+from p2pnetwork_tpu.chaos import ChaosPlane
 from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
@@ -45,6 +51,8 @@ __version__ = "0.4.0"
 __all__ = [
     "Node",
     "NodeConnection",
+    "ChaosPlane",
+    "chaos",
     "CausalNode",
     "CoordinateNode",
     "CRDTNode",

@@ -1,0 +1,14 @@
+"""Chaos plane for the sockets backend: seeded, deterministic fault
+injection mirroring the sim failures API (``sim/failures.py``) name-for-name
+— ``kill_nodes`` / ``revive_nodes`` / ``cut_links`` / ``partition`` — plus
+sockets-only faults (latency, throttle, frame drop/duplicate/corrupt,
+slow-drain peer). See :mod:`p2pnetwork_tpu.chaos.plane` for the design and
+GETTING_STARTED.md "Fault injection & chaos" for the sim↔sockets mapping.
+
+Stdlib-only, like the rest of the sockets backend — no jax import.
+"""
+
+from p2pnetwork_tpu.chaos.plane import ChaosPlane
+from p2pnetwork_tpu.chaos.streams import ChaosReader, ChaosWriter
+
+__all__ = ["ChaosPlane", "ChaosReader", "ChaosWriter"]

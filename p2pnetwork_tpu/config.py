@@ -30,8 +30,18 @@ class NodeConfig:
     connect_timeout: float = 10.0
     #: Seconds between reconnect-registry checks. The reference piggybacks the
     #: check on every accept-loop tick [ref: node.py:265]; a dedicated timer is
-    #: the event-loop equivalent.
+    #: the event-loop equivalent. This is the tick FLOOR: per-entry
+    #: exponential backoff (below) decides which entries actually retry on
+    #: a given tick.
     reconnect_interval: float = 0.5
+    #: First-retry delay of the per-entry reconnect backoff. The reference
+    #: retries every dead peer at the fixed tick cadence forever; here each
+    #: entry backs off with decorrelated jitter — delay_{n+1} drawn uniform
+    #: from [base, 3 * delay_n], capped — so a fleet reconnecting after a
+    #: peer restart does not stampede it in lockstep.
+    reconnect_backoff_base: float = 0.5
+    #: Cap on the per-entry backoff delay.
+    reconnect_backoff_max: float = 30.0
     #: Listen backlog [ref: listen(1), node.py:98 — raised here deliberately].
     listen_backlog: int = 16
     #: Default text encoding for str/dict payloads.
@@ -50,6 +60,11 @@ class NodeConfig:
                 f"unknown framing mode: {self.framing!r} "
                 f"(choose 'eot' or 'length')"
             )
+        if self.reconnect_backoff_base <= 0:
+            raise ValueError("reconnect_backoff_base must be positive")
+        if self.reconnect_backoff_max < self.reconnect_backoff_base:
+            raise ValueError(
+                "reconnect_backoff_max must be >= reconnect_backoff_base")
 
 
 @dataclasses.dataclass

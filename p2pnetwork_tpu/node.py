@@ -26,8 +26,11 @@ outbound handshake is an error instead of a phantom empty-id peer.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import random
 import socket
 import threading
+import time
 from typing import Callable, List, Optional, Union
 
 from p2pnetwork_tpu import telemetry
@@ -120,6 +123,17 @@ class Node(threading.Thread):
             "p2p_reconnect_attempts_total",
             "Reconnect attempts against registered dropped peers.",
             ("node",)).labels(self.id)
+        self._m_next_retry = t.gauge(
+            "p2p_reconnect_next_retry_seconds",
+            "Seconds until the next reconnect attempt of a registered "
+            "dropped peer (0 while connected).", ("node", "peer"))
+        self._m_reconnect_trigger_timeouts = t.counter(
+            "p2p_reconnect_trigger_timeouts_total",
+            "Manual reconnect_nodes() triggers that timed out waiting on a "
+            "busy or wedged event loop.", ("node",)).labels(self.id)
+        # Decorrelated-jitter draws for the reconnect backoff; per-node so
+        # chaos tests can reseed one node without touching global state.
+        self._reconnect_rng = random.Random()
         self._m_events = t.counter(
             "p2p_events_total", "Framework events fired, by event name.",
             ("node", "event"))
@@ -398,7 +412,12 @@ class Node(threading.Thread):
                 self.debug_print(
                     f"connect_with_node: Reconnection check is enabled on node {host}:{port}"
                 )
-                self.reconnect_to_nodes.append({"host": host, "port": port, "trials": 0})
+                self.reconnect_to_nodes.append({
+                    "host": host, "port": port, "trials": 0,
+                    # Per-entry backoff state: last drawn delay and the
+                    # monotonic deadline of the next attempt.
+                    "backoff": 0.0, "next_retry_at": 0.0,
+                })
             return True
         except Exception as error:
             if writer is not None:
@@ -464,35 +483,95 @@ class Node(threading.Thread):
         """Re-establish registered outbound connections that dropped.
 
         [ref: node.py:203-225] with the single-key fix (SURVEY.md 2.3.1): each
-        entry is ``{"host", "port", "trials"}``; the policy hook
-        ``node_reconnection_error`` decides retry (True) vs deregister
-        (False) per trial count."""
+        entry is ``{"host", "port", "trials", "backoff", "next_retry_at"}``;
+        the policy hook ``node_reconnection_error`` decides retry (True) vs
+        deregister (False) per trial count.
+
+        Retry cadence is per-entry exponential backoff with decorrelated
+        jitter (delay_{n+1} ~ U[base, 3 * delay_n], capped at
+        ``reconnect_backoff_max``) instead of the reference's fixed-interval
+        hammering of dead peers; ``reconnect_interval`` stays the tick floor.
+        Backoff resets on successful reconnect; the time to the next attempt
+        is published as the ``p2p_reconnect_next_retry_seconds`` gauge.
+
+        Due entries dial CONCURRENTLY: a serial walk would stall the tick
+        (and node shutdown, and manual triggers) for up to
+        ``K * connect_timeout`` when K peers are unreachable rather than
+        refusing. Each entry's next-retry deadline is stamped AFTER its
+        dial completes, from a fresh clock read — computing it up front
+        would let a slow dial consume the whole delay before it starts."""
+        dials = []
         for entry in list(self.reconnect_to_nodes):
             host, port = entry["host"], entry["port"]
+            peer_key = f"{host}:{port}"
             self.debug_print(f"reconnect_nodes: Checking node {host}:{port}")
             found = any(
                 n.host == host and n.port == port for n in self.nodes_outbound
             )
             if found:
                 entry["trials"] = 0
+                entry["backoff"] = 0.0
+                entry["next_retry_at"] = 0.0
+                self._m_next_retry.labels(self.id, peer_key).set(0.0)
                 self.debug_print(f"reconnect_nodes: Node {host}:{port} still running!")
+                continue
+            now = time.monotonic()
+            next_retry_at = entry.get("next_retry_at", 0.0)
+            if now < next_retry_at:
+                self._m_next_retry.labels(self.id, peer_key).set(next_retry_at - now)
+                continue
+            if entry.get("dialing"):
+                # A dial from an overlapping tick (manual trigger racing
+                # the periodic one) is still in flight; a second dial
+                # would double-count trials and can register a duplicate
+                # connection if the peer comes back mid-window.
                 continue
             entry["trials"] += 1
             self._m_reconnects.inc()
             if self.node_reconnection_error(host, port, entry["trials"]):
-                await self.connect_with_node_async(host, port)
+                entry["dialing"] = True
+                dials.append(self._dial_registered(entry, host, port))
             else:
                 self.debug_print(
                     f"reconnect_nodes: Removing node ({host}:{port}) from the reconnection list!"
                 )
                 self.reconnect_to_nodes.remove(entry)
+                # Deregistered: prune the gauge so the dead peer does not
+                # leave a forever-sample behind.
+                self._m_next_retry.remove(self.id, peer_key)
+        if dials:
+            await asyncio.gather(*dials)
+
+    async def _dial_registered(self, entry: dict, host: str, port: int) -> None:
+        """One reconnect dial plus its post-dial backoff bookkeeping."""
+        try:
+            await self.connect_with_node_async(host, port)
+        finally:
+            entry["dialing"] = False
+            base = self.config.reconnect_backoff_base
+            prev = entry.get("backoff") or base
+            backoff = min(self.config.reconnect_backoff_max,
+                          self._reconnect_rng.uniform(base, prev * 3.0))
+            entry["backoff"] = backoff
+            entry["next_retry_at"] = time.monotonic() + backoff
+            # A successful dial is reset by the found-check on the next tick.
+            self._m_next_retry.labels(self.id, f"{host}:{port}").set(backoff)
 
     def reconnect_nodes(self) -> None:
         """Manual trigger of one reconnect check [ref: node.py:203].
 
         Thread-safe; from an event handler (i.e. on the node's own loop) the
         check is scheduled in the background instead of awaited, since
-        blocking the loop on its own work would deadlock."""
+        blocking the loop on its own work would deadlock.
+
+        The cross-thread wait is BOUNDED at ``2 * config.connect_timeout``
+        plus one second of headroom — a healthy tick's slowest dial may
+        legitimately consume one connect timeout on TCP establishment and a
+        second on the handshake read: an unbounded ``.result()`` would hang
+        the caller forever if the loop is wedged (e.g. a stuck user handler).
+        On timeout the check keeps running on the loop, and the caller gets
+        a structured warning — a ``reconnect_trigger_timeout`` event-log
+        record plus the ``p2p_reconnect_trigger_timeouts_total`` counter."""
         loop = self._loop
         if loop is None or not loop.is_running():
             return
@@ -502,8 +581,20 @@ class Node(threading.Thread):
             running = None
         if running is loop:
             loop.create_task(self._reconnect_tick())
-        else:
-            asyncio.run_coroutine_threadsafe(self._reconnect_tick(), loop).result()
+            return
+        fut = asyncio.run_coroutine_threadsafe(self._reconnect_tick(), loop)
+        bound = 2.0 * self.config.connect_timeout + 1.0
+        try:
+            fut.result(timeout=bound)
+        except concurrent.futures.TimeoutError:
+            self._m_reconnect_trigger_timeouts.inc()
+            self.event_log.record(
+                "reconnect_trigger_timeout", None, {"timeout": bound})
+            self.debug_print(
+                f"reconnect_nodes: tick did not complete within {bound}s — "
+                "event loop busy or wedged; the check continues in the "
+                "background"
+            )
 
     # -------------------------------------------------------------- events
     #

@@ -92,14 +92,51 @@ def _phi_from(elapsed: float, mean: float, std: float) -> float:
 
 
 class PhiAccrualNode(Node):
-    """A :class:`Node` with adaptive, continuous peer suspicion."""
+    """A :class:`Node` with adaptive, continuous peer suspicion — and,
+    when ``quarantine_threshold`` is set, a quarantine -> probe -> readmit
+    lifecycle driven by it:
+
+    - a connected peer whose phi exceeds ``quarantine_threshold`` is
+      QUARANTINED: excluded from application broadcasts
+      (:meth:`send_to_nodes`) but NOT disconnected — heartbeats from
+      :meth:`tick` keep probing it;
+    - when its heartbeats resume and phi falls below
+      ``readmit_threshold`` (default: half the quarantine threshold —
+      hysteresis, so a peer hovering at the threshold does not flap), it
+      is READMITTED to broadcasts;
+    - a peer quarantined longer than ``evict_after`` seconds (``None`` =
+      never) is EVICTED: its connection is closed, handing the address to
+      the reconnect registry / application policy.
+
+    Transitions are evaluated on every :meth:`tick` (or explicitly via
+    :meth:`check_quarantine`), dispatched as ``node_quarantined`` /
+    ``node_readmitted`` events, and counted in the
+    ``p2p_quarantine_transitions_total{node, transition}`` family with the
+    current count in the ``p2p_quarantined_peers`` gauge."""
 
     def __init__(self, *args, window: int = 100, min_std: float = 0.01,
+                 quarantine_threshold: Optional[float] = None,
+                 readmit_threshold: Optional[float] = None,
+                 evict_after: Optional[float] = None,
                  **kwargs):
+        if readmit_threshold is None:
+            readmit_threshold = (quarantine_threshold / 2.0
+                                 if quarantine_threshold is not None else None)
+        if (quarantine_threshold is not None
+                and readmit_threshold >= quarantine_threshold):
+            # Inverted hysteresis would flap quarantine/readmit every
+            # sweep; validated before the base class binds the socket.
+            raise ValueError(
+                "readmit_threshold must be below quarantine_threshold")
         super().__init__(*args, **kwargs)
         self.window = window
         self.min_std = min_std
+        self.quarantine_threshold = quarantine_threshold
+        self.readmit_threshold = readmit_threshold
+        self.evict_after = evict_after
         self._arrivals: Dict[str, _ArrivalWindow] = {}
+        #: peer id -> monotonic time it entered quarantine.
+        self._quarantined: Dict[str, float] = {}
         # Heartbeats append on the event loop while phi()/suspected()
         # read from monitoring threads; an unguarded deque iteration
         # mid-append raises "deque mutated during iteration".
@@ -113,17 +150,33 @@ class PhiAccrualNode(Node):
             "p2p_heartbeats_received_total",
             "Inbound phi-accrual heartbeats consumed by the detector.",
             ("node",)).labels(self.id)
+        self._m_quarantined = self.telemetry.gauge(
+            "p2p_quarantined_peers",
+            "Peers currently quarantined by the phi lifecycle.",
+            ("node",)).labels(self.id)
+        self._m_transitions = self.telemetry.counter(
+            "p2p_quarantine_transitions_total",
+            "Phi quarantine lifecycle transitions "
+            "(quarantine | readmit | evict).",
+            ("node", "transition"))
 
     # ------------------------------------------------------------ app API
 
     def tick(self) -> None:
-        """Broadcast one heartbeat to every peer (thread-safe). Call at
-        the cadence your deployment chooses; the detector learns it."""
+        """Heartbeat every peer and evaluate quarantine transitions
+        (thread-safe). Call at the cadence your deployment chooses; the
+        detector learns it. Heartbeats go to quarantined peers too —
+        they are the PROBE that lets a recovering peer earn readmission."""
         loop = self._loop
         if loop is None or loop.is_closed():
             raise RuntimeError("node is not running — call start() first")
-        loop.call_soon_threadsafe(
-            lambda: self.send_to_nodes({HB_KEY: 1}))
+        loop.call_soon_threadsafe(self._tick_on_loop)
+
+    def _tick_on_loop(self) -> None:
+        for conn in self.all_nodes:
+            self.send_to_node(conn, {HB_KEY: 1})
+        if self.quarantine_threshold is not None:
+            self.check_quarantine()
 
     def phi(self, peer_id: str, now: Optional[float] = None) -> float:
         """Current suspicion of ``peer_id``: 0.0 while the stream is
@@ -155,6 +208,84 @@ class PhiAccrualNode(Node):
             peers = list(self._arrivals)
         return {pid: self.phi(pid, now) for pid in peers}
 
+    # ---------------------------------------------------------- quarantine
+
+    def quarantined(self) -> Dict[str, float]:
+        """Currently quarantined peers: ``{peer_id: seconds in quarantine}``."""
+        now = time.monotonic()
+        with self._phi_lock:
+            return {pid: now - since for pid, since in self._quarantined.items()}
+
+    def is_quarantined(self, peer_id: str) -> bool:
+        with self._phi_lock:
+            return peer_id in self._quarantined
+
+    def check_quarantine(self, now: Optional[float] = None) -> None:
+        """Evaluate quarantine / readmit / evict for every connected peer.
+
+        No-op unless ``quarantine_threshold`` is set. Runs on every
+        :meth:`tick`; callable directly (e.g. with a synthetic ``now``)
+        from tests or monitoring threads."""
+        if self.quarantine_threshold is None:
+            return
+        now = time.monotonic() if now is None else now
+        for conn in list(self.all_nodes):
+            pid = conn.id
+            value = self.phi(pid, now)
+            with self._phi_lock:
+                since = self._quarantined.get(pid)
+            if since is None:
+                if value > self.quarantine_threshold:
+                    self._transition(pid, "quarantine", now)
+                continue
+            if value < self.readmit_threshold:
+                # Fresh heartbeats pulled phi back down: the probe
+                # succeeded, the peer has earned its way back in.
+                self._transition(pid, "readmit", now)
+            elif (self.evict_after is not None
+                  and now - since > self.evict_after):
+                if self._transition(pid, "evict", now):
+                    conn.stop()
+
+    def _transition(self, peer_id: str, transition: str, now: float) -> bool:
+        """Atomically apply one lifecycle transition; returns whether it
+        took effect. The state check and the mutation share one lock
+        acquisition so concurrent sweeps (loop tick + a monitoring
+        thread) cannot double-fire a transition or evict a peer the
+        other sweep just readmitted."""
+        with self._phi_lock:
+            if transition == "quarantine":
+                if peer_id in self._quarantined:
+                    return False  # another sweep got here first
+                self._quarantined[peer_id] = now
+            else:
+                if self._quarantined.pop(peer_id, None) is None:
+                    return False
+            # Published under the lock so concurrent transitions cannot
+            # land their counts out of order and strand a stale gauge.
+            self._m_quarantined.set(len(self._quarantined))
+        self._m_transitions.labels(self.id, transition).inc()
+        event = {"quarantine": "node_quarantined",
+                 "readmit": "node_readmitted",
+                 "evict": "node_evicted"}[transition]
+        self.debug_print(f"{event}: {peer_id}")
+        self._dispatch(event, None, {"peer": peer_id})
+        return True
+
+    def send_to_nodes(self, data, exclude=None, compression="none") -> None:
+        """Broadcast excluding quarantined peers: a suspected-degrading
+        peer stops receiving application traffic (the graceful eviction)
+        while heartbeat probes from :meth:`tick` — which bypass this by
+        sending per-connection — keep testing it for readmission."""
+        exclude = list(exclude or [])
+        if self.quarantine_threshold is not None:
+            with self._phi_lock:
+                bad = set(self._quarantined)
+            if bad:
+                exclude += [c for c in self.all_nodes
+                            if c.id in bad and c not in exclude]
+        super().send_to_nodes(data, exclude, compression)
+
     # ------------------------------------------------------ interception
 
     def _record_heartbeat(self, peer_id: str,
@@ -174,9 +305,12 @@ class PhiAccrualNode(Node):
     def node_disconnected(self, node: NodeConnection) -> None:
         # TCP already rendered its verdict: drop the window so a
         # reconnecting peer starts a fresh estimate instead of being
-        # judged against its pre-crash rhythm.
+        # judged against its pre-crash rhythm. Quarantine state goes with
+        # it — a reconnecting peer starts active, not pre-condemned.
         with self._phi_lock:
             self._arrivals.pop(node.id, None)
+            self._quarantined.pop(node.id, None)
+            self._m_quarantined.set(len(self._quarantined))
         # Prune (not zero) the gauge: a departed peer must not leave a
         # forever-sample behind — under churn that cardinality only grows.
         self._m_phi.remove(self.id, node.id)

@@ -250,6 +250,61 @@ def fail_edges(graph: Graph, edge_ids) -> Graph:
     return with_edge_liveness(graph, alive)
 
 
+def revive_nodes(graph: Graph, node_ids, original: Graph) -> Graph:
+    """Un-fail the given node ids, restoring their ``original`` wiring.
+
+    The inverse of :func:`kill_nodes` on the sockets chaos plane
+    (chaos/plane.py). A failed graph has already zeroed the dead nodes'
+    edges, so reviving needs the pre-failure ``original`` to know what to
+    restore: the result is ``original`` re-masked to (previously live ∪
+    revived) nodes. Edge-level cuts applied after ``original`` was taken
+    are forgotten — revive node-level damage before link-level damage, or
+    reapply the cuts."""
+    _check_ids_in_range(node_ids, graph.n_nodes_padded, "node")
+    _count_injected("node_revive", node_ids)
+    ids = jnp.asarray(node_ids, dtype=jnp.int32)
+    revived = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[ids].set(True)
+    alive = graph.node_mask | (revived & original.node_mask)
+    return with_node_liveness(original, alive)
+
+
+def partition(graph: Graph, groups) -> Graph:
+    """Cut every edge crossing between the node-id ``groups`` — static COO
+    and dynamic-region links (sim/topology.py) both, so not a byte leaks
+    across the split (nodes in no group are unconstrained) — the sim
+    mirror of ``ChaosPlane.partition``. Keep the original graph around to
+    heal. Uses edge-level liveness, so blocked/hybrid kernel graphs must
+    use node failures or rebuild (see :func:`with_edge_liveness`)."""
+    side = np.full(graph.n_nodes_padded, -1, dtype=np.int64)
+    for gi, group in enumerate(groups):
+        ids = np.asarray(group, dtype=np.int64)
+        _check_ids_in_range(ids, graph.n_nodes_padded, "node")
+        side[ids] = gi
+    _count_injected("partition")
+
+    def _crossing(senders, receivers):
+        s, r = np.asarray(senders), np.asarray(receivers)
+        return (side[s] >= 0) & (side[r] >= 0) & (side[s] != side[r])
+
+    gp = with_edge_liveness(
+        graph, jnp.asarray(~_crossing(graph.senders, graph.receivers)))
+    if graph.dyn_mask is not None:
+        # with_edge_liveness passes the dynamic region through untouched;
+        # a runtime-added link spanning the split must die too.
+        dyn_mask = gp.dyn_mask & jnp.asarray(
+            ~_crossing(graph.dyn_senders, graph.dyn_receivers))
+        in_degree, out_degree = _degrees(gp, gp.edge_mask, dyn_mask)
+        gp = dataclasses.replace(gp, dyn_mask=dyn_mask,
+                                 in_degree=in_degree, out_degree=out_degree)
+    return gp
+
+
+#: Name-for-name aliases shared with the sockets chaos plane
+#: (chaos/plane.py): one failure-scenario vocabulary on both backends.
+kill_nodes = fail_nodes
+cut_links = fail_edges
+
+
 def random_node_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
     """Fail each live node independently with probability ``frac`` —
     the churn model for coverage-under-failure experiments."""

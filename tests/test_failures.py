@@ -193,3 +193,81 @@ def test_failures_compose():
     np.testing.assert_array_equal(
         np.asarray(segment.propagate_or(gf, sig, "gather")), ref
     )
+
+class TestChaosNameParity:
+    """The sockets chaos plane (chaos/plane.py) mirrors this module
+    name-for-name; the shared vocabulary must work sim-side too."""
+
+    def test_kill_and_cut_aliases(self):
+        g = G.ring(64)
+        np.testing.assert_array_equal(
+            np.asarray(failures.kill_nodes(g, [3]).node_mask),
+            np.asarray(failures.fail_nodes(g, [3]).node_mask))
+        np.testing.assert_array_equal(
+            np.asarray(failures.cut_links(g, [7]).edge_mask),
+            np.asarray(failures.fail_edges(g, [7]).edge_mask))
+
+    def test_partition_cuts_only_crossing_edges(self):
+        g = G.ring(8)  # directed ring: edges i -> i+1 and i -> i-1
+        gp = failures.partition(g, [[0, 1, 2, 3], [4, 5, 6, 7]])
+        s = np.asarray(g.senders)
+        r = np.asarray(g.receivers)
+        side = np.where(np.arange(g.n_nodes_padded) < 4, 0, 1)
+        crossing = (side[s] != side[r]) & np.asarray(g.edge_mask)
+        emask = np.asarray(gp.edge_mask)
+        assert not emask[crossing].any()
+        within = ~crossing & np.asarray(g.edge_mask)
+        np.testing.assert_array_equal(emask[within],
+                                      np.asarray(g.edge_mask)[within])
+        # A flood from node 0 covers only its side.
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+        for _ in range(8):
+            sig = sig | segment.propagate_or(gp, sig, "segment")
+        out = np.asarray(sig)[:8]
+        assert out[:4].all() and not out[4:].any()
+
+    def test_partition_leaves_ungrouped_nodes_connected(self):
+        g = G.ring(8)
+        gp = failures.partition(g, [[0, 1, 2], [5, 6, 7]])  # 3, 4 ungrouped
+        emask = np.asarray(gp.edge_mask)
+        s = np.asarray(g.senders)
+        r = np.asarray(g.receivers)
+        # 2 -> 3 and 3 -> 4 cross into/out of the ungrouped gap: alive.
+        bridge = ((s == 2) & (r == 3)) | ((s == 3) & (r == 4))
+        assert emask[bridge & np.asarray(g.edge_mask)].all()
+
+    def test_revive_restores_original_wiring(self):
+        g = G.ring(64)
+        gf = failures.kill_nodes(g, [3, 10])
+        gr = failures.revive_nodes(gf, [3], g)
+        alive = np.asarray(gr.node_mask)
+        assert alive[3] and not alive[10]
+        # 3's ring edges came back; 10's stayed dead.
+        assert np.asarray(gr.in_degree)[3] == 2
+        assert np.asarray(gr.in_degree)[10] == 0
+        # Full revival round-trips to the original graph.
+        g2 = failures.revive_nodes(gf, [3, 10], g)
+        np.testing.assert_array_equal(np.asarray(g2.node_mask),
+                                      np.asarray(g.node_mask))
+        np.testing.assert_array_equal(np.asarray(g2.edge_mask),
+                                      np.asarray(g.edge_mask))
+        np.testing.assert_array_equal(np.asarray(g2.in_degree),
+                                      np.asarray(g.in_degree))
+
+    def test_partition_cuts_dynamic_links_too(self):
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(G.ring(8), extra_edges=4)
+        g = topology.connect(g, [1], [6])  # runtime link spanning the split
+        gp = failures.partition(g, [[0, 1, 2, 3], [4, 5, 6, 7]])
+        assert int(np.asarray(gp.dyn_mask).sum()) == 0  # both directions dead
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[1].set(True)
+        for _ in range(8):
+            sig = sig | segment.propagate_or(gp, sig, "segment")
+        out = np.asarray(sig)[:8]
+        assert out[:4].all() and not out[4:].any()
+        # Same-side dynamic links survive a partition.
+        g2 = topology.connect(topology.with_capacity(G.ring(8), extra_edges=4),
+                              [0], [2])
+        gp2 = failures.partition(g2, [[0, 1, 2, 3], [4, 5, 6, 7]])
+        assert int(np.asarray(gp2.dyn_mask).sum()) == 2

@@ -305,7 +305,8 @@ class TestReconnect:
         # and its implementation has the tries/trials KeyError (SURVEY.md
         # 2.3.1). Here: a registered peer drops and comes back; the client
         # re-establishes automatically.
-        cfg = NodeConfig(reconnect_interval=0.1)
+        cfg = NodeConfig(reconnect_interval=0.1, reconnect_backoff_base=0.1,
+                         reconnect_backoff_max=0.5)
         server = make_node()
         server_port = server.port
         client = Node("127.0.0.1", 0, config=cfg)
@@ -328,7 +329,8 @@ class TestReconnect:
             stop_all([server, client])
 
     def test_policy_hook_deregisters(self):
-        cfg = NodeConfig(reconnect_interval=0.05)
+        cfg = NodeConfig(reconnect_interval=0.05, reconnect_backoff_base=0.05,
+                         reconnect_backoff_max=0.2)
 
         class GiveUpNode(Node):
             def node_reconnection_error(self, host, port, trials):

@@ -1,8 +1,11 @@
 """Phi-accrual detector: the estimator driven with synthetic clocks
 (deterministic — phi's monotonic growth in silence, adaptation to slow
-cadences, the min-std floor), plus a live two-node heartbeat check."""
+cadences, the min-std floor), plus a live two-node heartbeat check, plus
+the quarantine -> probe -> readmit lifecycle driven with synthetic clocks."""
 
-from p2pnetwork_tpu import PhiAccrualNode
+import pytest
+
+from p2pnetwork_tpu import PhiAccrualNode, telemetry
 from tests.helpers import stop_all, wait_until
 
 HOST = "127.0.0.1"
@@ -96,6 +99,43 @@ class TestLive:
         finally:
             stop_all(nodes)
 
+    def test_quarantine_probe_readmit_live(self):
+        # End-to-end lifecycle over real TCP: B stops ticking -> A
+        # quarantines it; B resumes -> A's probes see it and readmit.
+        import time
+
+        a = PhiAccrualNode(HOST, 0, id="A", min_std=0.01,
+                           quarantine_threshold=8.0)
+        b = PhiAccrualNode(HOST, 0, id="B", min_std=0.01)
+        try:
+            a.start()
+            b.start()
+            assert a.connect_with_node(HOST, b.port)
+            assert wait_until(lambda: len(a.all_nodes) == 1
+                              and len(b.all_nodes) == 1)
+
+            def beat(both, seconds):
+                deadline = time.monotonic() + seconds
+                while time.monotonic() < deadline:
+                    a.tick()
+                    if both:
+                        b.tick()
+                    time.sleep(0.02)
+
+            beat(both=True, seconds=1.0)  # A learns B's ~50 Hz cadence
+            assert not a.is_quarantined("B")
+            # B goes silent; A keeps ticking (probing + sweeping).
+            assert wait_until(lambda: (beat(both=False, seconds=0.2)
+                                       or a.is_quarantined("B")),
+                              timeout=10.0)
+            # B recovers: probes are still flowing, so its heartbeats
+            # resume and it earns readmission.
+            assert wait_until(lambda: (beat(both=True, seconds=0.2)
+                                       or not a.is_quarantined("B")),
+                              timeout=10.0)
+        finally:
+            stop_all([a, b])
+
     def test_heartbeats_invisible_to_app(self):
         seen = []
 
@@ -118,3 +158,127 @@ class TestLive:
             assert seen == ["app traffic"]
         finally:
             stop_all([a, b])
+
+
+class FakeConn:
+    """Stands in for a NodeConnection in synthetic-clock lifecycle tests:
+    just an id, a send recorder, and a stop recorder."""
+
+    def __init__(self, id):
+        self.id = id
+        self.sent = []
+        self.stopped = False
+
+    def send(self, data, compression="none"):
+        self.sent.append(data)
+
+    def stop(self):
+        self.stopped = True
+
+
+class TestQuarantineLifecycle:
+    """The quarantine -> probe -> readmit state machine, driven entirely
+    with synthetic clocks (no sockets, no sleeps): a degrading peer is
+    excluded from app broadcasts but keeps being probed, earns
+    readmission when its heartbeats resume, and is evicted only past
+    ``evict_after``."""
+
+    def _node(self, **kw):
+        reg = telemetry.Registry()
+        n = PhiAccrualNode(HOST, 0, id="me", min_std=0.05,
+                           registry=reg, **kw)
+        conn = FakeConn("p")
+        n.nodes_inbound.append(conn)
+        _feed(n, "p", [float(i) for i in range(20)])  # 1 Hz cadence
+        return n, conn, reg
+
+    def test_inverted_hysteresis_rejected(self):
+        with pytest.raises(ValueError):
+            PhiAccrualNode(HOST, 0, id="me", quarantine_threshold=8.0,
+                           readmit_threshold=10.0)
+
+    def test_disabled_by_default(self):
+        n, conn, _ = self._node()
+        try:
+            assert n.quarantine_threshold is None
+            n.check_quarantine(now=1000.0)  # silent no-op
+            assert not n.is_quarantined("p")
+        finally:
+            n.sock.close()
+
+    def test_quarantine_excludes_then_readmits(self):
+        n, conn, reg = self._node(quarantine_threshold=8.0)
+        try:
+            # Healthy: normal gap, peer stays active and reachable.
+            n.check_quarantine(now=19.5)
+            assert not n.is_quarantined("p")
+            n.send_to_nodes({"app": 1})
+            assert conn.sent == [{"app": 1}]
+            # Long silence: phi blows past the threshold -> quarantined,
+            # excluded from app broadcasts.
+            n.check_quarantine(now=40.0)
+            assert n.is_quarantined("p")
+            assert n.quarantined()  # seconds-in-quarantine view
+            n.send_to_nodes({"app": 2})
+            assert {"app": 2} not in conn.sent
+            assert reg.value("p2p_quarantine_transitions_total",
+                             node="me", transition="quarantine") == 1
+            assert reg.value("p2p_quarantined_peers", node="me") == 1
+            # The peer recovers: fresh heartbeat pulls phi down ->
+            # readmitted, broadcasts flow again.
+            n._record_heartbeat("p", now=41.0)
+            n.check_quarantine(now=41.1)
+            assert not n.is_quarantined("p")
+            n.send_to_nodes({"app": 3})
+            assert {"app": 3} in conn.sent
+            assert reg.value("p2p_quarantine_transitions_total",
+                             node="me", transition="readmit") == 1
+            assert reg.value("p2p_quarantined_peers", node="me") == 0
+        finally:
+            n.sock.close()
+
+    def test_hysteresis_between_thresholds(self):
+        # A peer whose phi sits between readmit and quarantine thresholds
+        # neither flaps in nor out.
+        n, conn, reg = self._node(quarantine_threshold=8.0,
+                                  readmit_threshold=2.0)
+        try:
+            n.check_quarantine(now=40.0)
+            assert n.is_quarantined("p")
+            # One heartbeat resumes, then a probe instant where phi sits
+            # BETWEEN the thresholds (above readmit, below quarantine):
+            # the peer stays put.
+            n._record_heartbeat("p", now=41.0)
+            gap = next(dt / 4.0 for dt in range(1, 200)
+                       if 2.0 < n.phi("p", now=41.0 + dt / 4.0) < 8.0)
+            n.check_quarantine(now=41.0 + gap)
+            assert n.is_quarantined("p")
+            assert reg.value("p2p_quarantine_transitions_total",
+                             node="me", transition="readmit") == 0
+        finally:
+            n.sock.close()
+
+    def test_evict_after_deadline(self):
+        n, conn, reg = self._node(quarantine_threshold=8.0, evict_after=5.0)
+        try:
+            n.check_quarantine(now=40.0)
+            assert n.is_quarantined("p")
+            n.check_quarantine(now=44.0)  # within the grace window
+            assert not conn.stopped
+            n.check_quarantine(now=46.0)  # past it: graceful eviction
+            assert conn.stopped
+            assert reg.value("p2p_quarantine_transitions_total",
+                             node="me", transition="evict") == 1
+        finally:
+            n.sock.close()
+
+    def test_disconnect_clears_quarantine(self):
+        n, conn, reg = self._node(quarantine_threshold=8.0)
+        try:
+            n.check_quarantine(now=40.0)
+            assert n.is_quarantined("p")
+            n.node_disconnected(conn)
+            assert not n.is_quarantined("p")
+            assert reg.value("p2p_quarantined_peers", node="me") == 0
+        finally:
+            n.sock.close()
