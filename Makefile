@@ -7,7 +7,7 @@
 PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test examples bench dryrun telemetry-check chaos-check
+.PHONY: test examples bench dryrun telemetry-check chaos-check perf-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -27,6 +27,16 @@ telemetry-check:
 chaos-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_chaos.py tests/test_phi.py -q
 	$(TEST_ENV) $(PY) examples/chaos_demo.py
+
+# Frontier fast path + bit-packed state: the full equivalence sweep
+# (frontier ≡ dense, bitset ≡ bool, donation, slow-marked edge-gather
+# bench included) plus a small-n smoke of the bench 1M stage on the CPU
+# backend — proves the frontier method column and its occupancy
+# attribution end to end (tox env "perf").
+perf-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_frontier.py -q
+	$(TEST_ENV) BENCH_N_1M=4000 BENCH_CACHE=0 BENCH_TELEMETRY_DIR=/tmp \
+		$(PY) bench.py --stage 1m
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

@@ -56,7 +56,8 @@ import jax
 import jax.numpy as jnp
 
 from p2pnetwork_tpu.models import base
-from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.ops import bitset, segment
+from p2pnetwork_tpu.ops import frontier as frontier_ops
 from p2pnetwork_tpu.sim.graph import Graph
 
 
@@ -70,6 +71,20 @@ class AdaptiveFloodState:
     fcount: jax.Array  # i32[] — frontier out-edge mass in W-slice work items
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AdaptiveFloodBitState:
+    """AdaptiveFloodState with the bool predicates bit-packed
+    (ops/bitset.py): the while-loop carry holds 32x less seen/frontier
+    state in HBM; the wave rounds unpack transiently."""
+
+    seen: jax.Array  # u32[N_pad // 32]
+    frontier: jax.Array  # u32[N_pad // 32]
+    fidx: jax.Array  # i32[k]
+    fslice: jax.Array  # i32[k]
+    fcount: jax.Array  # i32[]
+
+
 @dataclasses.dataclass(frozen=True, unsafe_hash=True)
 class AdaptiveFlood:
     """Single-source flood with frontier-sparse small rounds.
@@ -77,33 +92,54 @@ class AdaptiveFlood:
     ``k`` is the sparse-mode capacity in work items (a compile-time
     shape); ``method`` picks the dense round's aggregation lowering;
     ``slice_width`` is the per-item row-slice width W (0 = auto:
-    ``min(max_out_span, 128)``)."""
+    ``min(max_out_span, 128)``); ``bitset=True`` packs the carried
+    seen/frontier predicates into uint32 words — bit-identical results
+    (tests/test_frontier.py pins the parity)."""
 
     source: int = 0
     method: str = "auto"
     k: int = 1024
     slice_width: int = 0
+    bitset: bool = False
 
-    def init(self, graph: Graph, key: jax.Array) -> AdaptiveFloodState:
+    def init(self, graph: Graph, key: jax.Array):
         seed, fidx, fslice, count = _wave_seed(
             graph, self.source, self.k, self.slice_width, "AdaptiveFlood")
+        if self.bitset:
+            packed = bitset.pack_bits(seed)
+            return AdaptiveFloodBitState(seen=packed, frontier=packed,
+                                         fidx=fidx, fslice=fslice,
+                                         fcount=count)
         return AdaptiveFloodState(seen=seed, frontier=seed, fidx=fidx,
                                   fslice=fslice, fcount=count)
 
-    def coverage(self, graph: Graph, state: AdaptiveFloodState) -> jax.Array:
+    def coverage(self, graph: Graph, state) -> jax.Array:
         """Live-node coverage (Flood.coverage parity)."""
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        if isinstance(state, AdaptiveFloodBitState):
+            node_bits = bitset.pack_bits(graph.node_mask)
+            return bitset.popcount(state.seen & node_bits) / n_real
         return jnp.sum(state.seen & graph.node_mask) / n_real
 
-    def step(self, graph: Graph, state: AdaptiveFloodState, key: jax.Array):
+    def step(self, graph: Graph, state, key: jax.Array):
+        packed = isinstance(state, AdaptiveFloodBitState)
+        n_pad = graph.n_nodes_padded
+        seen0 = bitset.unpack_bits(state.seen, n_pad) if packed else state.seen
+        frontier0 = (bitset.unpack_bits(state.frontier, n_pad)
+                     if packed else state.frontier)
         seen, frontier, fidx, fslice, fcount, ncount, msgs = _wave_step(
             graph, self.k, self.slice_width, self.method,
-            state.seen, state.frontier, state.fidx, state.fslice,
-            state.fcount,
+            seen0, frontier0, state.fidx, state.fslice, state.fcount,
         )
-        new_state = AdaptiveFloodState(seen=seen, frontier=frontier,
-                                       fidx=fidx, fslice=fslice,
-                                       fcount=fcount)
+        if packed:
+            new_state = AdaptiveFloodBitState(
+                seen=bitset.pack_bits(seen),
+                frontier=bitset.pack_bits(frontier),
+                fidx=fidx, fslice=fslice, fcount=fcount)
+        else:
+            new_state = AdaptiveFloodState(seen=seen, frontier=frontier,
+                                           fidx=fidx, fslice=fslice,
+                                           fcount=fcount)
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
         stats = {
             "messages": msgs,
@@ -112,6 +148,10 @@ class AdaptiveFlood:
             # node failures (models/flood.py parity).
             "coverage": jnp.sum(seen & graph.node_mask) / n_real,
             "frontier": ncount,
+            # ops/frontier.py's canonical definition; the new frontier
+            # holds exactly the ncount winner nodes (live by
+            # construction), so the ints — and the f32 division — match.
+            "frontier_occupancy": frontier_ops.occupancy(graph, frontier),
         }
         return new_state, stats
 
@@ -364,6 +404,7 @@ class AdaptiveHopDistance:
             "messages": msgs,
             "coverage": jnp.sum(reached) / n_real,
             "frontier": ncount,
+            "frontier_occupancy": frontier_ops.occupancy(graph, frontier),
             "max_dist": jnp.max(dist),
         }
         return AdaptiveHopDistanceState(dist=dist, frontier=frontier,

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from p2pnetwork_tpu.models import base
-from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.ops import bitset, frontier, segment
 from p2pnetwork_tpu.sim.graph import Graph
 
 
@@ -30,20 +30,47 @@ class FloodState:
     frontier: jax.Array  # bool[N_pad] — nodes that first saw it last round
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FloodBitState:
+    """FloodState bit-packed: 32 nodes per uint32 word (ops/bitset.py) —
+    the scan/while loop carries 32x less predicate state in HBM."""
+
+    seen: jax.Array  # u32[N_pad // 32]
+    frontier: jax.Array  # u32[N_pad // 32]
+
+
 @dataclasses.dataclass(frozen=True, unsafe_hash=True)
 class Flood:
-    """Single-source flood. ``source`` is the seed node index."""
+    """Single-source flood. ``source`` is the seed node index.
+
+    ``bitset=True`` carries the seen/frontier predicates bit-packed
+    (:class:`FloodBitState`); the round's set algebra (dedup, union,
+    coverage count) then runs word-level (AND-NOT / OR / popcount), and
+    only the propagate's input unpacks transiently. Results are
+    bit-identical to the bool-state path — same seen sets, same stats
+    (tests/test_frontier.py pins this).
+
+    ``frontier_crossover`` overrides ``method="frontier"``'s sparse
+    budget (float = fraction of padded nodes, int = node budget; None =
+    the auto constant) — apply a value re-fit from bench.py's
+    per-round occupancy attribution here."""
 
     source: int = 0
     method: str = "auto"  # aggregation lowering, see ops/segment.py
+    bitset: bool = False  # pack carried state into uint32 words
+    frontier_crossover: object = None  # ops/frontier.py budget override
 
-    def init(self, graph: Graph, key: jax.Array) -> FloodState:
+    def init(self, graph: Graph, key: jax.Array):
         base.validate_source(graph, self.source)
         seed = jnp.zeros(graph.n_nodes_padded, dtype=bool).at[self.source].set(True)
         seed = seed & graph.node_mask
+        if self.bitset:
+            packed = bitset.pack_bits(seed)
+            return FloodBitState(seen=packed, frontier=packed)
         return FloodState(seen=seed, frontier=seed)
 
-    def coverage(self, graph: Graph, state: FloodState) -> jax.Array:
+    def coverage(self, graph: Graph, state) -> jax.Array:
         """Fraction of live nodes holding the message (resume seeding for
         engine.run_until_coverage_from).
 
@@ -51,12 +78,19 @@ class Flood:
         (sim/failures.py) ``seen`` can hold dead nodes, and counting them
         would report coverage > 1 and spuriously stop run-to-coverage."""
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        if isinstance(state, FloodBitState):
+            node_bits = bitset.pack_bits(graph.node_mask)
+            return bitset.popcount(state.seen & node_bits) / n_real
         return jnp.sum(state.seen & graph.node_mask) / n_real
 
-    def step(self, graph: Graph, state: FloodState, key: jax.Array):
+    def step(self, graph: Graph, state, key: jax.Array):
         """One synchronous round: frontier nodes broadcast; receivers that
         had not seen the message join the next frontier."""
-        delivered = segment.propagate_or(graph, state.frontier, self.method)
+        if isinstance(state, FloodBitState):
+            return self._step_bits(graph, state)
+        delivered = segment.propagate_or(
+            graph, state.frontier, self.method,
+            frontier_crossover=self.frontier_crossover)
         new = delivered & ~state.seen & graph.node_mask
         seen = state.seen | new
         n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
@@ -66,5 +100,31 @@ class Flood:
             # not push coverage past 1.
             "coverage": jnp.sum(seen & graph.node_mask) / n_real,
             "frontier": jnp.sum(new),
+            # The canonical definition (ops/frontier.py) — the same ints
+            # the crossover budget is measured against.
+            "frontier_occupancy": frontier.occupancy(graph, new),
         }
         return FloodState(seen=seen, frontier=new), stats
+
+    def _step_bits(self, graph: Graph, state: FloodBitState):
+        """The packed round: identical per-node logic, word-level algebra.
+        ``new = delivered & ~seen & alive`` and the coverage/frontier
+        counts are AND-NOT/OR/popcount over uint32 words; pack/unpack are
+        exact, so every count and every bit matches the bool path."""
+        n_pad = graph.n_nodes_padded
+        frontier = bitset.unpack_bits(state.frontier, n_pad)
+        delivered = segment.propagate_or(
+            graph, frontier, self.method,
+            frontier_crossover=self.frontier_crossover)
+        node_bits = bitset.pack_bits(graph.node_mask)
+        new = bitset.pack_bits(delivered) & ~state.seen & node_bits
+        seen = state.seen | new
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        n_new = bitset.popcount(new)
+        stats = {
+            "messages": segment.frontier_messages(graph, frontier),
+            "coverage": bitset.popcount(seen & node_bits) / n_real,
+            "frontier": n_new,
+            "frontier_occupancy": n_new / n_real,
+        }
+        return FloodBitState(seen=seen, frontier=new), stats

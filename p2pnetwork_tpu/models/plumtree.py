@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import bitset
 from p2pnetwork_tpu.sim.graph import Graph
 
 
@@ -75,13 +76,29 @@ class PlumtreeState:
     round: jax.Array  # i32[] — broadcasts completed
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PlumtreeBitState:
+    """PlumtreeState with the per-EDGE eager flags bit-packed
+    (ops/bitset.py): the carried eager set shrinks 32x — at 1M nodes /
+    ~10M directed edges that is ~10 MB -> ~0.3 MB of per-broadcast carry.
+    The broadcast loop unpacks transiently; results are bit-identical."""
+
+    eager: jax.Array  # u32[E_pad // 32]
+    round: jax.Array  # i32[]
+
+
 @dataclasses.dataclass(frozen=True, unsafe_hash=True)
 class Plumtree:
-    """Self-optimizing broadcast: flood once, then tree + lazy repair."""
+    """Self-optimizing broadcast: flood once, then tree + lazy repair.
+
+    ``bitset=True`` carries the eager edge set bit-packed
+    (:class:`PlumtreeBitState`) — same pruned trees, same stats."""
 
     source: int = 0
+    bitset: bool = False
 
-    def init(self, graph: Graph, key: jax.Array) -> PlumtreeState:
+    def init(self, graph: Graph, key: jax.Array):
         base.validate_source(graph, self.source)
         if graph.dyn_senders is not None:
             # The eager flags live on the STATIC edge slots; a runtime
@@ -93,8 +110,18 @@ class Plumtree:
             raise ValueError(
                 "Plumtree does not track the dynamic edge region; "
                 "consolidate the graph first")
-        return PlumtreeState(eager=jnp.ones(graph.n_edges_padded, dtype=bool),
-                             round=jnp.int32(0))
+        eager = jnp.ones(graph.n_edges_padded, dtype=bool)
+        if self.bitset:
+            return PlumtreeBitState(eager=bitset.pack_bits(eager),
+                                    round=jnp.int32(0))
+        return PlumtreeState(eager=eager, round=jnp.int32(0))
+
+    @staticmethod
+    def _eager_bool(graph: Graph, state) -> jax.Array:
+        """The eager set as bool[E_pad], whichever state carries it."""
+        if isinstance(state, PlumtreeBitState):
+            return bitset.unpack_bits(state.eager, graph.n_edges_padded)
+        return state.eager
 
     def tree_graph(self, graph: Graph, state: PlumtreeState,
                    **from_edges_kwargs) -> Graph:
@@ -125,7 +152,7 @@ class Plumtree:
             raise ValueError(
                 "Plumtree does not track the dynamic edge region; "
                 "consolidate the graph first")
-        em = _eager_mask(graph, state.eager)
+        em = _eager_mask(graph, self._eager_bool(graph, state))
         count = int(jnp.sum(em))
         idx = jnp.nonzero(em, size=max(count, 1), fill_value=0)[0]
         picked = np.asarray(_compact_edges(graph, idx))[:, :count]
@@ -153,7 +180,8 @@ class Plumtree:
         return dataclasses.replace(g,
                                    node_mask=graph.node_mask & g.node_mask)
 
-    def step(self, graph: Graph, state: PlumtreeState, key: jax.Array):
+    def step(self, graph: Graph, state, key: jax.Array):
+        eager0 = self._eager_bool(graph, state)
         n_pad = graph.n_nodes_padded
         e_pad = graph.n_edges_padded
         s, r = graph.senders, graph.receivers
@@ -224,7 +252,7 @@ class Plumtree:
                     grafts + jnp.where(do_graft, n_graft, 0), stop)
 
         dist, _, eager, _, grafts, _ = jax.lax.while_loop(
-            cond, body, (dist0, seed, state.eager, jnp.int32(0),
+            cond, body, (dist0, seed, eager0, jnp.int32(0),
                          jnp.int32(0), jnp.array(False)))
 
         reached = dist >= 0
@@ -258,7 +286,11 @@ class Plumtree:
         eager = jnp.where(into_reached, is_parent, eager)
 
         n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
-        new_state = PlumtreeState(eager=eager, round=state.round + 1)
+        if isinstance(state, PlumtreeBitState):
+            new_state = PlumtreeBitState(eager=bitset.pack_bits(eager),
+                                         round=state.round + 1)
+        else:
+            new_state = PlumtreeState(eager=eager, round=state.round + 1)
         stats = {
             "messages": messages,
             "ihave": ihave,

@@ -1,11 +1,13 @@
 """Aggregation primitives: segment/gather (segment.py), one-hot-matmul
-blocked (blocked.py), and the fused Pallas TPU kernel (pallas_edge.py)."""
+blocked (blocked.py), the fused Pallas TPU kernel (pallas_edge.py), the
+frontier-compacted sparse fast path (frontier.py), and bit-packed node
+predicates (bitset.py)."""
 
-from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.ops import bitset, frontier, segment
 from p2pnetwork_tpu.ops.segment import (frontier_messages, propagate_max,
                                         propagate_min_plus, propagate_or,
                                         propagate_sum)
 
-__all__ = ["segment", "propagate_max", "propagate_min_plus",
-           "propagate_or", "propagate_sum",
+__all__ = ["segment", "bitset", "frontier", "propagate_max",
+           "propagate_min_plus", "propagate_or", "propagate_sum",
            "frontier_messages"]

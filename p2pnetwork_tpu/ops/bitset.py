@@ -1,0 +1,83 @@
+"""Bit-packed node predicates: 32 bool lanes per uint32 word.
+
+The flood-family protocols carry two bool[N_pad] predicates (``seen``,
+``frontier``) through every ``lax.scan`` / ``lax.while_loop`` iteration.
+XLA materializes a bool as one byte, so at 10M padded nodes each predicate
+is ~10 MB of carry state double-buffered per round. Packed as uint32 words
+the same predicate is 32x smaller, set algebra becomes word-level bitwise
+ops (OR = union, AND-NOT = difference), and coverage counting becomes
+``lax.population_count`` + a word-sum — the packed-bitset state the sparse
+GNN-on-dense-hardware literature rides (PAPERS.md: *Fast Training of
+Sparse Graph Neural Networks on Dense Hardware*).
+
+Padding convention: node counts are padded to a multiple of 128
+(sim/graph.py ``node_pad_multiple``), which divides 32 exactly, so a
+``bool[N_pad]`` packs into ``N_pad // 32`` words with no ragged tail. Bit
+``i`` of word ``w`` is node ``32*w + i`` (LSB-first). All functions are
+jittable and shape-static.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+WORD = 32  #: bits per packed word (uint32 lanes)
+
+
+def n_words(n_bits: int) -> int:
+    """Words needed to hold ``n_bits`` predicates."""
+    return (n_bits + WORD - 1) // WORD
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """``bool[n] -> u32[ceil(n/32)]`` (LSB-first within each word).
+
+    A ragged tail (``n`` not a multiple of 32) zero-pads — harmless for
+    the set algebra since the pad bits never get set.
+    """
+    n = bits.shape[0]
+    pad = -n % WORD
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros(pad, dtype=bool)])
+    lanes = bits.reshape(-1, WORD).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32))[None, :]
+    return jnp.sum(lanes * weights, axis=1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jax.Array, n_bits: int) -> jax.Array:
+    """``u32[W] -> bool[n_bits]`` — inverse of :func:`pack_bits`."""
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)[None, :]
+    lanes = (words[:, None] >> shifts) & jnp.uint32(1)
+    return lanes.reshape(-1)[:n_bits].astype(bool)
+
+
+def popcount(words: jax.Array) -> jax.Array:
+    """Total set bits across the whole bitset, as i32 — the word-level
+    coverage numerator (``popcount(seen & node_bits)``)."""
+    return jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
+
+
+def test_bits(words: jax.Array, idx: jax.Array) -> jax.Array:
+    """Membership gather: ``bool`` of bit ``idx[i]`` for each index —
+    reads a packed predicate (e.g. ``seen[cand]``) without unpacking.
+    Indices must be in range (callers clamp/mask like any other gather).
+    """
+    w = (idx >> 5).astype(jnp.int32)
+    b = (idx & 31).astype(jnp.uint32)
+    return ((words[w] >> b) & jnp.uint32(1)).astype(bool)
+
+
+def set_bits(words: jax.Array, idx: jax.Array, valid: jax.Array) -> jax.Array:
+    """Scatter-OR: the bitset with bit ``idx[i]`` set wherever ``valid[i]``.
+
+    Duplicate indices are fine (OR is idempotent). Routed through a
+    transient bool scatter + repack rather than a word-level scatter:
+    ``.at[].set/max`` cannot OR two different bits landing in one word,
+    and the transient costs O(N) bytes once per call, not per carry.
+    """
+    n = words.shape[0] * WORD
+    hit = jnp.zeros(n, dtype=bool).at[
+        jnp.where(valid, idx, n)
+    ].set(True, mode="drop")
+    return words | pack_bits(hit)

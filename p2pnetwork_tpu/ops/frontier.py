@@ -1,0 +1,200 @@
+"""Frontier-compacted propagation: gather only the active rows.
+
+The dense lowerings (ops/segment.py) price a propagation round by the
+GRAPH — every padded edge slot rides the ~8 cycles/element gather floor
+(BENCH.md) whether one node is active or half the population is. But a
+flood's life is asymmetric: the first and last rounds move a sliver of
+the graph. This module prices the round by the FRONTIER instead: inside
+jit, ``nonzero``-compact the active nodes into a bounded ``k``-slot
+buffer, gather exactly their out-edge rows through the source-CSR view
+(``Graph.src_eid``/``src_offsets``), and scatter the contributions into
+the receiver vector — ``k * max_out_span`` touched slots, independent of
+``E_pad``. A ``lax.cond`` falls back to the dense path the moment the
+active count exceeds the buffer, so the compiled program carries both
+rounds and the round's cost tracks its frontier. This is the
+frontier/activity compaction the GNN-acceleration literature rides on
+dense hardware (PAPERS.md: *Fast Training of Sparse Graph Neural
+Networks on Dense Hardware*; *A Survey on GNN Acceleration*).
+
+Crossover: the sparse round touches ``k * max_out_span`` gathered slots
+plus a same-sized scatter; the dense round touches ``E_pad`` slots. The
+auto budget therefore sizes ``k`` so the sparse slot count stays under
+``E_pad / CROSSOVER_SLOT_FACTOR`` — the factor 2 default covers the
+scatter's second pass over the gathered slots (scatter ~ gather on the
+TPU's flat per-element floor, BENCH.md "segment buckets"). It is a
+measured starting point, not a guess-forever: bench.py attributes
+per-round frontier occupancy into BENCH_TELEMETRY.json so the constant
+can be re-fit from real runs; override per call via ``crossover=`` (an
+int node budget, or a float fraction of padded nodes).
+
+Results are BIT-exact vs the dense paths: OR/max/min are associative and
+commutative in f32/int, and every per-edge contribution
+(``signal[sender]``, ``dist[sender] + weight``) is computed from the
+same operands with the same op as the dense lowering — only the
+iteration order differs, which these reductions cannot observe
+(tests/test_frontier.py sweeps the equivalence).
+
+Dynamic (runtime-connected) edges never reach this module: the
+``propagate_*`` entry points fold the dynamic COO region in before
+method dispatch, exactly as for every other lowering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.sim.graph import Graph
+
+#: Sparse slots (budget * max_out_span) stay under E_pad / this factor.
+#: 2.0 = "sparse must beat dense even if its per-slot cost doubles"
+#: (gather + scatter passes vs the dense path's single gather).
+CROSSOVER_SLOT_FACTOR = 2.0
+
+#: Floor for the compaction buffer — one lane-friendly tile; below this
+#: the buffer bookkeeping costs more than the slots it saves.
+_MIN_BUDGET = 128
+
+
+def require_csr(graph: Graph) -> None:
+    if graph.src_eid is None:
+        raise ValueError(
+            "method='frontier' requires the source-CSR out-edge view — "
+            "build with from_edges(source_csr=True) or "
+            "graph.with_source_csr()"
+        )
+
+
+def budget(graph: Graph, crossover=None) -> int:
+    """STATIC node budget ``k`` of the compaction buffer (trace-time int).
+
+    ``crossover=None`` auto-sizes from the slot arithmetic above;
+    a float in (0, 1] is a fraction of padded nodes; an int is the node
+    budget itself. Auto returns **0 — sparse disabled —** when even the
+    ``_MIN_BUDGET`` floor would break the slot bound (a hub graph whose
+    ``max_out_span`` row spans much of ``E_pad``: the sparse gather is
+    always ``k * span`` slots whatever the frontier, so there it can
+    only LOSE to dense). Otherwise the result is clamped to
+    ``[_MIN_BUDGET, n_nodes_padded]`` — a budget covering every node
+    simply makes the sparse path unconditional. Explicit overrides are
+    honored as given (clamped to ``n_pad``).
+    """
+    n_pad = graph.n_nodes_padded
+    span = max(graph.max_out_span, 1)
+    if crossover is None:
+        k = graph.n_edges_padded // max(int(CROSSOVER_SLOT_FACTOR * span), 1)
+        if k < _MIN_BUDGET:
+            return 0
+    elif isinstance(crossover, float):
+        if not 0.0 < crossover <= 1.0:
+            raise ValueError(f"crossover fraction must be in (0, 1], got "
+                             f"{crossover}")
+        k = int(crossover * n_pad)
+    else:
+        k = int(crossover)
+    return max(_MIN_BUDGET, min(k, n_pad))
+
+
+def occupancy(graph: Graph, frontier: jax.Array) -> jax.Array:
+    """Active fraction of live nodes — the device-side stat the sparse/
+    dense crossover is measured by (f32 scalar)."""
+    n = jnp.maximum(jnp.sum(graph.node_mask), 1)
+    live = jnp.sum((frontier & graph.node_mask).astype(jnp.int32))
+    return (live / n).astype(jnp.float32)
+
+
+def _gather_active(graph: Graph, active: jax.Array, n_active: jax.Array,
+                   k: int):
+    """Compact the active nodes and gather their full out-edge rows.
+
+    Returns ``(f, eid, evalid)``: the ``k`` compacted node ids, their
+    ``[k, max_out_span]`` edge ids, and the liveness mask (in-row AND
+    slot-valid AND runtime ``edge_mask`` — failed edges stay in the
+    build-time CSR rows and are masked here, the adaptive-flood rule).
+    Only correct when ``n_active <= k`` — the callers' ``lax.cond``
+    guarantees it (``nonzero`` truncates past ``k``).
+    """
+    n_pad = graph.n_nodes_padded
+    idx = jnp.nonzero(active, size=k, fill_value=n_pad - 1)[0].astype(
+        jnp.int32)
+    valid = jnp.arange(k) < n_active
+    # fill_value rows can be REAL (node n_pad-1 exists when n_nodes is an
+    # exact pad multiple); `valid` masks them out of every contribution.
+    f = jnp.where(valid, idx, n_pad - 1)
+    w = max(graph.max_out_span, 1)
+    eid, in_row = graph.gather_row_slots(
+        graph.src_offsets[f], graph.src_offsets[f + 1], w)
+    evalid = in_row & valid[:, None] & graph.edge_mask[eid]
+    return f, eid, evalid
+
+
+def propagate_or_frontier(graph: Graph, signal: jax.Array, dense_fn,
+                          crossover=None) -> jax.Array:
+    """Frontier-compacted neighbor-OR; ``dense_fn(signal)`` is the dense
+    fallback taken when the active count exceeds the budget."""
+    require_csr(graph)
+    k = budget(graph, crossover)
+    if k == 0:  # sparse can't win on this graph (see budget) — trace-time
+        return dense_fn(signal)
+    n_active = jnp.sum(signal.astype(jnp.int32))
+
+    def sparse(sig):
+        n_pad = graph.n_nodes_padded
+        _, eid, evalid = _gather_active(graph, sig, n_active, k)
+        cand = jnp.where(evalid, graph.receivers[eid], n_pad).reshape(-1)
+        out = jnp.zeros(n_pad, dtype=bool).at[cand].set(True, mode="drop")
+        return out & graph.node_mask
+
+    return jax.lax.cond(n_active <= k, sparse, dense_fn, signal)
+
+
+def propagate_max_frontier(graph: Graph, signal: jax.Array,
+                           neutral: jax.Array, dense_fn,
+                           crossover=None) -> jax.Array:
+    """Frontier-compacted neighbor-max. Active = holding a non-neutral
+    value (``!=`` keeps NaN signals active, matching dense NaN
+    propagation); neutral senders contribute the identity either way."""
+    require_csr(graph)
+    k = budget(graph, crossover)
+    if k == 0:  # sparse can't win on this graph (see budget) — trace-time
+        return dense_fn(signal)
+    active = signal != neutral
+    n_active = jnp.sum(active.astype(jnp.int32))
+
+    def sparse(sig):
+        n_pad = graph.n_nodes_padded
+        f, eid, evalid = _gather_active(graph, sig != neutral, n_active, k)
+        vals = jnp.where(evalid, sig[f][:, None], neutral).reshape(-1)
+        cand = jnp.where(evalid, graph.receivers[eid], n_pad).reshape(-1)
+        agg = jnp.full(n_pad, neutral, dtype=sig.dtype).at[cand].max(
+            vals, mode="drop")
+        return jnp.where(graph.node_mask, agg, neutral)
+
+    return jax.lax.cond(n_active <= k, sparse, dense_fn, signal)
+
+
+def propagate_min_plus_frontier(graph: Graph, dist: jax.Array, dense_fn,
+                                crossover=None) -> jax.Array:
+    """Frontier-compacted min-plus relaxation (one Bellman-Ford round).
+    Active = finite-or-NaN distance; +inf senders contribute +inf to
+    every receiver in the dense path too, so skipping them is exact.
+    Weights ride the per-edge channel gathered at the same edge ids the
+    dense path reads, so each contribution is the identical f32 add."""
+    require_csr(graph)
+    k = budget(graph, crossover)
+    if k == 0:  # sparse can't win on this graph (see budget) — trace-time
+        return dense_fn(dist)
+    active = dist != jnp.inf
+    n_active = jnp.sum(active.astype(jnp.int32))
+
+    def sparse(d):
+        n_pad = graph.n_nodes_padded
+        f, eid, evalid = _gather_active(graph, d != jnp.inf, n_active, k)
+        w_e = 1.0 if graph.edge_weight is None else graph.edge_weight[eid]
+        vals = jnp.where(evalid, d[f][:, None] + w_e, jnp.inf).reshape(-1)
+        cand = jnp.where(evalid, graph.receivers[eid], n_pad).reshape(-1)
+        agg = jnp.full(n_pad, jnp.inf, dtype=d.dtype).at[cand].min(
+            vals, mode="drop")
+        return jnp.where(graph.node_mask, agg, jnp.inf)
+
+    return jax.lax.cond(n_active <= k, sparse, dense_fn, dist)

@@ -20,6 +20,13 @@ Two lowerings, chosen by what the graph carries:
   masked reduce along the degree axis. Dense, regular memory traffic that
   maps well onto TPU vector units for quasi-regular graphs; this shape is
   also what the Pallas kernel implements (ops/pallas_edge.py).
+
+A third family prices the round by the FRONTIER instead of the graph:
+``method="frontier"`` (ops/frontier.py) compacts the active nodes inside
+jit and gathers only their out-edge rows through the source-CSR view,
+falling back to the dense path via ``lax.cond`` when the active count
+exceeds the crossover budget — the fast path for the sparse first/last
+rounds of a flood. Requires ``from_edges(source_csr=True)``.
 """
 
 from __future__ import annotations
@@ -99,7 +106,8 @@ def _dynamic_sum(graph: Graph, signal: jax.Array) -> jax.Array:
     return agg * graph.node_mask.astype(signal.dtype)
 
 
-def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.Array:
+def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto", *,
+                 frontier_crossover=None) -> jax.Array:
     """Per-node OR over incoming neighbors: ``out[v] = any(signal[u], u->v)``.
 
     ``signal`` is bool[N_pad]; masked (padding) edges and nodes contribute
@@ -107,12 +115,23 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto") -> jax.A
     (gather when the graph carries a complete neighbor table whose
     padding waste stays under ``_GATHER_WASTE_BOUND`` — degree-skewed
     tables route to segment). Dynamic edges (sim/topology.py) are folded
-    in for every method.
+    in for every method. ``frontier_crossover`` overrides the
+    ``method="frontier"`` sparse budget (ops/frontier.py ``budget``:
+    float = fraction of padded nodes, int = node budget) — the supported
+    "apply" step for a crossover re-fit from measured occupancy.
     """
     if graph.dyn_senders is not None:
         static = dataclasses.replace(graph, dyn_senders=None,
                                      dyn_receivers=None, dyn_mask=None)
-        return propagate_or(static, signal, method) | _dynamic_or(graph, signal)
+        return (propagate_or(static, signal, method,
+                             frontier_crossover=frontier_crossover)
+                | _dynamic_or(graph, signal))
+    if method == "frontier":
+        from p2pnetwork_tpu.ops import frontier as FR
+
+        return FR.propagate_or_frontier(
+            graph, signal, lambda sig: propagate_or(graph, sig, "auto"),
+            crossover=frontier_crossover)
     if method == "auto":
         method = _auto_method(graph)
     if method == "gather":
@@ -229,7 +248,8 @@ def _dynamic_max(graph: Graph, signal: jax.Array) -> jax.Array:
 
 
 def propagate_max(graph: Graph, signal: jax.Array,
-                  method: str = "auto") -> jax.Array:
+                  method: str = "auto", *,
+                  frontier_crossover=None) -> jax.Array:
     """Per-node max over incoming neighbors: ``out[v] = max(signal[u], u->v)``.
 
     Nodes with no (live) incoming edges get the dtype's max-identity
@@ -245,8 +265,17 @@ def propagate_max(graph: Graph, signal: jax.Array,
     if graph.dyn_senders is not None:
         static = dataclasses.replace(graph, dyn_senders=None,
                                      dyn_receivers=None, dyn_mask=None)
-        return jnp.maximum(propagate_max(static, signal, method),
-                           _dynamic_max(graph, signal))
+        return jnp.maximum(
+            propagate_max(static, signal, method,
+                          frontier_crossover=frontier_crossover),
+            _dynamic_max(graph, signal))
+    if method == "frontier":
+        from p2pnetwork_tpu.ops import frontier as FR
+
+        return FR.propagate_max_frontier(
+            graph, signal, neutral,
+            lambda sig: propagate_max(graph, sig, "auto"),
+            crossover=frontier_crossover)
     if method == "auto":
         method = _auto_method(graph)
     if method == "gather":
@@ -269,9 +298,9 @@ def propagate_max(graph: Graph, signal: jax.Array,
         )
     else:
         raise ValueError(
-            f"propagate_max supports method 'segment', 'gather' or 'skew', "
-            f"got {method!r} (max does not ride the one-hot-matmul "
-            f"lowerings)"
+            f"propagate_max supports method 'segment', 'gather', 'skew' or "
+            f"'frontier', got {method!r} (max does not ride the "
+            f"one-hot-matmul lowerings)"
         )
     return jnp.where(graph.node_mask, agg, neutral)
 
@@ -294,7 +323,8 @@ def _dynamic_min_plus(graph: Graph, dist: jax.Array) -> jax.Array:
 
 
 def propagate_min_plus(graph: Graph, dist: jax.Array,
-                       method: str = "auto") -> jax.Array:
+                       method: str = "auto", *,
+                       frontier_crossover=None) -> jax.Array:
     """Per-node min-plus relaxation: ``out[v] = min(dist[u] + w(u, v))``
     over live incoming edges — one Bellman-Ford round over the whole
     population, the tropical-semiring sibling of :func:`propagate_max`.
@@ -311,8 +341,16 @@ def propagate_min_plus(graph: Graph, dist: jax.Array,
     if graph.dyn_senders is not None:
         static = dataclasses.replace(graph, dyn_senders=None,
                                      dyn_receivers=None, dyn_mask=None)
-        return jnp.minimum(propagate_min_plus(static, dist, method),
-                           _dynamic_min_plus(graph, dist))
+        return jnp.minimum(
+            propagate_min_plus(static, dist, method,
+                               frontier_crossover=frontier_crossover),
+            _dynamic_min_plus(graph, dist))
+    if method == "frontier":
+        from p2pnetwork_tpu.ops import frontier as FR
+
+        return FR.propagate_min_plus_frontier(
+            graph, dist, lambda d: propagate_min_plus(graph, d, "auto"),
+            crossover=frontier_crossover)
     weighted = graph.edge_weight is not None
     if method == "auto":
         method = _auto_method(graph)
@@ -356,9 +394,9 @@ def propagate_min_plus(graph: Graph, dist: jax.Array,
         )
     else:
         raise ValueError(
-            f"propagate_min_plus supports method 'segment', 'gather' or "
-            f"'skew', got {method!r} (min does not ride the one-hot-matmul "
-            f"lowerings)"
+            f"propagate_min_plus supports method 'segment', 'gather', "
+            f"'skew' or 'frontier', got {method!r} (min does not ride the "
+            f"one-hot-matmul lowerings)"
         )
     return jnp.where(graph.node_mask, agg, jnp.inf)
 

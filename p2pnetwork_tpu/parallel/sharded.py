@@ -49,6 +49,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exposes shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # this image's jax 0.4.x: experimental namespace,
+    # where the replication-check kwarg is still named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_exp(f, **kw)
+
 from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
 from p2pnetwork_tpu.sim.graph import Graph, _round_up
 from p2pnetwork_tpu.utils import accum
@@ -559,7 +570,7 @@ def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int, pieces=(),
     body = functools.partial(_remask_body, axis_name, S, block, pieces,
                              mxu_block)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 14,
         out_specs=(spec,) * 8,
@@ -672,7 +683,7 @@ def _member_body(axis_name, S,
 def _member_fn(mesh: Mesh, axis_name: str, S: int):
     body = functools.partial(_member_body, axis_name, S)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 6 + (P(),) * 4,
         out_specs=P(),
@@ -704,7 +715,7 @@ def _scatter_body(axis_name, S, block,
 def _scatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     body = functools.partial(_scatter_body, axis_name, S, block)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 5 + (P(),) * 5,
         out_specs=(spec,) * 5,
@@ -838,7 +849,7 @@ def _unscatter_body(axis_name, S, block,
 def _unscatter_fn(mesh: Mesh, axis_name: str, S: int, block: int):
     body = functools.partial(_unscatter_body, axis_name, S, block)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 5 + (P(),) * 4,
         out_specs=(spec,) * 3,
@@ -1184,7 +1195,7 @@ def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
     # check_vma=False: the body may invoke the Pallas bucket kernel, whose
     # vma-typed lowering trips a cache bug in current JAX (see
     # ops/pallas_edge.py); scoped to the ring-body programs only.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh, check_vma=False,
         in_specs=(spec,) * 14,
@@ -1298,7 +1309,7 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factory.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 14,
@@ -1418,9 +1429,11 @@ def _ring_rounds_gossip(axis_name, S, block, rng,
         # pcast: a fresh constant is shard-invariant by type; the ring
         # fold adds shard-varying blocks into it, so the accumulator must
         # be marked varying up front (scan carries demand matching vma).
-        acc0 = jax.lax.pcast(
-            jnp.zeros((block,), values.dtype), (axis_name,), to="varying"
-        )
+        # jax 0.4.x (this image) has no vma typing at all — the constant
+        # is already per-shard there, so the cast is an identity.
+        acc0 = jnp.zeros((block,), values.dtype)
+        if hasattr(jax.lax, "pcast"):
+            acc0 = jax.lax.pcast(acc0, (axis_name,), to="varying")
 
         def ring_step(rc, t):
             rot, acc = rc
@@ -1464,7 +1477,7 @@ def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
                rng: str):
     body = functools.partial(_ring_rounds_gossip, axis_name, S, block, rng)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
         in_specs=(spec,) * 4 + (P(), P()),
@@ -1694,7 +1707,7 @@ def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factory.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 13 + (P(), P(), P()),
@@ -1747,7 +1760,7 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
     # check_vma=False: the body may invoke the Pallas bucket kernel, whose
     # vma-typed lowering trips a cache bug in current JAX (see
     # ops/pallas_edge.py); scoped to the ring-body programs only.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh, check_vma=False,
         in_specs=(spec,) * 13 + (P(), P(), P()),
@@ -1897,7 +1910,7 @@ def _propagate_fn(mesh: Mesh, axis_name: str, S: int, block: int, op: str,
                              mxu_block, op)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(body, mesh=mesh, check_vma=False,
+    fn = shard_map(body, mesh=mesh, check_vma=False,
                        in_specs=(spec,) * 12, out_specs=spec)
     return jax.jit(fn)
 
@@ -2012,7 +2025,7 @@ def _pagerank_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
                              pieces, mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh, check_vma=False,
         in_specs=(spec,) * 13 + (P(), P()),
@@ -2133,7 +2146,7 @@ def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda tol, *args: body(tol, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 13 + (P(), P()),
@@ -2235,7 +2248,7 @@ def _leader_quiet_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block, max_rounds)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(body, mesh=mesh, check_vma=False,
+    fn = shard_map(body, mesh=mesh, check_vma=False,
                        in_specs=(spec,) * 12, out_specs=(spec, P()))
     return jax.jit(fn)
 
@@ -2369,7 +2382,7 @@ def _pushsum_variance_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda tol, *args: body(tol, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 14,
@@ -2419,7 +2432,7 @@ def _pushsum_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
                              pieces, mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh, check_vma=False,
         in_specs=(spec,) * 14,
@@ -2523,7 +2536,7 @@ def _hopdist_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
                              pieces, mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh, check_vma=False,
         in_specs=(spec,) * 14 + (P(),),
@@ -2612,7 +2625,7 @@ def _hopdist_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 14 + (P(),),
@@ -2938,7 +2951,7 @@ def _flood_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              pieces, mxu_block, k, span)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 16,
@@ -3016,7 +3029,7 @@ def _hopdist_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              block, pieces, mxu_block, k, span)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 16 + (P(),),
@@ -3173,7 +3186,7 @@ def _walk_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     body = functools.partial(_ring_rounds_walk, axis_name, S, block, W,
                              span, restart_p)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, check_vma=False,
         in_specs=(spec,) * 8 + (P(), P(), P(), spec, P()),
         out_specs=(P(), spec, P()),
@@ -3223,7 +3236,7 @@ def _walk_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     body = functools.partial(_ring_cov_walk, axis_name, S, block, W, span,
                              restart_p, steps_per_round)
     spec = P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
         in_specs=(P(),) + (spec,) * 8 + (P(), P(), P(), spec, P()),

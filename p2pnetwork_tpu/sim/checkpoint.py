@@ -257,8 +257,10 @@ def save_orbax(path: str, state: Any, key: jax.Array, round_index: int,
         # "host-local" to orbax in a multi-process job and refuses to
         # serialize; the key is tiny and identical on every process.
         "key_data": np.asarray(jax.random.key_data(key)),
-        "round_index": np.int64(round_index),
-        "message_count": np.int64(message_count),
+        # 0-d ndarrays, not np.int64 scalars: this image's orbax rejects
+        # numpy GENERIC scalars as unsupported leaf types.
+        "round_index": np.asarray(round_index, dtype=np.int64),
+        "message_count": np.asarray(message_count, dtype=np.int64),
     }
     # Context-manage: each StandardCheckpointer owns async worker threads;
     # a checkpoint-every-N-rounds loop must not leak one pool per save.

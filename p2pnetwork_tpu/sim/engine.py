@@ -5,11 +5,20 @@ The reference's runtime is its thread-and-poll loops (SURVEY.md section 1
 over protocol rounds, compiled once, with per-round stats as device-side
 reductions, plus a ``lax.while_loop`` variant for run-to-coverage with no
 host round-trips (the north-star benchmark loop).
+
+The resume entry points (``run_from`` / ``run_until_coverage_from`` /
+``run_until_converged``) DONATE the state carry by default — the caller's
+buffers alias the loop's instead of double-buffering in HBM, and are
+invalidated (``donate=False`` opts out; see ``run_from``). Protocols that
+expose a ``frontier_occupancy`` stat (the flood family) get its per-run
+mean packed into the summary and recorded into the
+``sim_frontier_occupancy`` histogram.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
 import time
 
 import jax
@@ -27,8 +36,54 @@ from p2pnetwork_tpu.utils import accum
 jaxhooks.install()
 
 
+#: Occupancy is a fraction of live nodes in [0, 1]; geometric buckets from
+#: ~0.1% up resolve the sparse tail where the frontier fast path pays off.
+_OCCUPANCY_BUCKETS = telemetry.exponential_buckets(1 / 1024, 2.0, 11)
+#: Cardinality bound for sim_frontier_occupancy's (loop, protocol) children
+#: — a sweep over many protocol configs must not grow the family without
+#: limit (the per-peer-gauge pruning rule, telemetry/registry.py).
+_OCCUPANCY_MAX_CHILDREN = 16
+#: Recency order of observed (loop, protocol) pairs — pruning evicts the
+#: LEAST-RECENTLY-observed child, not the oldest-registered: a histogram
+#: is cumulative, and a long-lived protocol's history must not be zeroed
+#: because 16 one-shot sweep configs registered after it. Guarded by its
+#: own lock: run summaries bridge from whatever thread finished the run
+#: (several JaxSimNodes in one process), and the registry's internal
+#: locking does not cover this side-table.
+_occupancy_recency: dict = {}
+_occupancy_lock = threading.Lock()
+
+
+def _observe_occupancy(loop: str, protocol_name: str, value: float) -> None:
+    """Record one run's mean per-round frontier occupancy, pruning the
+    least-recently-observed labeled children past the cardinality bound."""
+    hist = telemetry.default_registry().histogram(
+        "sim_frontier_occupancy",
+        "Mean per-round frontier occupancy (active fraction of live nodes) "
+        "per run-to-* invocation.",
+        ("loop", "protocol"), buckets=_OCCUPANCY_BUCKETS)
+    key = (loop, protocol_name)
+    with _occupancy_lock:
+        # observe INSIDE the lock: outside it, a concurrent prune at the
+        # bound could evict this child between observe and re-insert,
+        # dropping the sample just recorded.
+        hist.labels(*key).observe(value)
+        _occupancy_recency.pop(key, None)
+        _occupancy_recency[key] = None  # re-insert = move to most-recent
+        # Drop recency entries for children gone from the (possibly
+        # swapped) registry, then evict the coldest down to the bound.
+        live = {c.labels for c in hist.children()}
+        for stale in [k for k in _occupancy_recency if k not in live]:
+            del _occupancy_recency[stale]
+        while len(_occupancy_recency) > _OCCUPANCY_MAX_CHILDREN:
+            coldest = next(iter(_occupancy_recency))
+            del _occupancy_recency[coldest]
+            hist.remove(*coldest)
+
+
 def _record_run_summary(loop: str, wall_s: float, transfer_s: float,
-                        transfer_bytes: int, out: dict) -> None:
+                        transfer_bytes: int, out: dict,
+                        protocol_name: str = "") -> None:
     """Bridge one host-side run summary into the registry post-transfer.
 
     The compiled loops are pure device programs — the only host hooks are
@@ -58,18 +113,41 @@ def _record_run_summary(loop: str, wall_s: float, transfer_s: float,
         reg.gauge("sim_last_coverage", "Coverage reached by the most recent "
                   "run-to-coverage loop.", ("loop",)).labels(loop).set(
                       float(out["coverage"]))
+    if "frontier_occupancy_mean" in out:
+        _observe_occupancy(loop, protocol_name,
+                           float(out["frontier_occupancy_mean"]))
 
 
-def _timed_summary(loop: str, t0: float, state, packed):
+def _timed_summary(loop: str, t0: float, state, packed,
+                   protocol_name: str = "", has_occupancy: bool = False):
     """Unpack the packed one-transfer summary, timing the transfer, and
-    record the whole invocation into the registry."""
+    record the whole invocation into the registry. ``has_occupancy`` says
+    whether the protocol's stats carried ``frontier_occupancy`` — only
+    then does the packed fifth slot mean anything (it is zero-filled for
+    protocols without the stat, which must not pollute the histogram)."""
     t1 = time.perf_counter()
     out = _unpack_summary(packed)
+    extra = out.pop("extra", None)
+    if has_occupancy and extra is not None:
+        out["frontier_occupancy_mean"] = extra
     t2 = time.perf_counter()
     nbytes = sum(int(getattr(leaf, "nbytes", 0))
                  for leaf in jax.tree_util.tree_leaves(packed))
-    _record_run_summary(loop, t2 - t0, t2 - t1, nbytes, out)
+    _record_run_summary(loop, t2 - t0, t2 - t1, nbytes, out, protocol_name)
     return state, out
+
+
+def _scan_rounds(graph: Graph, protocol, state, key: jax.Array, rounds: int):
+    """The shared scan body of :func:`run` / :func:`run_from`."""
+
+    def body(carry, round_key):
+        st, = carry
+        st, stats = protocol.step(graph, st, round_key)
+        return (st,), stats
+
+    keys = jax.random.split(jax.random.fold_in(key, 1), rounds)
+    (state,), stats = jax.lax.scan(body, (state,), keys)
+    return state, stats
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "rounds"))
@@ -80,23 +158,61 @@ def run(graph: Graph, protocol, key: jax.Array, rounds: int):
     Stats come back as arrays of shape [rounds] per entry — the full
     per-round history of the device-side counters in one transfer.
     """
-    return run_from(graph, protocol, protocol.init(graph, key), key, rounds)
+    return _scan_rounds(graph, protocol, protocol.init(graph, key), key,
+                        rounds)
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "rounds"))
-def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int):
+_run_from_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "rounds"),
+    donate_argnames=("state",))(_scan_rounds)
+_run_from_keeping = functools.partial(
+    jax.jit, static_argnames=("protocol", "rounds"))(_scan_rounds)
+
+
+def _donatable(state, *others) -> bool:
+    """False when two leaves of ``state`` are the SAME array — XLA rejects
+    donating one buffer twice, and protocol inits routinely alias (Flood's
+    seed IS both ``seen`` and ``frontier``) — or when a state leaf is also
+    a leaf of a NON-donated argument (LeaderElection's state carries
+    ``graph.node_mask`` itself: `f(a, donate(a))` is equally rejected).
+    Such states ride the non-donating path transparently; after one real
+    step the leaves are distinct buffers and donation kicks in."""
+    leaves = jax.tree_util.tree_leaves(state)
+    ids = {id(leaf) for leaf in leaves}
+    if len(ids) < len(leaves):
+        return False
+    other_ids = {id(leaf) for o in others
+                 for leaf in jax.tree_util.tree_leaves(o)}
+    return not (ids & other_ids)
+
+
+def _pick_loop(donating, keeping, donate, state, graph, key):
+    """The one donation gate all three resume entry points share: the
+    donating jit variant only when asked AND the state's buffers are
+    cleanly donatable against the non-donated args."""
+    return donating if donate and _donatable(state, graph, key) else keeping
+
+
+def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int, *,
+             donate: bool = True):
     """Run ``rounds`` rounds continuing from an existing ``state`` (resume
     path — e.g. after loading a checkpoint, or incremental stepping from
-    JaxSimNode)."""
+    JaxSimNode).
 
-    def body(carry, round_key):
-        st, = carry
-        st, stats = protocol.step(graph, st, round_key)
-        return (st,), stats
-
-    keys = jax.random.split(jax.random.fold_in(key, 1), rounds)
-    (state,), stats = jax.lax.scan(body, (state,), keys)
-    return state, stats
+    ``donate=True`` (the default) donates the ``state`` buffers to the
+    compiled loop: the caller's copy stops double-buffering in HBM
+    alongside the loop carry — at 10M nodes that is tens of MB per
+    predicate — and is INVALIDATED (reading the passed-in state
+    afterwards raises). Pass ``donate=False`` to keep it (e.g. to resume
+    the same state twice), and checkpoint a pre-run state BEFORE the
+    donating call — ``sim/checkpoint.py`` copies to host at save time,
+    so save-then-run is safe, run-then-save-the-old-state is not. A
+    state whose leaves alias one buffer (fresh protocol inits do) skips
+    donation automatically rather than trip XLA's double-donate check.
+    """
+    fn = _pick_loop(_run_from_donating, _run_from_keeping, donate,
+                    state, graph, key)
+    return fn(graph, protocol, state, key, rounds)
 
 
 def run_until_coverage(
@@ -118,16 +234,21 @@ def run_until_coverage(
     :func:`run_until_coverage_from`).
 
     Requires the protocol's stats to include ``coverage`` and ``messages``
-    (e.g. models.flood.Flood).
+    (e.g. models.flood.Flood). Protocols that also expose
+    ``frontier_occupancy`` (the flood family) get its per-run mean back as
+    ``frontier_occupancy_mean`` and recorded into the
+    ``sim_frontier_occupancy`` histogram.
     """
-    _require_stats(graph, protocol, None, key, ("coverage", "messages"))
+    keys = _require_stats(graph, protocol, None, key, ("coverage", "messages"))
     t0 = time.perf_counter()
     state, packed = _coverage_with_init(
         graph, protocol, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
         steps_per_round=steps_per_round,
     )
-    return _timed_summary("coverage", t0, state, packed)
+    return _timed_summary("coverage", t0, state, packed,
+                          type(protocol).__name__,
+                          "frontier_occupancy" in keys)
 
 
 def run_until_coverage_from(
@@ -139,6 +260,7 @@ def run_until_coverage_from(
     coverage_target: float = 0.99,
     max_rounds: int = 1024,
     steps_per_round: int = 1,
+    donate: bool = True,
 ):
     """Run-to-coverage continuing from an existing ``state0`` (resume path).
 
@@ -152,15 +274,25 @@ def run_until_coverage_from(
     The whole summary (rounds, coverage, both limbs) comes back in ONE
     packed transfer — on tunneled backends every extra round trip is
     milliseconds.
+
+    ``donate=True`` (default) hands ``state0``'s buffers to the loop and
+    invalidates the caller's copy (see :func:`run_from` for the full
+    donation contract); pass ``donate=False`` to resume the same state
+    more than once.
     """
-    _require_stats(graph, protocol, state0, key, ("coverage", "messages"))
+    keys = _require_stats(graph, protocol, state0, key,
+                          ("coverage", "messages"))
     t0 = time.perf_counter()
-    state, packed = _coverage_loop(
+    loop_fn = _pick_loop(_coverage_loop_donating, _coverage_loop_keeping,
+                         donate, state0, graph, key)
+    state, packed = loop_fn(
         graph, protocol, state0, key,
         coverage_target=coverage_target, max_rounds=max_rounds,
         steps_per_round=steps_per_round,
     )
-    return _timed_summary("coverage_from", t0, state, packed)
+    return _timed_summary("coverage_from", t0, state, packed,
+                          type(protocol).__name__,
+                          "frontier_occupancy" in keys)
 
 
 # One-transfer run summaries, shared with the sharded coverage loops.
@@ -178,6 +310,7 @@ def run_until_converged(
     max_rounds: int = 1024,
     state0=None,
     steps_per_round: int = 1,
+    donate: bool = True,
 ):
     """Run until the scalar ``stats[stat]`` drops BELOW ``threshold`` — the
     run-to-coverage loop's sibling for convergence-style protocols
@@ -191,21 +324,26 @@ def run_until_converged(
     Thresholds have an f32 floor: an L1 residual summed over N ranks
     bottoms out around N * eps * scale (measured ~1.4e-8 at 50K nodes), so
     an unreachable threshold runs to ``max_rounds`` — size it to the
-    population, or watch ``value`` in the summary."""
-    _require_stats(graph, protocol, state0, key, (stat, "messages"))
+    population, or watch ``value`` in the summary.
+
+    ``donate=True`` (default) hands a non-None ``state0``'s buffers to the
+    loop and invalidates the caller's copy (see :func:`run_from`)."""
+    keys = _require_stats(graph, protocol, state0, key, (stat, "messages"))
     t0 = time.perf_counter()
-    state, packed = _converged_loop(
+    loop_fn = _pick_loop(_converged_loop_donating,
+                         _converged_loop_keeping, donate, state0, graph,
+                         key)
+    state, packed = loop_fn(
         graph, protocol, state0, key, stat=stat, threshold=threshold,
         max_rounds=max_rounds, steps_per_round=steps_per_round,
     )
-    state, out = _timed_summary("converged", t0, state, packed)
+    state, out = _timed_summary("converged", t0, state, packed,
+                                type(protocol).__name__,
+                                "frontier_occupancy" in keys)
     out["value"] = out.pop("coverage")  # pack_summary's f32 slot, reused
     return state, out
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("protocol", "stat", "max_rounds",
-                                    "steps_per_round"))
 def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
                     max_rounds, steps_per_round=1):
     if state0 is None:
@@ -217,6 +355,15 @@ def _converged_loop(graph, protocol, state0, key, *, stat, threshold,
     )
 
 
+_converged_loop_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "stat", "max_rounds",
+                              "steps_per_round"),
+    donate_argnames=("state0",))(_converged_loop)
+_converged_loop_keeping = functools.partial(
+    jax.jit, static_argnames=("protocol", "stat", "max_rounds",
+                              "steps_per_round"))(_converged_loop)
+
+
 #: Memoized stats-key sets per (protocol, graph structure) — the abstract
 #: trace of init+step runs once, not per call (the run-to-* entry points
 #: sit on paths budgeted in milliseconds). FIFO-bounded: a sweep over many
@@ -226,11 +373,12 @@ _stats_keys_cache: dict = {}
 _STATS_KEYS_CACHE_MAX = 128
 
 
-def _require_stats(graph, protocol, state0, key, required) -> None:
+def _require_stats(graph, protocol, state0, key, required):
     """Check the protocol's stats dict exposes ``required`` keys, by
     abstract tracing (no device work) — a typo'd or missing stat must be a
     clear ValueError up front, not a KeyError from inside the jitted
-    loop."""
+    loop. Returns the full stats-key frozenset so callers can sniff
+    OPTIONAL stats (``frontier_occupancy``) off the same cached trace."""
     cache_key = (protocol, jax.tree_util.tree_structure(graph))
     keys = _stats_keys_cache.get(cache_key)
     if keys is None:
@@ -249,6 +397,7 @@ def _require_stats(graph, protocol, state0, key, required) -> None:
             f"{type(protocol).__name__} exposes stats {sorted(keys)}; "
             f"this loop needs {sorted(missing)}"
         )
+    return keys
 
 
 def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
@@ -269,25 +418,35 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
     remainder of the super-step), and the sub-step RNG chain is the same
     ``k, sub = split(k)`` sequence the T=1 body walks. The only cost is
     up to T-1 discarded trailing step computations in the final
-    super-step."""
+    super-step.
+
+    When the protocol's stats include ``frontier_occupancy`` (the flood
+    family), its per-round values accumulate device-side and the packed
+    summary carries their mean in the fifth slot — zero for protocols
+    without the stat (the entry points know which is which and drop the
+    meaningless zeros)."""
     T = int(steps_per_round)
     if T < 1:
         raise ValueError(f"steps_per_round must be >= 1, got {T}")
 
+    def _occ(stats):
+        return jnp.float32(stats.get("frontier_occupancy", 0.0))
+
     def cond(carry):
-        _, _, rounds, value, _, _ = carry
+        _, _, rounds, value, _, _, _ = carry
         return keep_going(value, rounds)
 
     def body(carry):
-        state, k, rounds, _, hi, lo = carry
+        state, k, rounds, _, hi, lo, occ = carry
         k, sub = jax.random.split(k)
         state, stats = protocol.step(graph, state, sub)
         hi, lo = accum.add((hi, lo), stats["messages"])
-        return (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo)
+        return (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo,
+                occ + _occ(stats))
 
     def batched_body(carry):
         def substep(c, _):
-            state, k, rounds, value, hi, lo = c
+            state, k, rounds, value, hi, lo, occ = c
             live = keep_going(value, rounds)
             # k advances unconditionally: the while carry never exposes
             # it, and frozen sub-steps discard everything drawn from it,
@@ -302,15 +461,18 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
                           jnp.zeros_like(stats["messages"])))
             rounds = jnp.where(live, rounds + 1, rounds)
             value = jnp.where(live, jnp.float32(stats[stat]), value)
-            return (state, k, rounds, value, hi, lo), None
+            occ = occ + jnp.where(live, _occ(stats), jnp.float32(0.0))
+            return (state, k, rounds, value, hi, lo, occ), None
 
         carry, _ = jax.lax.scan(substep, carry, None, length=T)
         return carry
 
-    init = (state0, key, jnp.int32(0), value0, *accum.zero())
-    state, _, rounds, value, hi, lo = jax.lax.while_loop(
+    init = (state0, key, jnp.int32(0), value0, *accum.zero(),
+            jnp.float32(0.0))
+    state, _, rounds, value, hi, lo, occ = jax.lax.while_loop(
         cond, body if T == 1 else batched_body, init)
-    return state, _pack_summary(rounds, value, (hi, lo))
+    occ_mean = occ / jnp.maximum(rounds, 1)
+    return state, _pack_summary(rounds, value, (hi, lo), extra=occ_mean)
 
 
 def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds,
@@ -337,9 +499,15 @@ def _coverage_with_init(graph, protocol, key, *, coverage_target, max_rounds,
                           coverage_target, max_rounds, steps_per_round)
 
 
-@functools.partial(jax.jit, static_argnames=("protocol", "max_rounds",
-                                             "steps_per_round"))
 def _coverage_loop(graph, protocol, state0, key, *, coverage_target,
                    max_rounds, steps_per_round=1):
     return _coverage_body(graph, protocol, state0, key, coverage_target,
                           max_rounds, steps_per_round)
+
+
+_coverage_loop_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds", "steps_per_round"),
+    donate_argnames=("state0",))(_coverage_loop)
+_coverage_loop_keeping = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds",
+                              "steps_per_round"))(_coverage_loop)

@@ -49,23 +49,36 @@ def value(acc: Acc) -> int:
     return (int(np.asarray(hi)) << 32) + int(np.uint32(np.asarray(lo)))
 
 
-def pack_summary(rounds: jax.Array, coverage: jax.Array, acc: Acc) -> jax.Array:
+def pack_summary(rounds: jax.Array, coverage: jax.Array, acc: Acc,
+                 extra=None) -> jax.Array:
     """[rounds, coverage-bits, hi, lo-bits] as one i32[4] — a single
     device->host transfer carries a whole run summary (on tunneled
     backends every extra round trip is milliseconds). Shared by the
-    engine's and the sharded path's run-to-coverage loops."""
+    engine's and the sharded path's run-to-coverage loops.
+
+    ``extra`` (optional f32 scalar) appends a fifth slot — the engine
+    packs the mean per-round frontier occupancy there; callers that
+    don't pass it keep the original i32[4] layout byte for byte."""
     hi, lo = acc
-    return jnp.stack([
+    parts = [
         rounds,
         jax.lax.bitcast_convert_type(coverage, jnp.int32),
         hi,
         jax.lax.bitcast_convert_type(lo, jnp.int32),
-    ])
+    ]
+    if extra is not None:
+        parts.append(
+            jax.lax.bitcast_convert_type(jnp.float32(extra), jnp.int32))
+    return jnp.stack(parts)
 
 
 def unpack_summary(packed) -> dict:
-    """Host-side inverse of :func:`pack_summary` (forces the transfer)."""
+    """Host-side inverse of :func:`pack_summary` (forces the transfer).
+    A fifth slot, when present, comes back under ``"extra"``."""
     arr = np.asarray(packed)
     coverage = float(arr[1:2].view(np.float32)[0])
     messages = (int(arr[2]) << 32) + int(arr[3:4].view(np.uint32)[0])
-    return {"rounds": int(arr[0]), "coverage": coverage, "messages": messages}
+    out = {"rounds": int(arr[0]), "coverage": coverage, "messages": messages}
+    if arr.size >= 5:
+        out["extra"] = float(arr[4:5].view(np.float32)[0])
+    return out

@@ -175,7 +175,12 @@ class TestStageTelemetry:
         assert tel_1m["stages"]["graph_build_s"] == pytest.approx(
             recs[-1]["graph_build_s"], abs=0.01)
         assert set(tel_1m["per_method"]) == {
-            "pallas", "hybrid", "adaptive-1024", "adaptive-2048"}
+            "pallas", "hybrid", "adaptive-1024", "adaptive-2048", "frontier"}
+        # The frontier column carries the per-round occupancy attribution
+        # the crossover constant is re-fit from.
+        occ = tel_1m["per_method"]["frontier"]["frontier_occupancy_per_round"]
+        assert len(occ) == recs[-1]["rounds"]
+        assert all(0.0 <= v <= 1.0 for v in occ)
 
     def test_artifacts_exist_with_nonzero_core_timings(self, first_run):
         # Cheap coverage that rides first_run (later tests may re-run bench
@@ -230,15 +235,31 @@ class TestHangContainment:
         assert "stage 1m" in last["error"]
         assert "ValueError" in last["error"]
 
-    def test_dead_backend_probe_gives_structured_error(self, tmp_path):
-        # An unsatisfiable platform makes every probe fail fast; the
-        # retry window is tiny so this exercises give-up, not recovery.
+    def test_dead_backend_falls_back_to_cpu_record(self, tmp_path):
+        # An unsatisfiable platform makes every probe fail fast and the
+        # tiny window exhausts; the bench must then publish a REAL
+        # cpu-fallback record — never value: null when a fallback number
+        # is obtainable (BENCH_r05 lost a whole round to exactly that).
         r, recs = _run(tmp_path, JAX_PLATFORMS="nonexistent-platform",
                        BENCH_BACKEND_WINDOW_S=2, BENCH_PROBE_TIMEOUT_S=30)
+        assert r.returncode == 0, r.stderr[-2000:]
+        last = recs[-1]
+        assert last["backend"] == "cpu-fallback"
+        assert last["value"] is not None and last["value"] > 0
+        assert last["platform"] == "cpu"  # the child really measured on cpu
+        assert "backend_error" in last  # the outage cause rides along
+        assert "skipped" in last["scale_10M"]  # 10M is chip-only
+
+    def test_dead_backend_and_dead_fallback_is_structured_error(self, tmp_path):
+        # When the cpu fallback ALSO fails (here: a poisoned stage config),
+        # the old structured-error contract still holds.
+        r, recs = _run(tmp_path, JAX_PLATFORMS="nonexistent-platform",
+                       BENCH_BACKEND_WINDOW_S=2, BENCH_PROBE_TIMEOUT_S=30,
+                       BENCH_N_1M="not-a-number")
         assert r.returncode == 1
         last = recs[-1]
         assert last["value"] is None
-        assert "probe" in last["error"] or "backend" in last["error"]
+        assert "cpu fallback also failed" in last["error"]
 
 
 class TestPrebuild:

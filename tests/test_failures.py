@@ -169,7 +169,8 @@ def test_churn_mid_run_resumes():
     state, _ = engine.run(g, proto, key, 3)
     gf = failures.random_node_failures(g, jax.random.key(7), 0.4)
     # Nodes that already saw the message but died stop counting/forwarding.
-    state2, stats = engine.run_from(gf, proto, state, key, 12)
+    # donate=False: this test reads the pre-resume state again below.
+    state2, stats = engine.run_from(gf, proto, state, key, 12, donate=False)
     seen = np.asarray(state2.seen)
     alive = np.asarray(gf.node_mask)
     dead_new = seen & ~alive & (np.arange(seen.size) < 1000)

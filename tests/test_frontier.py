@@ -1,0 +1,446 @@
+"""Frontier-compacted fast path (ops/frontier.py) and bit-packed state
+(ops/bitset.py): bit-exact equivalence vs the dense lowerings over a
+seeded sweep, packed-state protocol parity, engine buffer donation, the
+occupancy stat plumbing, and the slow-marked edge-gather work bench.
+
+The equivalence sweep is deliberately hypothesis-free: fixed seeds over
+three graph families x three sizes x an occupancy ladder, including the
+padded-slot and isolated-node edge cases — every case is reproducible
+from its parameters alone."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu.models.adaptive_flood import AdaptiveFlood  # noqa: E402
+from p2pnetwork_tpu.models.flood import Flood, FloodBitState  # noqa: E402
+from p2pnetwork_tpu.models.plumtree import Plumtree  # noqa: E402
+from p2pnetwork_tpu.ops import bitset, frontier, segment  # noqa: E402
+from p2pnetwork_tpu.sim import engine, failures  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+
+
+def _families(n, **kw):
+    return [
+        G.erdos_renyi(n, min(8.0 / n, 0.4), seed=n, source_csr=True, **kw),
+        G.watts_strogatz(n, 4, 0.2, seed=n + 1, source_csr=True, **kw),
+        G.ring(n, source_csr=True, **kw),
+    ]
+
+
+#: Occupancy ladder: empty, singleton, sparse (the fast-path regime),
+#: past any crossover, full.
+_OCCUPANCIES = (0.0, "one", 0.05, 0.5, 1.0)
+
+
+def _signals(g, rng):
+    n_pad = g.n_nodes_padded
+    for occ in _OCCUPANCIES:
+        if occ == "one":
+            sig = np.zeros(n_pad, dtype=bool)
+            sig[rng.integers(0, g.n_nodes)] = True
+        else:
+            sig = rng.random(n_pad) < occ
+        yield jnp.asarray(sig) & g.node_mask
+
+
+class TestEquivalenceSweep:
+    @pytest.mark.parametrize("n", [17, 128, 1000])
+    def test_or_max_min_plus_match_dense(self, n):
+        rng = np.random.default_rng(7)
+        for g in _families(n):
+            n_pad = g.n_nodes_padded
+            # One jitted pair per (graph, op), reused across the whole
+            # occupancy ladder — per-call eager lax.cond would recompile
+            # its branches for every fresh closure.
+            pairs = [
+                (jax.jit(lambda s: segment.propagate_or(g, s, "frontier")),
+                 jax.jit(lambda s: segment.propagate_or(g, s, "segment")),
+                 lambda s: s),
+                (jax.jit(lambda x: segment.propagate_max(g, x, "frontier")),
+                 jax.jit(lambda x: segment.propagate_max(g, x, "segment")),
+                 lambda s: jnp.where(s, jnp.asarray(
+                     rng.integers(0, 1000, n_pad), jnp.int32),
+                     jnp.iinfo(jnp.int32).min)),
+                (jax.jit(lambda d: segment.propagate_min_plus(g, d,
+                                                              "frontier")),
+                 jax.jit(lambda d: segment.propagate_min_plus(g, d,
+                                                              "segment")),
+                 lambda s: jnp.where(s, jnp.asarray(
+                     rng.random(n_pad), jnp.float32), jnp.inf)),
+            ]
+            for sig in _signals(g, rng):
+                for fr, dense, make in pairs:
+                    x = make(sig)
+                    np.testing.assert_array_equal(np.asarray(fr(x)),
+                                                  np.asarray(dense(x)))
+
+    def test_weighted_min_plus_matches_dense(self):
+        g = G.watts_strogatz(256, 4, 0.2, seed=3, source_csr=True).with_weights(
+            lambda s, r: 0.5 + (s % 7) / 3.0)
+        rng = np.random.default_rng(5)
+        for d0 in _signals(g, rng):
+            d = jnp.where(d0, 1.0, jnp.inf)
+            np.testing.assert_array_equal(
+                np.asarray(segment.propagate_min_plus(g, d, "frontier")),
+                np.asarray(segment.propagate_min_plus(g, d, "segment")))
+
+    def test_padded_slot_signal_contributes_nothing(self):
+        # n=17 pads to 128 nodes / 128 edge slots; a signal lit on PADDED
+        # slots must not leak through either path (and both must agree).
+        g = G.ring(17, source_csr=True)
+        sig = jnp.ones(g.n_nodes_padded, dtype=bool)  # padded slots lit
+        a = segment.propagate_or(g, sig, "frontier")
+        b = segment.propagate_or(g, sig, "segment")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.asarray(a)[17:].any()
+
+    def test_isolated_node_gets_identity(self):
+        # Nodes 3/4 have no edges at all; an ACTIVE isolated node sends to
+        # no one and receives the aggregation identity on both paths.
+        g = G.from_edges([0, 1, 1, 2], [1, 0, 2, 1], 5, source_csr=True)
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[3].set(True)
+        a = segment.propagate_or(g, sig, "frontier")
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(segment.propagate_or(g, sig, "segment")))
+        assert not np.asarray(a)[:5].any()
+        d = jnp.where(sig, 0.0, jnp.inf)
+        mp = segment.propagate_min_plus(g, d, "frontier")
+        np.testing.assert_array_equal(
+            np.asarray(mp),
+            np.asarray(segment.propagate_min_plus(g, d, "segment")))
+        assert np.isinf(np.asarray(mp)[3])  # no in-edges -> identity
+
+    def test_dynamic_edges_fold_in(self):
+        from p2pnetwork_tpu.sim import topology
+
+        g = topology.with_capacity(G.ring(64, source_csr=True),
+                                   extra_edges=8)
+        g = topology.connect(g, [0], [32])
+        sig = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+        a = np.asarray(segment.propagate_or(g, sig, "frontier"))
+        np.testing.assert_array_equal(
+            a, np.asarray(segment.propagate_or(g, sig, "segment")))
+        assert a[32]  # the runtime link delivered
+
+    def test_failed_edges_masked(self):
+        g = G.ring(128, source_csr=True)
+        gf = failures.fail_edges(g, [0, 1, 5])
+        rng = np.random.default_rng(11)
+        for sig in _signals(gf, rng):
+            np.testing.assert_array_equal(
+                np.asarray(segment.propagate_or(gf, sig, "frontier")),
+                np.asarray(segment.propagate_or(gf, sig, "segment")))
+
+    def test_requires_source_csr(self):
+        g = G.ring(64)
+        with pytest.raises(ValueError, match="source-CSR"):
+            segment.propagate_or(g, g.node_mask, "frontier")
+
+    def test_budget_override_and_bounds(self):
+        g = G.ring(1000, source_csr=True)
+        auto = frontier.budget(g)
+        assert frontier._MIN_BUDGET <= auto <= g.n_nodes_padded
+        assert frontier.budget(g, 0.5) == g.n_nodes_padded // 2
+        assert frontier.budget(g, 300) == 300
+        with pytest.raises(ValueError, match="fraction"):
+            frontier.budget(g, 1.5)
+
+    def test_hub_graph_disables_sparse_but_stays_exact(self):
+        # A hub's out-row widens every compaction slot: when even the
+        # _MIN_BUDGET floor breaks the slot bound, auto disables the
+        # sparse branch outright (k=0) and method='frontier' is a pure
+        # dense pass-through — never a slowdown, always exact.
+        g = G.barabasi_albert(1024, 3, seed=2, source_csr=True)
+        if frontier.budget(g) == 0:  # the scenario the guard exists for
+            rng = np.random.default_rng(4)
+            for sig in _signals(g, rng):
+                np.testing.assert_array_equal(
+                    np.asarray(segment.propagate_or(g, sig, "frontier")),
+                    np.asarray(segment.propagate_or(g, sig, "segment")))
+        # an explicit override still forces the sparse machinery
+        assert frontier.budget(g, 256) == 256
+
+    def test_crossover_override_threads_through_and_stays_exact(self):
+        # The re-fit "apply" step: an explicit crossover reaches the
+        # budget through propagate_* and through Flood's config, forcing
+        # either branch — results stay bit-exact in both regimes.
+        g = G.watts_strogatz(512, 4, 0.2, seed=6, source_csr=True)
+        rng = np.random.default_rng(3)
+        sig = jnp.asarray(rng.random(g.n_nodes_padded) < 0.3) & g.node_mask
+        ref = np.asarray(segment.propagate_or(g, sig, "segment"))
+        for crossover in (1.0, frontier._MIN_BUDGET):  # always-sparse, ~dense
+            out = segment.propagate_or(g, sig, "frontier",
+                                       frontier_crossover=crossover)
+            np.testing.assert_array_equal(np.asarray(out), ref)
+        key = jax.random.key(0)
+        _, o_ref = engine.run_until_coverage(
+            g, Flood(source=0), key, coverage_target=0.99)
+        _, o_cfg = engine.run_until_coverage(
+            g, Flood(source=0, method="frontier", frontier_crossover=0.25),
+            key, coverage_target=0.99)
+        assert o_ref["rounds"] == o_cfg["rounds"]
+        assert o_ref["messages"] == o_cfg["messages"]
+
+    def test_both_branches_exercised(self):
+        # The auto budget must sit strictly inside (0, n) for this config
+        # so the sweep above really ran BOTH cond branches.
+        g = G.watts_strogatz(1000, 4, 0.2, seed=1, source_csr=True)
+        k = frontier.budget(g)
+        assert frontier._MIN_BUDGET <= k < g.n_nodes  # full frontier -> dense
+
+
+class TestBitset:
+    def test_pack_unpack_roundtrip_and_popcount(self):
+        rng = np.random.default_rng(0)
+        for n in (32, 128, 1000):  # 1000: ragged tail
+            bits = rng.random(n) < 0.3
+            words = bitset.pack_bits(jnp.asarray(bits))
+            assert words.dtype == jnp.uint32
+            assert words.shape == (bitset.n_words(n),)
+            np.testing.assert_array_equal(
+                np.asarray(bitset.unpack_bits(words, n)), bits)
+            assert int(bitset.popcount(words)) == int(bits.sum())
+
+    def test_test_bits_and_set_bits(self):
+        rng = np.random.default_rng(1)
+        bits = rng.random(512) < 0.5
+        words = bitset.pack_bits(jnp.asarray(bits))
+        idx = jnp.asarray(rng.integers(0, 512, 64, dtype=np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(bitset.test_bits(words, idx)),
+            bits[np.asarray(idx)])
+        valid = jnp.asarray(rng.random(64) < 0.5)
+        out = bitset.set_bits(words, idx, valid)
+        ref = bits.copy()
+        ref[np.asarray(idx)[np.asarray(valid)]] = True
+        np.testing.assert_array_equal(
+            np.asarray(bitset.unpack_bits(out, 512)), ref)
+
+
+class TestBitsetProtocolParity:
+    def test_flood_bitset_bitexact(self):
+        g = G.watts_strogatz(1000, 6, 0.1, seed=9, source_csr=True)
+        key = jax.random.key(0)
+        for method in ("auto", "frontier"):
+            sd, od = engine.run_until_coverage(
+                g, Flood(source=0, method=method), key, coverage_target=0.99)
+            sb, ob = engine.run_until_coverage(
+                g, Flood(source=0, method=method, bitset=True), key,
+                coverage_target=0.99)
+            assert isinstance(sb, FloodBitState)
+            assert od == ob
+            np.testing.assert_array_equal(
+                np.asarray(sd.seen),
+                np.asarray(bitset.unpack_bits(sb.seen, g.n_nodes_padded)))
+
+    def test_flood_bitset_per_round_stats_match(self):
+        g = G.erdos_renyi(512, 0.02, seed=7, source_csr=True)
+        key = jax.random.key(1)
+        _, st_d = engine.run(g, Flood(source=0), key, 8)
+        _, st_b = engine.run(g, Flood(source=0, bitset=True), key, 8)
+        for k in ("messages", "coverage", "frontier", "frontier_occupancy"):
+            np.testing.assert_array_equal(np.asarray(st_d[k]),
+                                          np.asarray(st_b[k]))
+
+    def test_adaptive_flood_bitset_bitexact(self):
+        g = G.watts_strogatz(2048, 6, 0.1, seed=8, source_csr=True)
+        key = jax.random.key(0)
+        sd, od = engine.run_until_coverage(
+            g, AdaptiveFlood(source=0, k=64), key, coverage_target=0.99)
+        sb, ob = engine.run_until_coverage(
+            g, AdaptiveFlood(source=0, k=64, bitset=True), key,
+            coverage_target=0.99)
+        assert od == ob
+        np.testing.assert_array_equal(
+            np.asarray(sd.seen),
+            np.asarray(bitset.unpack_bits(sb.seen, g.n_nodes_padded)))
+
+    def test_plumtree_bitset_bitexact_and_tree_extracts(self):
+        g = G.watts_strogatz(256, 4, 0.1, seed=3)
+        key = jax.random.key(0)
+        s1, st1 = engine.run(g, Plumtree(source=0), key, 2)
+        s2, st2 = engine.run(g, Plumtree(source=0, bitset=True), key, 2)
+        for k in st1:
+            np.testing.assert_array_equal(np.asarray(st1[k]),
+                                          np.asarray(st2[k]))
+        np.testing.assert_array_equal(
+            np.asarray(s1.eager),
+            np.asarray(bitset.unpack_bits(s2.eager, g.n_edges_padded)))
+        t1 = Plumtree(source=0).tree_graph(g, s1)
+        t2 = Plumtree(source=0, bitset=True).tree_graph(g, s2)
+        np.testing.assert_array_equal(np.asarray(t1.senders),
+                                      np.asarray(t2.senders))
+        np.testing.assert_array_equal(np.asarray(t1.receivers),
+                                      np.asarray(t2.receivers))
+
+    def test_plumtree_bitset_heals_after_failures(self):
+        g = G.watts_strogatz(128, 4, 0.1, seed=4)
+        key = jax.random.key(0)
+        proto = Plumtree(source=0, bitset=True)
+        state, _ = engine.run(g, proto, key, 2)  # tree formed
+        gf = failures.fail_nodes(g, [7, 19])
+        state, stats = engine.run_from(gf, proto, state, key, 1)
+        assert float(np.asarray(stats["coverage"])[-1]) > 0.9
+
+
+class TestDonation:
+    def test_run_from_does_not_retain_prestep_state(self):
+        g = G.ring(256)
+        proto = Flood(source=0)
+        key = jax.random.key(0)
+        st, _ = engine.run(g, proto, key, 2)
+        pre_seen, pre_frontier = st.seen, st.frontier
+        st2, _ = engine.run_from(g, proto, st, key, 2)
+        # The pre-step carry was donated into the loop, not retained as a
+        # second HBM copy beside it.
+        assert pre_seen.is_deleted() and pre_frontier.is_deleted()
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(st.seen)
+
+    def test_run_from_donate_false_keeps_state(self):
+        g = G.ring(256)
+        proto = Flood(source=0)
+        key = jax.random.key(0)
+        st, _ = engine.run(g, proto, key, 2)
+        a, _ = engine.run_from(g, proto, st, key, 3, donate=False)
+        b, _ = engine.run_from(g, proto, st, key, 3, donate=False)
+        np.testing.assert_array_equal(np.asarray(a.seen), np.asarray(b.seen))
+
+    def test_aliased_state_skips_donation_transparently(self):
+        # Fresh inits alias one buffer at several leaves (Flood's seed IS
+        # seen AND frontier): donation must auto-skip, not trip XLA's
+        # double-donate check, and the aliased input must stay readable.
+        g = G.ring(256)
+        proto = Flood(source=0)
+        st0 = proto.init(g, jax.random.key(0))
+        assert st0.seen is st0.frontier
+        st1, _ = engine.run_from(g, proto, st0, jax.random.key(0), 2)
+        assert not st0.seen.is_deleted()
+        ref, _ = engine.run(g, proto, jax.random.key(0), 2)
+        np.testing.assert_array_equal(np.asarray(st1.seen),
+                                      np.asarray(ref.seen))
+
+    def test_coverage_from_donates(self):
+        g = G.watts_strogatz(512, 4, 0.2, seed=2, source_csr=True)
+        proto = Flood(source=0)
+        key = jax.random.key(0)
+        st, _ = engine.run(g, proto, key, 2)
+        pre = st.seen
+        _, out = engine.run_until_coverage_from(
+            g, proto, st, key, coverage_target=0.99, max_rounds=64)
+        assert pre.is_deleted()
+        assert float(out["coverage"]) >= 0.99
+
+
+class TestOccupancyStat:
+    def test_scan_stats_carry_per_round_occupancy(self):
+        g = G.ring(128, source_csr=True)
+        _, stats = engine.run(g, Flood(source=0), jax.random.key(0), 4)
+        occ = np.asarray(stats["frontier_occupancy"])
+        assert occ.shape == (4,)
+        # ring flood: every round 2 new nodes (one per direction)
+        np.testing.assert_allclose(occ, 2 / 128, rtol=1e-6)
+
+    def test_coverage_loop_reports_mean_and_histogram(self):
+        from p2pnetwork_tpu import telemetry
+
+        reg = telemetry.Registry()
+        prev = telemetry.set_default_registry(reg)
+        try:
+            g = G.watts_strogatz(1000, 6, 0.1, seed=9, source_csr=True)
+            _, out = engine.run_until_coverage(
+                g, Flood(source=0), jax.random.key(0), coverage_target=0.99)
+            assert 0.0 < out["frontier_occupancy_mean"] < 1.0
+            # cross-check against the per-round series at the same rounds
+            _, stats = engine.run(g, Flood(source=0), jax.random.key(0),
+                                  int(out["rounds"]))
+            mean = float(np.asarray(stats["frontier_occupancy"]).mean())
+            assert out["frontier_occupancy_mean"] == pytest.approx(
+                mean, rel=1e-5)
+            hist = reg.get("sim_frontier_occupancy")
+            assert hist is not None
+            (child,) = hist.children()
+            assert child.labels == ("coverage", "Flood")
+            assert child.count == 1
+        finally:
+            telemetry.set_default_registry(prev)
+
+    def test_histogram_cardinality_pruned(self):
+        from p2pnetwork_tpu import telemetry
+        from p2pnetwork_tpu.sim.engine import (_OCCUPANCY_MAX_CHILDREN,
+                                               _observe_occupancy)
+
+        reg = telemetry.Registry()
+        prev = telemetry.set_default_registry(reg)
+        try:
+            _observe_occupancy("coverage", "HotProto", 0.2)
+            for i in range(3 * _OCCUPANCY_MAX_CHILDREN):
+                # keep the long-lived protocol HOT through the sweep
+                _observe_occupancy("coverage", "HotProto", 0.2)
+                _observe_occupancy("coverage", f"Sweep{i}", 0.1)
+            hist = reg.get("sim_frontier_occupancy")
+            assert len(hist.children()) <= _OCCUPANCY_MAX_CHILDREN
+            names = {c.labels[1] for c in hist.children()}
+            # LRU, not FIFO: the oldest-REGISTERED but still-hot child
+            # survives with its history; cold sweep labels are evicted.
+            assert "HotProto" in names
+            assert "Sweep0" not in names
+            (hot,) = [c for c in hist.children()
+                      if c.labels[1] == "HotProto"]
+            assert hot.count > 1  # history kept, not reset by pruning
+        finally:
+            telemetry.set_default_registry(prev)
+
+    def test_protocols_without_the_stat_stay_out(self):
+        from p2pnetwork_tpu import telemetry
+        from p2pnetwork_tpu.models.sir import SIR
+
+        reg = telemetry.Registry()
+        prev = telemetry.set_default_registry(reg)
+        try:
+            g = G.watts_strogatz(256, 4, 0.1, seed=5)
+            _, out = engine.run_until_coverage(
+                g, SIR(beta=0.9, gamma=0.05), jax.random.key(0),
+                coverage_target=0.5, max_rounds=64)
+            assert "frontier_occupancy_mean" not in out
+            assert reg.get("sim_frontier_occupancy") is None
+        finally:
+            telemetry.set_default_registry(prev)
+
+
+@pytest.mark.slow
+def test_frontier_halves_edge_gather_work_on_flood_tails():
+    """Acceptance bench: on a 10k-node WS flood, the frontier path's
+    edge-gather work — measured off the frontier-occupancy stat as
+    (sent-frontier nodes) * max_out_span slots — is >= 2x below the dense
+    path's E_pad slots on the first 3 AND last 3 rounds."""
+    # Low rewiring: the wave must have a real straggler tail (p=0.1's
+    # ~log-N wave peaks right up to its second-to-last round).
+    g = G.watts_strogatz(10_000, 10, 0.01, seed=0, source_csr=True)
+    key = jax.random.key(0)
+    # Run the flood to EXHAUSTION (empty frontier), not to the 99% target
+    # — the sparse tail the fast path exists for lives past that cut.
+    sd, stats = engine.run(g, Flood(source=0, method="frontier"), key, 32)
+    sref, ref_stats = engine.run(g, Flood(source=0), key, 32)
+    np.testing.assert_array_equal(np.asarray(sd.seen), np.asarray(sref.seen))
+    for k in ("messages", "coverage", "frontier", "frontier_occupancy"):
+        np.testing.assert_array_equal(np.asarray(stats[k]),
+                                      np.asarray(ref_stats[k]))
+    occ = np.asarray(stats["frontier_occupancy"])
+    n_live = g.n_nodes
+    # Round r sends the frontier that round r-1 produced; round 1 sends
+    # the seed (1 node). Only rounds that sent anything count.
+    sent = np.concatenate([[1.0 / n_live], occ[:-1]]) * n_live
+    active_rounds = np.flatnonzero(sent > 0)
+    assert active_rounds.size >= 6
+    sparse_slots = sent * g.max_out_span
+    dense_slots = g.n_edges_padded
+    for r in list(active_rounds[:3]) + list(active_rounds[-3:]):
+        assert 2 * sparse_slots[r] <= dense_slots, (
+            f"round {r + 1}: {sparse_slots[r]} gathered slots vs dense "
+            f"{dense_slots} — frontier fast path must be >= 2x cheaper")

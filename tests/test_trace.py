@@ -59,8 +59,9 @@ def test_summary_schema_pinned(graph):
     assert set(summary) == {"label", "summary", "rounds", "wall_s",
                             "compile_seconds", "device_transfer_bytes",
                             "n_nodes", "n_edges"}
-    # stats history: 2 rounds x (coverage, messages, frontier) float32s
-    assert summary["device_transfer_bytes"] == 2 * 3 * 4
+    # stats history: 2 rounds x (coverage, messages, frontier,
+    # frontier_occupancy) 4-byte scalars
+    assert summary["device_transfer_bytes"] == 2 * 4 * 4
     assert summary["compile_seconds"] >= 0.0
 
 

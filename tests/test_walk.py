@@ -413,8 +413,10 @@ class TestBatchedSteps:
         proto = RandomWalks(n_walkers=8)
         key = jax.random.key(9)
         mid, _ = engine.run(g, proto, key, 40)
+        # donate=False on the first resume: ``mid`` is resumed twice.
         s1, o1 = engine.run_until_coverage_from(
-            g, proto, mid, key, coverage_target=0.9, max_rounds=512)
+            g, proto, mid, key, coverage_target=0.9, max_rounds=512,
+            donate=False)
         sT, oT = engine.run_until_coverage_from(
             g, proto, mid, key, coverage_target=0.9, max_rounds=512,
             steps_per_round=8)
