@@ -7,7 +7,8 @@
 PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test examples bench dryrun telemetry-check chaos-check perf-check
+.PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
+	analysis-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -37,6 +38,15 @@ perf-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_frontier.py -q
 	$(TEST_ENV) BENCH_N_1M=4000 BENCH_CACHE=0 BENCH_TELEMETRY_DIR=/tmp \
 		$(PY) bench.py --stage 1m
+
+# graftlint gate: zero non-baselined static-analysis findings on the
+# package (JAX retrace/host-sync rules + lock discipline; stdlib-ast, no
+# jax needed), then the analysis test subset — every rule's deliberate-
+# failure fixture plus the retrace_guard runtime-budget tests (tox env
+# "analysis").
+analysis-check:
+	$(PY) -m p2pnetwork_tpu.analysis p2pnetwork_tpu/
+	$(TEST_ENV) $(PY) -m pytest tests/test_analysis.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

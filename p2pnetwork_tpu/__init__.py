@@ -24,6 +24,12 @@ Failure is an injectable input on both backends too: the sim flips
 device-side masks (`sim/failures.py`), the sockets backend has a seeded
 chaos plane (`p2pnetwork_tpu.chaos`) mirroring the same API name-for-name —
 see GETTING_STARTED.md "Fault injection & chaos".
+
+Both disciplines those halves depend on — no silent retraces/host syncs in
+the sim, no blocking-under-lock or lock-order hazards in the sockets
+backend — are enforced statically by `p2pnetwork_tpu.analysis` (graftlint:
+``python -m p2pnetwork_tpu.analysis``) with a runtime ``retrace_guard``
+complement — see GETTING_STARTED.md "Static analysis & retrace budgets".
 """
 
 from p2pnetwork_tpu import chaos, telemetry, wire

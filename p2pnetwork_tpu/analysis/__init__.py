@@ -1,0 +1,41 @@
+"""graftlint: static analysis + runtime retrace budgets for this repo.
+
+Two enforcement planes for the two disciplines the repo's performance
+and liveness rest on:
+
+- **Static** (stdlib ``ast``, no jax needed): JAX retrace/host-sync rules
+  and concurrency lock-discipline rules over the source tree, with inline
+  ``# graftlint: ignore[rule-id]`` suppressions and a checked-in
+  ``baseline.json`` for grandfathered findings. CLI:
+  ``python -m p2pnetwork_tpu.analysis p2pnetwork_tpu/`` (or the
+  ``graftlint`` console script) — exit 0 means no new findings.
+
+- **Runtime**: :class:`retrace_guard` asserts a per-block jit compile
+  budget via the telemetry jaxhooks counters — the complement for
+  retraces only visible with real shapes at runtime.
+
+See GETTING_STARTED.md ("Static analysis & retrace budgets") for the rule
+table and workflows.
+"""
+
+from p2pnetwork_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    SEVERITIES,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from p2pnetwork_tpu.analysis.retrace_guard import (  # noqa: F401
+    RetraceBudgetExceeded,
+    retrace_guard,
+)
+
+__all__ = [
+    "Finding", "SEVERITIES", "all_rules", "analyze_paths", "analyze_source",
+    "apply_baseline", "default_baseline_path", "load_baseline",
+    "write_baseline", "retrace_guard", "RetraceBudgetExceeded",
+]

@@ -1,0 +1,155 @@
+"""Runtime complement to graftlint: per-block jit compile budgets.
+
+The static rules (jaxrules.py) catch retrace hazards the AST can prove;
+everything else — shape churn from data, a cache key that includes an
+unhashed ndarray id, a library upgrade that changed tracing — only shows
+up as the compile counter climbing at runtime. :class:`retrace_guard`
+turns that counter into an assertion: wrap a block, declare how many
+backend compiles it is *allowed* to cost, and breaches become exceptions
+(tests), structured warnings (benches), or a callback (drivers).
+
+Counting rides the PR-1 telemetry jaxhooks (``jax.monitoring`` duration
+events -> ``jax_compiles_total``), so a guard sees every XLA backend
+compile in the process, wherever it was triggered from. Guards therefore
+measure *process-wide* compiles during the block: run them around
+single-flow regions (a bench stage, one test body), not concurrently.
+
+Usage::
+
+    from p2pnetwork_tpu.analysis import retrace_guard
+
+    with retrace_guard("steady-state", budget=0):
+        for _ in range(100):
+            step(state)          # raises RetraceBudgetExceeded if any
+                                 # iteration recompiles
+
+    with retrace_guard("bench-1m", budget=24, on_breach="warn") as g:
+        run_stage()
+    print(g.compiles, g.breached)
+
+Without jax (sockets-only environment) the guard is an inert no-op that
+reports zero compiles — importable anywhere the linter is.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Optional, Union
+
+from p2pnetwork_tpu.telemetry.registry import Registry, default_registry
+
+__all__ = ["retrace_guard", "RetraceBudgetExceeded"]
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """A guarded block compiled more jit programs than its budget."""
+
+    def __init__(self, block: str, compiles: int, budget: int):
+        self.block = block
+        self.compiles = compiles
+        self.budget = budget
+        super().__init__(
+            f"retrace_guard[{block}]: {compiles} backend compile(s), "
+            f"budget {budget} — something inside retraces per call "
+            f"(shape churn, fresh jit wrappers, or unhashable statics)")
+
+
+class retrace_guard:
+    """Context manager asserting a compile budget over its block.
+
+    Parameters
+    ----------
+    block:
+        Label for errors, warnings and the telemetry counters
+        (``retrace_guard_compiles_total{block}`` /
+        ``retrace_guard_breaches_total{block}``).
+    budget:
+        Maximum backend compiles the block may trigger. 0 is the
+        steady-state contract: everything warm, nothing retraces.
+    registry:
+        Telemetry registry to count into (default: the process default).
+    on_breach:
+        ``"raise"`` (default) — raise :class:`RetraceBudgetExceeded`;
+        ``"warn"`` — emit a ``RuntimeWarning`` and keep going; or a
+        callable receiving the guard (benches route this into their
+        structured-warning stream). Exceptions already propagating out
+        of the block take precedence — the guard never masks them.
+    """
+
+    def __init__(self, block: str, budget: int,
+                 registry: Optional[Registry] = None,
+                 on_breach: Union[str, Callable[["retrace_guard"],
+                                                None]] = "raise"):
+        if budget < 0:
+            raise ValueError("budget must be >= 0")
+        if not (on_breach in ("raise", "warn") or callable(on_breach)):
+            raise ValueError("on_breach must be 'raise', 'warn' or callable")
+        self.block = str(block)
+        self.budget = int(budget)
+        self.on_breach = on_breach
+        self._registry = registry
+        self._start: Optional[float] = None
+        #: Backend compiles observed during the block (valid after exit).
+        self.compiles: int = 0
+        #: Whether the block exceeded its budget (valid after exit).
+        self.breached: bool = False
+        self._active = False
+
+    # ------------------------------------------------------------ helpers
+
+    def _reg(self) -> Registry:
+        return self._registry if self._registry is not None \
+            else default_registry()
+
+    def _count(self) -> Optional[float]:
+        """Current process-wide compile count, or None when jax (or its
+        monitoring hooks) is unavailable — the guard then no-ops."""
+        from p2pnetwork_tpu.telemetry import jaxhooks
+
+        if not jaxhooks.install(self._registry):
+            return None
+        return jaxhooks.compile_count(self._registry)
+
+    # ------------------------------------------------------------ protocol
+
+    def __enter__(self) -> "retrace_guard":
+        if self._active:
+            raise RuntimeError("retrace_guard is not reentrant")
+        self._active = True
+        self.compiles = 0
+        self.breached = False
+        self._start = self._count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._active = False
+        if self._start is None:
+            return False  # no jax — nothing measured, nothing enforced
+        end = self._count()
+        if end is None:
+            return False
+        self.compiles = int(end - self._start)
+        reg = self._reg()
+        reg.counter(
+            "retrace_guard_compiles_total",
+            "Backend compiles observed inside retrace_guard blocks.",
+            ("block",)).labels(self.block).inc(self.compiles)
+        self.breached = self.compiles > self.budget
+        if not self.breached:
+            return False
+        reg.counter(
+            "retrace_guard_breaches_total",
+            "retrace_guard blocks that exceeded their compile budget.",
+            ("block",)).labels(self.block).inc()
+        if exc_type is not None:
+            return False  # the block's own failure outranks the breach
+        if self.on_breach == "raise":
+            raise RetraceBudgetExceeded(self.block, self.compiles,
+                                        self.budget)
+        if self.on_breach == "warn":
+            warnings.warn(
+                f"retrace_guard[{self.block}]: {self.compiles} compile(s) "
+                f"over budget {self.budget}", RuntimeWarning, stacklevel=2)
+        else:
+            self.on_breach(self)
+        return False

@@ -324,8 +324,13 @@ class ChaosPlane:
             return self._same_side(a, b)
 
     def _same_side(self, a: str, b: str) -> bool:
-        ga = self._groups.get(a)
-        gb = self._groups.get(b)
+        # Takes the plane lock itself: besides link_ok (which already
+        # holds it — RLock, re-entry is free), this runs as _sever's
+        # predicate on the partition path, where reading _groups unlocked
+        # would race a concurrent partition()/heal_partition() swap.
+        with self._lock:
+            ga = self._groups.get(a)
+            gb = self._groups.get(b)
         return ga is None or gb is None or ga == gb
 
     def frame_fault_probs(self) -> Tuple[float, float, float]:

@@ -217,13 +217,19 @@ class CRDTNode(Node):
     def _get(self, name: str, cls):
         with self._crdt_lock:
             cur = self._crdts.get(name)
-            if cur is None:
-                cur = self._crdts[name] = cls()
-            elif not isinstance(cur, cls):
-                raise TypeError(
-                    f"CRDT {name!r} is a {type(cur).__name__}, "
-                    f"not {cls.__name__}")
-            return cur
+        if cur is None:
+            # Construct the empty CRDT outside the lock (open-call
+            # discipline); setdefault re-checks, so two racing getters
+            # agree on one instance and the loser's empty candidate —
+            # never published, never mutated — is garbage.
+            candidate = cls()
+            with self._crdt_lock:
+                cur = self._crdts.setdefault(name, candidate)
+        if not isinstance(cur, cls):
+            raise TypeError(
+                f"CRDT {name!r} is a {type(cur).__name__}, "
+                f"not {cls.__name__}")
+        return cur
 
     def gcounter(self, name: str) -> GCounter:
         return self._get(name, GCounter)
@@ -321,15 +327,31 @@ class CRDTNode(Node):
                 return
             name = data[CRDT_KEY]
             incoming = cls.from_dict(data.get("state", {}))
-            with self._crdt_lock:
-                mine = self._crdts.get(name)
-                if mine is None:
-                    mine = cls()
-                elif not isinstance(mine, cls):
-                    self.debug_print(
-                        f"CRDT kind conflict for {name!r} dropped")
-                    return
-                merged = self._crdts[name] = mine.merge(incoming)
+            # Empty-CRDT construction and I/O (debug_print) both happen
+            # outside the lock; only the check + merge-then-replace —
+            # the lost-update window the lock exists for — stay inside.
+            # The hot path (name already known) takes the lock ONCE; the
+            # first message for a name releases it, constructs the empty
+            # CRDT, and retries — the open-call shape _get() uses. A
+            # racing insert between iterations just orphans `fresh`.
+            fresh = None
+            conflict = False
+            merged = None
+            while True:
+                with self._crdt_lock:
+                    mine = self._crdts.get(name)
+                    if mine is None:
+                        mine = fresh
+                    if mine is not None:
+                        if isinstance(mine, cls):
+                            merged = self._crdts[name] = mine.merge(incoming)
+                        else:
+                            conflict = True
+                        break
+                fresh = cls()
+            if conflict:
+                self.debug_print(f"CRDT kind conflict for {name!r} dropped")
+                return
             self.crdt_merged(name, merged)
             return
         super().node_message(node, data)

@@ -95,13 +95,13 @@ def _load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        so = _compile()
+        so = _compile()  # graftlint: ignore[blocking-under-lock] -- the lock EXISTS to serialize the build-once; concurrent callers must block until the .so exists
         if so is None:
             return None
         try:
-            lib = ctypes.CDLL(str(so))
-            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib = ctypes.CDLL(str(so))  # graftlint: ignore[lock-open-call] -- same build-once critical section
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")  # graftlint: ignore[lock-open-call] -- pure ctypes type ctor
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")  # graftlint: ignore[lock-open-call] -- pure ctypes type ctor
             lib.gc_sort_pairs_i32.argtypes = [i32p, i32p, ctypes.c_int64, i32p, i32p]
             lib.gc_sort_pairs_i32.restype = None
             lib.gc_sort_unique_i64.argtypes = [i64p, ctypes.c_int64]

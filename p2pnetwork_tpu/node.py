@@ -206,9 +206,14 @@ class Node(threading.Thread):
 
         Unlike a bare ``Thread.start``, returns only once the server is
         accepting (or failed to start), so ``connect_with_node`` right
-        after ``start()`` never races the loop coming up."""
+        after ``start()`` never races the loop coming up. The wait is
+        BOUNDED: a loop that cannot come up within 30 s (interpreter
+        wedged before ``_main`` runs its first statement) raises instead
+        of hanging the caller forever."""
         super().start()
-        self._ready.wait()
+        if not self._ready.wait(timeout=30.0):
+            raise RuntimeError(
+                "Node.start: event loop did not come up within 30s")
 
     def run(self) -> None:
         """Thread body: host the node's asyncio event loop."""
@@ -360,7 +365,23 @@ class Node(threading.Thread):
         fut = asyncio.run_coroutine_threadsafe(
             self.connect_with_node_async(host, port, reconnect), loop
         )
-        return fut.result()
+        # Bounded like reconnect_nodes(): a healthy attempt legitimately
+        # spends one connect timeout on TCP establishment and one on the
+        # handshake read; an unbounded .result() would hang this caller
+        # forever on a wedged loop (e.g. a stuck user handler).
+        bound = 2.0 * self.config.connect_timeout + 1.0
+        try:
+            return fut.result(timeout=bound)
+        except concurrent.futures.TimeoutError:
+            self.event_log.record(
+                "connect_trigger_timeout", None,
+                {"host": host, "port": port, "timeout": bound})
+            self.debug_print(
+                f"connect_with_node: no result within {bound}s — event "
+                "loop busy or wedged; the attempt continues in the "
+                "background (outbound_node_connected/. .._error still fire)"
+            )
+            return False
 
     async def connect_with_node_async(self, host: str, port: int,
                                       reconnect: bool = False) -> bool:

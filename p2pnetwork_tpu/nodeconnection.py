@@ -28,6 +28,7 @@ Public surface parity:
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import threading
 import time
 from typing import Any, Optional, Tuple, Union
@@ -255,7 +256,17 @@ class NodeConnection:
             self._task = loop.create_task(self._recv_loop())
         else:
             fut = asyncio.run_coroutine_threadsafe(self._spawn(), loop)
-            fut.result()
+            # Spawning a task is queue-bounded work; if it cannot complete
+            # within the connect timeout the loop is wedged, and an
+            # unbounded wait here would wedge the caller with it.
+            timeout = self.main_node.config.connect_timeout + 1.0
+            try:
+                fut.result(timeout=timeout)
+            except concurrent.futures.TimeoutError:
+                fut.cancel()
+                raise RuntimeError(
+                    f"NodeConnection.start: owning node's event loop did "
+                    f"not schedule the receive task within {timeout}s")
 
     async def _spawn(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._recv_loop())

@@ -117,8 +117,8 @@ def pick_width(in_degrees: np.ndarray,
     best_w, best_cost = candidates[0], np.inf
     for w in candidates:
         rows = (d + w - 1) // w
-        cost = (_GATHER_CYCLES_PER_SLOT * float(rows.sum()) * w
-                + _SEGMENT_CYCLES_PER_ELEM * float(rows.sum()))
+        cost = (_GATHER_CYCLES_PER_SLOT * float(rows.sum()) * w  # graftlint: ignore[host-sync-in-loop] -- numpy-only cost model, no device values
+                + _SEGMENT_CYCLES_PER_ELEM * float(rows.sum()))  # graftlint: ignore[host-sync-in-loop] -- numpy-only cost model
         if cost < best_cost:
             best_w, best_cost = w, cost
     return best_w

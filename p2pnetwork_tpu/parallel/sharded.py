@@ -218,14 +218,14 @@ def _extract_ring_diagonals(senders, receivers, n, S, block, max_diags,
         t_b = (-q - 1) % S
         if S == 1 or t_a == t_b:
             if piece_a.any() or piece_b.any():
-                pieces.append((t_a, int(r)))
+                pieces.append((t_a, int(r)))  # graftlint: ignore[host-sync-in-loop] -- r is a host int from divmod
                 mask_rows.append(dmask)
         else:
             if piece_a.any():
-                pieces.append((t_a, int(r)))
+                pieces.append((t_a, int(r)))  # graftlint: ignore[host-sync-in-loop] -- host int
                 mask_rows.append(piece_a)
             if piece_b.any():
-                pieces.append((t_b, int(r)))
+                pieces.append((t_b, int(r)))  # graftlint: ignore[host-sync-in-loop] -- host int
                 mask_rows.append(piece_b)
     if not pieces:
         return (), None, diag_sel

@@ -63,15 +63,23 @@ class _ArrivalWindow:
             self.intervals.append(now - self.last)
         self.last = now
 
-    def mean_std(self, min_std: float):
-        if not self.intervals:
-            return None
-        m = sum(self.intervals) / len(self.intervals)
-        var = sum((x - m) ** 2 for x in self.intervals) / len(self.intervals)
-        # The floor is RELATIVE to the cadence as well as absolute: a
-        # perfectly regular 1 Hz stream must not estimate sigma ~ 0 and
-        # saturate suspicion one jitter past the mean.
-        return m, max(math.sqrt(var), 0.1 * m, min_std)
+    def snapshot(self):
+        """``(intervals tuple, last arrival)`` — copied so the estimator
+        math runs OUTSIDE the detector lock (graftlint open-call
+        discipline: hold the lock to copy, compute after release)."""
+        return tuple(self.intervals), self.last
+
+
+def _mean_std(intervals, min_std: float):
+    """Mean/stddev of an interval snapshot, or None with no data."""
+    if not intervals:
+        return None
+    m = sum(intervals) / len(intervals)
+    var = sum((x - m) ** 2 for x in intervals) / len(intervals)
+    # The floor is RELATIVE to the cadence as well as absolute: a
+    # perfectly regular 1 Hz stream must not estimate sigma ~ 0 and
+    # saturate suspicion one jitter past the mean.
+    return m, max(math.sqrt(var), 0.1 * m, min_std)
 
 
 def _phi_from(elapsed: float, mean: float, std: float) -> float:
@@ -137,6 +145,10 @@ class PhiAccrualNode(Node):
         self._arrivals: Dict[str, _ArrivalWindow] = {}
         #: peer id -> monotonic time it entered quarantine.
         self._quarantined: Dict[str, float] = {}
+        #: bumped under the lock on every quarantine-set mutation;
+        #: _publish_quarantined uses it to publish the gauge OUTSIDE the
+        #: lock without letting racing publishers strand a stale value.
+        self._quarantine_gen = 0
         # Heartbeats append on the event loop while phi()/suspected()
         # read from monitoring threads; an unguarded deque iteration
         # mid-append raises "deque mutated during iteration".
@@ -186,8 +198,10 @@ class PhiAccrualNode(Node):
             w = self._arrivals.get(peer_id)
             if w is None or w.last is None:
                 return 0.0
-            stats = w.mean_std(self.min_std)
-            last = w.last
+            intervals, last = w.snapshot()
+        # Estimator math runs outside the lock on the copied window, so a
+        # hundred-peer suspicion sweep never stalls the heartbeat path.
+        stats = _mean_std(intervals, self.min_std)
         if stats is None:
             return 0.0
         now = time.monotonic() if now is None else now
@@ -261,9 +275,12 @@ class PhiAccrualNode(Node):
             else:
                 if self._quarantined.pop(peer_id, None) is None:
                     return False
-            # Published under the lock so concurrent transitions cannot
-            # land their counts out of order and strand a stale gauge.
-            self._m_quarantined.set(len(self._quarantined))
+            self._quarantine_gen += 1
+        # Gauge publication happens OUTSIDE the lock (the metric takes its
+        # own lock — graftlint's open-call discipline); the generation
+        # protocol in _publish_quarantined keeps racing publishers from
+        # stranding a stale count.
+        self._publish_quarantined()
         self._m_transitions.labels(self.id, transition).inc()
         event = {"quarantine": "node_quarantined",
                  "readmit": "node_readmitted",
@@ -271,6 +288,23 @@ class PhiAccrualNode(Node):
         self.debug_print(f"{event}: {peer_id}")
         self._dispatch(event, None, {"peer": peer_id})
         return True
+
+    def _publish_quarantined(self) -> None:
+        """Publish the quarantined-peer count without holding the detector
+        lock across the metric call. Snapshot (count, generation) under
+        the lock, set the gauge outside it, and re-check the generation:
+        whichever publisher observes the final generation also publishes
+        the final count, so interleaved publishers cannot strand a stale
+        gauge — the property the old set-under-the-lock bought, without
+        nesting the metric's lock under ours."""
+        while True:
+            with self._phi_lock:
+                gen = self._quarantine_gen
+                count = len(self._quarantined)
+            self._m_quarantined.set(count)
+            with self._phi_lock:
+                if self._quarantine_gen == gen:
+                    return
 
     def send_to_nodes(self, data, exclude=None, compression="none") -> None:
         """Broadcast excluding quarantined peers: a suspected-degrading
@@ -310,7 +344,8 @@ class PhiAccrualNode(Node):
         with self._phi_lock:
             self._arrivals.pop(node.id, None)
             self._quarantined.pop(node.id, None)
-            self._m_quarantined.set(len(self._quarantined))
+            self._quarantine_gen += 1
+        self._publish_quarantined()
         # Prune (not zero) the gauge: a departed peer must not leave a
         # forever-sample behind — under churn that cardinality only grows.
         self._m_phi.remove(self.id, node.id)

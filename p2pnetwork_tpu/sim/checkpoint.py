@@ -355,10 +355,13 @@ def save_graph(path: str, graph) -> None:
         "max_in_span": graph.max_in_span,
         "max_out_span": graph.max_out_span,
     }
-    for name in _GRAPH_ARRAYS:
-        v = getattr(graph, name)
-        if v is not None:
-            payload[name] = np.asarray(jax.device_get(v))
+    # One pytree transfer for every present array rather than a
+    # device_get per field: device_get batches the whole dict into a
+    # single device->host round trip (graftlint host-sync-in-loop).
+    present = {name: getattr(graph, name) for name in _GRAPH_ARRAYS
+               if getattr(graph, name) is not None}
+    payload.update({name: np.asarray(v)
+                    for name, v in jax.device_get(present).items()})
     if graph.blocked is not None:
         meta["blocked_block"] = graph.blocked.block
         payload["blocked_src"] = np.asarray(jax.device_get(graph.blocked.src))

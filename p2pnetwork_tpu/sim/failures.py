@@ -277,7 +277,7 @@ def partition(graph: Graph, groups) -> Graph:
     use node failures or rebuild (see :func:`with_edge_liveness`)."""
     side = np.full(graph.n_nodes_padded, -1, dtype=np.int64)
     for gi, group in enumerate(groups):
-        ids = np.asarray(group, dtype=np.int64)
+        ids = np.asarray(group, dtype=np.int64)  # graftlint: ignore[host-sync-in-loop] -- groups are host-side id lists, never device arrays
         _check_ids_in_range(ids, graph.n_nodes_padded, "node")
         side[ids] = gi
     _count_injected("partition")

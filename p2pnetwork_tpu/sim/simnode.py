@@ -266,9 +266,9 @@ class JaxSimNode(Node):
             )
         host_stats = {k: np.asarray(v) for k, v in stats.items()}
         for r in range(rounds):
-            round_stats = {k: host_stats[k][r].item() for k in host_stats}
+            round_stats = {k: host_stats[k][r].item() for k in host_stats}  # graftlint: ignore[host-sync-in-loop] -- host_stats is numpy (one transfer above the loop)
             if "messages" in round_stats:
-                self.sim_message_count += int(round_stats["messages"])
+                self.sim_message_count += int(round_stats["messages"])  # graftlint: ignore[host-sync-in-loop] -- already a Python scalar
             self.sim_round += 1
             self.node_message(self.sim_peer, {"sim_round": self.sim_round, **round_stats})
         return host_stats

@@ -42,10 +42,12 @@ def _on_event_duration(event: str, duration: float, **kw) -> None:
     if stage.endswith("_duration"):
         stage = stage[: -len("_duration")]
     with _lock:
-        # None subscribes "whatever the process default is NOW", so a test
-        # that swaps the default registry keeps receiving compile events.
-        targets = {default_registry() if r is None else r
-                   for r in _registries}
+        subscribed = list(_registries)
+    # Resolved OUTSIDE the lock: default_registry() takes the registry
+    # module's own lock (open-call discipline — no nesting). None
+    # subscribes "whatever the process default is NOW", so a test that
+    # swaps the default registry keeps receiving compile events.
+    targets = {default_registry() if r is None else r for r in subscribed}
     for reg in targets:
         reg.counter(
             "jax_compile_seconds_total",
@@ -73,7 +75,12 @@ def install(registry: Optional[Registry] = None) -> bool:
     with _lock:
         if not _listener_registered:
             try:
-                monitoring.register_event_duration_secs_listener(
+                # Registration must be atomic with the flag: two racing
+                # installs outside the lock would double-register and
+                # double-count every compile. jax.monitoring appends to a
+                # plain list without locks of its own, so the nesting is
+                # acyclic by construction.
+                monitoring.register_event_duration_secs_listener(  # graftlint: ignore[lock-open-call]
                     _on_event_duration)
             except Exception:
                 return False

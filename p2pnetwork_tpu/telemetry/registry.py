@@ -180,9 +180,15 @@ class _Metric:
                 f"{self.name} expects labels {self.labelnames}, got {values}")
         with self._lock:
             child = self._children.get(values)
-            if child is None:
-                child = self._children[values] = self._child_cls(self, values)
-            return child
+        if child is None:
+            # Construct OUTSIDE the lock (a subclass child __init__ is
+            # foreign code — open-call discipline); setdefault re-checks,
+            # so two racing creators agree on one child and the loser's
+            # never-published candidate is garbage.
+            candidate = self._child_cls(self, values)
+            with self._lock:
+                child = self._children.setdefault(values, candidate)
+        return child
 
     def _anon(self) -> _Child:
         if self.labelnames:
@@ -292,9 +298,14 @@ class Registry:
         labelnames = tuple(labelnames)
         with self._lock:
             m = self._metrics.get(name)
-            if m is None:
-                m = self._metrics[name] = cls(name, help, labelnames, **kw)
-                return m
+        if m is None:
+            # Construct outside the lock — metric __init__ validates and
+            # allocates (open-call discipline) — then commit atomically;
+            # a racing registrant's candidate loses to setdefault and the
+            # shared checks below validate against the winner.
+            candidate = cls(name, help, labelnames, **kw)
+            with self._lock:
+                m = self._metrics.setdefault(name, candidate)
         if not isinstance(m, cls):
             raise ValueError(
                 f"{name} already registered as a {m.kind}, not a {cls.kind}")
@@ -387,7 +398,12 @@ _default_lock = threading.Lock()
 def default_registry() -> Registry:
     """The process-wide registry every instrumentation site reports to
     unless handed an explicit one."""
-    return _default
+    # Read under the same lock that guards the swap: a torn read is not
+    # actually possible for one reference, but the asymmetric discipline
+    # (guarded write, bare read) is exactly what rots under refactoring —
+    # and what graftlint's lock-guard rule flags.
+    with _default_lock:
+        return _default
 
 
 def set_default_registry(registry: Registry) -> Registry:

@@ -103,7 +103,7 @@ def run_traced(
     for i in range(rounds):
         rec = {"label": label, "round": i}
         for k, v in host_stats.items():
-            rec[k] = float(v[i])
+            rec[k] = float(v[i])  # graftlint: ignore[host-sync-in-loop] -- host_stats is numpy (single transfer above)
         records.append(rec)
     summary = {
         "label": label,

@@ -30,6 +30,16 @@ apply_platform_env()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Registered here (not only in pyproject) so ad-hoc invocations that
+    # bypass pyproject's ini options stay warning-clean in tier-1:
+    # `-m analysis` selects the graftlint static-analysis suite.
+    config.addinivalue_line(
+        "markers",
+        "analysis: graftlint static-analysis + retrace_guard tests "
+        "(select with -m analysis; part of the default tier-1 run)")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Bound the live compiled-program count across the suite.

@@ -1,0 +1,1104 @@
+"""graftlint (p2pnetwork_tpu/analysis/) tests.
+
+Three layers, mirroring the analyzer's contract:
+
+- **rule fixtures** — for every rule, a snippet that deliberately
+  deadlocks / host-syncs / retraces, asserting the rule fires at the
+  exact ``file:line`` (and a negative twin asserting the disciplined
+  variant stays clean);
+- **engine machinery** — suppressions, baseline round-trip (including
+  line-number drift, which must NOT churn the baseline), CLI exit codes;
+- **the live tree** — ``p2pnetwork_tpu/`` must have zero non-baselined
+  findings: the CI gate this suite keeps honest;
+
+plus the runtime complement: ``retrace_guard`` must demonstrably catch an
+intentionally re-jitting loop and stay silent on a warm one.
+"""
+
+import json
+import os
+import textwrap
+import warnings
+
+import pytest
+
+from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu.analysis import (
+    RetraceBudgetExceeded,
+    analyze_paths,
+    analyze_source,
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    retrace_guard,
+    write_baseline,
+)
+from p2pnetwork_tpu.analysis import core
+from p2pnetwork_tpu.analysis.__main__ import main as graftlint_main
+
+pytestmark = pytest.mark.analysis
+
+
+def lint(source, path="snippet.py", **kw):
+    return analyze_source(textwrap.dedent(source), path=path, **kw)
+
+
+def line_of(source, needle, which=0):
+    """1-based line number of the ``which``-th line containing ``needle``."""
+    hits = [i for i, ln in enumerate(textwrap.dedent(source).splitlines(), 1)
+            if needle in ln]
+    return hits[which]
+
+
+def only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def assert_fires(source, rule, needle, which=0, path="snippet.py"):
+    findings = only(lint(source, path=path), rule)
+    assert findings, f"{rule} did not fire"
+    expected = line_of(source, needle, which)
+    lines = [f.line for f in findings]
+    assert expected in lines, (
+        f"{rule} fired at lines {lines}, expected {path}:{expected}")
+    for f in findings:
+        assert f.file == path
+    return findings
+
+
+# ===================================================== JAX rule fixtures
+
+
+class TestJaxRules:
+    def test_jit_in_loop_fires_at_line(self):
+        src = """
+            import jax
+
+            def drive(xs):
+                out = []
+                for x in xs:
+                    out.append(jax.jit(lambda v: v + 1)(x))  # HOT
+                return out
+        """
+        assert_fires(src, "jit-in-loop", "HOT")
+
+    def test_jit_in_nested_loops_is_one_finding(self):
+        # A call nested in two loops is walked once per enclosing loop;
+        # the rule must still report it once — duplicates inflate counts
+        # and bake a count=2 budget into --write-baseline.
+        src = """
+            import jax
+
+            def drive(rows):
+                out = []
+                for row in rows:
+                    for x in row:
+                        out.append(jax.jit(lambda v: v + 1)(x))  # HOT
+                return out
+        """
+        assert len(only(lint(src), "jit-in-loop")) == 1
+        assert_fires(src, "jit-in-loop", "HOT")
+
+    def test_jit_hoisted_out_of_loop_is_clean(self):
+        src = """
+            import jax
+
+            step = jax.jit(lambda v: v + 1)
+
+            def drive(xs):
+                return [step(x) for x in xs]
+        """
+        assert not only(lint(src), "jit-in-loop")
+
+    def test_jit_immediate_call_fires_at_line(self):
+        src = """
+            import jax
+
+            def f(x):
+                return x
+
+            y = jax.jit(f)(3)  # HOT
+        """
+        assert_fires(src, "jit-immediate-call", "HOT")
+
+    def test_partial_jit_wrapping_is_not_immediate_call(self):
+        # partial(jax.jit, ...)(fn) CONSTRUCTS the jitted function — the
+        # engine's loop-variant pattern must not be flagged.
+        src = """
+            import functools
+            import jax
+
+            def f(state, n):
+                return state
+
+            f_jit = functools.partial(jax.jit, static_argnames=("n",))(f)
+        """
+        assert not only(lint(src), "jit-immediate-call")
+
+    @pytest.mark.parametrize("stmt, needle", [
+        ("total += x.item()", ".item()"),
+        ("host = jax.device_get(x)", "device_get"),
+        ("total += float(x)", "float(x)"),
+        ("buf = np.asarray(x)", "np.asarray"),
+    ])
+    def test_host_sync_in_loop_forms(self, stmt, needle):
+        src = f"""
+            import jax
+            import numpy as np
+
+            def drive(xs):
+                total = 0
+                for x in xs:
+                    {stmt}  # HOT
+                return total
+        """
+        assert_fires(src, "host-sync-in-loop", "HOT")
+
+    def test_host_sync_outside_loop_is_clean(self):
+        src = """
+            import jax
+
+            def summarize(x):
+                return x.item()
+        """
+        assert not only(lint(src), "host-sync-in-loop")
+
+    def test_host_sync_needs_jax_import(self):
+        src = """
+            def drive(xs):
+                return [float(x) for x in xs]
+
+            def loop(xs):
+                t = 0
+                for x in xs:
+                    t += float(x)
+                return t
+        """
+        assert not only(lint(src), "host-sync-in-loop")
+
+    def test_tracer_branch_fires_through_assignment(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x + 1
+                if y > 0:  # HOT
+                    return y
+                return x
+        """
+        findings = assert_fires(src, "tracer-branch", "HOT")
+        assert "'y'" in findings[0].message
+
+    def test_tracer_branch_on_shape_is_clean(self):
+        src = """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if mode == "fast":      # static arg: fine
+                    return x
+                if x.shape[0] > 4:      # shape: trace-time constant
+                    return x * 2
+                while len(x) > 0:       # len: static for arrays
+                    return x
+                return x
+        """
+        assert not only(lint(src), "tracer-branch")
+
+    def test_jit_static_array_default_fires(self):
+        src = """
+            import functools
+            import jax
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnames=("weights",))
+            def f(x, weights=np.ones(4)):  # HOT
+                return x
+        """
+        assert_fires(src, "jit-static-array", "HOT")
+
+    def test_jit_static_hashable_arg_is_clean(self):
+        src = """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, k=4):
+                return x * k
+        """
+        assert not only(lint(src), "jit-static-array")
+
+    def test_jit_closure_ndarray_fires(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def build():
+                table = np.arange(8)
+
+                def inner(x):
+                    return x + table
+
+                return jax.jit(inner)  # HOT
+        """
+        findings = assert_fires(src, "jit-closure-ndarray", "HOT")
+        assert "table" in findings[0].message
+
+    def test_jit_closure_passing_array_as_arg_is_clean(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def build():
+                table = np.arange(8)
+
+                def inner(x, table):
+                    return x + table
+
+                return jax.jit(inner), table
+        """
+        assert not only(lint(src), "jit-closure-ndarray")
+
+    def test_f64_literal_forms(self):
+        src = """
+            import jax.numpy as jnp
+
+            x = jnp.zeros(4, dtype=jnp.float64)   # HOT-ATTR
+            y = jnp.arange(3, dtype="float64")    # HOT-STR
+        """
+        assert_fires(src, "f64-literal", "HOT-ATTR")
+        assert_fires(src, "f64-literal", "HOT-STR")
+
+    def test_carry_no_donate_decorator_form(self):
+        src = """
+            import functools
+            import jax
+            from jax import lax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def run(state, n):  # HOT
+                def cond(c):
+                    return c.sum() < n
+
+                def body(c):
+                    return c + 1
+
+                return lax.while_loop(cond, body, state)
+        """
+        assert_fires(src, "carry-no-donate", "HOT")
+
+    def test_carry_no_donate_call_form(self):
+        src = """
+            import jax
+            from jax import lax
+
+            def run(state, n):
+                return lax.while_loop(lambda c: c[1] < n,
+                                      lambda c: (c[0], c[1] + 1), state)
+
+            run_jit = jax.jit(run, static_argnames=("n",))  # HOT
+        """
+        assert_fires(src, "carry-no-donate", "HOT")
+
+    def test_jit_immediate_call_arg_is_not_carry_target(self):
+        # In `jax.jit(f)(state)` the outer call's argument is RUNTIME
+        # data, not a function being wrapped — even when its name happens
+        # to match a loop-carrying module function, carry-no-donate must
+        # not fire on the call site (jit-immediate-call owns that shape).
+        src = """
+            import jax
+            from jax import lax
+
+            def state(carry, xs):
+                def step(c, x):
+                    return c + x, x
+                return lax.scan(step, carry, xs)
+
+            def drive(f, xs):
+                return jax.jit(f)(state)
+        """
+        assert not only(lint(src), "carry-no-donate")
+
+    def test_carry_donated_or_internal_is_clean(self):
+        src = """
+            import functools
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            @functools.partial(jax.jit, donate_argnames=("state",))
+            def donated(state):
+                return lax.while_loop(lambda c: c.sum() < 3,
+                                      lambda c: c + 1, state)
+
+            @jax.jit
+            def internal(n):
+                # Carry built inside the function: donation of arguments
+                # has nothing to recycle — must not be flagged.
+                carry = jnp.zeros(8)
+                return lax.while_loop(lambda c: c.sum() < 3,
+                                      lambda c: c + 1, carry)
+        """
+        assert not only(lint(src), "carry-no-donate")
+
+
+# ============================================= concurrency rule fixtures
+
+
+class TestConcurrencyRules:
+    def test_lock_order_cycle_fires(self):
+        src = """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:  # HOT
+                        pass
+        """
+        findings = only(lint(src), "lock-order-cycle")
+        assert findings, "cycle not detected"
+        assert any("a -> b -> a" in f.message or "b -> a -> b" in f.message
+                   for f in findings)
+
+    def test_consistent_lock_order_is_clean(self):
+        src = """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+
+            def two():
+                with a:
+                    with b:
+                        pass
+        """
+        assert not only(lint(src), "lock-order-cycle")
+
+    def test_nonreentrant_self_deadlock_via_call(self):
+        src = """
+            import threading
+
+            L = threading.Lock()
+
+            def outer():
+                with L:
+                    inner()  # HOT
+
+            def inner():
+                with L:
+                    pass
+        """
+        findings = assert_fires(src, "lock-order-cycle", "HOT")
+        assert "re-acquired" in findings[0].message
+
+    def test_rlock_reentry_is_clean(self):
+        src = """
+            import threading
+
+            L = threading.RLock()
+
+            def outer():
+                with L:
+                    inner()
+
+            def inner():
+                with L:
+                    pass
+        """
+        assert not only(lint(src), "lock-order-cycle")
+
+    def test_blocking_under_lock_direct(self):
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    time.sleep(1)  # HOT
+        """
+        assert_fires(src, "blocking-under-lock", "HOT")
+
+    def test_blocking_under_lock_through_call_edge(self):
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def helper():
+                time.sleep(0.1)
+
+            def f():
+                with L:
+                    helper()  # HOT
+        """
+        findings = assert_fires(src, "blocking-under-lock", "HOT")
+        assert "helper" in findings[0].message
+
+    def test_blocking_outside_lock_is_clean(self):
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    n = 1
+                time.sleep(n)
+        """
+        assert not only(lint(src), "blocking-under-lock")
+
+    def test_untimed_queue_get_under_lock(self):
+        src = """
+            import queue
+            import threading
+
+            L = threading.Lock()
+            work_queue = queue.Queue()
+
+            def f():
+                with L:
+                    item = work_queue.get()  # HOT
+                return item
+        """
+        assert_fires(src, "blocking-under-lock", "HOT")
+
+    def test_lock_across_await_fires(self):
+        src = """
+            import threading
+
+            L = threading.Lock()
+
+            async def f(peer):
+                with L:
+                    await peer.flush()  # HOT
+        """
+        assert_fires(src, "lock-across-await", "HOT")
+
+    def test_copy_then_await_is_clean(self):
+        src = """
+            import threading
+
+            L = threading.Lock()
+            items = []
+
+            async def f(peer):
+                with L:
+                    snapshot = list(items)
+                await peer.send(snapshot)
+        """
+        assert not only(lint(src), "lock-across-await")
+
+    def test_async_blocking_call_fires(self):
+        src = """
+            import time
+
+            async def f():
+                time.sleep(1)  # HOT
+        """
+        assert_fires(src, "async-blocking-call", "HOT")
+
+    def test_awaited_asyncio_wait_is_clean(self):
+        src = """
+            import asyncio
+
+            async def f(ev):
+                await asyncio.wait_for(ev.wait(), timeout=2.0)
+                await asyncio.sleep(0.1)
+        """
+        assert not only(lint(src), "async-blocking-call")
+
+    def test_async_blocking_through_call_edge(self):
+        src = """
+            import time
+
+            def helper():
+                time.sleep(0.5)
+
+            async def f():
+                helper()  # HOT
+        """
+        assert_fires(src, "async-blocking-call", "HOT")
+
+    def test_lock_guard_class_attr_fires(self):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    return self._items.get(k)  # HOT
+        """
+        findings = assert_fires(src, "lock-guard", "HOT")
+        assert "_items" in findings[0].message
+
+    def test_lock_guard_consistent_class_is_clean(self):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._items[k] = v
+
+                def peek(self, k):
+                    with self._lock:
+                        return self._items.get(k)
+        """
+        assert not only(lint(src), "lock-guard")
+
+    def test_lock_guard_module_global_fires(self):
+        src = """
+            import threading
+
+            _lock = threading.Lock()
+            _state = {}
+
+            def set_state(s):
+                global _state
+                with _lock:
+                    _state = s
+
+            def get_state():
+                return _state  # HOT
+        """
+        assert_fires(src, "lock-guard", "HOT")
+
+    def test_lock_open_call_fires(self):
+        src = """
+            import threading
+
+            class Pub:
+                def __init__(self, sink):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._sink = sink
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+                        self._sink.publish(self._n)  # HOT
+        """
+        findings = assert_fires(src, "lock-open-call", "HOT")
+        assert "_sink.publish" in findings[0].message
+
+    def test_lock_open_call_names_derived_receiver(self):
+        # `mine = self._crdts.get(name); mine.merge(x)` must be reported
+        # as a call on `mine` (derived from self._crdts), not as
+        # `self._crdts.merge()` — a method the container doesn't have.
+        src = """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._crdts = {}
+
+                def absorb(self, name, incoming):
+                    with self._lock:
+                        mine = self._crdts.get(name)
+                        merged = mine.merge(incoming)  # HOT
+        """
+        findings = assert_fires(src, "lock-open-call", "HOT")
+        assert "mine.merge()" in findings[0].message
+        assert "derived from self._crdts" in findings[0].message
+        assert "self._crdts.merge" not in findings[0].message
+
+    def test_lock_open_call_copy_then_call_is_clean(self):
+        src = """
+            import threading
+
+            class Pub:
+                def __init__(self, sink):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._sink = sink
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+                        n = self._n
+                    self._sink.publish(n)
+        """
+        assert not only(lint(src), "lock-open-call")
+
+    def test_wait_untimed_fires_and_timed_is_clean(self):
+        src = """
+            def bad(ev):
+                ev.wait()  # HOT
+
+            def good(ev):
+                return ev.wait(5.0)
+        """
+        findings = assert_fires(src, "wait-untimed", "HOT")
+        assert len(findings) == 1
+
+    def test_wait_untimed_result_and_join(self):
+        src = """
+            def bad(fut, thread):
+                fut.result()    # HOT-RESULT
+                thread.join()   # HOT-JOIN
+
+            def fine(parts):
+                return ",".join(parts)
+        """
+        assert_fires(src, "wait-untimed", "HOT-RESULT")
+        assert_fires(src, "wait-untimed", "HOT-JOIN")
+        findings = only(lint(src), "wait-untimed")
+        assert len(findings) == 2  # str.join(args) untouched
+
+
+# ======================================================= engine machinery
+
+
+class TestEngine:
+    BLOCKING = """
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def f():
+            with L:
+                time.sleep(1){suffix}
+    """
+
+    def test_inline_suppression_silences_one_rule(self):
+        src = self.BLOCKING.format(
+            suffix="  # graftlint: ignore[blocking-under-lock] -- test")
+        assert not only(lint(src), "blocking-under-lock")
+
+    def test_bare_suppression_silences_all_rules(self):
+        src = self.BLOCKING.format(suffix="  # graftlint: ignore")
+        assert not lint(src)
+
+    def test_suppression_does_not_leak_to_other_lines(self):
+        src = textwrap.dedent(self.BLOCKING.format(suffix=""))
+        src += textwrap.dedent("""
+            def g():
+                with L:
+                    time.sleep(2)  # graftlint: ignore[blocking-under-lock]
+        """)
+        findings = only(lint(src), "blocking-under-lock")
+        assert len(findings) == 1
+        assert findings[0].line == line_of(src, "time.sleep(1)")
+
+    def test_no_suppressions_mode_reports_everything(self):
+        src = self.BLOCKING.format(suffix="  # graftlint: ignore")
+        assert only(lint(src, respect_suppressions=False),
+                    "blocking-under-lock")
+
+    def test_standalone_comment_does_not_silence_enclosing_block(self):
+        # A marker on its own comment line between statements must not
+        # map to the whole enclosing function — that would let one stray
+        # comment swallow every later finding in it (silent P0 false
+        # negatives behind a green gate).
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f(ev):
+                # graftlint: ignore -- stray comment, binds to nothing
+                ev.wait()  # HOT-WAIT
+                with L:
+                    time.sleep(1)  # HOT-SLEEP
+        """
+        assert_fires(src, "wait-untimed", "HOT-WAIT")
+        assert_fires(src, "blocking-under-lock", "HOT-SLEEP")
+
+    def test_header_suppression_covers_header_not_body(self):
+        # On a compound statement's header line the marker covers the
+        # header (e.g. a with-expression finding) but not the body.
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f(ev):
+                with L:  # graftlint: ignore[blocking-under-lock] -- t
+                    time.sleep(1)  # HOT
+        """
+        assert_fires(src, "blocking-under-lock", "HOT")
+
+    def test_unknown_rule_in_suppression_does_not_silence(self):
+        src = self.BLOCKING.format(
+            suffix="  # graftlint: ignore[some-other-rule]")
+        assert only(lint(src), "blocking-under-lock")
+
+    def test_every_rule_has_fixture_coverage(self):
+        # The rule registry and this test file must move together: a new
+        # rule without a deliberate-failure fixture is untested policy.
+        expected = {
+            "jit-in-loop", "jit-immediate-call", "host-sync-in-loop",
+            "tracer-branch", "jit-static-array", "jit-closure-ndarray",
+            "f64-literal", "carry-no-donate",
+            "lock-order-cycle", "lock-across-await", "blocking-under-lock",
+            "async-blocking-call", "lock-guard", "lock-open-call",
+            "wait-untimed",
+        }
+        assert set(all_rules()) == expected
+
+    def _tree(self, tmp_path, source):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent(source))
+        return f
+
+    def test_baseline_roundtrip_and_line_drift(self, tmp_path):
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    time.sleep(1)
+        """
+        self._tree(tmp_path, src)
+        modules = {}
+        findings = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                                 collect_sources=modules)
+        assert findings
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, modules, str(bl_path))
+        baseline = load_baseline(str(bl_path))
+        new, old = apply_baseline(findings, modules, baseline)
+        assert new == [] and len(old) == len(findings)
+
+        # Drift the line numbers: the baseline must still absorb the
+        # findings (fingerprints key on source text, not line numbers).
+        drifted = "# a new leading comment\n\n" + textwrap.dedent(src)
+        (tmp_path / "mod.py").write_text(drifted)
+        modules2 = {}
+        findings2 = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                                  collect_sources=modules2)
+        new2, old2 = apply_baseline(findings2, modules2, baseline)
+        assert new2 == [] and len(old2) == len(findings2)
+
+    def test_baseline_does_not_absorb_new_duplicates(self, tmp_path):
+        src = """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    time.sleep(1)
+        """
+        self._tree(tmp_path, src)
+        modules = {}
+        findings = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                                 collect_sources=modules)
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, modules, str(bl_path))
+
+        # A second, NEW copy of the same offending line must not ride in
+        # on the old entry's fingerprint.
+        doubled = textwrap.dedent(src) + textwrap.dedent("""
+            def g():
+                with L:
+                    time.sleep(1)
+        """)
+        (tmp_path / "mod.py").write_text(doubled)
+        modules2 = {}
+        findings2 = analyze_paths([str(tmp_path)], root=str(tmp_path),
+                                  collect_sources=modules2)
+        new2, old2 = apply_baseline(findings2, modules2,
+                                    load_baseline(str(bl_path)))
+        assert len(old2) == len(findings)
+        assert len(new2) == len(findings2) - len(findings)
+
+    def test_parse_error_is_a_finding_not_a_crash(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def broken(:\n")
+        findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+        assert [f.rule for f in findings] == ["parse-error"]
+
+    def test_cli_exit_codes(self, tmp_path, capsys, monkeypatch):
+        self._tree(tmp_path, """
+            import threading
+            import time
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    time.sleep(1)
+        """)
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        assert graftlint_main(["mod.py", "--baseline", str(bl)]) == 1
+        out = capsys.readouterr().out
+        assert "blocking-under-lock" in out and "mod.py:" in out
+
+        assert graftlint_main(["mod.py", "--baseline", str(bl),
+                               "--write-baseline"]) == 0
+        assert graftlint_main(["mod.py", "--baseline", str(bl)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_cli_json_output(self, tmp_path, monkeypatch, capsys):
+        self._tree(tmp_path, """
+            def bad(ev):
+                ev.wait()
+        """)
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        rc = graftlint_main(["mod.py", "--json", "--baseline", str(bl)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1 and doc["ok"] is False
+        assert doc["findings"][0]["rule"] == "wait-untimed"
+        assert doc["findings"][0]["file"] == "mod.py"
+
+    def test_cli_list_rules(self, capsys):
+        assert graftlint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("lock-order-cycle", "tracer-branch"):
+            assert rule in out
+
+    def test_suppression_covers_multiline_statement(self):
+        # The marker may sit on a continuation line of the statement the
+        # finding anchors to — the documented "inside the flagged
+        # statement" contract.
+        src = """
+            import jax
+
+            def drive(xs):
+                out = []
+                for x in xs:
+                    out.append(jax.device_get(
+                        x))  # graftlint: ignore[host-sync-in-loop] -- t
+                return out
+        """
+        assert not only(lint(src), "host-sync-in-loop")
+
+    def test_null_byte_file_is_a_finding_not_a_crash(self, tmp_path):
+        (tmp_path / "nul.py").write_bytes(b"x = 1\x00\n")
+        findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+        assert [f.rule for f in findings] == ["parse-error"]
+
+    def test_write_baseline_refuses_filtered_runs(self, tmp_path,
+                                                  monkeypatch):
+        self._tree(tmp_path, "def f(ev):\n    ev.wait()\n")
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        rc = graftlint_main(["mod.py", "--baseline", str(bl),
+                             "--rules", "wait-untimed",
+                             "--write-baseline"])
+        assert rc == 2 and not bl.exists()
+
+    def test_missing_path_is_exit_2_not_clean(self, tmp_path, monkeypatch,
+                                              capsys):
+        # A typo'd target must not analyze zero files and exit 0 — that
+        # would permanently disable the gate with a green check.
+        monkeypatch.chdir(tmp_path)
+        rc = graftlint_main(["no_such_dir_xyz",
+                             "--baseline", str(tmp_path / "bl.json")])
+        assert rc == 2
+        assert "no such file" in capsys.readouterr().err
+        with pytest.raises(FileNotFoundError):
+            analyze_paths([str(tmp_path / "missing.py")])
+
+    def test_root_resolves_to_repo_root_from_subdir(self, monkeypatch):
+        # Running from a subdirectory of the checkout must key files
+        # exactly as the checked-in baseline does (repo-root-relative),
+        # or grandfathered findings report as new.
+        from p2pnetwork_tpu import analysis
+        from p2pnetwork_tpu.analysis.__main__ import _resolve_root
+        pkg_dir = os.path.dirname(os.path.abspath(analysis.__file__))
+        repo_root = os.path.dirname(os.path.dirname(pkg_dir))
+        monkeypatch.chdir(pkg_dir)
+        assert _resolve_root(None, ["core.py"]) == repo_root
+
+    def test_write_baseline_path_subset_keeps_other_files(self, tmp_path,
+                                                          monkeypatch):
+        # `--write-baseline <subset>` must preserve grandfathered entries
+        # for files outside the subset — otherwise a narrow regeneration
+        # silently un-grandfathers the rest of the tree and the next full
+        # gate fails on findings nobody introduced.
+        (tmp_path / "a.py").write_text("def f(ev):\n    ev.wait()\n")
+        (tmp_path / "b.py").write_text("def g(ev):\n    ev.wait()\n")
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        assert graftlint_main(["a.py", "b.py", "--baseline", str(bl),
+                               "--write-baseline"]) == 0
+        assert graftlint_main(["a.py", "b.py", "--baseline", str(bl)]) == 0
+        # Regenerate from a.py alone: b.py's entry must survive.
+        assert graftlint_main(["a.py", "--baseline", str(bl),
+                               "--write-baseline"]) == 0
+        assert graftlint_main(["a.py", "b.py", "--baseline", str(bl)]) == 0
+        files = {e["file"] for e in
+                 json.loads(bl.read_text())["findings"]}
+        assert files == {"a.py", "b.py"}
+        # ...while a fixed analyzed file still shrinks the baseline.
+        (tmp_path / "a.py").write_text("def f(ev):\n    ev.wait(1.0)\n")
+        assert graftlint_main(["a.py", "--baseline", str(bl),
+                               "--write-baseline"]) == 0
+        files = {e["file"] for e in
+                 json.loads(bl.read_text())["findings"]}
+        assert files == {"b.py"}
+
+    def test_no_suppressions_audit_keeps_exit_code(self, tmp_path,
+                                                   monkeypatch, capsys):
+        self._tree(tmp_path, """
+            def f(ev):
+                ev.wait()  # graftlint: ignore[wait-untimed] -- test
+        """)
+        monkeypatch.chdir(tmp_path)
+        bl = tmp_path / "bl.json"
+        assert graftlint_main(["mod.py", "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+        # Audit mode shows the suppressed finding but must not gate on it.
+        assert graftlint_main(["mod.py", "--baseline", str(bl),
+                               "--no-suppressions"]) == 0
+        out = capsys.readouterr().out
+        assert "suppressed finding" in out and "wait-untimed" in out
+
+    def test_gate_matches_baseline_from_any_cwd(self, tmp_path,
+                                                monkeypatch, capsys):
+        # The installed `graftlint` script runs from arbitrary
+        # directories; relative baseline paths must still resolve.
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        monkeypatch.chdir(tmp_path)
+        rc = graftlint_main([os.path.join(repo, "p2pnetwork_tpu")])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+
+
+# ======================================================== the live tree
+
+
+class TestLiveTree:
+    def test_package_has_zero_nonbaselined_findings(self):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        modules = {}
+        findings = analyze_paths(
+            [os.path.join(repo, "p2pnetwork_tpu")], root=repo,
+            collect_sources=modules)
+        new, _ = apply_baseline(findings, modules, load_baseline())
+        assert new == [], "\n".join(f.render() for f in new)
+
+    def test_checked_in_baseline_is_not_stale(self):
+        # Every baseline entry must still correspond to a real finding —
+        # fixed findings must leave the baseline (regenerate with
+        # --write-baseline) or the gate slowly goes blind.
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        modules = {}
+        findings = analyze_paths(
+            [os.path.join(repo, "p2pnetwork_tpu")], root=repo,
+            collect_sources=modules)
+        baseline = load_baseline()
+        _, grandfathered = apply_baseline(findings, modules, baseline)
+        assert len(grandfathered) == sum(baseline.values()), (
+            "baseline over-claims: regenerate with --write-baseline")
+
+
+# ======================================================== retrace_guard
+
+
+class TestRetraceGuard:
+    def test_catches_intentionally_rejitting_loop(self):
+        import jax
+        import jax.numpy as jnp
+
+        reg = telemetry.Registry()
+        with pytest.raises(RetraceBudgetExceeded) as exc:
+            with retrace_guard("rejit", budget=2, registry=reg):
+                for i in range(5):
+                    # A FRESH jit wrapper per iteration: the compile
+                    # cache misses every time — the exact bug class
+                    # jaxrules' jit-in-loop flags statically.
+                    jax.jit(lambda x, _i=i: x + _i)(jnp.arange(4))
+        assert exc.value.compiles > exc.value.budget == 2
+        assert reg.value("retrace_guard_breaches_total", block="rejit") == 1
+        assert reg.value("retrace_guard_compiles_total",
+                         block="rejit") >= exc.value.compiles
+
+    def test_warm_loop_stays_within_zero_budget(self):
+        import jax
+        import jax.numpy as jnp
+
+        reg = telemetry.Registry()
+        step = jax.jit(lambda x: x * 2)
+        step(jnp.arange(8))  # compile OUTSIDE the guard
+        with retrace_guard("steady", budget=0, registry=reg) as g:
+            for _ in range(5):
+                step(jnp.arange(8))
+        assert g.compiles == 0 and not g.breached
+
+    def test_warn_mode_warns_and_continues(self):
+        import jax
+        import jax.numpy as jnp
+
+        reg = telemetry.Registry()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with retrace_guard("warned", budget=0, registry=reg,
+                               on_breach="warn") as g:
+                jax.jit(lambda x: x - 1)(jnp.arange(3))
+        assert g.breached
+        assert any("retrace_guard[warned]" in str(w.message) for w in caught)
+
+    def test_callable_breach_handler(self):
+        import jax
+        import jax.numpy as jnp
+
+        reg = telemetry.Registry()
+        seen = []
+        with retrace_guard("cb", budget=0, registry=reg,
+                           on_breach=seen.append) as g:
+            jax.jit(lambda x: x + 7)(jnp.arange(3))
+        assert seen == [g] and g.breached
+
+    def test_block_exception_outranks_breach(self):
+        import jax
+        import jax.numpy as jnp
+
+        reg = telemetry.Registry()
+        with pytest.raises(KeyError):
+            with retrace_guard("err", budget=0, registry=reg):
+                jax.jit(lambda x: x)(jnp.arange(2))
+                raise KeyError("the real failure")
+
+    def test_guard_validates_arguments(self):
+        with pytest.raises(ValueError):
+            retrace_guard("x", budget=-1)
+        with pytest.raises(ValueError):
+            retrace_guard("x", budget=0, on_breach="explode")
