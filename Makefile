@@ -8,7 +8,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
-	analysis-check
+	analysis-check supervise-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -38,6 +38,14 @@ perf-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_frontier.py -q
 	$(TEST_ENV) BENCH_N_1M=4000 BENCH_CACHE=0 BENCH_TELEMETRY_DIR=/tmp \
 		$(PY) bench.py --stage 1m
+
+# Supervised execution plane: watchdog/store/crash-recovery tests (the
+# slow-marked double-SIGKILL subprocess soak included) plus a live demo
+# that preempts a PRNG-dependent run twice, corrupts a checkpoint, and
+# proves bit-identical resume (tox env "supervise").
+supervise-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_supervise.py -q
+	$(TEST_ENV) $(PY) examples/supervised_run_demo.py
 
 # graftlint gate: zero non-baselined static-analysis findings on the
 # package (JAX retrace/host-sync rules + lock discipline; stdlib-ast, no

@@ -30,9 +30,14 @@ the sim, no blocking-under-lock or lock-order hazards in the sockets
 backend — are enforced statically by `p2pnetwork_tpu.analysis` (graftlint:
 ``python -m p2pnetwork_tpu.analysis``) with a runtime ``retrace_guard``
 complement — see GETTING_STARTED.md "Static analysis & retrace budgets".
+
+Long runs survive the hardware they run on via the supervised execution
+plane (`p2pnetwork_tpu.supervise`): chunked runs with deadline watchdogs,
+atomic auto-checkpoint directories, and bit-exact SIGKILL/preemption
+resume — see GETTING_STARTED.md "Supervised runs & crash recovery".
 """
 
-from p2pnetwork_tpu import chaos, telemetry, wire
+from p2pnetwork_tpu import chaos, supervise, telemetry, wire
 from p2pnetwork_tpu.chaos import ChaosPlane
 from p2pnetwork_tpu.config import MeshConfig, NodeConfig, SimConfig, TopologyConfig
 from p2pnetwork_tpu.node import Node
@@ -75,6 +80,7 @@ __all__ = [
     "SimConfig",
     "TopologyConfig",
     "MeshConfig",
+    "supervise",
     "telemetry",
     "wire",
     "__version__",

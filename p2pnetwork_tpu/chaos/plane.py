@@ -12,7 +12,13 @@ sim (``sim.failures``)      sockets (``ChaosPlane``)
 ``revive_nodes(g, ids, o)`` ``plane.revive_nodes(ids)``
 ``cut_links(g, edge_ids)``  ``plane.cut_links(pairs)``
 ``partition(g, groups)``    ``plane.partition(groups)``
+``preempt(run, at_round)``  ``plane.preempt(ids)`` / ``revive_preempted()``
 ==========================  ===========================================
+
+(The sim's ``preempt`` kills the *run harness* at a round boundary — the
+supervised-run lifecycle, ``supervise/runner.py``; the sockets mirror
+preempts *peers*: fail-stop now, revive en bloc later — both count under
+the shared ``preempt`` fault kind.)
 
 plus sockets-only faults no mask can express: added latency, bandwidth
 throttle, frame drop / duplicate / corrupt, and a slow-drain peer (stops
@@ -91,6 +97,7 @@ class ChaosPlane:
         self._nodes: Dict[str, object] = {}
         self._orig_factory: Dict[str, object] = {}
         self._dead: set = set()
+        self._preempted: set = set()    # subset of _dead, revivable en bloc
         self._cut: set = set()          # frozenset({a, b}) pairs
         self._groups: Dict[str, int] = {}
         self._latency = 0.0
@@ -186,10 +193,44 @@ class ChaosPlane:
         ids = [str(i) for i in node_ids]
         with self._lock:
             self._dead.difference_update(ids)
+            self._preempted.difference_update(ids)
             for i in ids:
                 self._log.append(("node_revive", i, None, None))
         self._count("node_revive", len(ids))
         self._update_gauges()
+
+    def preempt(self, node_ids: Iterable) -> None:
+        """Preempt node ids: fail-stop now (identical network effect to
+        :meth:`kill_nodes`), revive later en bloc via
+        :meth:`revive_preempted` — the sockets mirror of the sim side's
+        ``failures.preempt`` kill-then-revive lifecycle, and the
+        machine-reclaimed flavor of failure (a preempted VM comes back;
+        a killed one is a decision). Counted under its own ``preempt``
+        kind so a scenario's transient capacity loss reads apart from its
+        permanent one."""
+        ids = [str(i) for i in node_ids]
+        with self._lock:
+            self._dead.update(ids)
+            self._preempted.update(ids)
+            for i in ids:
+                self._log.append(("preempt", i, None, None))
+        self._count("preempt", len(ids))
+        self._sever(lambda a, b: a in ids or b in ids)
+        self._update_gauges()
+
+    def revive_preempted(self) -> List[str]:
+        """Revive every currently-preempted node (deterministic inverse of
+        :meth:`preempt`); returns the revived ids. Reconnect machinery
+        re-establishes their links, as after any revive."""
+        with self._lock:
+            ids = sorted(self._preempted)
+            self._preempted.clear()
+            self._dead.difference_update(ids)
+            for i in ids:
+                self._log.append(("preempt_revive", i, None, None))
+        self._count("preempt_revive", len(ids))
+        self._update_gauges()
+        return ids
 
     def cut_links(self, pairs: Iterable[Tuple]) -> None:
         """Cut the given (a, b) node-id links, both directions."""
@@ -307,6 +348,7 @@ class ChaosPlane:
         """Back to a fault-free plane (structural faults included)."""
         with self._lock:
             self._dead.clear()
+            self._preempted.clear()
             self._cut.clear()
             self._groups = {}
             self._log.append(("reset", None, None, None))
@@ -401,9 +443,11 @@ class ChaosPlane:
     def _update_gauges(self) -> None:
         with self._lock:
             dead, cut = len(self._dead), len(self._cut)
+            preempted = len(self._preempted)
             groups = len(set(self._groups.values()))
             slow = len(self._slow)
         self._m_active.labels("dead_nodes").set(dead)
+        self._m_active.labels("preempted_nodes").set(preempted)
         self._m_active.labels("cut_links").set(cut)
         self._m_active.labels("partition_groups").set(groups)
         self._m_active.labels("slow_drain_nodes").set(slow)

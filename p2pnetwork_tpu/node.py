@@ -131,6 +131,11 @@ class Node(threading.Thread):
             "p2p_reconnect_trigger_timeouts_total",
             "Manual reconnect_nodes() triggers that timed out waiting on a "
             "busy or wedged event loop.", ("node",)).labels(self.id)
+        self._m_undelivered = t.counter(
+            "p2p_shutdown_undelivered_total",
+            "Bytes still queued toward peers when a deadline-bounded "
+            "Node.stop(deadline=) gave up draining them.",
+            ("node",)).labels(self.id)
         # Decorrelated-jitter draws for the reconnect backoff; per-node so
         # chaos tests can reseed one node without touching global state.
         self._reconnect_rng = random.Random()
@@ -154,6 +159,8 @@ class Node(threading.Thread):
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._stop_event: Optional[asyncio.Event] = None
+        # Drain budget of a deadline-bounded stop(); None = legacy close.
+        self._stop_deadline: Optional[float] = None
         # NOT named _started: threading.Thread owns that attribute.
         self._ready = threading.Event()
 
@@ -247,11 +254,19 @@ class Node(threading.Thread):
             await self._shutdown()
 
     async def _shutdown(self) -> None:
-        """Stop epilogue [ref: node.py:269-280]: close server, stop peers, join."""
+        """Stop epilogue [ref: node.py:269-280]: close server, stop peers, join.
+
+        A deadline-bounded stop first drains outbound write buffers within
+        the deadline (:meth:`stop`); whatever is still queued past it is
+        counted into ``p2p_shutdown_undelivered_total`` and force-aborted,
+        so the supervised-shutdown story holds on the sockets backend too:
+        bounded exit, with the loss measured instead of silent."""
         print("Node stopping...")
         if self._server is not None:
             self._server.close()
         conns = list(self.all_nodes)
+        if self._stop_deadline is not None:
+            await self._drain_outbound(conns, self._stop_deadline)
         for conn in conns:
             conn.stop()
         for conn in conns:
@@ -266,11 +281,59 @@ class Node(threading.Thread):
                 self.debug_print("Node: server.wait_closed timed out")
         print("Node stopped")
 
-    def stop(self) -> None:
+    async def _drain_outbound(self, conns, deadline: float) -> int:
+        """Wait (up to ``deadline`` seconds) for every peer's write buffer
+        to empty; returns the bytes abandoned past the deadline.
+
+        Undrained connections are marked for force-abort so the close
+        epilogue stays prompt — a peer that stopped reading must not turn
+        a bounded stop into a 10 s-per-connection graceful-close wait.
+        Abandoned bytes count into ``p2p_shutdown_undelivered_total``."""
+        def _buffered(conn) -> int:
+            transport = conn.writer.transport
+            if transport is None or transport.is_closing():
+                return 0
+            try:
+                return int(transport.get_write_buffer_size())
+            except Exception:
+                return 0
+
+        give_up_at = time.monotonic() + max(float(deadline), 0.0)
+        while True:
+            remaining = sum(_buffered(c) for c in conns)
+            if remaining == 0:
+                return 0
+            if time.monotonic() >= give_up_at:
+                break
+            await asyncio.sleep(0.01)
+        for conn in conns:
+            if _buffered(conn) > 0:
+                conn._abort = True  # undrained: stop() force-aborts
+        self._m_undelivered.inc(remaining)
+        self.event_log.record(
+            "shutdown_undelivered", None,
+            {"bytes": remaining, "deadline": deadline})
+        self.debug_print(
+            f"stop: abandoned {remaining} undelivered byte(s) after "
+            f"{deadline}s drain deadline")
+        return remaining
+
+    def stop(self, deadline: Optional[float] = None) -> None:
         """Request the node to stop [ref: node.py:191-194].
 
-        Thread-safe and idempotent, like the reference's flag-set."""
+        Thread-safe and idempotent, like the reference's flag-set.
+
+        ``deadline`` (seconds) opts into a *measured* shutdown: the stop
+        epilogue drains every peer's outbound queue for at most that long
+        before closing; bytes still queued past the deadline are reported
+        via the ``p2p_shutdown_undelivered_total`` counter and a
+        ``shutdown_undelivered`` event-log record, and their connections
+        are force-aborted so the stop itself stays bounded. Without a
+        deadline the legacy behavior is unchanged (graceful close, the
+        per-connection ``wait_closed`` 10 s bound)."""
         self.node_request_to_stop()
+        if deadline is not None:
+            self._stop_deadline = float(deadline)
         self.terminate_flag.set()
         loop, stop_event = self._loop, self._stop_event
         if loop is not None and stop_event is not None and not loop.is_closed():

@@ -24,12 +24,59 @@ not round-trip through host memory).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import tempfile
-from typing import Any, Dict, Tuple
+import zipfile
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file failed integrity verification.
+
+    Raised instead of the bare ``zipfile.BadZipFile`` / ``zlib.error`` /
+    ``ValueError`` soup a truncated or bit-flipped ``.npz`` produces — a
+    crash-tolerant resume loop (supervise/store.py) needs to tell "this
+    entry is damaged, skip to the previous one" apart from "the caller
+    passed the wrong template" (which stays a ``ValueError``). Carries the
+    file path plus, for hash mismatches, the expected and actual digests.
+    """
+
+    def __init__(self, path: str, detail: str = "",
+                 expected: Optional[str] = None, actual: Optional[str] = None):
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+        msg = f"corrupt checkpoint {path!r}"
+        if expected is not None:
+            msg += f": content hash mismatch (expected {expected}, got {actual})"
+        elif detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+#: npz entry carrying the content digest; excluded from its own hash.
+_DIGEST_KEY = "__sha256__"
+
+
+def _payload_digest(payload: Dict[str, np.ndarray]) -> str:
+    """sha256 over every payload entry (name, dtype, shape, raw bytes), in
+    sorted-name order — the integrity hash ``save`` embeds and ``load``
+    verifies. Deterministic across processes: no pickled objects, no dict
+    order dependence."""
+    h = hashlib.sha256()
+    for name in sorted(payload):
+        if name == _DIGEST_KEY:
+            continue
+        arr = np.ascontiguousarray(payload[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(repr(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def topology_state(graph) -> Dict[str, Any]:
@@ -201,13 +248,17 @@ def load_node_payload(path: str, graph, protocol_state_template) -> Tuple[
 def save(path: str, state: Any, key: jax.Array, round_index: int,
          message_count: int = 0) -> None:
     """Atomically write (state pytree, PRNG key, round counter, message
-    counter) to ``path``."""
+    counter) to ``path``, with an embedded content hash ``load`` verifies
+    (a bit-flipped or truncated file raises :class:`CheckpointCorrupt`
+    instead of resuming from garbage)."""
     leaves, treedef = jax.tree_util.tree_flatten(state)
     payload = {f"leaf_{i}": np.asarray(jax.device_get(x)) for i, x in enumerate(leaves)}
     payload["__key__"] = np.asarray(jax.random.key_data(key))
     payload["__round__"] = np.asarray(round_index, dtype=np.int64)
     payload["__messages__"] = np.asarray(message_count, dtype=np.int64)
     payload["__treedef__"] = np.frombuffer(str(treedef).encode(), dtype=np.uint8)
+    payload[_DIGEST_KEY] = np.frombuffer(
+        _payload_digest(payload).encode(), dtype=np.uint8)
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
@@ -226,20 +277,47 @@ def load(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
     ``template`` is a state pytree with the same structure (e.g. a freshly
     built ``protocol.init(...)``); its treedef validates the file.
     Returns ``(state, key, round_index, message_count)``.
+
+    Integrity: a file carrying the embedded content hash (every checkpoint
+    written since the hash landed in the format) is verified against it; a
+    truncated, bit-flipped, or otherwise unreadable file raises
+    :class:`CheckpointCorrupt` (file + expected/actual hash), never a bare
+    ``zipfile``/``zlib`` error. Old hashless files load unverified for
+    back-compat. A structure mismatch against ``template`` stays a
+    ``ValueError`` — that is a caller error, not file damage.
     """
-    with np.load(path) as data:
-        _, treedef = jax.tree_util.tree_flatten(template)
-        stored = bytes(data["__treedef__"]).decode()
-        if stored != str(treedef):
-            raise ValueError(
-                f"checkpoint structure mismatch:\n  file: {stored}\n  template: {treedef}"
-            )
-        n = len([k for k in data.files if k.startswith("leaf_")])
-        leaves = [data[f"leaf_{i}"] for i in range(n)]
-        state = jax.tree_util.tree_unflatten(treedef, leaves)
-        key = jax.random.wrap_key_data(data["__key__"])
-        messages = int(data["__messages__"]) if "__messages__" in data.files else 0
-        return state, key, int(data["__round__"]), messages
+    try:
+        # Read every member eagerly inside the guard: npz members load
+        # lazily, so a file truncated mid-member only fails at access time.
+        with np.load(path) as data:
+            payload = {k: np.asarray(data[k]) for k in data.files}
+    except (zipfile.BadZipFile, OSError, EOFError, KeyError, ValueError) as e:
+        raise CheckpointCorrupt(
+            path, detail=f"{type(e).__name__}: {e}") from e
+    if _DIGEST_KEY in payload:
+        stored_digest = bytes(payload[_DIGEST_KEY]).decode()
+        actual = _payload_digest(payload)
+        if stored_digest != actual:
+            raise CheckpointCorrupt(path, expected=stored_digest,
+                                    actual=actual)
+    if "__treedef__" not in payload or "__round__" not in payload \
+            or "__key__" not in payload:
+        raise CheckpointCorrupt(
+            path, detail="missing checkpoint bookkeeping entries "
+            "(not a sim/checkpoint.py file, or truncated before the "
+            "hash format)")
+    _, treedef = jax.tree_util.tree_flatten(template)
+    stored = bytes(payload["__treedef__"]).decode()
+    if stored != str(treedef):
+        raise ValueError(
+            f"checkpoint structure mismatch:\n  file: {stored}\n  template: {treedef}"
+        )
+    n = len([k for k in payload if k.startswith("leaf_")])
+    leaves = [payload[f"leaf_{i}"] for i in range(n)]
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    key = jax.random.wrap_key_data(payload["__key__"])
+    messages = int(payload["__messages__"]) if "__messages__" in payload else 0
+    return state, key, int(payload["__round__"]), messages
 
 
 def save_orbax(path: str, state: Any, key: jax.Array, round_index: int,

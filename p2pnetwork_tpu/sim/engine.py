@@ -186,10 +186,30 @@ def _donatable(state, *others) -> bool:
     return not (ids & other_ids)
 
 
+def _check_not_donated(state) -> None:
+    """Resuming from a state whose buffers a previous donating run already
+    consumed surfaces, without this check, as an opaque XLA "Buffer has
+    been deleted or donated" error from deep inside the dispatch. Detect
+    deleted leaves up front and name the actual fix."""
+    for leaf in jax.tree_util.tree_leaves(state):
+        if (isinstance(leaf, jax.Array)
+                and not isinstance(leaf, jax.core.Tracer)
+                and leaf.is_deleted()):
+            raise ValueError(
+                "resume state has deleted device buffers — they were "
+                "donated to a previous run_from / run_until_coverage_from "
+                "/ run_until_converged call (donate=True is the default). "
+                "To resume the same state more than once pass "
+                "donate=False to the earlier call, or reload the state "
+                "from a checkpoint."
+            )
+
+
 def _pick_loop(donating, keeping, donate, state, graph, key):
     """The one donation gate all three resume entry points share: the
     donating jit variant only when asked AND the state's buffers are
     cleanly donatable against the non-donated args."""
+    _check_not_donated(state)
     return donating if donate and _donatable(state, graph, key) else keeping
 
 

@@ -305,6 +305,29 @@ kill_nodes = fail_nodes
 cut_links = fail_edges
 
 
+def preempt(run, at_round: int):
+    """Arm a deterministic preemption of a supervised run harness.
+
+    The other fault kinds in this module damage the *simulated network*;
+    ``preempt`` damages the *run itself* — the machine it executes on is
+    reclaimed, exactly what this environment's wedged device tunnels and
+    driver timeouts keep doing for real. ``run`` is a
+    :class:`~p2pnetwork_tpu.supervise.runner.SupervisedRun` (anything with
+    ``arm_preemption``); at the first chunk boundary at or past
+    ``at_round`` it raises
+    :class:`~p2pnetwork_tpu.supervise.runner.Preempted` *before* taking
+    the checkpoint due there, so the durable trail ends where a real
+    SIGKILL's would. Reviving is calling the same ``run_*`` entry again —
+    it resumes from the last durable checkpoint, and the revived run's
+    final state is bit-identical to an uninterrupted one (the supervised
+    determinism contract). Counted as
+    ``sim_injected_failures_total{kind="preempt"}`` like every other
+    injected fault. Returns ``run`` for chaining."""
+    _count_injected("preempt")
+    run.arm_preemption(int(at_round))
+    return run
+
+
 def random_node_failures(graph: Graph, key: jax.Array, frac: float) -> Graph:
     """Fail each live node independently with probability ``frac`` —
     the churn model for coverage-under-failure experiments."""

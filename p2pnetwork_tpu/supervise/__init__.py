@@ -1,0 +1,39 @@
+"""Supervised execution plane: watchdogs, auto-checkpoint/resume,
+crash-tolerant long runs.
+
+- :class:`~p2pnetwork_tpu.supervise.watchdog.Watchdog` /
+  :class:`~p2pnetwork_tpu.supervise.watchdog.StallTimeout` — deadline
+  watchdog over heartbeats (stdlib-only, importable without jax);
+- :class:`~p2pnetwork_tpu.supervise.store.CheckpointStore` — atomic,
+  retention-bounded checkpoint directory with corrupt-skip resume;
+- :class:`~p2pnetwork_tpu.supervise.runner.SupervisedRun` /
+  :class:`~p2pnetwork_tpu.supervise.runner.Preempted` — chunked,
+  auto-checkpointing, resumable driver for the sim engine's run-to-*
+  loops.
+
+The store and runner need jax (they sit on ``sim/checkpoint.py`` and the
+engine); they load lazily so the sockets-only surface of this package —
+the watchdog — imports clean without it, matching the repo's "sockets
+backend is stdlib-only" rule.
+"""
+
+from p2pnetwork_tpu.supervise.watchdog import StallTimeout, Watchdog
+
+__all__ = ["Watchdog", "StallTimeout", "CheckpointStore", "SupervisedRun",
+           "Preempted"]
+
+_LAZY = {
+    "CheckpointStore": ("p2pnetwork_tpu.supervise.store", "CheckpointStore"),
+    "SupervisedRun": ("p2pnetwork_tpu.supervise.runner", "SupervisedRun"),
+    "Preempted": ("p2pnetwork_tpu.supervise.runner", "Preempted"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

@@ -38,6 +38,11 @@ def pytest_configure(config):
         "markers",
         "analysis: graftlint static-analysis + retrace_guard tests "
         "(select with -m analysis; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "supervise: supervised execution plane tests — watchdogs, "
+        "checkpoint store, crash-tolerant runs (select with -m supervise; "
+        "part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")
