@@ -8,7 +8,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
-	analysis-check supervise-check
+	analysis-check supervise-check audit-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -47,14 +47,22 @@ supervise-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_supervise.py -q
 	$(TEST_ENV) $(PY) examples/supervised_run_demo.py
 
-# graftlint gate: zero non-baselined static-analysis findings on the
-# package (JAX retrace/host-sync rules + lock discipline; stdlib-ast, no
-# jax needed), then the analysis test subset — every rule's deliberate-
-# failure fixture plus the retrace_guard runtime-budget tests (tox env
-# "analysis").
+# graftlint + graftaudit gates: zero non-baselined findings at BOTH
+# layers — source AST (retrace/host-sync/lock discipline) and compiled IR
+# (jaxpr rules, signature parity, donation aliasing, cost ratchet) —
+# then both test subsets (tox env "analysis").
 analysis-check:
 	$(PY) -m p2pnetwork_tpu.analysis p2pnetwork_tpu/
+	$(PY) -m p2pnetwork_tpu.analysis.ir
 	$(TEST_ENV) $(PY) -m pytest tests/test_analysis.py -q
+
+# graftaudit gate alone: the device-free IR audit over the full lowering
+# registry (the CLI pins JAX_PLATFORMS=cpu + the 8-device virtual mesh
+# itself), then its test subset — rule fixtures, parity gate, donation
+# audit, budgets round-trip/ratchet (tox env "audit").
+audit-check:
+	$(PY) -m p2pnetwork_tpu.analysis.ir
+	$(TEST_ENV) $(PY) -m pytest tests/test_iraudit.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

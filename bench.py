@@ -501,6 +501,10 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         },
         "supervised": tel.get("supervised", {}),
         "per_method": tel.get("per_method", {}),
+        # The static cost model beside the measured numbers: graftaudit's
+        # blessed flops/bytes per lowering for this stage's shape-class,
+        # so drift between model and wall-clock is visible per artifact.
+        "ir_cost_model": _ir_cost_slice(stage),
         "metrics": reg.snapshot(),
     }
     path = _telemetry_path(stage)
@@ -512,6 +516,28 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
     except Exception as e:
         _warn_event("bench_telemetry_write_failed", path=path,
                     error=f"{type(e).__name__}: {e}")
+
+
+def _ir_cost_slice(stage: str) -> dict:
+    """The graftaudit cost-table slice for this stage — flops/bytes (and
+    the collective census) per lowering on the stage's shape-class, read
+    from the checked-in analysis/ir/budgets.json. Both measuring stages
+    run the WS family, so the canonical ``ws1k`` class is the static
+    model the measured per-method wall-clocks are compared against
+    (cost_analysis prices the program; the graph scale multiplies both
+    sides). Failure to load must not sink a measured bench."""
+    try:
+        from p2pnetwork_tpu.analysis.ir import budgets as irb
+
+        doc = irb.load_budgets()
+        cls = "ws1k"
+        entries = {name: rec for name, rec in
+                   doc.get("entries", {}).items()
+                   if name.endswith("@" + cls) and "error" not in rec}
+        return {"shape_class": cls, "jaxlib": doc.get("jaxlib"),
+                "tolerance": doc.get("tolerance"), "entries": entries}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def _stage_compile_budget(stage: str) -> int:

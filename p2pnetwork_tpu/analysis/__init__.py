@@ -14,8 +14,15 @@ and liveness rest on:
   budget via the telemetry jaxhooks counters — the complement for
   retraces only visible with real shapes at runtime.
 
-See GETTING_STARTED.md ("Static analysis & retrace budgets") for the rule
-table and workflows.
+A third plane lives one layer down: **graftaudit**
+(:mod:`p2pnetwork_tpu.analysis.ir`, the ``graftaudit`` CLI) audits what
+the lowering zoo COMPILES to — jaxpr rules, signature parity, donation
+aliasing, and the compiled-cost ratchet. It needs jax (CPU backend only)
+and is therefore not imported here; this package stays importable in a
+sockets-only environment.
+
+See GETTING_STARTED.md ("Static analysis & retrace budgets" and
+"IR audit & cost ratchet") for the rule tables and workflows.
 """
 
 from p2pnetwork_tpu.analysis.core import (  # noqa: F401

@@ -1,0 +1,58 @@
+"""graftaudit — IR-level static audit of the lowering zoo.
+
+graftlint (:mod:`p2pnetwork_tpu.analysis`) polices Python source, but the
+failure modes that actually burn TPU time live one layer down, in what the
+lowering zoo *compiles to*: a silently dropped donation double-buffers the
+carry for a whole run, an f64 widening doubles bandwidth, a broken frontier
+compaction gathers the whole padded edge set every round, and collective
+traffic drifts PR over PR — none of it visible to an AST rule and none
+exercised by unit tests. graftaudit closes that gap statically, with **zero
+device time**: everything runs under ``JAX_PLATFORMS=cpu`` via abstract
+tracing (``jax.make_jaxpr`` / ``jax.eval_shape``) and AOT lowering
+(``jit(f).lower(...).compile()`` on the CPU backend).
+
+Four planes, one CLI (``graftaudit``, beside ``graftlint``):
+
+- **Lowering registry** (:mod:`.registry`) — every propagation variant
+  (``ops/segment.py`` segment/gather, ``ops/blocked.py``, ``ops/skew.py``,
+  ``ops/frontier.py``, ``ops/bitset.py`` via the packed flood step, the
+  ``parallel/sharded.py`` ppermute coverage loop, the engine coverage
+  loop) × canonical shape-classes, traced to jaxprs and abstract output
+  signatures.
+- **Jaxpr rules** (:mod:`.rules`) — forbidden host callbacks, f64
+  ``convert_element_type`` widenings / f64 values, gather/scatter slot
+  counts vs the frontier budget, plus the cross-lowering abstract-
+  signature **parity gate** (all lowerings of one op must agree on
+  ``eval_shape`` signatures).
+- **Donation audit** (:mod:`.donation`) — AOT-compiles the engine's
+  state-carry steps and asserts the compiled executable's
+  ``input_output_alias`` actually aliases every carry leaf, so donation
+  can never again be dropped silently.
+- **Cost ratchet** (:mod:`.budgets`) — ``Compiled.cost_analysis()``
+  flops/bytes and a collective census (ppermute/psum/all_gather counts +
+  estimated ICI bytes, compiled bytes cross-checked through the commviz
+  parser) per (lowering, shape-class), persisted in the checked-in
+  ``budgets.json`` with graftlint-style baseline semantics — CI fails on
+  unexplained cost growth without running a single benchmark.
+
+Findings ride the graftlint machinery (:mod:`p2pnetwork_tpu.analysis.core`
+``Finding`` records, severity order, baseline fingerprinting), so the two
+gates render, sort, and grandfather identically.
+"""
+
+__all__ = ["Lowering", "Trace", "all_lowerings", "shape_class",
+           "trace_lowering"]
+
+
+def __getattr__(name):
+    # Lazy re-exports (PEP 562): the device-free guarantee depends on the
+    # CLI pinning JAX_PLATFORMS BEFORE jax first imports (jax captures the
+    # env var at import, not at backend init), and `python -m ...ir` /
+    # the console script both execute this module before __main__.main()
+    # can pin — so importing this package must not touch registry/jax.
+    if name in __all__:
+        from p2pnetwork_tpu.analysis.ir import registry
+
+        return getattr(registry, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

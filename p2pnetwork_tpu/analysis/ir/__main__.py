@@ -1,0 +1,237 @@
+"""graftaudit CLI: ``python -m p2pnetwork_tpu.analysis.ir`` / ``graftaudit``.
+
+Exit codes mirror graftlint: 0 — no non-baselined findings; 1 — findings
+to fix; 2 — bad invocation. The audit is device-free by construction:
+this module pins ``JAX_PLATFORMS=cpu`` and the 8-way virtual host
+platform BEFORE jax initializes, so the full registry — the sharded
+ppermute path included — runs in CPU-only CI.
+
+Typical invocations::
+
+    graftaudit                       # the CI gate (rules + parity +
+                                     #   donation + cost ratchet)
+    graftaudit --json                # machine-readable document
+    graftaudit --no-cost             # skip AOT compiles (fast rule pass)
+    graftaudit --write-budgets       # bless current costs into budgets.json
+    graftaudit --list-lowerings      # registry table
+    graftaudit --list-rules          # rule table
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _pin_cpu_platform() -> None:
+    """Device-free guarantee: the audit must not grab a TPU (or hang on a
+    tunneled backend) and must see the 8-device virtual mesh. Only
+    effective before jax's backend initializes — the conftest does the
+    same dance for the test suite."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftaudit",
+        description=("IR-level static audit of the lowering zoo: jaxpr "
+                     "rules, signature parity, donation aliasing, and the "
+                     "compiled-cost ratchet — all device-free (CPU-only "
+                     "abstract tracing + AOT lowering)."))
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (one JSON document with "
+                        "findings, census, and cost tables)")
+    p.add_argument("--budgets", default=None, metavar="PATH",
+                   help="budgets file (default: the package's checked-in "
+                        "analysis/ir/budgets.json)")
+    p.add_argument("--write-budgets", action="store_true",
+                   help="bless the current compiled costs into the "
+                        "budgets file and exit 0 (commit the diff)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="grandfathered-findings baseline (default: "
+                        "analysis/ir/baseline.json; absent = empty)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather every current finding into the "
+                        "baseline file and exit 0")
+    p.add_argument("--no-cost", action="store_true",
+                   help="skip AOT compilation (no cost ratchet, no "
+                        "donation audit) — the fast jaxpr-rule pass")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="cost-growth tolerance override (fraction; "
+                        "default: the value stored in budgets.json)")
+    p.add_argument("--rules", default=None, metavar="ID[,ID...]",
+                   help="run only these jaxpr rule ids (parity/donation/"
+                        "ratchet gates still run unless skipped)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--list-lowerings", action="store_true",
+                   help="print the lowering registry and exit")
+    return p
+
+
+def _default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    _pin_cpu_platform()
+
+    from p2pnetwork_tpu.analysis import core
+    from p2pnetwork_tpu.analysis.ir import budgets as B
+    from p2pnetwork_tpu.analysis.ir import donation, registry, rules
+
+    if args.list_rules:
+        table = rules.all_ir_rules()
+        width = max(len(r) for r in table)
+        for rule in sorted(table.values(), key=lambda r: (r.severity, r.id)):
+            print(f"{rule.id:<{width}}  {rule.severity}  {rule.doc}")
+        print(f"{'ir-sig-parity':<{width}}  P0  cross-lowering "
+              "eval_shape signature parity gate (rules.parity_findings)")
+        print(f"{'ir-donation-dropped':<{width}}  P0  compiled "
+              "input_output_alias must cover every donated carry leaf "
+              "(donation.audit_donation)")
+        print(f"{'ir-cost-ratchet':<{width}}  P1  compiled cost vs the "
+              "blessed budgets.json (budgets.check_budgets)")
+        return 0
+
+    entries = registry.all_lowerings()
+    import jax
+
+    n_dev = len(jax.devices())
+    runnable = [e for e in entries if e.needs_devices <= n_dev]
+    skipped = [e for e in entries if e.needs_devices > n_dev]
+    if skipped:
+        # Only possible when a host imported jax before this CLI could
+        # pin the virtual mesh — CI never hits this, humans should know.
+        print(f"graftaudit: {len(skipped)} lowering(s) need "
+              f">{n_dev} devices and were skipped: "
+              + ", ".join(e.name for e in skipped), file=sys.stderr)
+
+    if args.list_lowerings:
+        width = max(len(e.name) for e in entries)
+        for e in entries:
+            mark = "" if e in runnable else "  [skipped: needs "\
+                f"{e.needs_devices} devices]"
+            parity = "parity" if e.parity else "      "
+            print(f"{e.name:<{width}}  {parity}  {e.doc or e.op}{mark}")
+        return 0
+
+    selected = rules.all_ir_rules()
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in selected]
+        if unknown:
+            print(f"graftaudit: unknown rule(s): {', '.join(unknown)} "
+                  "(try --list-rules)", file=sys.stderr)
+            return 2
+        selected = {r: selected[r] for r in wanted}
+
+    traces = [registry.trace_lowering(e) for e in runnable]
+    findings = rules.run_ir_rules(traces, selected)
+    findings += rules.parity_findings(traces)
+
+    costs: Dict[str, dict] = {}
+    if not args.no_cost:
+        findings += donation.audit_donation()
+        costs = B.collect_costs(traces)
+        if args.write_budgets:
+            broken = sorted(n for n, c in costs.items() if "error" in c)
+            if broken:
+                # Blessing an error record would permanently un-gate that
+                # lowering: check_budgets has no metrics to compare
+                # against, so later regressions pass silently.
+                print("graftaudit: refusing --write-budgets while "
+                      "lowering(s) fail to compile: "
+                      + ", ".join(broken)
+                      + " — fix the entries, then bless", file=sys.stderr)
+                return 2
+            if skipped:
+                # A degraded run must not bless: the written file would
+                # drop the sharded entries and fail the next full CI run
+                # as "new lowering with no blessed budget".
+                print("graftaudit: refusing --write-budgets on a degraded "
+                      "run (skipped: "
+                      + ", ".join(e.name for e in skipped)
+                      + ") — rerun where graftaudit can pin the full "
+                      "virtual mesh (no prior jax import)",
+                      file=sys.stderr)
+                return 2
+            # A re-bless keeps the stored tolerance unless explicitly
+            # overridden — check_budgets honors the stored value, so the
+            # bless path must not silently reset it to the default.
+            stored = B.load_budgets(args.budgets).get("tolerance")
+            tol = (args.tolerance if args.tolerance is not None
+                   else stored if stored is not None
+                   else B.DEFAULT_TOLERANCE)
+            path = B.write_budgets(costs, args.budgets, tolerance=tol)
+            print(f"graftaudit: wrote {len(costs)} budget entr(ies) to "
+                  f"{path}")
+            return 0
+        findings += B.check_budgets(costs, B.load_budgets(args.budgets),
+                                    tolerance=args.tolerance,
+                                    skipped=[e.name for e in skipped])
+    elif args.write_budgets:
+        print("graftaudit: --write-budgets needs the compile pass; drop "
+              "--no-cost", file=sys.stderr)
+        return 2
+
+    findings = sorted(findings)
+    baseline_path = args.baseline or _default_baseline_path()
+    if args.write_baseline:
+        path = core.write_baseline(findings, {}, baseline_path)
+        print(f"graftaudit: wrote {len(findings)} finding(s) to {path}")
+        return 0
+    baseline = core.load_baseline(baseline_path)
+    new, grandfathered = core.apply_baseline(findings, {}, baseline)
+
+    census = {t.entry.name: {"collectives": t.collectives,
+                             "ici_bytes_est": t.ici_bytes_est}
+              for t in traces if t.collectives}
+    if args.as_json:
+        doc = {
+            "findings": [f.to_json() for f in new],
+            "baselined": len(grandfathered),
+            "lowerings": [t.entry.name for t in traces],
+            "skipped": [e.name for e in skipped],
+            "census": census,
+            "costs": costs,
+            "ok": not new,
+        }
+        print(json.dumps(doc, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if new:
+        counts: Dict[str, int] = {}
+        for f in new:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        summary = ", ".join(f"{n} {sev}" for sev, n in sorted(counts.items()))
+        print(f"graftaudit: {len(new)} finding(s) ({summary}); "
+              f"{len(grandfathered)} baselined")
+        return 1
+    suffix = f" ({len(grandfathered)} baselined)" if grandfathered else ""
+    print(f"graftaudit: clean{suffix} — {len(traces)} lowering(s) audited"
+          + ("" if args.no_cost else
+             f", {len(costs)} cost-ratcheted, donation verified"))
+    return 0
+
+
+def _cli() -> int:
+    try:
+        return main()
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_cli())

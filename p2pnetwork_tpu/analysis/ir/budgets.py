@@ -1,0 +1,202 @@
+"""graftaudit cost ratchet: compiled costs, pinned and gated per PR.
+
+``Compiled.cost_analysis()`` prices a program (flops, bytes accessed)
+without executing it — deterministic for a fixed (program, backend,
+jaxlib), which makes it a RATCHET: persist the per-lowering costs in a
+checked-in ``budgets.json``, and CI fails on unexplained growth with zero
+benchmark time. The same record pins the collective census (ppermute /
+psum / all_gather occurrences and the estimated ICI bytes of the ring
+model in registry.py, cross-checked against the compiled HLO through the
+commviz parser) — collective drift is how multi-chip perf regressions
+arrive, one extra psum at a time.
+
+Baseline semantics mirror graftlint's: the checked-in file grandfathers
+HEAD, ``graftaudit --write-budgets`` blesses a deliberate change (commit
+the diff — it IS the review artifact), stale entries fail so the file
+can only shrink by being regenerated. Growth within ``tolerance``
+(default 20%, stored in the file) absorbs backend jitter across jaxlib
+upgrades; the recorded jaxlib version marks when a wholesale re-bless is
+the right response to a noisy diff.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from p2pnetwork_tpu.analysis.core import Finding
+from p2pnetwork_tpu.analysis.ir.registry import Trace
+
+__all__ = ["collect_costs", "load_budgets", "write_budgets",
+           "check_budgets", "default_budgets_path", "DEFAULT_TOLERANCE"]
+
+SCHEMA = "graftaudit-budgets-v1"
+DEFAULT_TOLERANCE = 0.20
+
+
+def default_budgets_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "budgets.json")
+
+
+def _hlo_collective_bytes(hlo: str) -> int:
+    """Total collective payload bytes of a compiled module, through the
+    one HLO collective parser the repo already trusts (commviz)."""
+    from p2pnetwork_tpu.parallel import commviz
+
+    return sum(c[3] for c in commviz.collectives(hlo))
+
+
+def collect_costs(traces: Sequence[Trace]) -> Dict[str, dict]:
+    """AOT-compile every traced lowering and extract its cost record:
+    ``{name: {flops, bytes, collectives, ici_bytes_est, ici_bytes_hlo}}``.
+    Entries that failed to trace are skipped (ir-trace-error already
+    fired); a compile failure records ``{"error": ...}`` so the ratchet
+    reports it instead of silently ungating the entry."""
+    out: Dict[str, dict] = {}
+    for trace in traces:
+        if trace.error is not None:
+            continue
+        name = trace.entry.name
+        try:
+            fn, args = trace.entry.build()
+            lowered = (
+                fn.lower(*args) if hasattr(fn, "lower")
+                # graftlint: ignore[jit-in-loop] -- AOT audit driver: each
+                # iteration lowers a DIFFERENT entry exactly once; nothing
+                # executes, so there is no compile cache to preserve.
+                else jax.jit(fn).lower(*args))
+            compiled = lowered.compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax: one per device
+                ca = ca[0] if ca else {}
+            record = {
+                # graftlint: ignore[host-sync-in-loop] -- cost_analysis
+                # returns a host dict of Python floats; no device values.
+                "flops": float(ca.get("flops", -1.0)),
+                "bytes": float(ca.get("bytes accessed", -1.0)),
+                "collectives": dict(sorted(trace.collectives.items())),
+                "ici_bytes_est": int(trace.ici_bytes_est),
+            }
+            if trace.collectives:
+                record["ici_bytes_hlo"] = _hlo_collective_bytes(
+                    compiled.as_text())
+            out[name] = record
+        except Exception as e:  # noqa: BLE001 — surfaced by the ratchet
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def load_budgets(path: Optional[str] = None) -> dict:
+    """The checked-in budget document (``{}`` when absent — a repo
+    without budgets gates nothing until ``--write-budgets`` blesses)."""
+    path = path or default_budgets_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budgets(costs: Dict[str, dict], path: Optional[str] = None,
+                  tolerance: float = DEFAULT_TOLERANCE) -> str:
+    """Bless the current costs as the new budget baseline."""
+    import jaxlib
+
+    path = path or default_budgets_path()
+    payload = {
+        "schema": SCHEMA,
+        "comment": ("graftaudit compiled-cost budgets. flops/bytes come "
+                    "from Compiled.cost_analysis() on the CPU backend; "
+                    "collectives/ici bytes from the jaxpr census and the "
+                    "compiled HLO. CI fails on growth past `tolerance` or "
+                    "any collective-count change; bless deliberate "
+                    "changes with `graftaudit --write-budgets` and commit "
+                    "the diff."),
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "tolerance": tolerance,
+        "entries": {k: costs[k] for k in sorted(costs)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def _ratchet(name: str, message: str, severity: str = "P1") -> Finding:
+    return Finding(severity=severity, file=name, line=0, col=0,
+                   rule="ir-cost-ratchet", message=message)
+
+
+def check_budgets(costs: Dict[str, dict], budgets: dict,
+                  tolerance: Optional[float] = None,
+                  skipped: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Current costs vs the blessed budgets. Fails on: growth of flops /
+    bytes / ICI bytes past tolerance, ANY collective-count change, new
+    lowerings without a budget, stale budget entries, and compile
+    failures. Shrink past tolerance also fails — a win is blessed the
+    same way as a regression, so the file keeps matching HEAD.
+
+    ``skipped`` names lowerings this run could not audit (a degraded
+    host pinned fewer devices than the entry needs); their budget
+    entries are NOT stale — flagging them would tell the operator to
+    regenerate a budgets.json missing the sharded entries."""
+    entries = budgets.get("entries", {})
+    if tolerance is None:
+        tolerance = float(budgets.get("tolerance", DEFAULT_TOLERANCE))
+    out: List[Finding] = []
+    for name, cost in sorted(costs.items()):
+        if "error" in cost:
+            out.append(_ratchet(
+                name, f"lowering failed to AOT-compile: {cost['error']} — "
+                      "the cost gate is off for it"))
+            continue
+        budget = entries.get(name)
+        if budget is None:
+            out.append(_ratchet(
+                name, "new lowering with no blessed budget — run "
+                      "`graftaudit --write-budgets` and commit "
+                      "budgets.json", severity="P2"))
+            continue
+        if "error" in budget:
+            # A blessed error record has no metrics to compare against —
+            # left alone it would silently un-gate this lowering forever.
+            out.append(_ratchet(
+                name, "blessed budget is a compile-error record — no "
+                      "metrics to ratchet against; re-bless with "
+                      "--write-budgets once the lowering compiles"))
+            continue
+        for metric in ("flops", "bytes", "ici_bytes_est", "ici_bytes_hlo"):
+            have, want = cost.get(metric), budget.get(metric)
+            if have is None or want is None or want <= 0:
+                continue
+            ratio = float(have) / float(want)  # graftlint: ignore[host-sync-in-loop] -- budget JSON values, plain Python floats on the host
+            if ratio > 1.0 + tolerance:
+                out.append(_ratchet(
+                    name, f"{metric} grew {ratio:.2f}x over budget "
+                          f"({have:.0f} vs {want:.0f}, tolerance "
+                          f"{tolerance:.0%}) — explain the regression or "
+                          "bless it with --write-budgets"))
+            elif ratio < 1.0 - tolerance:
+                out.append(_ratchet(
+                    name, f"{metric} shrank to {ratio:.2f}x of budget "
+                          f"({have:.0f} vs {want:.0f}) — nice, but bless "
+                          "it (--write-budgets) so the ratchet holds the "
+                          "new level", severity="P2"))
+        if dict(cost.get("collectives", {})) != dict(
+                budget.get("collectives", {})):
+            out.append(_ratchet(
+                name, f"collective census changed: "
+                      f"{budget.get('collectives', {})} -> "
+                      f"{cost.get('collectives', {})} — multi-chip "
+                      "traffic structure drifted; verify against the "
+                      "commviz comm budgets, then bless"))
+    stale = sorted(set(entries) - set(costs) - set(skipped or ()))
+    for name in stale:
+        out.append(_ratchet(
+            name, "budget entry for a lowering the registry no longer "
+                  "produces — regenerate budgets.json (--write-budgets) "
+                  "so the file matches HEAD", severity="P2"))
+    return sorted(out)

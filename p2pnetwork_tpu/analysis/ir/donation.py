@@ -1,0 +1,199 @@
+"""graftaudit donation audit: donated carries must actually alias.
+
+PR 3 made the engine donate the state carry by default — at 10M nodes the
+donated predicates are tens of MB of HBM that would otherwise
+double-buffer for a whole run. But donation fails SILENTLY: a refactor
+that drops ``donate_argnames``, or an argument change that makes XLA
+refuse the alias (dtype/layout mismatch), compiles and runs bit-identically
+— just slower and twice as heavy. graftlint's ``carry-no-donate`` catches
+the missing *kwarg* in source; this module catches the dropped *effect* in
+the compiled artifact, where it is ground truth:
+
+- the **lowered MLIR** carries one ``tf.aliasing_output`` /
+  ``jax.buffer_donor`` attribute per donated input — proof jax REQUESTED
+  the donation;
+- the **compiled HLO** carries ``input_output_alias={ {i}: (j, ...) }``
+  pairs — proof XLA HONORED it.
+
+Both counts must cover every leaf of the donated carry. AOT only
+(``lower()`` + ``compile()`` on the CPU backend): nothing executes, so
+the audit runs in device-free CI like the rest of graftaudit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.analysis.core import Finding
+from p2pnetwork_tpu.analysis.ir.registry import shape_class
+
+__all__ = ["DonationAudit", "all_donation_audits", "check_aliasing",
+           "audit_donation"]
+
+#: ``input_output_alias={ {0}: (4, {}, may-alias), ... }`` — one
+#: ``{output_path}: (param_index`` pair per honored alias.
+_ALIAS_PAIR = re.compile(r"\{[\d,\s]*\}:\s*\(\d+")
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationAudit:
+    """One carry-donating program to verify. ``build()`` returns
+    ``(jitted_fn, args, kwargs, n_carry_leaves)`` — the jitted donating
+    variant, concrete example arguments (kwargs carry its static
+    configuration), and how many array leaves of the carry must come
+    back aliased."""
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple, dict, int]]
+    doc: str = ""
+
+
+def _flood_resume_state(g):
+    """A mid-run FloodState whose leaves are DISTINCT buffers — fresh
+    inits alias seen/frontier to one array, which the engine's
+    ``_donatable`` gate deliberately routes around donation."""
+    from p2pnetwork_tpu.models.flood import FloodState
+
+    seed = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+    seed = seed & g.node_mask
+    return FloodState(seen=seed | jnp.zeros_like(seed),
+                      frontier=jnp.zeros_like(seed).at[1].set(True))
+
+
+def _pushsum_resume_state(g):
+    """A mid-run PushSumState (two distinct f32 leaves) for the
+    run-to-convergence carry audit."""
+    from p2pnetwork_tpu.models.pushsum import PushSumState
+
+    n = g.n_nodes_padded
+    return PushSumState(s=jnp.linspace(0.0, 1.0, n, dtype=jnp.float32),
+                        w=jnp.ones(n, dtype=jnp.float32))
+
+
+def all_donation_audits() -> List[DonationAudit]:
+    """The engine's donating state-carry entry points, resolved through
+    the engine's own ``donating_carry_loops()`` seam (sim/engine.py) —
+    the exact jitted objects the resume paths dispatch, so a dropped
+    ``donate_argnames`` on the real seam fails here, and a renamed or
+    removed loop fails as unverifiable instead of silently ungating."""
+
+    def run_from():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        state = _flood_resume_state(g)
+        args = (g, Flood(source=0), state, jax.random.key(0), 4)
+        return engine.donating_carry_loops()["run_from"], args, {}, len(
+            jax.tree_util.tree_leaves(state))
+
+    def coverage_from():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        state = _flood_resume_state(g)
+        args = (g, Flood(source=0), state, jax.random.key(0))
+        kwargs = {"coverage_target": 0.99, "max_rounds": 64}
+        return (engine.donating_carry_loops()["coverage_from"], args,
+                kwargs, len(jax.tree_util.tree_leaves(state)))
+
+    def converged_from():
+        from p2pnetwork_tpu.models import PushSum
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        state = _pushsum_resume_state(g)
+        args = (g, PushSum(), state, jax.random.key(0))
+        kwargs = {"stat": "variance", "threshold": 1e-6, "max_rounds": 64}
+        return (engine.donating_carry_loops()["converged_from"], args,
+                kwargs, len(jax.tree_util.tree_leaves(state)))
+
+    return [
+        DonationAudit(
+            name="engine/run_from", build=run_from,
+            doc="fixed-rounds resume loop (engine.run_from)"),
+        DonationAudit(
+            name="engine/coverage_from", build=coverage_from,
+            doc="run-to-coverage resume loop "
+                "(engine.run_until_coverage_from)"),
+        DonationAudit(
+            name="engine/converged_from", build=converged_from,
+            doc="run-to-convergence resume loop "
+                "(engine.run_until_converged)"),
+    ]
+
+
+def _alias_section(hlo: str) -> str:
+    """The balanced-brace ``input_output_alias={...}`` section of the
+    ENTRY line (alias paths contain nested ``{}``, so a lazy regex would
+    stop at the first pair and under-count)."""
+    i = hlo.find("input_output_alias=")
+    if i < 0:
+        return ""
+    j = hlo.index("{", i)
+    depth = 0
+    for k in range(j, len(hlo)):
+        if hlo[k] == "{":
+            depth += 1
+        elif hlo[k] == "}":
+            depth -= 1
+            if depth == 0:
+                return hlo[j:k + 1]
+    return hlo[j:]
+
+
+def check_aliasing(fn, args, expected: int, kwargs=None) -> Dict[str, int]:
+    """AOT-lower and compile ``fn(*args, **kwargs)``; count donation
+    attributes in the MLIR (requested) and alias pairs in the compiled
+    HLO (honored). Returns ``{"requested", "honored", "expected"}``."""
+    kwargs = kwargs or {}
+    lowered = fn.lower(*args, **kwargs) if hasattr(fn, "lower") \
+        else jax.jit(fn).lower(*args, **kwargs)
+    mlir = lowered.as_text()
+    requested = mlir.count("tf.aliasing_output") \
+        + mlir.count("jax.buffer_donor")
+    hlo = lowered.compile().as_text()
+    honored = len(_ALIAS_PAIR.findall(_alias_section(hlo)))
+    return {"requested": requested, "honored": honored,
+            "expected": expected}
+
+
+def audit_donation(audits: Optional[List[DonationAudit]] = None
+                   ) -> List[Finding]:
+    """Verify every donating carry seam; one P0 finding per failure."""
+    out: List[Finding] = []
+    for audit in (audits if audits is not None else all_donation_audits()):
+        try:
+            fn, args, kwargs, expected = audit.build()
+            counts = check_aliasing(fn, args, expected, kwargs)
+        except Exception as e:  # noqa: BLE001 — failure IS the finding
+            out.append(Finding(
+                severity="P1", file=audit.name, line=0, col=0,
+                rule="ir-donation-unverifiable",
+                message=f"could not AOT-compile the carry step: "
+                        f"{type(e).__name__}: {e}"))
+            continue
+        if counts["requested"] < expected:
+            out.append(Finding(
+                severity="P0", file=audit.name, line=0, col=0,
+                rule="ir-donation-dropped",
+                message=(f"jit requests donation for only "
+                         f"{counts['requested']} of {expected} carry "
+                         "leaves — donate_argnums/donate_argnames was "
+                         "dropped or no longer covers the carry")))
+        elif counts["honored"] < expected:
+            out.append(Finding(
+                severity="P0", file=audit.name, line=0, col=0,
+                rule="ir-donation-dropped",
+                message=(f"XLA aliased only {counts['honored']} of "
+                         f"{expected} requested carry leaves — the "
+                         "compiled input_output_alias dropped the "
+                         "donation (shape/dtype/layout mismatch between "
+                         "carry input and output?)")))
+    return sorted(out)

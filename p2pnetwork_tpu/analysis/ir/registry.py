@@ -1,0 +1,342 @@
+"""graftaudit lowering registry: every propagation variant × shape-class.
+
+One :class:`Lowering` entry names one compiled-code path the repo ships —
+``or/frontier@ws1k`` is "propagate_or through the frontier-compacted
+lowering on the quasi-regular 1k Watts-Strogatz class". ``build()`` returns
+``(fn, args)``; everything downstream is abstract: :func:`trace_lowering`
+produces the jaxpr, the primitive census, the collective census with
+estimated ICI bytes, and the canonical output signature — no device work,
+no concrete execution, so the whole registry audits in CPU-only CI.
+
+Shape-classes are deliberately SMALL (1k nodes): jaxpr structure, rule
+verdicts, signature parity, and the *relative* cost ratchet are all
+shape-class-stable — what drifts with a bad PR is the program, not the
+problem size — and small classes keep the gate sub-minute. Two classes
+cover the routing space: ``ws1k`` (quasi-regular; ``auto`` routes to
+gather) and ``ba1k`` (degree-skewed with a skew table; ``auto`` routes to
+skew), matching the measured break-evens in ops/segment.py.
+
+Entries in the same ``(op, shape_class)`` parity group must agree on
+``eval_shape`` signatures — the cross-lowering parity gate in
+:mod:`.rules`. Representation-changing variants (the bitset flood step)
+participate through a normalizing wrapper (bool in, bool out) so the gate
+compares the LOGICAL op, not the carry encoding; backends with a different
+contract (the sharded [S, block] layout) opt out via ``parity=False`` and
+are still censused, rule-checked, and cost-ratcheted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Lowering", "Trace", "all_lowerings", "shape_class",
+           "trace_lowering", "signature_text", "COLLECTIVE_PRIMS"]
+
+#: Cross-device primitives the census tracks, with the per-occurrence ICI
+#: byte model: bytes moved ≈ operand_bytes × factor(S) on an S-way ring —
+#: ppermute moves each operand once; psum (ring all-reduce) moves
+#: 2·(S-1)/S ≈ 2 copies; all_gather moves (S-1) shard-sized pieces. A
+#: static, documented model feeding the same comm budgets commviz measures
+#: on compiled HLO (parallel/commviz.py) — the ratchet pins both.
+COLLECTIVE_PRIMS = ("ppermute", "psum", "all_gather", "all_to_all",
+                    "reduce_scatter", "pmax", "pmin")
+
+
+@dataclasses.dataclass(frozen=True)
+class Lowering:
+    """One auditable lowering: a name, its parity group, and a builder.
+
+    ``build()`` -> ``(fn, args)`` with ``fn(*args)`` traceable (``fn`` may
+    already be jitted — pjit traces and lowers like any function).
+    ``slot_budget`` is the frontier gather bound in SLOTS (``k · span``)
+    for entries riding the compaction path; None disables the slot rule.
+    ``needs_devices`` gates entries that only trace on a multi-device
+    mesh (the sharded ppermute path needs the 8-way virtual CPU mesh)
+    and doubles as the mesh width the ICI byte model prices collectives
+    at — the entry builds its own mesh, so the width is static registry
+    knowledge.
+    """
+
+    name: str
+    op: str
+    variant: str
+    shape_class: str
+    build: Callable[[], Tuple[Callable, tuple]]
+    parity: bool = True
+    slot_budget: Optional[int] = None
+    needs_devices: int = 1
+    doc: str = ""
+
+
+@dataclasses.dataclass
+class Trace:
+    """Abstract-trace artifacts of one lowering (device-free)."""
+
+    entry: Lowering
+    jaxpr: Optional[object] = None        # ClosedJaxpr
+    out_sig: Optional[str] = None         # canonical eval_shape signature
+    prims: Dict[str, int] = dataclasses.field(default_factory=dict)
+    collectives: Dict[str, int] = dataclasses.field(default_factory=dict)
+    ici_bytes_est: int = 0
+    error: Optional[str] = None           # trace failure (becomes a finding)
+
+
+# ------------------------------------------------------------ shape-classes
+
+_GRAPH_CACHE: Dict[str, object] = {}
+
+
+def shape_class(name: str):
+    """The canonical graph of one shape-class (host-built, cached)."""
+    g = _GRAPH_CACHE.get(name)
+    if g is None:
+        from p2pnetwork_tpu.sim import graph as G
+
+        if name == "ws1k":
+            # Quasi-regular small-world: `auto` routes to gather; carries
+            # every single-chip representation the zoo lowers through.
+            g = G.watts_strogatz(1024, 6, 0.2, seed=0, blocked=True,
+                                 skew_table=True, source_csr=True)
+        elif name == "ba1k":
+            # Degree-skewed scale-free: the skew table's home class
+            # (`auto` routes to skew once the gather waste bound trips).
+            g = G.barabasi_albert(1024, 3, seed=0, skew_table=True,
+                                  source_csr=True)
+        else:
+            raise ValueError(f"unknown shape-class {name!r}")
+        _GRAPH_CACHE[name] = g
+    return g
+
+
+def _signal(g, dtype):
+    n = g.n_nodes_padded
+    if dtype is bool:
+        return jnp.zeros(n, dtype=bool)
+    return jnp.zeros(n, dtype=jnp.float32)
+
+
+def _frontier_slots(g) -> Optional[int]:
+    """The compaction buffer's slot bound (ops/frontier.py owns the
+    arithmetic), or None when the auto budget disables the sparse path
+    on this class."""
+    from p2pnetwork_tpu.ops import frontier as FR
+
+    return FR.budget_slots(g) or None
+
+
+# ------------------------------------------------------------ entry builders
+
+
+def _kernel_entry(op: str, variant: str, cls: str, *, dtype=bool,
+                  parity: bool = True, doc: str = "") -> Lowering:
+    """A propagate_* kernel × method entry (ops/segment.py dispatch)."""
+
+    def build():
+        from p2pnetwork_tpu.ops import segment as S
+
+        g = shape_class(cls)
+        kernel = {"or": S.propagate_or, "sum": S.propagate_sum,
+                  "max": S.propagate_max, "minplus": S.propagate_min_plus}[op]
+        sig = _signal(g, dtype)
+        return functools.partial(kernel, g, method=variant), (sig,)
+
+    slot = None
+    if variant == "frontier":
+        slot = _frontier_slots(shape_class(cls))
+    return Lowering(name=f"{op}/{variant}@{cls}", op=op, variant=variant,
+                    shape_class=cls, build=build, parity=parity,
+                    slot_budget=slot, doc=doc)
+
+
+def _flood_step_entry(variant: str, cls: str) -> Lowering:
+    """The flood protocol step — dense bool state vs the bit-packed
+    carry (ops/bitset.py), normalized to bool-in/bool-out so the parity
+    gate compares the logical round, not the carry encoding."""
+
+    def build():
+        from p2pnetwork_tpu.models.flood import (Flood, FloodBitState,
+                                                 FloodState)
+        from p2pnetwork_tpu.ops import bitset
+
+        g = shape_class(cls)
+        proto = Flood(source=0, bitset=(variant == "bitset"))
+        key = jax.random.key(0)
+
+        def step(seen, frontier):
+            if variant == "bitset":
+                st = FloodBitState(seen=bitset.pack_bits(seen),
+                                   frontier=bitset.pack_bits(frontier))
+                st, stats = proto.step(g, st, key)
+                n = g.n_nodes_padded
+                return (bitset.unpack_bits(st.seen, n),
+                        bitset.unpack_bits(st.frontier, n), stats)
+            st, stats = proto.step(g, FloodState(seen=seen,
+                                                 frontier=frontier), key)
+            return st.seen, st.frontier, stats
+
+        sig = _signal(g, bool)
+        return step, (sig, sig)
+
+    return Lowering(name=f"floodstep/{variant}@{cls}", op="floodstep",
+                    variant=variant, shape_class=cls, build=build)
+
+
+def _engine_cov_entry(cls: str) -> Lowering:
+    """The single-chip run-to-coverage loop (engine._coverage_with_init):
+    init + early-exit while_loop + packed summary in one program — the
+    1M/10M bench stages' measured shape, censused and cost-ratcheted."""
+
+    def build():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class(cls)
+        proto = Flood(source=0)
+
+        def cov(graph, key):
+            return engine._coverage_with_init(
+                graph, proto, key, coverage_target=0.99, max_rounds=64)
+
+        return cov, (g, jax.random.key(0))
+
+    return Lowering(name=f"cov/flood-engine@{cls}", op="cov",
+                    variant="flood-engine", shape_class=cls, build=build,
+                    parity=False)
+
+
+def _sharded_cov_entry(cls: str) -> Lowering:
+    """The multi-chip ppermute coverage loop (parallel/sharded.py): the
+    ring pass whose collective census — ppermute/psum occurrences and
+    estimated ICI bytes — feeds the commviz comm budgets."""
+
+    def build():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded as SH
+
+        g = shape_class(cls)
+        mesh = M.ring_mesh(8)
+        sg = SH.shard_graph(g, mesh)
+        seen0, frontier0 = SH.init_state(sg, Flood(source=0), None)
+        fn = SH._flood_cov_fn(mesh, SH.DEFAULT_AXIS, sg.n_shards, sg.block,
+                              64, sg.diag_pieces, sg.mxu_block)
+        args = (jnp.float32(0.99), sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *SH._dyn_or_empty(sg), *SH._mxu_or_empty(sg),
+                SH._diag_masks_or_empty(sg), sg.node_mask, sg.out_degree,
+                seen0, frontier0)
+        return fn, args
+
+    return Lowering(name=f"cov/flood-ppermute@{cls}", op="cov",
+                    variant="flood-ppermute", shape_class=cls, build=build,
+                    parity=False, needs_devices=8)
+
+
+def all_lowerings() -> List[Lowering]:
+    """The full registry, parity-grouped by ``(op, shape_class)``.
+
+    Variant lists mirror the dispatch tables in ops/segment.py (max/min
+    ride no MXU lowering; skew needs the two-level table the class
+    carries). The pallas/hybrid MXU kernels are chip-only programs — they
+    do not lower on the CPU backend — and are audited at the source level
+    by graftlint instead.
+    """
+    entries: List[Lowering] = []
+    for v in ("segment", "gather", "blocked", "skew", "frontier"):
+        entries.append(_kernel_entry("or", v, "ws1k", dtype=bool))
+    for v in ("segment", "gather", "blocked", "skew"):
+        entries.append(_kernel_entry("sum", v, "ws1k", dtype=float))
+    for v in ("segment", "gather", "skew", "frontier"):
+        entries.append(_kernel_entry("max", v, "ws1k", dtype=float))
+    for v in ("segment", "gather", "skew", "frontier"):
+        entries.append(_kernel_entry("minplus", v, "ws1k", dtype=float))
+    entries.append(_flood_step_entry("dense", "ws1k"))
+    entries.append(_flood_step_entry("bitset", "ws1k"))
+    entries.append(_engine_cov_entry("ws1k"))
+    entries.append(_sharded_cov_entry("ws1k"))
+    # The degree-skewed class: the three lowerings whose crossover the
+    # routing actually arbitrates there (segment vs skew vs frontier).
+    for v in ("segment", "skew", "frontier"):
+        entries.append(_kernel_entry("or", v, "ba1k", dtype=bool))
+    return entries
+
+
+# ----------------------------------------------------------------- tracing
+
+
+def _walk_jaxpr(jaxpr, visit) -> None:
+    """Depth-first over every eqn of ``jaxpr`` and every sub-jaxpr in its
+    params (cond branches, while/scan bodies, pjit/shard_map callees)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            for x in (v if isinstance(v, (tuple, list)) else (v,)):
+                inner = getattr(x, "jaxpr", None)
+                if hasattr(x, "eqns"):
+                    _walk_jaxpr(x, visit)
+                elif inner is not None and hasattr(inner, "eqns"):
+                    _walk_jaxpr(inner, visit)
+
+
+def iter_eqns(closed_jaxpr):
+    """Every eqn of a ClosedJaxpr, sub-jaxprs included (list, docs order)."""
+    out = []
+    _walk_jaxpr(closed_jaxpr.jaxpr, out.append)
+    return out
+
+
+def signature_text(shapes) -> str:
+    """Canonical text of an ``eval_shape`` result tree: dtype[shape] per
+    leaf, joined in tree order — the string the parity gate compares."""
+    leaves = jax.tree_util.tree_leaves(shapes)
+    parts = [f"{jnp.dtype(l.dtype).name}[{','.join(map(str, l.shape))}]"
+             for l in leaves]
+    return "; ".join(parts)
+
+
+def _collective_bytes(eqn, prim: str, axis_size: int) -> int:
+    """The ring-model byte estimate of one collective eqn. ``axis_size``
+    is the entry's mesh width — static registry knowledge (the entry
+    builds its own mesh), not a runtime axis-env lookup, which is not
+    available when walking a finished jaxpr."""
+    nbytes = sum(int(getattr(v.aval, "size", 0))
+                 * jnp.dtype(v.aval.dtype).itemsize
+                 for v in eqn.invars if hasattr(v, "aval"))
+    s = max(axis_size, 2)
+    if prim == "ppermute":
+        return nbytes
+    if prim in ("psum", "pmax", "pmin"):
+        return int(nbytes * 2 * (s - 1) / s)
+    if prim in ("all_gather", "all_to_all", "reduce_scatter"):
+        return nbytes * (s - 1)
+    return nbytes
+
+
+def trace_lowering(entry: Lowering) -> Trace:
+    """Abstractly trace one lowering: jaxpr, output signature, primitive
+    and collective censuses. Never raises — an untraceable lowering is a
+    P1 finding (rules.py), not a dead audit."""
+    trace = Trace(entry=entry)
+    try:
+        fn, args = entry.build()
+        closed = jax.make_jaxpr(fn)(*args)
+        trace.jaxpr = closed
+        # The jaxpr's out_avals ARE the eval_shape result (flattened) —
+        # reading them here instead of calling jax.eval_shape avoids a
+        # full second abstract trace of every registry entry.
+        trace.out_sig = signature_text(closed.out_avals)
+    except Exception as e:  # noqa: BLE001 — any failure is the finding
+        trace.error = f"{type(e).__name__}: {e}"
+        return trace
+    for eqn in iter_eqns(closed):
+        prim = eqn.primitive.name
+        trace.prims[prim] = trace.prims.get(prim, 0) + 1
+        if prim in COLLECTIVE_PRIMS:
+            trace.collectives[prim] = trace.collectives.get(prim, 0) + 1
+            trace.ici_bytes_est += _collective_bytes(
+                eqn, prim, entry.needs_devices)
+    return trace

@@ -1,0 +1,232 @@
+"""graftaudit jaxpr rules: what a lowering must never compile to.
+
+Rules run over :class:`~p2pnetwork_tpu.analysis.ir.registry.Trace`
+artifacts — pure jaxpr inspection, no device, no execution — and emit the
+same :class:`~p2pnetwork_tpu.analysis.core.Finding` records graftlint
+uses, with the LOWERING NAME in the file slot (``or/frontier@ws1k:0``)
+so baselines fingerprint on (rule, lowering) exactly like source findings
+fingerprint on (rule, file, line text).
+
+========================  =====  ==============================================
+rule                      sev    fires on
+========================  =====  ==============================================
+``ir-trace-error``        P1     a registry lowering that no longer traces —
+                                 a dead entry gates nothing
+``ir-host-callback``      P0     host callback primitives (pure_callback /
+                                 io_callback / debug_callback ...) inside a
+                                 lowering — a device->host sync EVERY round,
+                                 invisible to timing until it is the bench
+``ir-f64-widen``          P1     convert_element_type to f64, or any f64
+                                 value flowing through the jaxpr — doubled
+                                 bandwidth on chip, silent f32 truncation
+                                 under default x64-off
+``ir-gather-slot-budget`` P1     a frontier-compacted lowering none of whose
+                                 branches keeps gather/scatter traffic within
+                                 the ``k·span`` slot budget — the compaction
+                                 is broken and every round pays dense cost
+``ir-sig-parity``         P0     lowerings of one (op, shape-class) group
+                                 disagreeing on eval_shape signatures —
+                                 variants are no longer interchangeable
+========================  =====  ==============================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.analysis.core import SEVERITIES, Finding
+from p2pnetwork_tpu.analysis.ir.registry import Trace, iter_eqns
+
+__all__ = ["IRRule", "all_ir_rules", "run_ir_rules", "parity_findings"]
+
+#: Primitive names that call back into the host. Any of these inside a
+#: lowering serializes every execution on a device->host round trip.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "xla_python_cpu_callback",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class IRRule:
+    """One jaxpr check: ``run(trace)`` yields messages; id/severity are
+    stamped into Findings here (mirrors core.Rule for Module rules)."""
+
+    id: str
+    severity: str
+    doc: str
+    run: Callable[[Trace], Iterable[str]]
+
+
+_IR_RULES: Dict[str, IRRule] = {}
+
+
+def _register(id: str, severity: str, doc: str):
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def deco(fn):
+        _IR_RULES[id] = IRRule(id=id, severity=severity, doc=doc, run=fn)
+        return fn
+    return deco
+
+
+def all_ir_rules() -> Dict[str, IRRule]:
+    return dict(_IR_RULES)
+
+
+def _finding(rule: IRRule, trace: Trace, message: str) -> Finding:
+    return Finding(severity=rule.severity, file=trace.entry.name, line=0,
+                   col=0, rule=rule.id, message=message)
+
+
+def run_ir_rules(traces: Sequence[Trace],
+                 rules: Dict[str, IRRule] = None) -> List[Finding]:
+    """Every rule over every trace, sorted worst-first like graftlint."""
+    rules = rules if rules is not None else all_ir_rules()
+    out: List[Finding] = []
+    for trace in traces:
+        for rule in rules.values():
+            out.extend(_finding(rule, trace, msg)
+                       for msg in rule.run(trace))
+    return sorted(out)
+
+
+# ----------------------------------------------------------------- rules
+
+
+@_register(
+    "ir-trace-error", "P1",
+    "A registry lowering failed to trace — the audit can no longer see "
+    "this code path, so the gate is silently off for it.")
+def rule_trace_error(trace: Trace) -> Iterable[str]:
+    if trace.error is not None:
+        yield (f"lowering failed to trace: {trace.error} — fix the entry "
+               "or the code path it names; an untraceable lowering is "
+               "ungated")
+
+
+@_register(
+    "ir-host-callback", "P0",
+    "Host callback primitive compiled into a lowering: every execution "
+    "blocks on a device->host round trip.")
+def rule_host_callback(trace: Trace) -> Iterable[str]:
+    for prim, n in sorted(trace.prims.items()):
+        if prim in CALLBACK_PRIMS:
+            yield (f"{n} `{prim}` op(s) compiled into this lowering — a "
+                   "host sync per execution; compute device-side or move "
+                   "the callback outside the hot program")
+
+
+@_register(
+    "ir-f64-widen", "P1",
+    "float64 values in a lowered jaxpr: doubled HBM/ICI bandwidth under "
+    "x64-on, silent f32 truncation under the default x64-off — either "
+    "way a drift from the sim's f32 discipline.")
+def rule_f64_widen(trace: Trace) -> Iterable[str]:
+    if trace.jaxpr is None:
+        return
+    f64 = jnp.dtype(np.float64)  # graftlint: ignore[f64-literal] -- the rule must name the dtype it hunts; nothing computes in f64 here
+    widens = 0
+    carriers: Dict[str, int] = {}
+    for eqn in iter_eqns(trace.jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is None or getattr(aval, "dtype", None) != f64:
+                continue
+            if eqn.primitive.name == "convert_element_type":
+                widens += 1
+            else:
+                name = eqn.primitive.name
+                carriers[name] = carriers.get(name, 0) + 1
+    if widens:
+        yield (f"{widens} convert_element_type op(s) widening to float64 "
+               "— pick an explicit f32 (or isolate the precision need) "
+               "instead of letting x64 flags decide")
+    if carriers:
+        ops = ", ".join(f"{p}×{n}" for p, n in sorted(carriers.items()))
+        yield (f"float64 values flow through this lowering ({ops}) — "
+               "f64 doubles bandwidth on every byte it touches")
+
+
+@_register(
+    "ir-gather-slot-budget", "P1",
+    "A frontier-compacted lowering whose gather/scatter traffic exceeds "
+    "the k·span slot budget on EVERY branch: the sparse path no longer "
+    "bounds its work by the frontier.")
+def rule_gather_slot_budget(trace: Trace) -> Iterable[str]:
+    budget = trace.entry.slot_budget
+    if budget is None or trace.jaxpr is None:
+        return
+    # The compiled program carries BOTH rounds (lax.cond: sparse within
+    # budget, dense fallback past it). The invariant is existential: some
+    # branch of each cond must keep its gather/scatter slots within the
+    # budget — if none does, the compaction itself is broken and every
+    # round pays dense-gather cost. Branch order in the jaxpr is an
+    # implementation detail, so the rule checks all of them.
+    conds = [e for e in iter_eqns(trace.jaxpr)
+             if e.primitive.name == "cond" and "branches" in e.params]
+    if not conds:
+        yield ("no lax.cond sparse/dense dispatch found in a lowering "
+               "with a frontier slot budget — the compaction (and its "
+               "dense fallback) has been compiled out")
+        return
+    for eqn in conds:
+        worst_per_branch = []
+        for branch in eqn.params["branches"]:
+            slots = 0
+            for sub in iter_eqns(branch):
+                prim = sub.primitive.name
+                if prim == "gather":
+                    slots = max(slots, int(sub.outvars[0].aval.size))  # graftlint: ignore[host-sync-in-loop] -- aval.size is static trace-time metadata, not a device value
+                elif prim.startswith("scatter"):
+                    # operands are (target, indices, updates); the traffic
+                    # the budget bounds is the updates being scattered.
+                    slots = max(slots, int(sub.invars[-1].aval.size))  # graftlint: ignore[host-sync-in-loop] -- static aval metadata again
+            worst_per_branch.append(slots)
+        if worst_per_branch and min(worst_per_branch) > budget:
+            yield (f"every branch of the sparse/dense cond moves more "
+                   f"slots than the frontier budget (min branch "
+                   f"{min(worst_per_branch)} > k·span {budget}) — the "
+                   "compaction no longer bounds work by the frontier")
+
+
+# ------------------------------------------------------------ parity gate
+
+
+def parity_findings(traces: Sequence[Trace]) -> List[Finding]:
+    """The cross-lowering abstract-signature gate: every traced lowering
+    of one ``(op, shape_class)`` parity group must produce the identical
+    ``eval_shape`` signature — otherwise the variants stopped being
+    interchangeable and every "bit-exact vs dense" claim is void. The
+    majority signature is treated as intended; minority entries get the
+    P0 finding (so one broken variant yields one finding, not N-1)."""
+    groups: Dict[tuple, List[Trace]] = {}
+    for t in traces:
+        if t.entry.parity and t.out_sig is not None:
+            groups.setdefault((t.entry.op, t.entry.shape_class),
+                              []).append(t)
+    out: List[Finding] = []
+    for (op, cls), members in sorted(groups.items()):
+        sigs: Dict[str, List[Trace]] = {}
+        for t in members:
+            sigs.setdefault(t.out_sig, []).append(t)
+        if len(sigs) <= 1:
+            continue
+        majority = max(sigs.values(), key=len)[0].out_sig
+        for sig, ts in sorted(sigs.items()):
+            if sig == majority:
+                continue
+            for t in ts:
+                out.append(Finding(
+                    severity="P0", file=t.entry.name, line=0, col=0,
+                    rule="ir-sig-parity",
+                    message=(f"abstract signature diverges from the other "
+                             f"`{op}@{cls}` lowerings: {sig} != {majority} "
+                             "— variants of one op must be drop-in "
+                             "interchangeable")))
+    return sorted(out)

@@ -17,8 +17,10 @@ rule                      sev    fires on
                                  compiled program is thrown away after the call
 ``host-sync-in-loop``     P1     ``.item()``, ``jax.device_get``, ``float()``/
                                  ``int()`` on non-literals, ``np.asarray``/
-                                 ``np.array`` on non-literals inside explicit
-                                 loops of a jax-importing module
+                                 ``np.array`` (device->host) and
+                                 ``jnp.asarray``/``jnp.array`` (host->device)
+                                 on non-literals inside explicit loops of a
+                                 jax-importing module
 ``tracer-branch``         P1     Python ``if``/``while`` on a value derived
                                  from a jitted function's traced parameters
                                  (shape/dtype/ndim/len derivations are static
@@ -240,6 +242,7 @@ def rule_host_sync_in_loop(module: Module) -> Iterable[Tuple[ast.AST, str]]:
     if not module.imports_package("jax"):
         return
     np_names = module.names_for("numpy")
+    jnp_names = module.names_for("jax.numpy")
     seen: Set[int] = set()
     for loop in ast.walk(module.tree):
         if not isinstance(loop, (ast.For, ast.While)):
@@ -265,14 +268,20 @@ def rule_host_sync_in_loop(module: Module) -> Iterable[Tuple[ast.AST, str]]:
                        "array; keep it on-device or convert after the loop")
             elif (isinstance(fn, ast.Attribute)
                   and isinstance(fn.value, ast.Name)
-                  and fn.value.id in np_names
+                  and fn.value.id in (np_names | jnp_names)
                   and fn.attr in ("asarray", "array")
                   and node.args
                   and not isinstance(node.args[0], (ast.Constant, ast.List,
                                                     ast.Tuple))):
-                msg = (f"np.{fn.attr}() on a non-literal in a loop — a "
-                       "device->host transfer per iteration when fed a jax "
-                       "array; transfer once after the loop")
+                # np.* forces the value to HOST each iteration when fed a
+                # jax array; jnp.* forces it to DEVICE each iteration when
+                # fed host data — either direction is a per-iteration
+                # transfer serializing the loop.
+                direction = ("device->host" if fn.value.id in np_names
+                             else "host->device")
+                msg = (f"{fn.value.id}.{fn.attr}() on a non-literal in a "
+                       f"loop — a {direction} transfer per iteration; "
+                       "convert once outside the loop")
             if msg is not None:
                 seen.add(id(node))
                 yield node, msg

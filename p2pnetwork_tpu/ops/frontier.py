@@ -95,6 +95,18 @@ def budget(graph: Graph, crossover=None) -> int:
     return max(_MIN_BUDGET, min(k, n_pad))
 
 
+def budget_slots(graph: Graph, crossover=None) -> int:
+    """Gathered/scattered slots of one SPARSE round: ``k · max_out_span``
+    (0 = sparse disabled on this graph). This is the IR-level invariant
+    the compiled program must honor — graftaudit's
+    ``ir-gather-slot-budget`` rule (analysis/ir/rules.py) checks every
+    gather/scatter of the sparse branch against exactly this number, so
+    the bound lives here, next to the budget arithmetic it derives from,
+    not re-derived in the auditor."""
+    k = budget(graph, crossover)
+    return k * max(graph.max_out_span, 1) if k else 0
+
+
 def occupancy(graph: Graph, frontier: jax.Array) -> jax.Array:
     """Active fraction of live nodes — the device-side stat the sparse/
     dense crossover is measured by (f32 scalar)."""

@@ -1270,11 +1270,11 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
     )
 
     def cond(carry):
-        _, _, rounds, covered, _, _ = carry
+        _, _, rounds, covered, _, _, _ = carry
         return (covered / n_live < coverage_target) & (rounds < max_rounds)
 
     def body(carry):
-        seen, frontier, rounds, _, hi, lo = carry
+        seen, frontier, rounds, prev_covered, hi, lo, occ = carry
         delivered = pass_(frontier)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
@@ -1284,21 +1284,31 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
         hi, lo = accum.add((hi, lo), msgs)
         covered = jax.lax.psum(jnp.sum((seen & node_mask_b).astype(jnp.int32)),
                                axis_name)
-        return seen, new, rounds + 1, covered, hi, lo
+        # Per-round frontier occupancy, the engine's ints exactly
+        # (ops/frontier.py occupancy: live-new count / live-node count as
+        # f32) so the packed mean matches the single-chip summary
+        # bit-for-bit — run-summary parity the mesh JaxSimNode tests pin.
+        # `new` is disjoint from the prior seen and pre-masked, so its
+        # live count IS the coverage delta — no extra psum per round.
+        occ = occ + ((covered - prev_covered) / n_live).astype(jnp.float32)
+        return seen, new, rounds + 1, covered, hi, lo, occ
 
     seen0_b = seen0[0]
     covered0 = jax.lax.psum(
         jnp.sum((seen0_b & node_mask_b).astype(jnp.int32)), axis_name
     )
-    init = (seen0_b, frontier0[0], jnp.int32(0), covered0, *accum.zero())
-    seen, frontier, rounds, covered, hi, lo = jax.lax.while_loop(
+    init = (seen0_b, frontier0[0], jnp.int32(0), covered0, *accum.zero(),
+            jnp.float32(0.0))
+    seen, frontier, rounds, covered, hi, lo, occ = jax.lax.while_loop(
         cond, body, init
     )
-    # One packed i32[4] (replicated) carries the whole summary back — the
-    # engine's single-transfer trick; four separate scalars cost four
-    # device->host round trips on tunneled backends.
+    # One packed i32[5] (replicated) carries the whole summary back — the
+    # engine's single-transfer trick; separate scalars each cost a
+    # device->host round trip on tunneled backends. The fifth slot is the
+    # mean per-round frontier occupancy (engine _stat_while parity).
     return seen[None], frontier[None], accum.pack_summary(
-        rounds, covered / n_live, (hi, lo)
+        rounds, covered / n_live, (hi, lo),
+        extra=occ / jnp.maximum(rounds, 1)
     )
 
 
@@ -1379,6 +1389,12 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
             jnp.float32(coverage_target), *common, seen0, frontier0,
         )
     out = accum.unpack_summary(packed)
+    # The packed fifth slot is the mean per-round frontier occupancy —
+    # surface it under the engine's summary key (run-summary parity:
+    # engine.run_until_coverage on a flood returns the same dict).
+    occ = out.pop("extra", None)
+    if occ is not None:
+        out["frontier_occupancy_mean"] = occ
     if return_state:
         return (seen, frontier), out
     return seen, out
@@ -2909,11 +2925,12 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
     pad_id = S * block - 1
 
     def cond(carry):
-        _, _, _, _, _, rounds, covered, _, _ = carry
+        _, _, _, _, _, rounds, covered, _, _, _ = carry
         return (covered / n_live < coverage_target) & (rounds < max_rounds)
 
     def body(carry):
-        seen, frontier, F, fncount, ficount, rounds, _, hi, lo = carry
+        (seen, frontier, F, fncount, ficount, rounds, prev_covered,
+         hi, lo, occ) = carry
         seen, frontier, F, fncount, ficount, msgs = jax.lax.cond(
             ficount <= k, sparse_round, dense_round,
             seen, frontier, F, fncount, ficount,
@@ -2922,8 +2939,13 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         covered = jax.lax.psum(
             jnp.sum((seen & node_mask_b).astype(jnp.int32)), axis_name
         )
+        # Same ints as the dense loop and the engine (ops/frontier.py
+        # occupancy) — the adaptive and dense summaries must stay
+        # bit-identical (tests pin `out_a == out_d`). The new frontier's
+        # live count IS the coverage delta, so no extra psum per round.
+        occ = occ + ((covered - prev_covered) / n_live).astype(jnp.float32)
         return (seen, frontier, F, fncount, ficount, rounds + 1, covered,
-                hi, lo)
+                hi, lo, occ)
 
     seen_b, frontier_b = seen0[0], frontier0[0]
     count0 = jnp.sum(frontier_b).astype(jnp.int32)
@@ -2934,12 +2956,13 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
         jnp.sum((seen_b & node_mask_b).astype(jnp.int32)), axis_name
     )
     init = (seen_b, frontier_b, F0, ncount0, item_budget(F0, ncount0),
-            jnp.int32(0), covered0, *accum.zero())
-    seen, frontier, _, _, _, rounds, covered, hi, lo = jax.lax.while_loop(
+            jnp.int32(0), covered0, *accum.zero(), jnp.float32(0.0))
+    seen, frontier, _, _, _, rounds, covered, hi, lo, occ = jax.lax.while_loop(
         cond, body, init
     )
     return seen[None], frontier[None], accum.pack_summary(
-        rounds, covered / n_live, (hi, lo)
+        rounds, covered / n_live, (hi, lo),
+        extra=occ / jnp.maximum(rounds, 1)
     )
 
 

@@ -384,6 +384,20 @@ _converged_loop_keeping = functools.partial(
                               "steps_per_round"))(_converged_loop)
 
 
+def donating_carry_loops() -> dict:
+    """The donating state-carry loops, by name — the exact jitted objects
+    the resume entry points dispatch, exposed as a stable seam for
+    graftaudit's donation audit (analysis/ir/donation.py: the compiled
+    ``input_output_alias`` must cover every carry leaf). Keyed by name so
+    a renamed or removed loop fails the audit loudly instead of leaving
+    the aliasing gate silently pointed at nothing."""
+    return {
+        "run_from": _run_from_donating,
+        "coverage_from": _coverage_loop_donating,
+        "converged_from": _converged_loop_donating,
+    }
+
+
 #: Memoized stats-key sets per (protocol, graph structure) — the abstract
 #: trace of init+step runs once, not per call (the run-to-* entry points
 #: sit on paths budgeted in milliseconds). FIFO-bounded: a sweep over many

@@ -35,7 +35,7 @@ def edge_uniform(key: jax.Array, walker, sender, receiver) -> jax.Array:
     golden = jnp.uint32(0x9E3779B9)
     h = kd[..., 0] ^ golden
     for v in (kd[..., 1], walker, sender, receiver):
-        v = jnp.asarray(v).astype(jnp.uint32)
+        v = jnp.asarray(v).astype(jnp.uint32)  # graftlint: ignore[host-sync-in-loop] -- 4-way trace-time unroll inside jit; asarray on a tracer is a no-op, not a transfer
         # boost::hash_combine, elementwise over the broadcast shape.
         h = h ^ (v + golden + (h << 6) + (h >> 2))
     # murmur3 fmix32 finalizer: full avalanche.

@@ -43,6 +43,11 @@ def pytest_configure(config):
         "supervise: supervised execution plane tests — watchdogs, "
         "checkpoint store, crash-tolerant runs (select with -m supervise; "
         "part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "audit: graftaudit IR-level audit tests — jaxpr rules, signature "
+        "parity, donation aliasing, cost ratchet (select with -m audit; "
+        "part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -140,10 +140,14 @@ class TestJaxRules:
         ("host = jax.device_get(x)", "device_get"),
         ("total += float(x)", "float(x)"),
         ("buf = np.asarray(x)", "np.asarray"),
+        ("buf = np.array(x)", "np.array"),
+        ("dev = jnp.asarray(x)", "jnp.asarray"),
+        ("dev = jnp.array(x)", "jnp.array"),
     ])
     def test_host_sync_in_loop_forms(self, stmt, needle):
         src = f"""
             import jax
+            import jax.numpy as jnp
             import numpy as np
 
             def drive(xs):
@@ -153,6 +157,22 @@ class TestJaxRules:
                 return total
         """
         assert_fires(src, "host-sync-in-loop", "HOT")
+
+    def test_jnp_asarray_on_literal_in_loop_is_clean(self):
+        # The non-literal condition: converting a CONSTANT per iteration
+        # is wasteful but not a transfer of loop data — stays clean, like
+        # the np.* twins (literal lists/tuples included).
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            def drive(xs):
+                out = []
+                for x in xs:
+                    out.append(jnp.asarray([1, 2, 3]) + jnp.array(0.5))
+                return out
+        """
+        assert not only(lint(src), "host-sync-in-loop")
 
     def test_host_sync_outside_loop_is_clean(self):
         src = """

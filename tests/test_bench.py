@@ -194,6 +194,12 @@ class TestStageTelemetry:
             assert tel["stages"]["graph_build_s"] > 0
             assert tel["stages"]["compile_s"] > 0
             assert tel["stages"]["transfer_bytes"] > 0
+            # The graftaudit static cost model rides beside the measured
+            # numbers: the stage's shape-class slice of budgets.json.
+            model = tel["ir_cost_model"]
+            assert model["shape_class"] == "ws1k"
+            assert model["entries"]["or/frontier@ws1k"]["flops"] > 0
+            assert "cov/flood-ppermute@ws1k" in model["entries"]
 
     def test_headline_format_unchanged_by_telemetry(self, first_run):
         # The driver parses the LAST stdout line; the artifact must not
