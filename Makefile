@@ -8,7 +8,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
-	analysis-check supervise-check audit-check
+	analysis-check supervise-check audit-check build-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -63,6 +63,15 @@ analysis-check:
 audit-check:
 	$(PY) -m p2pnetwork_tpu.analysis.ir
 	$(TEST_ENV) $(PY) -m pytest tests/test_iraudit.py -q
+
+# Incremental builds + IO-aware layouts: delta/rebuild bit-identity
+# property sweep (native + numpy fallback), reorder-pass parity, layout
+# cache, and the CI perf ratchet — a 1%-edge delta at 1M-edge scale must
+# beat the from-scratch rebuild >= 10x on CPU (ratio-based, no
+# wall-clock thresholds, no TPU; tox env "buildperf").
+build-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_layout_delta.py -q
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m buildperf
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

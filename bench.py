@@ -36,13 +36,17 @@ a time, twice exactly when the driver ran this file):
   supervised pass resumes that trail bit-identically.
 
 Graph construction is the dominant host-side cost (≈16 s at 1M, ≈49 s at
-10M): built graphs are persisted once via the repo's own
-``sim/checkpoint.py`` ``save_graph``/``load_graph`` under ``bench_cache/``
-and reloaded on later runs, shrinking the healthy-tunnel window a
-successful bench needs. ``BENCH_CACHE=0`` disables; a corrupt/missing
-cache file falls back to a fresh build, reported as a structured
-``bench_cache_miss`` warning event (stderr JSONL, telemetry-schema) plus
-a ``bench_cache_miss_total{reason=...}`` counter — never swallowed.
+10M): built graphs are persisted once through the shared content-addressed
+layout store (``sim/layoutcache.py``, which generalized this file's
+original private cache) under ``bench_cache/`` and reloaded on later
+runs, shrinking the healthy-tunnel window a successful bench needs.
+``BENCH_CACHE=0`` disables; a corrupt/missing cache file falls back to a
+fresh build, reported as a structured ``bench_cache_miss`` warning event
+(stderr JSONL, telemetry-schema) plus a
+``bench_cache_miss_total{reason=...}`` counter — never swallowed. Cold
+builds additionally publish the per-phase attribution of where the build
+seconds went (dedup/sort/tables/CSR/layouts/reorder — sim/graph.py) as
+``build_phases`` in the stage telemetry artifact.
 
 Telemetry (telemetry/): each measuring stage writes a per-stage artifact —
 ``BENCH_TELEMETRY.json`` for the 1M headline stage (``BENCH_TELEMETRY_10M
@@ -157,28 +161,22 @@ def _cache_dir():
 
 def _layout_fingerprint():
     """Hash of the sources that determine a built graph's arrays and kernel
-    layouts. Folded into cache filenames so an edit to the builder or the
-    blocked/hybrid/CSR layout code invalidates stale caches automatically —
-    bench_cache/ persists across rounds on the driver box, and measuring a
-    previous round's data layout would be a silently wrong benchmark."""
-    import hashlib
+    layouts, via the shared library-level store (sim/layoutcache.py — its
+    DEFAULT_SOURCES cover the graph builder, reorder pass, topology
+    generators, kernel layouts, native sort/merge kernels and the
+    serializer). bench.py itself is folded in on top: the cache NAME only
+    carries n, so an edit to a build call's other kwargs (k, p, layout
+    flags) must also invalidate."""
+    from p2pnetwork_tpu.sim import layoutcache
 
-    h = hashlib.blake2b(digest_size=6)
-    # bench.py itself is in the set: the cache NAME only carries n, so an
-    # edit to a build call's other kwargs (k, p, layout flags) must also
-    # invalidate.
-    for rel in ("bench.py", "p2pnetwork_tpu/sim/graph.py",
-                "p2pnetwork_tpu/ops/blocked.py", "p2pnetwork_tpu/ops/diag.py",
-                "p2pnetwork_tpu/ops/skew.py", "p2pnetwork_tpu/ops/bitset.py",
-                "p2pnetwork_tpu/ops/frontier.py",
-                "p2pnetwork_tpu/sim/checkpoint.py"):
-        with open(os.path.join(_HERE, rel), "rb") as f:
-            h.update(f.read())
-    return h.hexdigest()
+    return layoutcache.fingerprint(
+        extra_sources=(os.path.join(_HERE, "bench.py"),))
 
 
 def _cached_graph(name: str, build):
-    """Load ``bench_cache/<name>.npz`` if present, else build + persist.
+    """Load ``bench_cache/<name>.npz`` if present, else build + persist —
+    the shared content-addressed layout store (sim/layoutcache.py) keyed
+    under BENCH_CACHE_DIR.
 
     Returns ``(graph, build_seconds, from_cache)``. Any cache failure
     (missing file, version skew, truncated write) falls back to a fresh
@@ -189,46 +187,28 @@ def _cached_graph(name: str, build):
     ``bench_cache_miss_total{reason=missing|corrupt|disabled}`` counter —
     a driver round quietly paying a 49 s rebuild is a diagnosis, not noise.
     """
-    from p2pnetwork_tpu.sim import checkpoint as ckpt
+    from p2pnetwork_tpu.sim import layoutcache
 
     misses = telemetry.default_registry().counter(
         "bench_cache_miss_total",
         "Graph-cache misses by cause; every miss costs a full rebuild.",
         ("reason",))
-    path = os.path.join(_cache_dir(), f"{name}_{_layout_fingerprint()}.npz")
-    enabled = os.environ.get("BENCH_CACHE", "1") != "0"
-    if enabled and os.path.exists(path):
-        try:
-            t0 = time.perf_counter()
-            g = ckpt.load_graph(path)
-            dt = time.perf_counter() - t0
-            print(f"# {name}: loaded cached graph in {dt:.1f}s ({path})",
-                  file=sys.stderr, flush=True)
-            return g, dt, True
-        except Exception as e:
-            misses.labels(reason="corrupt").inc()
-            _warn_event("bench_cache_miss", reason="corrupt", graph=name,
-                        path=path, error=f"{type(e).__name__}: {e}")
-    elif enabled:
-        misses.labels(reason="missing").inc()
-        _warn_event("bench_cache_miss", reason="missing", graph=name,
-                    path=path)
-    else:
-        misses.labels(reason="disabled").inc()
-        _warn_event("bench_cache_miss", reason="disabled", graph=name)
-    t0 = time.perf_counter()
-    g = build()
-    dt = time.perf_counter() - t0
-    if enabled:
-        try:
-            os.makedirs(_cache_dir(), exist_ok=True)
-            ckpt.save_graph(path, g)
-            print(f"# {name}: built in {dt:.1f}s, cached to {path}",
-                  file=sys.stderr, flush=True)
-        except Exception as e:  # a full disk must not sink the bench
-            print(f"# {name}: cache save failed ({type(e).__name__}: {e})",
-                  file=sys.stderr, flush=True)
-    return g, dt, False
+
+    def on_miss(reason, path, error):
+        misses.labels(reason=reason).inc()
+        data = {"reason": reason, "graph": name}
+        if reason != "disabled":
+            data["path"] = path
+        if error is not None:
+            data["error"] = error
+        _warn_event("bench_cache_miss", **data)
+
+    return layoutcache.cached_graph(
+        name, build, cache_dir=_cache_dir(),
+        extra_sources=(os.path.join(_HERE, "bench.py"),),
+        enabled=os.environ.get("BENCH_CACHE", "1") != "0",
+        on_miss=on_miss,
+        log=lambda msg: print(f"# {msg}", file=sys.stderr, flush=True))
 
 
 # --------------------------------------------------------- supervised stages
@@ -374,9 +354,15 @@ def bench_1m(record):
     and returns the per-stage telemetry dict BENCH_TELEMETRY.json carries."""
     import jax
 
+    from p2pnetwork_tpu.sim import graph as G
+
     n, name, build = _graph_spec_1m()
     target = 0.99
     g, build_s, cached = _cached_graph(name, build)
+    # Per-phase attribution of where the build seconds went (dedup/sort/
+    # tables/CSR/layouts/reorder) — empty on a cache hit, which built
+    # nothing.
+    build_phases = {} if cached else G.last_build_phases()
     # Crash-evidence pass FIRST: everything after this point wedging still
     # leaves a resumable checkpoint trail + manifest for the parent.
     supervised = _supervised_pass("1m", g, target=target, max_rounds=64)
@@ -429,13 +415,17 @@ def bench_1m(record):
         "n_edges": g.n_edges,
     })
     return {"graph_build_s": round(build_s, 4), "cache_hit": cached,
+            "build_phases": build_phases,
             "supervised": supervised, "per_method": per_method}
 
 
 def bench_10m():
     """The scale row: 10M nodes / ~100M directed edges on ONE chip."""
+    from p2pnetwork_tpu.sim import graph as G
+
     n, name, build = _graph_spec_10m()
     g, build_s, cached = _cached_graph(name, build)
+    build_phases = {} if cached else G.last_build_phases()
     supervised = _supervised_pass("10m", g, target=0.99, max_rounds=64)
     secs, out, timing = time_flood(g, "adaptive-2048", target=0.99,
                                    max_rounds=64, reps=3)
@@ -455,7 +445,7 @@ def bench_10m():
         "n_nodes": n,
         "n_edges": g.n_edges,
     }, {"graph_build_s": round(build_s, 4), "cache_hit": cached,
-        "supervised": supervised,
+        "build_phases": build_phases, "supervised": supervised,
         "per_method": {"adaptive-2048": {"best_s": round(secs, 6), **timing}}}
 
 
@@ -489,6 +479,7 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         "schema": "bench-telemetry-v1",
         "stage": stage,
         "stage_wall_s": round(stage_wall_s, 4),
+        "build_phases": tel.get("build_phases", {}),
         "stages": {
             "graph_build_s": tel.get("graph_build_s", 0.0),
             "cache_hit": tel.get("cache_hit", False),

@@ -79,6 +79,123 @@ void gc_sort_pairs_i32(const int32_t* keys, const int32_t* vals, int64_t n,
     }
 }
 
+// ---------------------------------------------------------------- deltas
+//
+// Incremental (delta) graph builds: the base COO edge arrays are already
+// receiver-sorted, so applying an add/remove batch never needs the full
+// radix sort again — only the delta is sorted (gc_sort_pairs_i32 above),
+// then these linear passes merge/anti-merge it into the base order. All
+// of them are single sweeps with no allocation; the Python layer
+// (sim/graph.py apply_delta) owns the padding and bookkeeping.
+
+// Anti-merge: mark which base edges survive a removal batch. The base
+// arrays are the full padded COO (receiver-sorted among live slots);
+// alive[i] != 0 marks live slots. Removals (rr, rs) must be sorted by
+// (receiver, sender). keep[i] is set to 1 exactly for live, un-removed
+// edges; rem_hits[j] counts how many live copies removal j matched (the
+// caller raises on zeros — removing an absent edge is a bug, not a
+// no-op). Returns the kept count.
+int64_t gc_delta_antimerge_i32(const int32_t* br, const int32_t* bs,
+                               const uint8_t* alive, int64_t nb,
+                               const int32_t* rr, const int32_t* rs,
+                               int64_t nr, uint8_t* keep,
+                               int32_t* rem_hits) {
+    // Removal-driven: keep starts as the liveness mask (one memcpy), then
+    // each removal binary-searches its receiver's contiguous run and
+    // clears the matching copies — O(removals * (log E + run width)), no
+    // O(E) sweep at all. The padded receiver array is globally sorted
+    // (padding holds the max id), so the search covers dead slots too;
+    // the alive[] check skips them.
+    std::memcpy(keep, alive, nb);
+    int64_t cleared = 0;
+    int64_t lo = 0, hi = 0;
+    int32_t win_r = -1;
+    for (int64_t j = 0; j < nr; ++j) {
+        if (rr[j] != win_r) {  // removals sorted by (receiver, sender)
+            lo = std::lower_bound(br, br + nb, rr[j]) - br;
+            hi = std::upper_bound(br + lo, br + nb, rr[j]) - br;
+            win_r = rr[j];
+        }
+        int32_t hits = 0;
+        for (int64_t i = lo; i < hi; ++i) {
+            if (alive[i] && bs[i] == rs[j]) {
+                ++hits;  // every live copy counts, duplicates included
+                if (keep[i]) {
+                    keep[i] = 0;
+                    ++cleared;
+                }
+            }
+        }
+        rem_hits[j] = hits;
+    }
+    return cleared;
+}
+
+// Stable merge of the kept base edges with a receiver-sorted delta batch
+// (base first on equal receivers — exactly the order a stable from-scratch
+// sort of [kept base, delta] would produce). Writes the merged
+// receiver/sender arrays plus each side's landing position: posa[i] is the
+// merged index of base slot i (-1 for dropped slots), posb[j] the merged
+// index of delta entry j. Returns the merged count.
+int64_t gc_delta_merge_i32(const int32_t* br, const int32_t* bs,
+                           const uint8_t* keep, int64_t nb,
+                           const int32_t* dr, const int32_t* ds, int64_t nd,
+                           int32_t* out_r, int32_t* out_s,
+                           int32_t* posa, int32_t* posb) {
+    int64_t out = 0, j = 0;
+    for (int64_t i = 0; i < nb; ++i) {
+        if (!keep[i]) {
+            posa[i] = -1;
+            continue;
+        }
+        while (j < nd && dr[j] < br[i]) {
+            out_r[out] = dr[j];
+            out_s[out] = ds[j];
+            posb[j++] = (int32_t)out++;
+        }
+        out_r[out] = br[i];
+        out_s[out] = bs[i];
+        posa[i] = (int32_t)out++;
+    }
+    while (j < nd) {
+        out_r[out] = dr[j];
+        out_s[out] = ds[j];
+        posb[j++] = (int32_t)out++;
+    }
+    return out;
+}
+
+// Remap an edge-id list through a position map, dropping entries that map
+// to -1 (removed edges). Order-preserving; returns the surviving count.
+int64_t gc_map_filter_i32(const int32_t* eids, int64_t n,
+                          const int32_t* pos, int32_t* out) {
+    int64_t m = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t p = pos[eids[i]];
+        if (p >= 0) out[m++] = p;
+    }
+    return m;
+}
+
+// Merge two edge-id lists, each already sorted by (senders[eid], eid)
+// ascending, preserving that order — the incremental source-CSR update
+// (sim/graph.py apply_delta): the surviving old CSR order merged with the
+// delta's sender-sorted ids replaces a full radix re-sort of E edges.
+void gc_merge_eids_by_sender_i32(const int32_t* senders, const int32_t* ea,
+                                 int64_t na, const int32_t* eb, int64_t nb,
+                                 int32_t* out) {
+    int64_t i = 0, j = 0, o = 0;
+    while (i < na && j < nb) {
+        int32_t sa = senders[ea[i]], sb = senders[eb[j]];
+        if (sa < sb || (sa == sb && ea[i] < eb[j]))
+            out[o++] = ea[i++];
+        else
+            out[o++] = eb[j++];
+    }
+    while (i < na) out[o++] = ea[i++];
+    while (j < nb) out[o++] = eb[j++];
+}
+
 // Sort non-negative int64 keys ascending, drop duplicates in place;
 // returns the unique count.
 int64_t gc_sort_unique_i64(int64_t* keys, int64_t n) {

@@ -1,11 +1,14 @@
 """Simulation backend: populations of peers as device arrays.
 
-- ``graph``: static-shape peer graphs + generators
+- ``graph``: static-shape peer graphs + generators, incremental
+  ``GraphDelta``/``apply_delta`` builds
 - ``engine``: compiled round execution (scan / while_loop)
 - ``simnode``: JaxSimNode, the Node-API bridge
 - ``checkpoint``: save/resume of simulation state
 - ``failures``: fault injection (node/edge liveness masks)
 - ``topology``: runtime joins/connects (capacity-padded dynamic edges)
+- ``layout``: IO-aware build-time node reordering (degree / RCM)
+- ``layoutcache``: content-addressed persistence of built layouts
 """
 
 from p2pnetwork_tpu.utils.jax_env import apply_platform_env as _apply_platform_env
@@ -17,12 +20,14 @@ from p2pnetwork_tpu.sim import (  # noqa: E402
     engine,
     failures,
     graph,
+    layout,
+    layoutcache,
     topology,
 )
-from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.sim.graph import Graph, GraphDelta
 from p2pnetwork_tpu.sim.simnode import JaxSimNode, SimPeer
 
 __all__ = [
-    "Graph", "JaxSimNode", "SimPeer", "checkpoint", "engine", "failures",
-    "graph", "topology",
+    "Graph", "GraphDelta", "JaxSimNode", "SimPeer", "checkpoint", "engine",
+    "failures", "graph", "layout", "layoutcache", "topology",
 ]

@@ -408,7 +408,7 @@ _GRAPH_ARRAYS = (
     "senders", "receivers", "edge_mask", "node_mask", "in_degree",
     "out_degree", "neighbors", "neighbor_mask", "dyn_senders",
     "dyn_receivers", "dyn_mask", "src_eid", "src_offsets", "edge_weight",
-    "neighbor_weight",
+    "neighbor_weight", "layout_perm", "layout_inv",
 )
 
 
@@ -430,6 +430,8 @@ def save_graph(path: str, graph) -> None:
         "n_nodes": graph.n_nodes,
         "n_edges": graph.n_edges,
         "neighbors_complete": graph.neighbors_complete,
+        "max_degree_cap": graph.max_degree_cap,
+        "edge_pad_multiple": graph.edge_pad_multiple,
         "max_in_span": graph.max_in_span,
         "max_out_span": graph.max_out_span,
     }
@@ -541,10 +543,13 @@ def load_graph(path: str):
                 offsets=tuple(meta["hybrid_offsets"]),
                 n=int(meta["hybrid_n"]),
             )
+        cap = meta.get("max_degree_cap")  # absent in pre-cap files
         return Graph(
             n_nodes=int(meta["n_nodes"]),
             n_edges=int(meta["n_edges"]),
             neighbors_complete=bool(meta["neighbors_complete"]),
+            max_degree_cap=None if cap is None else int(cap),
+            edge_pad_multiple=int(meta.get("edge_pad_multiple", 128)),
             max_in_span=int(meta["max_in_span"]),
             max_out_span=int(meta["max_out_span"]),
             blocked=blocked,

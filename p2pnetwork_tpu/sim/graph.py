@@ -26,17 +26,94 @@ propagation.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import threading
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from p2pnetwork_tpu import native
+from p2pnetwork_tpu import native, telemetry
 
 
 def _round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
+
+
+# ------------------------------------------------------ build-phase timing
+#
+# Host-side graph construction is the scale bottleneck (BENCH_r02:
+# graph_build_s 40x the 1M headline), so where the build time goes —
+# dedup, radix sort, neighbor tables, CSR, kernel layouts, reordering —
+# is first-class telemetry: per-phase wall seconds accumulate into the
+# registry (`sim_graph_build_seconds_total{phase}`) and the most recent
+# build's breakdown is readable via :func:`last_build_phases` (bench.py
+# publishes it as ``build_phases`` in BENCH_TELEMETRY.json).
+
+_phases_tls = threading.local()
+
+
+def _phases_dict() -> dict:
+    d = getattr(_phases_tls, "d", None)
+    if d is None:
+        d = _phases_tls.d = {}
+    return d
+
+
+def _reset_phases() -> None:
+    """Start a fresh per-build phase record, folding in any dedup time a
+    generator accumulated just before calling :func:`from_edges` (the
+    generators dedup BEFORE building, so the pending value belongs to the
+    build that follows)."""
+    d = _phases_dict()
+    d.clear()
+    pending = getattr(_phases_tls, "pending_dedup", 0.0)
+    if pending:
+        d["dedup_s"] = round(pending, 6)
+        _phases_tls.pending_dedup = 0.0
+
+
+def _note_dedup(seconds: float) -> None:
+    """Accumulate generator-side dedup/sample time for the NEXT build."""
+    _phases_tls.pending_dedup = getattr(
+        _phases_tls, "pending_dedup", 0.0) + seconds
+    telemetry.default_registry().counter(
+        "sim_graph_build_seconds_total",
+        "Host-side graph construction wall seconds by build phase.",
+        ("phase",)).labels("dedup").inc(seconds)
+
+
+def last_build_phases() -> dict:
+    """Per-phase wall-second breakdown of the most recent graph build
+    (``from_edges`` or ``apply_delta``) on this thread."""
+    return dict(_phases_dict())
+
+
+def _note_phase(name: str, seconds: float) -> None:
+    d = _phases_dict()
+    d[name + "_s"] = round(d.get(name + "_s", 0.0) + seconds, 6)
+    telemetry.default_registry().counter(
+        "sim_graph_build_seconds_total",
+        "Host-side graph construction wall seconds by build phase.",
+        ("phase",)).labels(name).inc(seconds)
+
+
+class _phase:
+    """Context manager: time one build phase into the thread-local record
+    and the ``sim_graph_build_seconds_total{phase}`` counter."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _note_phase(self.name, time.perf_counter() - self._t0)
+        return False
 
 
 def _padded_row_fill(starts: np.ndarray, counts: np.ndarray, width: int):
@@ -91,6 +168,21 @@ class Graph:
     neighbors_complete: bool = dataclasses.field(
         default=True, metadata=dict(static=True)
     )
+    # The from_edges(max_degree=...) cap as given, or None. Distinct from
+    # the table width: a cap WIDER than the build-time max in-degree
+    # leaves the table complete at the narrower width, but must still
+    # bound it when churn (apply_delta) or consolidation later grows a
+    # hub past it. None on graphs from old checkpoints (pre-cap format).
+    max_degree_cap: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+    # The from_edges(edge_pad_multiple=) value, recorded so apply_delta
+    # re-pads to the SAME multiple — a base built with a coarse multiple
+    # to hold shapes stable across churn must not snap back to 128 (and
+    # recompile every jitted consumer) on the first delta.
+    edge_pad_multiple: int = dataclasses.field(
+        default=128, metadata=dict(static=True)
+    )
     # Widest contiguous run of one receiver id among the LIVE (unpadded)
     # COO entries — i.e. the max static in-degree at build. The padding
     # tail (receiver n_pad-1) can extend that id's physical run far wider;
@@ -134,6 +226,14 @@ class Graph:
     # the neighbor table rows); built alongside the table when weights are
     # present so propagate_min_plus's gather lowering has aligned costs.
     neighbor_weight: Optional[jax.Array] = None  # f32[N_pad, max_degree]
+    # IO-aware build-time node relabeling (sim/layout.py, from_edges
+    # ``reorder=``): ``layout_perm[old] = new`` and ``layout_inv[new] =
+    # old`` over the padded id space, or None when the graph keeps caller
+    # order. Every runtime id (protocol sources, failures, deltas) speaks
+    # the RELABELED space; map per-node results back with
+    # ``layout.to_original_order``.
+    layout_perm: Optional[jax.Array] = None  # i32[N_pad]
+    layout_inv: Optional[jax.Array] = None  # i32[N_pad]
 
     @property
     def n_nodes_padded(self) -> int:
@@ -251,6 +351,17 @@ class Graph:
 
         return dataclasses.replace(self, skew=build_skew(self, width))
 
+    def apply_delta(self, delta: "GraphDelta", *,
+                    edge_pad_multiple: Optional[int] = None,
+                    donate: bool = False) -> "Graph":
+        """Apply an add/remove edge batch incrementally — see
+        :func:`apply_delta` (O(delta + touched rows) host work instead of
+        a from-scratch rebuild, bit-identical results; ``donate=True``
+        updates the neighbor table in place, invalidating this graph's
+        copy)."""
+        return apply_delta(self, delta, edge_pad_multiple=edge_pad_multiple,
+                           donate=donate)
+
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
@@ -288,6 +399,516 @@ def _build_source_csr(senders: np.ndarray, edge_mask: np.ndarray,
     return eid, offsets, span
 
 
+# ------------------------------------------------------- incremental builds
+
+
+def _as_edge_array(x, dtype=np.int32) -> np.ndarray:
+    return (np.zeros(0, dtype=dtype) if x is None
+            else np.asarray(x, dtype=dtype).reshape(-1))
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphDelta:
+    """A host-side add/remove edge batch for :func:`apply_delta`.
+
+    Directed edges, like :func:`from_edges` — for the usual undirected
+    overlay semantics build via :meth:`undirected`, which stores both
+    directions of every pair. ``add_weights`` is required exactly when the
+    target graph carries ``edge_weight``. Removals name (sender, receiver)
+    pairs; every named pair must match at least one live edge (removing an
+    absent edge is an error, not a no-op), and removal drops ALL live
+    copies of the pair.
+    """
+
+    add_senders: Optional[np.ndarray] = None  # i32[A]
+    add_receivers: Optional[np.ndarray] = None  # i32[A]
+    add_weights: Optional[np.ndarray] = None  # f32[A]
+    remove_senders: Optional[np.ndarray] = None  # i32[R]
+    remove_receivers: Optional[np.ndarray] = None  # i32[R]
+
+    def __post_init__(self):
+        set_ = object.__setattr__  # frozen dataclass
+        set_(self, "add_senders", _as_edge_array(self.add_senders))
+        set_(self, "add_receivers",
+             _as_edge_array(self.add_receivers))
+        set_(self, "remove_senders",
+             _as_edge_array(self.remove_senders))
+        set_(self, "remove_receivers",
+             _as_edge_array(self.remove_receivers))
+        if self.add_weights is not None:
+            set_(self, "add_weights",
+                 np.asarray(self.add_weights, dtype=np.float32).reshape(-1))
+            if self.add_weights.shape != self.add_senders.shape:
+                raise ValueError("add_weights must align with add_senders")
+        if self.add_senders.shape != self.add_receivers.shape:
+            raise ValueError("add_senders/add_receivers shape mismatch")
+        if self.remove_senders.shape != self.remove_receivers.shape:
+            raise ValueError(
+                "remove_senders/remove_receivers shape mismatch")
+
+    @classmethod
+    def undirected(cls, add_senders=None, add_receivers=None,
+                   add_weights=None, remove_senders=None,
+                   remove_receivers=None) -> "GraphDelta":
+        """Both directions of every pair — the reference's TCP-connection
+        semantic, matching what the generators store."""
+        a_s = _as_edge_array(add_senders)
+        a_r = _as_edge_array(add_receivers)
+        r_s = _as_edge_array(remove_senders)
+        r_r = _as_edge_array(remove_receivers)
+        a_w = None
+        if add_weights is not None:
+            w = np.asarray(add_weights, dtype=np.float32).reshape(-1)
+            a_w = np.concatenate([w, w])
+        return cls(
+            add_senders=np.concatenate([a_s, a_r]),
+            add_receivers=np.concatenate([a_r, a_s]),
+            add_weights=a_w,
+            remove_senders=np.concatenate([r_s, r_r]),
+            remove_receivers=np.concatenate([r_r, r_s]),
+        )
+
+    @property
+    def n_adds(self) -> int:
+        return int(self.add_senders.size)
+
+    @property
+    def n_removes(self) -> int:
+        return int(self.remove_senders.size)
+
+
+def _pow2_pad(n: int) -> int:
+    """Next power of two — the shape bucket for the donated scatters, so a
+    churn storm whose batch sizes vary only compiles log2(N) variants
+    instead of one per distinct delta size (the retrace hazard
+    analysis/retrace_guard exists to catch)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _pad_repeat_last(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad axis 0 to ``n`` by repeating the last entry — safe filler for
+    scatters whose duplicate indices carry identical values."""
+    if arr.shape[0] == n:
+        return arr
+    reps = np.repeat(arr[-1:], n - arr.shape[0], axis=0)
+    return np.concatenate([arr, reps])
+
+
+@functools.partial(jax.jit, donate_argnames=("arr",))
+def _scatter_add_donating(arr, idx, deltas):
+    """Donated scatter-add: the delta path's in-place degree update —
+    O(delta) writes into the existing buffer instead of an O(N) copy.
+    The donor graph's degree buffer is invalidated."""
+    return arr.at[idx].add(deltas)
+
+
+@functools.partial(jax.jit, donate_argnames=("table",))
+def _scatter_rows_donating(table, rows, vals):
+    """Donated row scatter: the delta path's in-place neighbor-table
+    update. Donation lets XLA write the touched rows into the EXISTING
+    buffer — O(touched) instead of an O(N x width) copy, which is the
+    difference between a delta apply and a rebuild at 10M-node table
+    sizes. The donor graph's table buffer is invalidated (engine-style
+    donation contract)."""
+    return table.at[rows].set(vals)
+
+
+def _delta_neighbor_tables(graph: Graph, out_r, out_s, w_unpadded,
+                           static_in, touched, width_old, pristine,
+                           donate=False):
+    """The neighbor-table piece of :func:`apply_delta`: copy the base
+    table, recompute only the touched rows (plus every width-capped row —
+    their shared subsample RNG stream is global) — bit-identical to the
+    table :func:`from_edges` builds from the merged edge list. With
+    ``donate=True`` (and an unchanged width on a pristine base) the
+    touched rows scatter into the base table IN PLACE — no copy at
+    all."""
+    e_new = out_r.size
+    n_pad = graph.n_nodes_padded
+    true_width = int(static_in.max()) if e_new else 0
+    cap = graph.max_degree_cap
+    if cap is None and not graph.neighbors_complete:
+        # Old-checkpoint graphs predate the recorded cap; an incomplete
+        # table's width IS the cap (it bit at build).
+        cap = width_old
+    if cap is None:
+        complete = True
+        width = max(true_width, 1)
+    else:
+        complete = cap >= true_width
+        width = max(min(true_width, cap), 1)
+    weighted = graph.neighbor_weight is not None
+    in_place = donate and pristine and width == width_old
+    if not pristine:
+        # Liveness-re-masked base (edges dropped by failures): copied rows
+        # would keep their holes while a rebuild compacts them — recompute
+        # everything.
+        touched = np.arange(n_pad, dtype=np.int32)
+    if not complete or (not graph.neighbors_complete):
+        # Any over-width row's subsample keys come from ONE rng stream over
+        # all capped rows, so every capped row recomputes whenever a cap is
+        # in play — still O(capped edges), never O(E).
+        capped_rows = np.flatnonzero(static_in > width)
+        touched = np.union1d(touched, capped_rows)
+    # int32 rows keep searchsorted from promoting (and re-copying) the
+    # million-element receiver array to int64 per call.
+    rows = np.asarray(touched, dtype=np.int32)
+
+    vals = valid = wvals = None
+    if rows.size:
+        starts = np.searchsorted(out_r, rows)
+        ends = np.searchsorted(out_r, rows, side="right")
+        deg = ends - starts
+        take, valid = _padded_row_fill(starts, np.minimum(deg, width), width)
+        capped_local = np.nonzero(deg > width)[0]
+        if capped_local.size:
+            # The exact from_edges subsample, restricted to the capped rows
+            # (which are all present in `rows`, ascending): same rng seed,
+            # same draw order, same ranking — bit-identical keep sets.
+            cap_rng = np.random.default_rng(0)
+            degc = deg[capped_local]
+            cap_edge = np.repeat(capped_local, degc)
+            offs = np.arange(cap_edge.size) - np.repeat(
+                np.cumsum(degc) - degc, degc)
+            edge_idx = starts[cap_edge] + offs
+            keys = cap_rng.random(edge_idx.size)
+            order = np.lexsort((keys, cap_edge))
+            rank = np.empty_like(offs)
+            rank[order] = offs
+            kept = rank < width
+            resort = np.lexsort((edge_idx[kept], cap_edge[kept]))
+            take[capped_local] = edge_idx[kept][resort].reshape(
+                capped_local.size, width)
+        pool = out_s if e_new else np.zeros(1, dtype=np.int32)
+        take_safe = np.minimum(take, max(e_new - 1, 0))
+        vals = np.where(valid, pool[take_safe], 0).astype(np.int32)
+        if weighted:
+            wpool = w_unpadded if e_new else np.zeros(1, dtype=np.float32)
+            wvals = np.where(valid, wpool[take_safe], 0.0).astype(np.float32)
+
+    if in_place:
+        # Donated scatter: the touched rows land in the base buffers with
+        # no table-sized copy — the donor graph's table is invalidated.
+        if rows.size:
+            # Bucket the scatter shape (pad by repeating the last row —
+            # duplicate indices carry identical values, so the write is
+            # idempotent) to bound recompiles across varying batch sizes.
+            b = _pow2_pad(rows.size)
+            rows_j = jnp.asarray(_pad_repeat_last(rows, b))
+            nb = _scatter_rows_donating(graph.neighbors, rows_j,
+                                        _pad_repeat_last(vals, b))
+            nbm = _scatter_rows_donating(graph.neighbor_mask, rows_j,
+                                         _pad_repeat_last(valid, b))
+            nw = (_scatter_rows_donating(graph.neighbor_weight, rows_j,
+                                         _pad_repeat_last(wvals, b))
+                  if weighted else None)
+        else:  # nothing touched: the table is exactly the base table
+            nb, nbm = graph.neighbors, graph.neighbor_mask
+            nw = graph.neighbor_weight
+        return nb, nbm, nw, complete
+
+    nw = None
+    if width == width_old and pristine:
+        nb = np.array(graph.neighbors)  # writable copies
+        nbm = np.array(graph.neighbor_mask)
+        if weighted:
+            nw = np.array(graph.neighbor_weight)
+    elif pristine:
+        c = min(width, width_old)
+        nb = np.zeros((n_pad, width), dtype=np.int32)
+        nbm = np.zeros((n_pad, width), dtype=bool)
+        nb[:, :c] = np.asarray(graph.neighbors)[:, :c]
+        nbm[:, :c] = np.asarray(graph.neighbor_mask)[:, :c]
+        if weighted:
+            nw = np.zeros((n_pad, width), dtype=np.float32)
+            nw[:, :c] = np.asarray(graph.neighbor_weight)[:, :c]
+    else:
+        nb = np.zeros((n_pad, width), dtype=np.int32)
+        nbm = np.zeros((n_pad, width), dtype=bool)
+        if weighted:
+            nw = np.zeros((n_pad, width), dtype=np.float32)
+    if rows.size:
+        nb[rows] = vals
+        nbm[rows] = valid
+        if weighted:
+            nw[rows] = wvals
+    return nb, nbm, nw, complete
+
+
+def apply_delta(graph: Graph, delta: GraphDelta, *,
+                edge_pad_multiple: Optional[int] = None,
+                donate: bool = False) -> Graph:
+    """Apply a :class:`GraphDelta` incrementally — bit-identical to a
+    from-scratch :func:`from_edges` on the merged edge list, in
+    O(delta + touched) host work instead of a full rebuild.
+
+    ``donate=True`` is the churn-storm fast path: when the base is
+    pristine (no failure-masked edges) and the table width is unchanged,
+    the touched neighbor-table rows scatter into the base graph's
+    buffers IN PLACE — O(touched rows) instead of an O(N x width) table
+    copy, the difference between a delta and a rebuild at 10M-node
+    table sizes. Like the engine's donating run loops, the donor
+    graph's table buffers are INVALIDATED (reading them afterwards
+    raises); use it in ``g = apply_delta(g, d, donate=True)`` rolling
+    form and keep ``donate=False`` (the default) when the pre-delta
+    graph must stay usable.
+
+    The base COO is already receiver-sorted, so only the DELTA is
+    radix-sorted; linear native merge/anti-merge passes
+    (native/graphcore.cpp, numpy fallback under ``force_fallback()``)
+    splice it into the base order, degrees and spans update from the
+    batch, and only the touched neighbor-table rows recompute. The
+    source-CSR view merges the surviving old order with the delta's
+    sender-sorted ids — no E-element re-sort anywhere.
+
+    Equivalence contract: the result equals
+    ``from_edges(kept + adds, n_nodes, ...)`` with the base's layout
+    settings, where ``kept`` is the base's LIVE edges (in sorted order)
+    minus the removed pairs. Consequences:
+
+    - edges masked out by failures are dropped for good (the
+      ``consolidate`` semantic); node liveness (``node_mask``) is
+      preserved as-is;
+    - attached blocked/hybrid/skew layouts are REBUILT from the merged
+      arrays (full host cost — they bake edge order); the incremental
+      win covers COO, degrees, spans, neighbor tables, and the
+      source CSR;
+    - the dynamic edge region and any layout permutation ride along
+      unchanged; delta ids speak the graph's (possibly relabeled) id
+      space.
+    """
+    _reset_phases()
+    n_pad = graph.n_nodes_padded
+    # Default to the base's recorded multiple: shapes stay stable across
+    # churn, so jitted consumers keep their compiled programs.
+    pad_mult = edge_pad_multiple or graph.edge_pad_multiple
+    add_s, add_r = delta.add_senders, delta.add_receivers
+    rem_s, rem_r = delta.remove_senders, delta.remove_receivers
+    if add_s.size and (add_s.max() >= graph.n_nodes
+                       or add_r.max() >= graph.n_nodes):
+        raise ValueError("edge endpoint out of range")
+    if add_s.size and (add_s.min() < 0 or add_r.min() < 0):
+        raise ValueError("edge endpoint out of range")
+    weighted = graph.edge_weight is not None
+    if weighted and add_s.size and delta.add_weights is None:
+        raise ValueError(
+            "graph carries edge weights; GraphDelta adds need add_weights")
+    if not weighted and delta.add_weights is not None:
+        raise ValueError(
+            "add_weights on an unweighted graph — build with "
+            "from_edges(weights=...) first")
+
+    with _phase("delta_sort"):
+        # Radix-sort only the delta (native sort_pairs): adds stably by
+        # receiver — the order a stable from-scratch sort would give the
+        # appended batch — removals by (receiver, sender) for the linear
+        # anti-merge walk.
+        add_w = delta.add_weights
+        if add_s.size:
+            _, perm = native.sort_pairs(
+                add_r, np.arange(add_s.size, dtype=np.int32))
+            add_r, add_s = add_r[perm], add_s[perm]
+            if weighted:
+                add_w = add_w[perm]
+        if rem_s.size:
+            order = np.lexsort((rem_s, rem_r))
+            rem_r, rem_s = rem_r[order], rem_s[order]
+
+    base_s = np.asarray(graph.senders)
+    base_r = np.asarray(graph.receivers)
+    emask = np.asarray(graph.edge_mask)
+    live_count = int(np.count_nonzero(emask))
+    # Pristine = every build edge still live: the precondition for
+    # copy-then-patch on the neighbor table and CSR (a failure-masked base
+    # compacts differently; those fall back to full recomputes of just
+    # those two derived views).
+    pristine = live_count == graph.n_edges
+
+    with _phase("delta_merge"):
+        keep, matched = native.delta_antimerge(
+            base_r, base_s, emask, rem_r, rem_s)
+        if not bool(matched.all()):
+            missing = np.flatnonzero(~matched)[:5]
+            pairs = [(int(rem_s[i]), int(rem_r[i])) for i in missing]
+            raise ValueError(
+                f"{int((~matched).sum())} removal pair(s) match no live "
+                f"edge (first few as (sender, receiver): {pairs})")
+        e_new = int(np.count_nonzero(keep)) + int(add_s.size)
+        e_pad = _round_up(max(e_new, 1), pad_mult)
+        # The merge writes straight into the padded target buffers — no
+        # second copy pass; only the padding tails are filled after.
+        s_arr = np.empty(e_pad, dtype=np.int32)
+        r_arr = np.empty(e_pad, dtype=np.int32)
+        out_r, out_s, posa, posb = native.delta_merge(
+            base_r, base_s, keep, add_r, add_s, out_r=r_arr, out_s=s_arr)
+        r_arr[e_new:] = n_pad - 1
+        s_arr[e_new:] = 0
+        emask_new = np.empty(e_pad, dtype=bool)
+        emask_new[:e_new] = True
+        emask_new[e_new:] = False
+        w_arr = w_unpadded = None
+        if weighted:
+            w_host = np.asarray(graph.edge_weight)
+            w_arr = np.zeros(e_pad, dtype=np.float32)
+            kept_slots = posa >= 0
+            w_arr[posa[kept_slots]] = w_host[kept_slots]
+            if add_s.size:
+                w_arr[posb] = add_w
+            w_unpadded = w_arr[:e_new]
+
+    with _phase("delta_degrees"):
+        # Degrees update from the batch alone (in place, O(delta));
+        # dynamic-region contributions (sim/topology.py connect) ride
+        # inside in_degree/out_degree already and stay put — only the
+        # STATIC views (span, table width, CSR counts) subtract them.
+        rm_pos = (np.flatnonzero(emask ^ keep) if rem_s.size  # keep ⊆ emask
+                  else np.zeros(0, dtype=np.int64))
+        if donate:
+            # Donated scatter-add: no O(N) degree-array copies; the donor
+            # graph's degree buffers are invalidated.
+            if rm_pos.size or add_s.size:
+                # Zero-padded to a power-of-two bucket (adding 0 at index
+                # 0 is the identity) so varying batch sizes reuse a
+                # handful of compiled scatters.
+                b = _pow2_pad(rm_pos.size + add_s.size)
+                deltas = np.zeros(b, dtype=np.int32)
+                deltas[:rm_pos.size] = -1
+                deltas[rm_pos.size:rm_pos.size + add_s.size] = 1
+                idx_r = np.zeros(b, dtype=np.int32)
+                idx_r[:rm_pos.size + add_s.size] = np.concatenate(
+                    [base_r[rm_pos], add_r])
+                idx_s = np.zeros(b, dtype=np.int32)
+                idx_s[:rm_pos.size + add_s.size] = np.concatenate(
+                    [base_s[rm_pos], add_s])
+                in_deg_new = _scatter_add_donating(
+                    graph.in_degree, idx_r, deltas)
+                out_deg_new = _scatter_add_donating(
+                    graph.out_degree, idx_s, deltas)
+            else:
+                in_deg_new, out_deg_new = graph.in_degree, graph.out_degree
+            in_host = np.asarray(in_deg_new)
+            out_host = np.asarray(out_deg_new)
+        else:
+            in_host = np.asarray(graph.in_degree).copy()
+            out_host = np.asarray(graph.out_degree).copy()
+            if rm_pos.size:
+                np.subtract.at(in_host, base_r[rm_pos], 1)
+                np.subtract.at(out_host, base_s[rm_pos], 1)
+            if add_s.size:
+                np.add.at(in_host, add_r, 1)
+                np.add.at(out_host, add_s, 1)
+            in_deg_new, out_deg_new = in_host, out_host
+        if graph.dyn_mask is not None:
+            dm = np.asarray(graph.dyn_mask)
+            static_in = in_host - np.bincount(
+                np.asarray(graph.dyn_receivers)[dm],
+                minlength=n_pad).astype(np.int32)
+            static_out = out_host - np.bincount(
+                np.asarray(graph.dyn_senders)[dm],
+                minlength=n_pad).astype(np.int32)
+        else:
+            static_in, static_out = in_host, out_host
+        max_in_span = max(int(static_in.max()) if e_new else 0, 1)
+
+    nb = nbm = nw = None
+    complete = graph.neighbors_complete
+    if graph.neighbors is not None:
+        with _phase("neighbor_table"):
+            touched = np.unique(np.concatenate([rem_r, add_r]))
+            nb, nbm, nw, complete = _delta_neighbor_tables(
+                graph, out_r, out_s, w_unpadded, static_in, touched,
+                graph.max_degree, pristine, donate=donate)
+
+    src_eid = src_offsets = None
+    max_out_span = graph.max_out_span
+    if graph.src_eid is not None:
+        with _phase("source_csr"):
+            counts = static_out[:n_pad]
+            src_offsets = np.zeros(n_pad + 1, dtype=np.int32)
+            np.cumsum(counts, out=src_offsets[1:])
+            max_out_span = int(counts.max()) if e_new else 0
+            eid_arr = np.empty(e_pad, dtype=np.int32)
+            eid_arr[e_new:] = e_pad - 1
+            if pristine:
+                kept_eids = native.map_filter(
+                    np.asarray(graph.src_eid)[:graph.n_edges], posa)
+                if add_s.size:
+                    # posb ascends along the (receiver-sorted) adds, so a
+                    # stable sender sort leaves per-sender ids ascending —
+                    # the (sender, eid) order the merge needs.
+                    _, add_eids = native.sort_pairs(add_s, posb)
+                    native.merge_eids_by_sender(
+                        out_s, kept_eids, add_eids, out=eid_arr[:e_new])
+                else:
+                    eid_arr[:e_new] = kept_eids
+            else:
+                eid_arr, src_offsets, max_out_span = _build_source_csr(
+                    s_arr, emask_new, n_pad, e_pad)
+            src_eid = eid_arr
+
+    blocked_rep, hybrid_rep, skew_rep = graph.blocked, graph.hybrid, graph.skew
+    if blocked_rep is not None or hybrid_rep is not None \
+            or skew_rep is not None:
+        with _phase("layouts"):
+            # Rebuilds keep the base's RECORDED tuning (blocked/hybrid
+            # block size, skew row width); the hybrid diagonal budget
+            # (max_diags/min_count) is not recorded on the representation
+            # and re-derives at its defaults.
+            if blocked_rep is not None:
+                from p2pnetwork_tpu.ops.blocked import \
+                    build_blocked_from_arrays
+
+                blocked_rep = build_blocked_from_arrays(
+                    out_s, out_r, n_pad, blocked_rep.block)
+            if hybrid_rep is not None:
+                from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
+
+                kw = {}
+                if hybrid_rep.remainder is not None:
+                    kw["block"] = hybrid_rep.remainder.block
+                hybrid_rep = build_hybrid_from_arrays(
+                    out_s, out_r, graph.n_nodes, n_pad, **kw)
+            if skew_rep is not None:
+                from p2pnetwork_tpu.ops.skew import build_skew_from_arrays
+
+                skew_rep = build_skew_from_arrays(
+                    out_s, out_r, n_pad, e_pad, width=skew_rep.width,
+                    weights=w_unpadded)
+
+    arrays = {
+        "senders": s_arr,
+        "receivers": r_arr,
+        "edge_mask": emask_new,
+        "in_degree": in_deg_new,
+        "out_degree": out_deg_new,
+    }
+    if nb is not None:
+        arrays["neighbors"] = nb
+        arrays["neighbor_mask"] = nbm
+    if nw is not None:
+        arrays["neighbor_weight"] = nw
+    if src_eid is not None:
+        arrays["src_eid"] = src_eid
+        arrays["src_offsets"] = src_offsets
+    if w_arr is not None:
+        arrays["edge_weight"] = w_arr
+    # One batched host->device put for every updated array (a per-array
+    # jnp.asarray pays a fixed dispatch cost ~10x over).
+    arrays = jax.device_put(arrays)
+    return dataclasses.replace(
+        graph,
+        n_edges=e_new,
+        neighbors_complete=complete,
+        edge_pad_multiple=pad_mult,
+        max_in_span=max_in_span,
+        blocked=blocked_rep,
+        hybrid=hybrid_rep,
+        skew=skew_rep,
+        max_out_span=max_out_span,
+        **arrays,
+    )
+
+
 def from_edges(
     senders,
     receivers,
@@ -303,6 +924,7 @@ def from_edges(
     skew_width: int = 0,
     source_csr: bool = False,
     weights=None,
+    reorder: Optional[str] = None,
 ) -> Graph:
     """Build a :class:`Graph` from host-side edge arrays.
 
@@ -319,6 +941,13 @@ def from_edges(
     ``with_blocked()`` / ``with_hybrid()`` methods, but built from the
     host-side arrays already in hand instead of pulling device arrays back
     over the wire (a multi-second round trip at BASELINE scale).
+
+    ``reorder`` (opt-in; ``"degree"`` or ``"rcm"``, sim/layout.py) relabels
+    node ids through an IO-aware permutation before building, so gathers
+    over neighbor rows hit contiguous memory; the mapping is recorded on
+    the graph (``layout_perm``/``layout_inv``) and every runtime id then
+    speaks the relabeled space — map results back with
+    ``layout.to_original_order``.
     """
     senders = np.asarray(senders, dtype=np.int32)
     receivers = np.asarray(receivers, dtype=np.int32)
@@ -327,18 +956,32 @@ def from_edges(
     if senders.size and (senders.max() >= n_nodes or receivers.max() >= n_nodes):
         raise ValueError("edge endpoint out of range")
 
-    if weights is not None:
-        # Per-edge costs (latency-weighted overlays): permute through the
-        # same receiver sort as the endpoints so everything stays aligned.
-        weights = np.asarray(weights, dtype=np.float32)
-        if weights.shape != senders.shape:
-            raise ValueError("weights must align with senders/receivers")
-        receivers, perm = native.sort_pairs(
-            receivers, np.arange(senders.size, dtype=np.int32))
-        senders = senders[perm]
-        weights = weights[perm]
-    else:
-        receivers, senders = native.sort_pairs(receivers, senders)
+    _reset_phases()
+    layout_perm = layout_inv = None
+    if reorder is not None:
+        with _phase("reorder"):
+            from p2pnetwork_tpu.sim import layout
+
+            perm = layout.node_permutation(senders, receivers, n_nodes,
+                                           strategy=reorder)
+            senders = perm[senders]
+            receivers = perm[receivers]
+            layout_perm = perm
+
+    with _phase("sort"):
+        if weights is not None:
+            # Per-edge costs (latency-weighted overlays): permute through
+            # the same receiver sort as the endpoints so everything stays
+            # aligned.
+            weights = np.asarray(weights, dtype=np.float32)
+            if weights.shape != senders.shape:
+                raise ValueError("weights must align with senders/receivers")
+            receivers, perm = native.sort_pairs(
+                receivers, np.arange(senders.size, dtype=np.int32))
+            senders = senders[perm]
+            weights = weights[perm]
+        else:
+            receivers, senders = native.sort_pairs(receivers, senders)
 
     n_pad = _round_up(max(n_nodes, 1), node_pad_multiple)
     e = senders.size
@@ -365,8 +1008,18 @@ def from_edges(
     # window only needs to span the widest LIVE run.
     max_in_span = max(int(in_deg.max()) if e else 0, 1)
 
+    if layout_perm is not None:
+        # Pad the relabeling with the identity over the padding ids so the
+        # recorded mapping covers the full padded id space.
+        layout_perm = np.concatenate([
+            layout_perm.astype(np.int32),
+            np.arange(n_nodes, n_pad, dtype=np.int32)])
+        layout_inv = np.empty_like(layout_perm)
+        layout_inv[layout_perm] = np.arange(n_pad, dtype=np.int32)
+
     neighbors = neighbor_mask = neighbor_weight = None
     neighbors_complete = True
+    _t_table = time.perf_counter()
     if build_neighbor_table:
         width = int(in_deg.max()) if e else 0
         if max_degree is not None:
@@ -411,7 +1064,11 @@ def from_edges(
             neighbor_weight = np.where(valid, wpool[take_safe], 0.0).astype(
                 np.float32)
 
+    if build_neighbor_table:
+        _note_phase("neighbor_table", time.perf_counter() - _t_table)
+
     blocked_rep = hybrid_rep = skew_rep = None
+    _t_layouts = time.perf_counter()
     if blocked:
         from p2pnetwork_tpu.ops.blocked import build_blocked_from_arrays
 
@@ -427,15 +1084,18 @@ def from_edges(
             senders, receivers, n_pad, e_pad, width=skew_width,
             weights=weights,
         )
+    if blocked or hybrid or skew_table:
+        _note_phase("layouts", time.perf_counter() - _t_layouts)
 
     src_eid = src_offsets = None
     max_out_span = 0
     if source_csr:
-        src_eid, src_offsets, max_out_span = _build_source_csr(
-            s, emask, n_pad, e_pad
-        )
-        src_eid = jnp.asarray(src_eid)
-        src_offsets = jnp.asarray(src_offsets)
+        with _phase("source_csr"):
+            src_eid, src_offsets, max_out_span = _build_source_csr(
+                s, emask, n_pad, e_pad
+            )
+            src_eid = jnp.asarray(src_eid)
+            src_offsets = jnp.asarray(src_offsets)
 
     return Graph(
         senders=jnp.asarray(s),
@@ -449,6 +1109,8 @@ def from_edges(
         n_nodes=n_nodes,
         n_edges=e,
         neighbors_complete=neighbors_complete,
+        max_degree_cap=max_degree,
+        edge_pad_multiple=edge_pad_multiple,
         max_in_span=max_in_span,
         blocked=blocked_rep,
         hybrid=hybrid_rep,
@@ -459,6 +1121,9 @@ def from_edges(
         edge_weight=None if w is None else jnp.asarray(w),
         neighbor_weight=(None if neighbor_weight is None
                          else jnp.asarray(neighbor_weight)),
+        layout_perm=(None if layout_perm is None
+                     else jnp.asarray(layout_perm)),
+        layout_inv=None if layout_inv is None else jnp.asarray(layout_inv),
     )
 
 
@@ -484,11 +1149,14 @@ def _dedup_undirected(src: np.ndarray, dst: np.ndarray, n: int):
     SIR). Shifts/masks, not ``*n`` / ``// n``: the int64 divisions of the
     arithmetic encoding were a measured hotspot of graph build at 10M nodes.
     """
+    t0 = time.perf_counter()
     b = _pair_bits(n)
     lo = np.minimum(src, dst).astype(np.int64)
     hi = np.maximum(src, dst)
     keys = native.sort_unique((lo << b) | hi)
-    return (keys >> b).astype(np.int32), (keys & ((1 << b) - 1)).astype(np.int32)
+    out = (keys >> b).astype(np.int32), (keys & ((1 << b) - 1)).astype(np.int32)
+    _note_dedup(time.perf_counter() - t0)
+    return out
 
 
 def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
@@ -507,6 +1175,7 @@ def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
     # Accumulate unique pairs until we have at least m, then subsample to
     # exactly m uniformly — truncating the (sorted) unique keys instead would
     # bias edges toward low-index nodes.
+    t0 = time.perf_counter()
     b = _pair_bits(n)
     keys = np.zeros(0, dtype=np.int64)
     draw = int(m * 1.2) + 16
@@ -518,6 +1187,7 @@ def erdos_renyi(n: int, p: float, seed: int = 0, **kw) -> Graph:
         keys = native.sort_unique(np.concatenate([keys, (lo << b) | hi]))
         draw *= 2
     keys = rng.permutation(keys)[:m]
+    _note_dedup(time.perf_counter() - t0)
     lo = (keys >> b).astype(np.int32)
     hi = (keys & ((1 << b) - 1)).astype(np.int32)
     return from_edges(*_undirect(lo, hi), n, **kw)

@@ -349,12 +349,17 @@ def consolidate(graph: Graph, *, extra_edges: int = 0, extra_nodes: int = 0,
     # Neighbor-table settings carry over like the kernel layouts do: a
     # graph built without one (the documented 10M-node path) must not get
     # an O(N·max_in_degree) table silently rebuilt host-side, and an
-    # explicit width cap survives (only when one was actually applied —
-    # an uncapped table's width is just the old true max, and the merged
-    # edge list may legitimately exceed it).
+    # explicit width cap survives — the recorded from_edges(max_degree=)
+    # value when the graph carries one (it bounds the rebuilt table even
+    # if it never bit at build), else an incomplete table's width (old
+    # checkpoints predating the recorded cap).
     from_edges_kwargs.setdefault("build_neighbor_table",
                                  graph.neighbors is not None)
-    if graph.neighbors is not None and not graph.neighbors_complete:
+    from_edges_kwargs.setdefault("edge_pad_multiple",
+                                 graph.edge_pad_multiple)
+    if graph.max_degree_cap is not None:
+        from_edges_kwargs.setdefault("max_degree", graph.max_degree_cap)
+    elif graph.neighbors is not None and not graph.neighbors_complete:
         from_edges_kwargs.setdefault("max_degree", graph.max_degree)
     defer_layouts = bool(extra_nodes)
     if not defer_layouts:

@@ -48,6 +48,11 @@ def pytest_configure(config):
         "audit: graftaudit IR-level audit tests — jaxpr rules, signature "
         "parity, donation aliasing, cost ratchet (select with -m audit; "
         "part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "buildperf: incremental-build perf ratchet — delta apply vs "
+        "from-scratch rebuild ratio at 1M-edge scale (select with "
+        "-m buildperf; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

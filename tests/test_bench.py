@@ -174,6 +174,12 @@ class TestStageTelemetry:
         # headline and artifact must agree on the graph-build attribution
         assert tel_1m["stages"]["graph_build_s"] == pytest.approx(
             recs[-1]["graph_build_s"], abs=0.01)
+        # A cold run built the graph, so the per-phase build attribution
+        # (sim/graph.py) rides along: dedup + sort at minimum for the WS
+        # family, CSR because the spec builds source_csr=True.
+        phases = tel_1m["build_phases"]
+        assert phases["sort_s"] >= 0 and phases["dedup_s"] >= 0
+        assert "source_csr_s" in phases
         assert set(tel_1m["per_method"]) == {
             "pallas", "hybrid", "adaptive-1024", "adaptive-2048", "frontier"}
         # The frontier column carries the per-round occupancy attribution
@@ -194,6 +200,9 @@ class TestStageTelemetry:
             assert tel["stages"]["graph_build_s"] > 0
             assert tel["stages"]["compile_s"] > 0
             assert tel["stages"]["transfer_bytes"] > 0
+            # the per-phase build breakdown is always present (empty only
+            # on cache-hit runs, which built nothing)
+            assert isinstance(tel["build_phases"], dict)
             # The graftaudit static cost model rides beside the measured
             # numbers: the stage's shape-class slice of budgets.json.
             model = tel["ir_cost_model"]
