@@ -8,7 +8,7 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
-	analysis-check supervise-check audit-check build-check
+	analysis-check supervise-check audit-check build-check race-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -63,6 +63,15 @@ analysis-check:
 audit-check:
 	$(PY) -m p2pnetwork_tpu.analysis.ir
 	$(TEST_ENV) $(PY) -m pytest tests/test_iraudit.py -q
+
+# graftrace gate: the deterministic-concurrency scenario battery (every
+# builtin scenario × K seeded schedules, zero non-baselined races or
+# deadlocks) plus its test subset — scheduler replay determinism, the
+# racy/clean twin per HB edge kind, detector internals, CLI exit codes
+# (tox env "race").
+race-check:
+	$(TEST_ENV) $(PY) -m p2pnetwork_tpu.analysis.race
+	$(TEST_ENV) $(PY) -m pytest tests/test_graftrace.py -q
 
 # Incremental builds + IO-aware layouts: delta/rebuild bit-identity
 # property sweep (native + numpy fallback), reorder-pass parity, layout

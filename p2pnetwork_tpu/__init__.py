@@ -30,6 +30,12 @@ the sim, no blocking-under-lock or lock-order hazards in the sockets
 backend — are enforced statically by `p2pnetwork_tpu.analysis` (graftlint:
 ``python -m p2pnetwork_tpu.analysis``) with a runtime ``retrace_guard``
 complement — see GETTING_STARTED.md "Static analysis & retrace budgets".
+The threaded plane is additionally checked *dynamically*: every
+thread/lock/event/queue primitive is constructed through the
+`p2pnetwork_tpu.concurrency` seam, and graftrace
+(``python -m p2pnetwork_tpu.analysis.race``) explores seeded
+deterministic schedules over it with vector-clock happens-before race
+detection — see GETTING_STARTED.md "Deterministic concurrency testing".
 
 Long runs survive the hardware they run on via the supervised execution
 plane (`p2pnetwork_tpu.supervise`): chunked runs with deadline watchdogs,

@@ -21,8 +21,17 @@ aliasing, and the compiled-cost ratchet. It needs jax (CPU backend only)
 and is therefore not imported here; this package stays importable in a
 sockets-only environment.
 
-See GETTING_STARTED.md ("Static analysis & retrace budgets" and
-"IR audit & cost ratchet") for the rule tables and workflows.
+And a fourth EXECUTES the thread plane: **graftrace**
+(:mod:`p2pnetwork_tpu.analysis.race`, the ``graftrace`` CLI) explores
+seeded deterministic schedules over the
+:mod:`p2pnetwork_tpu.concurrency` seam with vector-clock happens-before
+race detection — the dynamic verdict on what the static lock rules can
+only conjecture. Not imported here either (it loads scenario modules);
+its findings flow through this package's Finding/baseline machinery.
+
+See GETTING_STARTED.md ("Static analysis & retrace budgets",
+"IR audit & cost ratchet", "Deterministic concurrency testing") for the
+rule tables and workflows.
 """
 
 from p2pnetwork_tpu.analysis.core import (  # noqa: F401

@@ -47,6 +47,13 @@ _LOCK_FACTORIES = {
     "threading.Lock": "Lock",
     "threading.RLock": "RLock",
     "threading.Condition": "Condition",
+    # The concurrency seam's factories (p2pnetwork_tpu/concurrency.py):
+    # production code constructs locks through these, and the inventory
+    # must keep recognizing them or every guard/ordering rule silently
+    # degrades to the "lockish word" heuristic.
+    "p2pnetwork_tpu.concurrency.lock": "Lock",
+    "p2pnetwork_tpu.concurrency.rlock": "RLock",
+    "p2pnetwork_tpu.concurrency.condition": "Condition",
 }
 _LOCKISH_WORDS = ("lock", "mutex", "cond")
 
@@ -86,6 +93,10 @@ def _blocking_desc(module: Module, call: ast.Call) -> Optional[str]:
     resolved = resolve_dotted(module, fn)
     if resolved == "time.sleep":
         return "time.sleep()"
+    if resolved == "p2pnetwork_tpu.concurrency.sleep":
+        # The seam's sleep is time.sleep in production (a scheduling
+        # point only under graftrace) — same blocking verdict.
+        return "concurrency.sleep()"
     if resolved in _SUBPROCESS_BLOCKING:
         return f"{resolved}()"
     if resolved is not None and resolved.startswith("requests."):
@@ -699,6 +710,53 @@ def rule_lock_open_call(module: Module) -> Iterable[Tuple[ast.AST, str]]:
                          "— an open-call discipline keeps foreign code "
                          "outside critical sections; copy under the lock, "
                          "call after release")
+
+
+#: Constructions the concurrency seam (p2pnetwork_tpu/concurrency.py)
+#: owns: building one of these directly bypasses the seam, so graftrace
+#: can neither schedule nor observe it. ``threading.local`` is absent
+#: deliberately (thread-local storage is not a synchronization
+#: primitive), as is ``threading.current_thread`` (a query, not a
+#: construction).
+_RAW_PRIMITIVES = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Thread", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "threading.Timer",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "time.sleep",
+})
+
+_SEAM_EQUIVALENT = {
+    "threading.Lock": "concurrency.lock()",
+    "threading.RLock": "concurrency.rlock()",
+    "threading.Condition": "concurrency.condition()",
+    "threading.Event": "concurrency.event()",
+    "threading.Thread": "concurrency.thread(...)",
+    "queue.Queue": "concurrency.fifo_queue()",
+    "time.sleep": "concurrency.sleep()",
+}
+
+
+@register_rule(
+    "raw-concurrency-primitive", "P2",
+    "A threading/queue primitive (or time.sleep) is constructed directly "
+    "instead of through the p2pnetwork_tpu.concurrency seam: graftrace "
+    "cannot schedule or observe it, so the deterministic-concurrency "
+    "gate silently loses coverage of whatever it guards.")
+def rule_raw_concurrency_primitive(module: Module
+                                   ) -> Iterable[Tuple[ast.AST, str]]:
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = resolve_dotted(module, node.func)
+        if resolved not in _RAW_PRIMITIVES:
+            continue
+        hint = _SEAM_EQUIVALENT.get(
+            resolved, "a p2pnetwork_tpu.concurrency factory")
+        yield node, (f"direct {resolved}(...) bypasses the concurrency "
+                     f"seam — use {hint} so graftrace can instrument it "
+                     "(or suppress with the rationale that this one must "
+                     "stay raw)")
 
 
 @register_rule(

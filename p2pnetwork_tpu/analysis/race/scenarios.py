@@ -1,0 +1,427 @@
+"""graftrace scenario battery: the threaded plane's hazard surfaces as
+deterministic, seed-explorable bodies.
+
+Each scenario drives REAL library objects — nodes, the chaos plane, the
+watchdog/checkpoint pair, the telemetry registry — from managed threads
+that mirror the production thread roles (one "loop" thread for
+loop-confined state, plus the foreign threads the public API documents
+as safe callers). No sockets traffic flows and no event loop runs: what
+is under test is exactly the cross-thread shared-state discipline, which
+is the part the asyncio confinement does NOT cover and chaos soaks only
+sample. Lock-guarded attributes are auto-tracked
+(:func:`~p2pnetwork_tpu.analysis.race.detector.watch`), so any
+unordered conflicting access — or any deadlock — in ANY explored
+schedule fails the gate.
+
+Determinism rules for scenario authors:
+
+- pass explicit ``now=`` timestamps into everything that branches on
+  time (phi sweeps, quarantine evictions) — wall clock must never pick
+  the code path;
+- iterate deterministically (dicts, sorted sets);
+- close what you open (sockets, watchdog threads) inside the body, so a
+  schedule ends with every task finished.
+
+Scenarios self-describe optional dependencies: a factory raising
+:class:`ScenarioUnavailable` (e.g. no jax for the supervise scenario)
+reports as a skip with its reason, never as a crash of the battery.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from typing import Callable, Dict, List, NamedTuple
+
+from p2pnetwork_tpu import concurrency
+from p2pnetwork_tpu.analysis.race.detector import watch
+
+__all__ = ["SCENARIOS", "ScenarioUnavailable", "scenario", "builtin_names"]
+
+
+class ScenarioUnavailable(RuntimeError):
+    """Raised by a factory whose dependencies are absent on this image;
+    the battery reports a skip with this reason."""
+
+
+class _Scenario(NamedTuple):
+    name: str
+    doc: str
+    factory: Callable[[], Callable[[], None]]
+    builtin: bool
+
+
+#: name -> scenario. Builtins are the CI battery; externally registered
+#: scenarios (``--scenarios-from``, test fixtures) join the registry but
+#: not the default gate.
+SCENARIOS: Dict[str, _Scenario] = {}
+
+
+def scenario(name: str, doc: str, *, builtin: bool = True):
+    """Register a scenario factory. The factory runs OUTSIDE the managed
+    world (imports, dependency checks); the body it returns runs as the
+    managed main task, once per explored schedule."""
+    def deco(factory):
+        # Last registration wins: an external scenarios file is loaded
+        # both by import and by --scenarios-from in the same process
+        # (tests do), and re-registration must refresh, not crash.
+        SCENARIOS[name] = _Scenario(name, doc, factory, builtin)
+        return factory
+    return deco
+
+
+def builtin_names() -> List[str]:
+    return [n for n, s in sorted(SCENARIOS.items()) if s.builtin]
+
+
+# --------------------------------------------------------------- helpers
+
+class _StubConn:
+    """The NodeConnection surface the registry/chaos/phi paths touch:
+    id/host/port, a thread-safe stop(), a counting send(). No transport."""
+
+    def __init__(self, id: str, host: str = "127.0.0.1", port: int = 0):
+        self.id = str(id)
+        self.host = host
+        self.port = port
+        self.stopped = concurrency.event()
+        self.sent: int = 0
+
+    def stop(self) -> None:
+        self.stopped.set()
+
+    def send(self, data, compression: str = "none") -> None:
+        self.sent += 1
+
+
+def _fresh_registry():
+    # Constructed inside the managed body so its locks are instrumented.
+    from p2pnetwork_tpu import telemetry
+    return telemetry.Registry()
+
+
+# -------------------------------------------------------------- scenarios
+
+@scenario(
+    "connect_disconnect_storm",
+    "Peer registry churn under chaos severing: a loop-role thread "
+    "registers/deregisters connections via node_disconnected while "
+    "foreign threads broadcast, trigger reconnect checks and the chaos "
+    "plane kills/partitions/revives — the recovery surface PR 2 soaks, "
+    "here under every explored interleaving.")
+def _connect_disconnect_storm():
+    from p2pnetwork_tpu.chaos.plane import ChaosPlane
+    from p2pnetwork_tpu.node import Node
+
+    def body():
+        reg = _fresh_registry()
+        node = Node("127.0.0.1", 0, id="n0", registry=reg)
+        try:
+            plane = watch(ChaosPlane(seed=7, registry=reg))
+            watch(node.event_log)
+            plane.attach(node)
+            conns = [_StubConn(f"p{i}") for i in range(4)]
+            node.nodes_inbound.extend(conns[:2])
+            node.nodes_outbound.extend(conns[2:])
+
+            def loop_role():
+                # The event-loop thread's share: registry mutation plus
+                # upward dispatch (event log, conn gauges).
+                node.node_disconnected(conns[0])
+                node.nodes_inbound.append(conns[0])
+                node.node_disconnected(conns[2])
+                node.nodes_outbound.append(conns[2])
+
+            def broadcaster():
+                for _ in range(3):
+                    node.send_to_nodes({"k": 1})
+                    # Apps log custom events from their own threads; the
+                    # EventLog is documented thread-safe, so the storm
+                    # must drive it cross-thread (the loop role records
+                    # disconnect events into the same deque).
+                    node.event_log.record("app_note", None, {})
+
+            def chaos_role():
+                plane.kill_nodes(["p0"])
+                plane.partition([["n0", "p1"], ["p2", "p3"]])
+                plane.heal_partition()
+                plane.revive_nodes(["p0"])
+                plane.cut_links([("n0", "p3")])
+                plane.heal_links([("n0", "p3")])
+
+            def prober():
+                for a, b in (("n0", "p0"), ("n0", "p1"), ("n0", "p3")):
+                    plane.link_ok(a, b)
+                plane.fault_log()
+                node.event_log.count("inbound_node_disconnected")
+                node.event_log.snapshot()
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("loop", loop_role), ("bcast", broadcaster),
+                                ("chaos", chaos_role), ("probe", prober))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+            plane.detach(node)
+        finally:
+            node.sock.close()
+    return body
+
+
+@scenario(
+    "phi_quarantine",
+    "Phi quarantine transitions under concurrent sweeps: heartbeats land "
+    "while a loop-role tick and a monitoring thread both evaluate "
+    "quarantine/readmit/evict, a peer disconnects mid-sweep, and the "
+    "chaos plane severs — the _phi_lock discipline PR 4 restructured, "
+    "checked dynamically.")
+def _phi_quarantine():
+    from p2pnetwork_tpu.chaos.plane import ChaosPlane
+    from p2pnetwork_tpu.phi import PhiAccrualNode
+
+    def body():
+        reg = _fresh_registry()
+        node = PhiAccrualNode(
+            "127.0.0.1", 0, id="n0", window=8, quarantine_threshold=2.0,
+            evict_after=50.0, registry=reg)
+        try:
+            watch(node)
+            plane = watch(ChaosPlane(seed=3, registry=reg))
+            plane.attach(node)
+            conns = [_StubConn(f"p{i}") for i in range(3)]
+            node.nodes_inbound.extend(conns)
+
+            def heartbeats():
+                # A healthy cadence for p0, then silence; p1 heartbeats
+                # throughout. Explicit timestamps: the detector must see
+                # the same arithmetic in every schedule.
+                for t in range(1, 9):
+                    node._record_heartbeat("p0", now=float(t))  # graftlint: ignore[host-sync-in-loop] -- plain int loop index, not a device value
+                for t in range(1, 17):
+                    node._record_heartbeat("p1", now=float(t))  # graftlint: ignore[host-sync-in-loop] -- plain int loop index, not a device value
+
+            def tick_sweep():
+                # The loop-role tick: quarantines p0 once its silence
+                # stretches (phi at now=200 is astronomically high).
+                node.check_quarantine(now=200.0)
+                node.check_quarantine(now=300.0)  # evict_after exceeded
+
+            def monitor_sweep():
+                node.phi("p0", now=250.0)
+                node.check_quarantine(now=250.0)
+                node.is_quarantined("p0")
+                node.suspicion_levels()
+
+            def churn():
+                node.node_disconnected(conns[2])
+                plane.kill_nodes(["p1"])
+                plane.revive_nodes(["p1"])
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("hb", heartbeats), ("tick", tick_sweep),
+                                ("mon", monitor_sweep), ("churn", churn))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+            plane.detach(node)
+        finally:
+            node.sock.close()
+    return body
+
+
+@scenario(
+    "crdt_merge_storm",
+    "CRDT merge storm: inbound state merges on the loop-role thread race "
+    "create-on-miss accessors from foreign threads — the lost-update "
+    "window _crdt_lock exists for, and the dynamic verdict on the "
+    "merge-under-lock hazard graftlint grandfathered in PR 4.")
+def _crdt_merge_storm():
+    from p2pnetwork_tpu.crdt import CRDTNode
+
+    def body():
+        reg = _fresh_registry()
+        node = CRDTNode("127.0.0.1", 0, id="n0", registry=reg)
+        try:
+            watch(node)
+            src = _StubConn("peer")
+
+            def merges():
+                # The loop-role thread: one merge stream, first-contact
+                # construct-and-retry included (the baseline entry's
+                # exact line runs here, under every explored schedule).
+                for i in range(1, 4):
+                    node.node_message(src, {
+                        "_crdt": "hits", "kind": "gcounter",
+                        "state": {"counts": {"peer": i}}})
+                node.node_message(src, {
+                    "_crdt": "names", "kind": "orset",
+                    "state": {"adds": {"a": [["peer", 1]]},
+                              "tombs": [], "next": 1}})
+
+            def accessor_a():
+                node.gcounter("hits").value
+                node.gcounter("fresh").value  # create-on-miss race
+
+            def accessor_b():
+                node.set_("names").elements()
+                node.gcounter("hits").value
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("loop", merges), ("acc-a", accessor_a),
+                                ("acc-b", accessor_b))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+        finally:
+            node.sock.close()
+    return body
+
+
+@scenario(
+    "registry_storm",
+    "Concurrent metric creation: racing get-or-create of families and "
+    "labeled children, updates, and snapshot/value readers — the "
+    "setdefault re-check discipline telemetry/registry.py documents, "
+    "checked under every explored interleaving.")
+def _registry_storm():
+    def body():
+        from p2pnetwork_tpu.telemetry.registry import Registry
+        reg = watch(Registry())
+
+        def creator_a():
+            c = watch(reg.counter("storm_total", "x", ("who",)))
+            c.labels("a").inc()
+            reg.gauge("storm_gauge", "y").set(1.0)
+
+        def creator_b():
+            c = watch(reg.counter("storm_total", "x", ("who",)))
+            c.labels("a").inc()
+            c.labels("b").inc(2.0)
+            reg.histogram("storm_hist", "z").observe(0.5)
+
+        def reader():
+            reg.value("storm_total", who="a")
+            reg.snapshot()
+            reg.collect()
+
+        ts = [concurrency.thread(target=f, name=nm)
+              for nm, f in (("mk-a", creator_a), ("mk-b", creator_b),
+                            ("read", reader))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+    return body
+
+
+@scenario(
+    "watchdog_emergency_checkpoint",
+    "Watchdog stall firing emergency_checkpoint from the on-stall "
+    "thread while the run thread swaps the fallback and saves boundary "
+    "checkpoints — the _fb_lock/_save_lock discipline PR 5 documents as "
+    "thread-safe, driven from the exact threads it promises.")
+def _watchdog_emergency_checkpoint():
+    try:
+        import jax
+        import numpy as np  # noqa: F401
+        from p2pnetwork_tpu.supervise.runner import SupervisedRun
+        from p2pnetwork_tpu.supervise.store import CheckpointStore
+        from p2pnetwork_tpu.supervise.watchdog import Watchdog
+    except Exception as e:  # pragma: no cover - jax-less image
+        raise ScenarioUnavailable(f"needs jax/supervise: {e}") from e
+    import numpy as np
+    key = jax.random.key(0)
+    state = {"x": np.arange(4, dtype=np.int32)}
+
+    def body():
+        reg = _fresh_registry()
+        tmp = tempfile.mkdtemp(prefix="graftrace_wd_")
+        try:
+            store = watch(CheckpointStore(tmp, retain=2, registry=reg))
+            run = watch(SupervisedRun(
+                None, None, store, chunk_rounds=4, registry=reg))
+            hook_saved = []
+
+            def on_stall(dog):
+                # The documented on-stall driver seam, from the
+                # watchdog-role thread: persist the live fallback.
+                hook_saved.append(run.emergency_checkpoint())
+
+            wd = watch(Watchdog(deadline_s=60.0, name="graftrace",
+                                on_stall=on_stall, registry=reg))
+            wd.start()
+
+            def run_role():
+                # Chunk boundaries: publish fallback, save, retract.
+                for rnd in (4, 8):
+                    run._set_fallback((state, key, rnd, 0))
+                    store.save(state, key, rnd, 0)
+                    run._set_fallback(None)
+                    wd.heartbeat()
+
+            def watchdog_role():
+                # The detection-time path _watch runs on its own thread:
+                # fire a stall while the run thread is mid-boundary.
+                wd._fire(75.0)
+                wd._fire(80.0)
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("run", run_role),
+                                ("stall", watchdog_role))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+            wd.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return body
+
+
+@scenario(
+    "partition_heal",
+    "The PR 2 partition-heal soak's control plane under exploration: "
+    "partition, concurrent traffic probing link_ok on both sides, heal, "
+    "kill/revive — the seeded 8-node soak proves recovery end to end "
+    "over real sockets; this proves its ChaosPlane bookkeeping has no "
+    "interleaving that tears the partition state.")
+def _partition_heal():
+    from p2pnetwork_tpu.chaos.plane import ChaosPlane
+
+    def body():
+        reg = _fresh_registry()
+        plane = watch(ChaosPlane(seed=11, registry=reg))
+        side_a = [f"a{i}" for i in range(4)]
+        side_b = [f"b{i}" for i in range(4)]
+
+        def splitter():
+            plane.partition([side_a, side_b])
+            plane.heal_partition()
+            plane.partition([side_a[:2] + side_b[:2],
+                             side_a[2:] + side_b[2:]])
+            plane.heal_partition()
+
+        def traffic():
+            for a in side_a[:2]:
+                for b in side_b[:2]:
+                    plane.link_ok(a, b)
+            plane.fault_log()
+
+        def churn():
+            plane.kill_nodes([side_b[0]])
+            plane.link_ok(side_a[0], side_b[0])
+            plane.revive_nodes([side_b[0]])
+            plane.cut_links([(side_a[1], side_b[1])])
+            plane.heal_links([(side_a[1], side_b[1])])
+
+        ts = [concurrency.thread(target=f, name=nm)
+              for nm, f in (("split", splitter), ("traffic", traffic),
+                            ("churn", churn))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+    return body

@@ -70,10 +70,9 @@ from __future__ import annotations
 import collections
 import hashlib
 import random
-import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.chaos.streams import ChaosReader, ChaosWriter
 
 __all__ = ["ChaosPlane"]
@@ -93,7 +92,7 @@ class ChaosPlane:
     def __init__(self, seed: int = 0,
                  registry: Optional[telemetry.Registry] = None):
         self.seed = int(seed)
-        self._lock = threading.RLock()
+        self._lock = concurrency.rlock()
         self._nodes: Dict[str, object] = {}
         self._orig_factory: Dict[str, object] = {}
         self._dead: set = set()

@@ -1,0 +1,158 @@
+"""The concurrency seam: one factory for every thread/lock/queue primitive.
+
+The sockets/chaos/supervise plane spans 30+ ``threading`` primitives
+across 18 modules. graftlint (analysis/concurrency.py) reasons about
+them statically; graftrace (analysis/race/) needs to OBSERVE them — to
+serialize instrumented threads at acquire/release/wait/notify/put/get
+boundaries under a seeded deterministic scheduler and derive
+happens-before edges from what actually happened. That only works if
+every primitive the plane uses is constructed through one seam a
+test-time provider can substitute, instead of monkeypatching
+``threading`` (which would also hijack the scheduler's own internals,
+pytest, and every third-party library in the process).
+
+So: production code in this package never calls ``threading.Lock()``,
+``threading.Event()``, ``threading.Thread(...)``, ``queue.Queue()`` or
+``time.sleep()`` directly — it calls :func:`lock`, :func:`event`,
+:func:`thread`, :func:`fifo_queue`, :func:`sleep` here. With no provider
+installed (the default, always in production) these return the stdlib
+objects with zero added indirection per *use* — the substitution cost is
+one guarded read at *construction* time only. graftlint's
+``raw-concurrency-primitive`` rule keeps the seam from eroding: any
+direct construction outside this module is a finding.
+
+A provider is any object with the same-named factory methods
+(``lock/rlock/condition/event/thread/fifo_queue/sleep``); graftrace's
+:class:`~p2pnetwork_tpu.analysis.race.sched.TraceProvider` is the one
+real implementation. Install is process-global and intended for
+controlled test runs only — the graftrace driver installs around one
+explored schedule and restores after.
+
+Stdlib-only: the sockets backend must import this without jax installed.
+"""
+
+from __future__ import annotations
+
+import queue as _queue_mod
+import threading as _threading
+import time as _time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "lock", "rlock", "condition", "event", "thread", "fifo_queue",
+    "sleep", "install", "installed", "substituted",
+]
+
+#: The active provider, or None for raw stdlib primitives. Swapped only
+#: by graftrace around a controlled run; guarded so the swap and every
+#: construction-time read agree (the discipline graftlint's lock-guard
+#: rule checks).
+_provider: Optional[Any] = None
+# The seam's own bootstrap lock must be raw: it exists before any
+# provider can, and instrumenting it would recurse.
+_provider_lock = _threading.Lock()  # graftlint: ignore[raw-concurrency-primitive] -- the seam's bootstrap lock predates any provider
+
+
+def _current() -> Optional[Any]:
+    with _provider_lock:
+        return _provider
+
+
+def install(provider: Optional[Any]) -> Optional[Any]:
+    """Swap the process-wide provider (``None`` restores raw stdlib
+    primitives); returns the previous provider so callers can restore
+    it. Prefer :func:`substituted` for scoped use."""
+    global _provider
+    with _provider_lock:
+        prev, _provider = _provider, provider
+    return prev
+
+
+def installed() -> Optional[Any]:
+    """The active provider, or ``None`` (raw stdlib)."""
+    return _current()
+
+
+@contextmanager
+def substituted(provider: Optional[Any]):
+    """Install ``provider`` for the duration of the block, restoring the
+    previous provider (usually ``None``) on exit, even on error."""
+    prev = install(provider)
+    try:
+        yield provider
+    finally:
+        install(prev)
+
+
+# ------------------------------------------------------------- factories
+#
+# Each factory reads the provider under the seam lock, then constructs
+# OUTSIDE it (open-call discipline: a provider factory is foreign code).
+# The raw constructions below are the one sanctioned home of these
+# calls; everywhere else they are graftlint findings.
+
+def lock():
+    """A mutex (``threading.Lock`` semantics: non-reentrant)."""
+    p = _current()
+    if p is None:
+        return _threading.Lock()  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+    return p.lock()
+
+
+def rlock():
+    """A reentrant mutex (``threading.RLock`` semantics)."""
+    p = _current()
+    if p is None:
+        return _threading.RLock()  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+    return p.rlock()
+
+
+def condition(lock: Optional[Any] = None):
+    """A condition variable (``threading.Condition`` semantics)."""
+    p = _current()
+    if p is None:
+        return _threading.Condition(lock)  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+    return p.condition(lock)
+
+
+def event():
+    """A one-way flag (``threading.Event`` semantics)."""
+    p = _current()
+    if p is None:
+        return _threading.Event()  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+    return p.event()
+
+
+def thread(target: Optional[Callable] = None, *, name: Optional[str] = None,
+           args: tuple = (), kwargs: Optional[dict] = None,
+           daemon: Optional[bool] = None):
+    """A thread handle (``threading.Thread`` call-shape subset the repo
+    uses: target/name/args/kwargs/daemon keywords, ``start``/``join``/
+    ``is_alive``/``name``/``daemon``)."""
+    p = _current()
+    if p is None:
+        return _threading.Thread(  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+            target=target, name=name, args=args, kwargs=kwargs or {},
+            daemon=daemon)
+    return p.thread(target=target, name=name, args=args,
+                    kwargs=kwargs or {}, daemon=daemon)
+
+
+def fifo_queue(maxsize: int = 0):
+    """A FIFO queue (``queue.Queue`` semantics, including the
+    ``queue.Empty``/``queue.Full`` exceptions)."""
+    p = _current()
+    if p is None:
+        return _queue_mod.Queue(maxsize)  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+    return p.fifo_queue(maxsize)
+
+
+def sleep(seconds: float) -> None:
+    """``time.sleep`` through the seam: a provider turns it into a pure
+    scheduling point (no wall time passes under graftrace)."""
+    p = _current()
+    if p is None:
+        _time.sleep(seconds)  # graftlint: ignore[raw-concurrency-primitive] -- the seam itself
+        return
+    p.sleep(seconds)

@@ -32,10 +32,10 @@ the anti-entropy catch-up for peers that joined late.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, Optional, Set, Tuple
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 
@@ -210,7 +210,7 @@ class CRDTNode(Node):
         # clobber a concurrently merged state (a lost-update race a
         # poll loop can actually hit). One lock covers every
         # check-then-insert and merge-then-replace.
-        self._crdt_lock = threading.Lock()
+        self._crdt_lock = concurrency.lock()
 
     # ------------------------------------------------------------ access
 
@@ -246,7 +246,7 @@ class CRDTNode(Node):
     # ---------------------------------------------------------- mutation
 
     def update(self, name: str, kind: str, fn,
-               done: Optional[threading.Event] = None,
+               done: Optional[Any] = None,
                error: Optional[list] = None) -> None:
         """Run ``fn(crdt)`` on the event loop, then broadcast the state.
         ``kind`` is one of gcounter/pncounter/lww/orset. Thread-safe.
@@ -280,7 +280,7 @@ class CRDTNode(Node):
         """:meth:`update`, but blocks until the mutation has applied
         locally (the broadcast is still asynchronous); re-raises
         whatever ``fn`` raised."""
-        ev = threading.Event()
+        ev = concurrency.event()
         err: list = []
         self.update(name, kind, fn, done=ev, error=err)
         if not ev.wait(timeout):
@@ -344,7 +344,15 @@ class CRDTNode(Node):
                         mine = fresh
                     if mine is not None:
                         if isinstance(mine, cls):
-                            merged = self._crdts[name] = mine.merge(incoming)
+                            # merge() under the lock is the atomicity
+                            # this lock exists for (check + merge +
+                            # replace as one step); graftrace refuted
+                            # the open-call hazard dynamically — merge
+                            # is pure CRDT algebra, acquires no locks
+                            # and never blocks, verified across the
+                            # seeded crdt_merge_storm schedule battery
+                            # (tests/test_graftrace.py pins it).
+                            merged = self._crdts[name] = mine.merge(incoming)  # graftlint: ignore[lock-open-call] -- graftrace-refuted: merge() is pure (no locks, no blocking); see crdt_merge_storm scenario
                         else:
                             conflict = True
                         break

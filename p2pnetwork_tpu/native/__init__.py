@@ -20,15 +20,16 @@ import ctypes
 import os
 import subprocess
 import tempfile
-import threading
 from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
 
+from p2pnetwork_tpu import concurrency
+
 _SRC = Path(__file__).with_name("graphcore.cpp")
 
-_lock = threading.Lock()
+_lock = concurrency.lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _forced_fallback = False

@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Union
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.config import NodeConfig
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 from p2pnetwork_tpu.utils import EventLog, generate_id
@@ -66,8 +66,10 @@ class Node(threading.Thread):
         self.callback = callback
         self.config = config or NodeConfig()
 
-        # Set when the node should stop [ref: node.py:36].
-        self.terminate_flag = threading.Event()
+        # Set when the node should stop [ref: node.py:36]. Constructed
+        # through the concurrency seam (like every primitive in this
+        # plane) so graftrace can instrument it.
+        self.terminate_flag = concurrency.event()
 
         # Peer registries [ref: node.py:46-52]. Only mutated on the loop.
         self.nodes_inbound: List[NodeConnection] = []
@@ -162,7 +164,7 @@ class Node(threading.Thread):
         # Drain budget of a deadline-bounded stop(); None = legacy close.
         self._stop_deadline: Optional[float] = None
         # NOT named _started: threading.Thread owns that attribute.
-        self._ready = threading.Event()
+        self._ready = concurrency.event()
 
     # ------------------------------------------------------------ telemetry
 

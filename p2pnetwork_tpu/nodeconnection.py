@@ -29,11 +29,10 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
-import threading
 import time
 from typing import Any, Optional, Tuple, Union
 
-from p2pnetwork_tpu import wire
+from p2pnetwork_tpu import concurrency, wire
 
 #: The transport handed to ``create_new_connection`` — an asyncio stream pair.
 StreamPair = Tuple[asyncio.StreamReader, asyncio.StreamWriter]
@@ -65,9 +64,10 @@ class NodeConnection:
         # Per-connection key/value store [ref: nodeconnection.py:44, :231-235].
         self.info: dict = {}
 
-        # Parity flag; set by stop(). A threading.Event so non-loop threads
-        # can observe it, like the reference's flag [ref: nodeconnection.py:32].
-        self.terminate_flag = threading.Event()
+        # Parity flag; set by stop(). An event so non-loop threads can
+        # observe it, like the reference's flag [ref: nodeconnection.py:32];
+        # seam-constructed so graftrace can instrument it.
+        self.terminate_flag = concurrency.event()
 
         self._decoder = wire.make_decoder(
             main_node.config.framing,

@@ -38,11 +38,11 @@ and never reach ``node_message`` subclass traffic.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import deque
 from typing import Dict, Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 
@@ -152,7 +152,7 @@ class PhiAccrualNode(Node):
         # Heartbeats append on the event loop while phi()/suspected()
         # read from monitoring threads; an unguarded deque iteration
         # mid-append raises "deque mutated during iteration".
-        self._phi_lock = threading.Lock()
+        self._phi_lock = concurrency.lock()
         self._m_phi = self.telemetry.gauge(
             "p2p_phi_suspicion",
             "Phi-accrual suspicion level per peer (refreshed on "

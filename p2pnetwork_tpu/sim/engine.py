@@ -18,13 +18,12 @@ mean packed into the summary and recorded into the
 from __future__ import annotations
 
 import functools
-import threading
 import time
 
 import jax
 import jax.numpy as jnp
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.telemetry import jaxhooks
 from p2pnetwork_tpu.utils import accum
@@ -51,7 +50,7 @@ _OCCUPANCY_MAX_CHILDREN = 16
 #: (several JaxSimNodes in one process), and the registry's internal
 #: locking does not cover this side-table.
 _occupancy_recency: dict = {}
-_occupancy_lock = threading.Lock()
+_occupancy_lock = concurrency.lock()
 
 
 def _observe_occupancy(loop: str, protocol_name: str, value: float) -> None:

@@ -47,10 +47,10 @@ safely — recording is tracked per id, the standard generalization.
 
 from __future__ import annotations
 
-import threading
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 
@@ -88,7 +88,7 @@ class SnapshotNode(Node):
         # (setdefault under the GIL) — waiting must work even before the
         # posted _local_start has run, or before this node has ever heard
         # of the id (a remote participant awaiting the initiator's cut).
-        self._snap_events: Dict[str, threading.Event] = {}
+        self._snap_events: Dict[str, Any] = {}  # sid -> seam event
 
     # ------------------------------------------------------------ app API
 
@@ -139,7 +139,7 @@ class SnapshotNode(Node):
                       ) -> Optional[dict]:
         """Block the calling thread until ``sid`` completes locally (or
         ``timeout`` elapses — then returns None)."""
-        self._snap_events.setdefault(sid, threading.Event()).wait(timeout)
+        self._snap_events.setdefault(sid, concurrency.event()).wait(timeout)
         return self.get_snapshot(sid)
 
     def discard_snapshot(self, sid: str) -> Optional[dict]:
@@ -204,7 +204,7 @@ class SnapshotNode(Node):
         }
         self._snap_done[sid] = snapshot
         del self._snap_pending[sid]
-        self._snap_events.setdefault(sid, threading.Event()).set()
+        self._snap_events.setdefault(sid, concurrency.event()).set()
         self.snapshot_complete(snapshot)
 
     # ------------------------------------------------------ interceptions

@@ -40,14 +40,13 @@ valid state to emergency-checkpoint before unwinding
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Callable, Dict, Optional, Union
 
 import jax
 import numpy as np
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim import engine
 from p2pnetwork_tpu.supervise.store import CheckpointStore
 from p2pnetwork_tpu.supervise.watchdog import Watchdog
@@ -140,7 +139,7 @@ class SupervisedRun:
         # of a checkpoint-boundary chunk, published for the duration of
         # that chunk's dispatch. Guarded: the watchdog's on_stall hook
         # reads it from the watchdog thread while the run thread swaps it.
-        self._fb_lock = threading.Lock()
+        self._fb_lock = concurrency.lock()
         self._fallback: Optional[tuple] = None
 
     # ----------------------------------------------------------- preemption

@@ -29,10 +29,9 @@ import hashlib
 import json
 import os
 import tempfile
-import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim import checkpoint as ckpt
 
 __all__ = ["CheckpointStore"]
@@ -69,7 +68,7 @@ class CheckpointStore:
         # Serializes the manifest read-modify-write: the run thread's
         # boundary save can race an emergency_checkpoint fired from the
         # watchdog's on_stall thread.
-        self._save_lock = threading.Lock()
+        self._save_lock = concurrency.lock()
         reg = registry if registry is not None else telemetry.default_registry()
         self._m_written = reg.counter(
             "supervise_checkpoints_written_total",

@@ -33,12 +33,11 @@ joins it with a timeout.
 
 from __future__ import annotations
 
-import threading
 import time
 import warnings
-from typing import Callable, Optional, Union
+from typing import Any, Callable, Optional, Union
 
-from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu import concurrency, telemetry
 
 __all__ = ["Watchdog", "StallTimeout"]
 
@@ -94,8 +93,8 @@ class Watchdog:
             "observed by its watchdog — climbs while a dispatch is "
             "wedged, resets on the next heartbeat.", ("watchdog",)
         ).labels(self.name)
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
+        self._lock = concurrency.lock()
+        self._stop = concurrency.event()
         self._last_beat = time.monotonic()
         self._fired_this_gap = False     # one stall event per heartbeat gap
         self._pending_raise: Optional[StallTimeout] = None
@@ -103,7 +102,7 @@ class Watchdog:
         self.stalls = 0
         #: Gap length of the most recent stall event (seconds).
         self.last_stall_s = 0.0
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[Any] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -115,7 +114,7 @@ class Watchdog:
         with self._lock:
             self._last_beat = now
             self._fired_this_gap = False
-        self._thread = threading.Thread(
+        self._thread = concurrency.thread(
             target=self._watch, name=f"Watchdog({self.name})", daemon=True)
         self._thread.start()
         return self

@@ -38,9 +38,9 @@ quiesced on OUR side.
 from __future__ import annotations
 
 import hashlib
-import threading
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 
@@ -70,7 +70,7 @@ class SyncNode(Node):
         self.store: Dict[str, str] = {}
         self._digests: Dict[str, str] = {}  # key -> hex digest (cached)
         self.sync_messages_sent = 0
-        self._sync_events: Dict[str, threading.Event] = {}
+        self._sync_events: Dict[str, Any] = {}  # peer id -> seam event
         self._walk_pending: Dict[str, int] = {}  # peer id -> open requests
         #: peer id -> root hash from an ``_ms_root`` that arrived while
         #: our walk with that peer was still mid-flight; consumed by
@@ -101,7 +101,7 @@ class SyncNode(Node):
         # Clear SYNCHRONOUSLY: posted to the loop, a caller's immediate
         # wait_synced could observe the previous session's still-set
         # event and return before this session even started.
-        self._sync_events.setdefault(n.id, threading.Event()).clear()
+        self._sync_events.setdefault(n.id, concurrency.event()).clear()
 
         def _do():
             self._send(n, {"_ms_root": self._subtree_hash("")})
@@ -116,7 +116,7 @@ class SyncNode(Node):
         mid-session also releases the wait — quiesced is not converged
         then; check the peer's liveness if the distinction matters."""
         return self._sync_events.setdefault(
-            peer_id, threading.Event()).wait(timeout)
+            peer_id, concurrency.event()).wait(timeout)
 
     def sync_complete(self, peer_id: str) -> None:
         """Our side of a sync session quiesced. Extension hook."""
@@ -182,7 +182,7 @@ class SyncNode(Node):
             return
         if notify_peer:
             self._send(n, {"_ms_done": True})
-        self._sync_events.setdefault(n.id, threading.Event()).set()
+        self._sync_events.setdefault(n.id, concurrency.event()).set()
         self.sync_complete(n.id)
 
     def _bump(self, n: NodeConnection, delta: int) -> None:
@@ -239,7 +239,7 @@ class SyncNode(Node):
                 self._pending_root[node.id] = data["_ms_root"]
                 return
             self._sync_events.setdefault(node.id,
-                                         threading.Event()).clear()
+                                         concurrency.event()).clear()
             self._walk_pending[node.id] = 0
             if data["_ms_root"] == self._subtree_hash(""):
                 self._quiesce(node, notify_peer=True)

@@ -12,9 +12,9 @@ from __future__ import annotations
 
 import http.server
 import json
-import threading
-from typing import Optional
+from typing import Any, Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.telemetry.registry import Registry, default_registry
 from p2pnetwork_tpu.telemetry import export
 
@@ -61,7 +61,7 @@ class MetricsServer:
         self.host = host
         self.port = port
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[Any] = None
 
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
@@ -71,7 +71,7 @@ class MetricsServer:
         self._httpd = http.server.ThreadingHTTPServer(
             (self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
+        self._thread = concurrency.thread(
             target=self._httpd.serve_forever,
             name=f"MetricsServer({self.host}:{self.port})", daemon=True)
         self._thread.start()

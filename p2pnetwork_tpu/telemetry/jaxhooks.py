@@ -20,15 +20,15 @@ a sockets-only install (no jax) just reports hooks unavailable.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.telemetry.registry import Registry, default_registry
 
 __all__ = ["install", "uninstall", "installed", "compile_seconds",
            "compile_count"]
 
-_lock = threading.Lock()
+_lock = concurrency.lock()
 _registries: set = set()
 _listener_registered = False
 

@@ -21,9 +21,10 @@ is :meth:`Registry.snapshot` / :meth:`Registry.value`.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from p2pnetwork_tpu import concurrency
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
@@ -160,7 +161,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = concurrency.lock()
         self._children: Dict[Tuple[str, ...], _Child] = {}
 
     def labels(self, *values, **kv) -> _Child:
@@ -288,7 +289,7 @@ class Registry:
     instrumentation sites never race over "who registers first"."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = concurrency.lock()
         self._metrics: Dict[str, _Metric] = {}
         self.created_at = time.time()
 
@@ -392,7 +393,7 @@ class Registry:
 
 
 _default = Registry()
-_default_lock = threading.Lock()
+_default_lock = concurrency.lock()
 
 
 def default_registry() -> Registry:

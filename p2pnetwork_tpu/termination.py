@@ -43,6 +43,7 @@ import threading
 import uuid
 from typing import Dict, Optional
 
+from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.node import Node
 from p2pnetwork_tpu.nodeconnection import NodeConnection
 
@@ -74,7 +75,7 @@ class TerminationNode(Node):
         # entry (a second start_diffusing can sneak in before the loop
         # runs the first), so the reservation must happen caller-side.
         self._cids_used: set = set()
-        self._cid_lock = threading.Lock()
+        self._cid_lock = concurrency.lock()
         # Local-completion events, creatable from ANY thread (setdefault
         # under the GIL): wait_terminated must work even before the
         # posted start_diffusing closure has created the comp entry.
@@ -173,7 +174,7 @@ class TerminationNode(Node):
         unbounded computations should :meth:`forget_computation` ids it
         is done asking about (that also releases them for reuse)."""
         return self._term_events.setdefault(
-            comp_id, threading.Event()).wait(timeout)
+            comp_id, concurrency.event()).wait(timeout)
 
     def forget_computation(self, comp_id: str) -> None:
         """Release the completion record of a finished computation (and
@@ -202,12 +203,12 @@ class TerminationNode(Node):
         # after handlers return or acks arrive) with zero deficit.
         if comp.is_root:
             del self._comps[cid]
-            self._term_events.setdefault(cid, threading.Event()).set()
+            self._term_events.setdefault(cid, concurrency.event()).set()
             self.computation_terminated(cid)
         elif comp.engager is not None:
             engager, comp.engager = comp.engager, None
             del self._comps[cid]
-            self._term_events.setdefault(cid, threading.Event()).set()
+            self._term_events.setdefault(cid, concurrency.event()).set()
             self.send_to_node(engager, {ACK_KEY: cid})
 
     def _on_work(self, node: NodeConnection, cid: str, payload) -> None:

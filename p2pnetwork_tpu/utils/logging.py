@@ -17,9 +17,10 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import threading
 import time
 from typing import IO, Any, Deque, List, Optional, Union
+
+from p2pnetwork_tpu import concurrency
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,7 +38,7 @@ class EventLog:
 
     def __init__(self, maxlen: int = 4096):
         self._events: Deque[EventRecord] = collections.deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = concurrency.lock()
 
     def record(self, event: str, peer_id: Optional[str] = None, data: Any = None) -> None:
         rec = EventRecord(event, time.monotonic(), peer_id, data)

@@ -53,6 +53,11 @@ def pytest_configure(config):
         "buildperf: incremental-build perf ratchet — delta apply vs "
         "from-scratch rebuild ratio at 1M-edge scale (select with "
         "-m buildperf; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "race: graftrace deterministic-concurrency tests — scheduler "
+        "replay, HB detector twins, scenario battery, CLI gate (select "
+        "with -m race; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -696,6 +696,78 @@ class TestConcurrencyRules:
         findings = only(lint(src), "wait-untimed")
         assert len(findings) == 2  # str.join(args) untouched
 
+    def test_raw_concurrency_primitive_fires_per_construction(self):
+        src = """
+            import queue
+            import threading
+            import time
+            from threading import Event
+
+            def build():
+                lk = threading.Lock()     # HOT-LOCK
+                ev = Event()              # HOT-EVENT
+                q = queue.Queue()         # HOT-QUEUE
+                time.sleep(0.1)           # HOT-SLEEP
+                return lk, ev, q
+        """
+        for needle in ("HOT-LOCK", "HOT-EVENT", "HOT-QUEUE", "HOT-SLEEP"):
+            assert_fires(src, "raw-concurrency-primitive", needle)
+        assert len(only(lint(src), "raw-concurrency-primitive")) == 4
+
+    def test_raw_concurrency_primitive_seam_twin_is_clean(self):
+        # The clean twin: the same primitives built through the seam, and
+        # non-primitive threading surface (local storage, queries) is
+        # never flagged.
+        src = """
+            import threading
+            from p2pnetwork_tpu import concurrency
+
+            _tls = threading.local()
+
+            def build():
+                lk = concurrency.lock()
+                ev = concurrency.event()
+                q = concurrency.fifo_queue()
+                concurrency.sleep(0.1)
+                me = threading.current_thread()
+                return lk, ev, q, me
+        """
+        assert not only(lint(src), "raw-concurrency-primitive")
+
+    def test_seam_factories_join_the_lock_inventory(self):
+        # The inventory must keep full-strength guard analysis on
+        # seam-constructed locks, or the refactor silently downgrades
+        # every lock rule to the name heuristic.
+        src = """
+            from p2pnetwork_tpu import concurrency
+
+            class C:
+                def __init__(self):
+                    self._mu = concurrency.lock()
+                    self.state = {}
+
+                def put(self, k, v):
+                    with self._mu:
+                        self.state[k] = v
+
+                def peek(self):
+                    return self.state  # HOT
+        """
+        assert_fires(src, "lock-guard", "HOT")
+
+    def test_seam_sleep_is_blocking_under_lock(self):
+        src = """
+            import threading
+            from p2pnetwork_tpu import concurrency
+
+            L = threading.Lock()
+
+            def f():
+                with L:
+                    concurrency.sleep(1)  # HOT
+        """
+        assert_fires(src, "blocking-under-lock", "HOT")
+
 
 # ======================================================= engine machinery
 
@@ -719,6 +791,10 @@ class TestEngine:
 
     def test_bare_suppression_silences_all_rules(self):
         src = self.BLOCKING.format(suffix="  # graftlint: ignore")
+        # The raw construction line needs its own bare ignore now that
+        # raw-concurrency-primitive polices it — per-line semantics.
+        src = src.replace("L = threading.Lock()",
+                          "L = threading.Lock()  # graftlint: ignore")
         assert not lint(src)
 
     def test_suppression_does_not_leak_to_other_lines(self):
@@ -786,7 +862,7 @@ class TestEngine:
             "f64-literal", "carry-no-donate",
             "lock-order-cycle", "lock-across-await", "blocking-under-lock",
             "async-blocking-call", "lock-guard", "lock-open-call",
-            "wait-untimed",
+            "wait-untimed", "raw-concurrency-primitive",
         }
         assert set(all_rules()) == expected
 

@@ -43,6 +43,14 @@ def test_examples_discovered():
 
 @pytest.mark.parametrize("name", EXAMPLES)
 def test_example_runs(name):
+    if name == "secure_node_demo.py":
+        # The demo's Ed25519 path needs the `secure` extra; the HMAC
+        # fallback covers the library (tests/test_securenode.py) but the
+        # demo script itself signs with real keys.
+        pytest.importorskip(
+            "cryptography",
+            reason="secure_node_demo needs the `cryptography` package "
+                   "(install the `secure` extra)")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"  # examples must not grab the bench TPU
     proc = subprocess.run(
