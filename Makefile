@@ -8,7 +8,8 @@ PY ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
-	analysis-check supervise-check audit-check build-check race-check
+	analysis-check supervise-check audit-check build-check race-check \
+	batch-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -81,6 +82,14 @@ race-check:
 build-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_layout_delta.py -q
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m buildperf
+
+# Batched message plane: lane-packed kernel parity, MessageBatch
+# lifecycle (admission/retire/freeze), batched-vs-sequential bit
+# identity, donation, and the slow-marked B=1024 aggregate-throughput
+# ratchet (>= 20x vs sequential single-message runs, ratio-based on
+# CPU; tox env "batch").
+batch-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_messagebatch.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

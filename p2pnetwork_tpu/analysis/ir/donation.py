@@ -114,6 +114,23 @@ def all_donation_audits() -> List[DonationAudit]:
         return (engine.donating_carry_loops()["converged_from"], args,
                 kwargs, len(jax.tree_util.tree_leaves(state)))
 
+    def batch_from():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        proto = BatchFlood(method="auto")
+        # init's admit scatters build every leaf as a distinct buffer,
+        # so the fresh batch is already cleanly donatable (unlike the
+        # single-message Flood init, whose seed IS both predicates).
+        batch = proto.init(g, np.arange(32, dtype=np.int32) * 11 % 900)
+        args = (g, proto, batch, jax.random.key(0))
+        return (engine.donating_carry_loops()["batch_from"], args,
+                {"max_rounds": 64},
+                len(jax.tree_util.tree_leaves(batch)))
+
     return [
         DonationAudit(
             name="engine/run_from", build=run_from,
@@ -126,6 +143,10 @@ def all_donation_audits() -> List[DonationAudit]:
             name="engine/converged_from", build=converged_from,
             doc="run-to-convergence resume loop "
                 "(engine.run_until_converged)"),
+        DonationAudit(
+            name="engine/batch_from", build=batch_from,
+            doc="batched message-plane loop "
+                "(engine.run_batch_until_coverage)"),
     ]
 
 

@@ -186,6 +186,60 @@ def _flood_step_entry(variant: str, cls: str) -> Lowering:
                     variant=variant, shape_class=cls, build=build)
 
 
+def _lanes_kernel_entry(variant: str, cls: str) -> Lowering:
+    """A lane-packed ``propagate_or_lanes`` × method entry (the batched
+    message plane's round kernel, ops/segment.py): u32[1, N] in/out —
+    one word = 32 concurrent messages; the vmap-over-words outer
+    dimension is shape-polymorphic, so one word audits the program every
+    width runs. The frontier variant's slot budget is the LANE bound
+    (``budget_slots_lanes``): the compacted gather is shared, the
+    scatter moves a 32-wide bit-plane row per slot."""
+
+    def build():
+        from p2pnetwork_tpu.ops import segment as S
+
+        g = shape_class(cls)
+        lanes = jnp.zeros((1, g.n_nodes_padded), dtype=jnp.uint32)
+        return functools.partial(S.propagate_or_lanes, g,
+                                 method=variant), (lanes,)
+
+    slot = None
+    if variant == "frontier":
+        from p2pnetwork_tpu.ops import frontier as FR
+
+        slot = FR.budget_slots_lanes(shape_class(cls), n_words=1) or None
+    return Lowering(name=f"or_lanes/{variant}@{cls}", op="or_lanes",
+                    variant=variant, shape_class=cls, build=build,
+                    slot_budget=slot)
+
+
+def _engine_batch_cov_entry(cls: str) -> Lowering:
+    """The batched run-to-coverage loop (engine._batch_loop): B=32
+    lane-packed floods, per-lane completion detection, packed per-lane
+    summary — the batched bench column's measured shape, censused and
+    cost-ratcheted like the single-message loop."""
+
+    def build():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class(cls)
+        proto = BatchFlood(method="auto")
+        batch = proto.init(g, np.arange(32, dtype=np.int32) * 7 % 1000)
+
+        def cov(graph, b, key):
+            return engine._batch_loop_keeping(graph, proto, b, key,
+                                              max_rounds=64)
+
+        return cov, (g, batch, jax.random.key(0))
+
+    return Lowering(name=f"cov/batchflood-engine@{cls}", op="cov",
+                    variant="batchflood-engine", shape_class=cls,
+                    build=build, parity=False)
+
+
 def _engine_cov_entry(cls: str) -> Lowering:
     """The single-chip run-to-coverage loop (engine._coverage_with_init):
     init + early-exit while_loop + packed summary in one program — the
@@ -256,12 +310,21 @@ def all_lowerings() -> List[Lowering]:
         entries.append(_kernel_entry("minplus", v, "ws1k", dtype=float))
     entries.append(_flood_step_entry("dense", "ws1k"))
     entries.append(_flood_step_entry("bitset", "ws1k"))
+    # The lane-packed batched kernels (32 messages per word) and the
+    # batched engine loop — the message plane's compiled surface.
+    for v in ("segment", "gather", "frontier"):
+        entries.append(_lanes_kernel_entry(v, "ws1k"))
     entries.append(_engine_cov_entry("ws1k"))
+    entries.append(_engine_batch_cov_entry("ws1k"))
     entries.append(_sharded_cov_entry("ws1k"))
     # The degree-skewed class: the three lowerings whose crossover the
-    # routing actually arbitrates there (segment vs skew vs frontier).
+    # routing actually arbitrates there (segment vs skew vs frontier) —
+    # and the batched kernels' own arbitrated pair (lanes-auto routes to
+    # segment on skewed tables; frontier shares the compaction budget).
     for v in ("segment", "skew", "frontier"):
         entries.append(_kernel_entry("or", v, "ba1k", dtype=bool))
+    for v in ("segment", "frontier"):
+        entries.append(_lanes_kernel_entry(v, "ba1k"))
     return entries
 
 

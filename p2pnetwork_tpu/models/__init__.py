@@ -30,6 +30,13 @@ from p2pnetwork_tpu.models.components import (
     ConnectedComponentsState,
 )
 from p2pnetwork_tpu.models.flood import Flood, FloodState
+from p2pnetwork_tpu.models.messagebatch import (
+    BatchFlood,
+    MessageBatch,
+    lane_frontier,
+    lane_messages,
+    lane_seen,
+)
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
 from p2pnetwork_tpu.models.hits import HITS, HITSState
 from p2pnetwork_tpu.models.hopdist import (
@@ -73,10 +80,15 @@ __all__ = [
     "transitivity",
     "transitivity_sample",
     "triangles_per_node",
+    "lane_frontier",
+    "lane_messages",
+    "lane_seen",
     "AdaptiveFlood",
     "AdaptiveFloodState",
     "AntiEntropy",
     "AntiEntropyState",
+    "BatchFlood",
+    "MessageBatch",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",
     "BipartiteCheck",

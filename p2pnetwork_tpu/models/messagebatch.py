@@ -1,0 +1,390 @@
+"""Batched message plane: B concurrent floods as one lane-packed state.
+
+Production traffic is thousands of overlapping broadcasts, not one flood
+(ROADMAP item 2a) — yet a :class:`~p2pnetwork_tpu.models.flood.Flood` run
+per message pays B× the engine loops, B× the dispatches and B× the N-wide
+state of one. This module batches them the way the sparse-GNN-on-dense-
+hardware literature batches many small sparse problems into one
+dense-shaped program (PAPERS.md): since ``ops/bitset.py`` packs 32
+predicates per uint32, 32 broadcast states fit in the footprint of one —
+``seen``/``frontier`` become ``u32[B_words, N_pad]`` where bit L of word w
+at node v is message ``32w+L``'s predicate — and one jitted round-step
+(``ops/segment.propagate_or_lanes``) advances every in-flight message.
+
+Per-message semantics are EXACTLY the single-message flood's, lane by
+lane: the same seed masking, the same ``new = delivered & ~seen & alive``
+dedup, the same masked coverage numerator, the same per-round message
+count, the same "run while coverage < target" round accounting — each
+lane's final ``seen`` set and round count is bit-identical to an
+independent ``Flood`` run from the same source
+(tests/test_messagebatch.py pins the sweep). Completed lanes FREEZE: they
+are masked out of the batch frontier, so stragglers stop paying for
+finished messages.
+
+Admission is staggered by design: a batch has fixed lane CAPACITY, and
+:meth:`BatchFlood.admit` seeds new messages into open lanes between
+engine calls — the seam a serving front-end drives (submit → admit,
+poll → :func:`lane_seen` / :meth:`MessageBatch` metadata, complete →
+:meth:`BatchFlood.retire` recycles the lane). The engine side is
+``engine.run_batch_until_coverage`` — one donated-carry ``while_loop``
+advancing the whole batch with per-lane completion detection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.ops import bitset, frontier, segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MessageBatch:
+    """Lane-packed state of up to ``capacity = 32 · B_words`` concurrent
+    floods. Message lane ``b`` lives at bit ``b % 32`` of word
+    ``b // 32`` (ops/bitset.py lane order — ``bitset.pack_bits`` of a
+    ``bool[capacity]`` flag yields exactly the per-word lane masks).
+
+    ``seen``/``frontier`` are the broadcast predicates of every lane at
+    once; the per-lane metadata tracks each message's lifecycle. A lane
+    is OPEN (seedable by ``admit``) when ``~admitted``; RUNNING while
+    ``admitted & ~done``; FROZEN once ``done`` (coverage target reached —
+    its bits stop entering the batch frontier). ``rounds`` counts the
+    steps APPLIED to the lane (identical to the single-message engine's
+    round count). Per-lane send totals are NOT accumulated per round —
+    that would cost a per-(node, lane) weighted reduction every round;
+    instead ``sent`` records which nodes have broadcast for each lane (a
+    flood node sends exactly once, the round after it first sees the
+    message), and :func:`lane_messages` derives the exact per-lane total
+    from it on demand, outside the hot loop."""
+
+    seen: jax.Array       # u32[B_words, N_pad] — lane-packed seen sets
+    frontier: jax.Array   # u32[B_words, N_pad] — lane-packed frontiers
+    sent: jax.Array       # u32[B_words, N_pad] — nodes that have SENT
+    source: jax.Array     # i32[capacity] — seed node per lane (-1 = open)
+    admitted: jax.Array   # bool[capacity]
+    done: jax.Array       # bool[capacity] — frozen (target reached)
+    rounds: jax.Array     # i32[capacity] — steps applied per lane
+    seen_count: jax.Array  # i32[capacity] — live nodes holding the message
+    target: jax.Array     # f32[capacity] — per-lane coverage target
+
+    @property
+    def n_words(self) -> int:
+        return self.seen.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.n_words * bitset.WORD
+
+
+def _lane_word(batch: MessageBatch, lane: int):
+    """(word, bit) of a lane id, bounds-checked: an out-of-range lane
+    would otherwise silently CLAMP to the last word and read another
+    message's predicate (the same silent-clamp footgun
+    base.validate_source guards seeds against, on the poll side)."""
+    lane = int(lane)
+    if not 0 <= lane < batch.capacity:
+        raise ValueError(
+            f"lane {lane} outside this batch's capacity "
+            f"{batch.capacity} — stale or foreign lane id?")
+    return divmod(lane, bitset.WORD)
+
+
+def lane_seen(batch: MessageBatch, lane: int) -> jax.Array:
+    """One lane's ``seen`` predicate as ``bool[N_pad]`` — the per-message
+    result view (poll/read side of the serving seam)."""
+    w, b = _lane_word(batch, lane)
+    return ((batch.seen[w] >> jnp.uint32(b)) & jnp.uint32(1)).astype(bool)
+
+
+def lane_frontier(batch: MessageBatch, lane: int) -> jax.Array:
+    """One lane's ``frontier`` predicate as ``bool[N_pad]``."""
+    w, b = _lane_word(batch, lane)
+    return ((batch.frontier[w] >> jnp.uint32(b)) & jnp.uint32(1)).astype(
+        bool)
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class BatchFlood:
+    """The flood family's batched form: B single-source floods advanced
+    by one compiled program per round.
+
+    ``method`` picks the lane-packed lowering
+    (ops/segment.propagate_or_lanes: ``auto``/``gather``/``segment``/
+    ``frontier``); ``frontier_crossover`` overrides the shared
+    union-frontier compaction budget exactly like ``Flood``'s knob. The
+    protocol is a static-hyperparameter dataclass so it hashes stably
+    into jit caches, like every other model."""
+
+    method: str = "auto"
+    frontier_crossover: object = None  # ops/frontier.py budget override
+
+    # ------------------------------------------------------------ lifecycle
+
+    def empty(self, graph: Graph, capacity: int) -> MessageBatch:
+        """An all-open batch of ``capacity`` lanes (rounded UP to a whole
+        word — ragged capacities waste only the pad lanes' bits)."""
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        n_words = bitset.n_words(capacity)
+        cap = n_words * bitset.WORD
+        n_pad = graph.n_nodes_padded
+        return MessageBatch(
+            seen=jnp.zeros((n_words, n_pad), dtype=jnp.uint32),
+            frontier=jnp.zeros((n_words, n_pad), dtype=jnp.uint32),
+            sent=jnp.zeros((n_words, n_pad), dtype=jnp.uint32),
+            source=jnp.full(cap, -1, dtype=jnp.int32),
+            admitted=jnp.zeros(cap, dtype=bool),
+            done=jnp.zeros(cap, dtype=bool),
+            rounds=jnp.zeros(cap, dtype=jnp.int32),
+            seen_count=jnp.zeros(cap, dtype=jnp.int32),
+            target=jnp.ones(cap, dtype=jnp.float32),
+        )
+
+    def init(self, graph: Graph, sources, *,
+             coverage_target: float = 0.99,
+             capacity: int = None) -> MessageBatch:
+        """A fresh batch with one lane admitted per entry of ``sources``
+        (any int sequence; duplicates are independent messages).
+        ``capacity`` reserves open lanes beyond them for later
+        :meth:`admit` waves (default: just enough words for
+        ``len(sources)``)."""
+        sources = np.asarray(sources, dtype=np.int32).reshape(-1)
+        if sources.size == 0:
+            raise ValueError("init needs at least one source")
+        cap = capacity if capacity is not None else sources.size
+        if cap < sources.size:
+            raise ValueError(f"capacity {cap} < {sources.size} sources")
+        batch = self.empty(graph, cap)
+        batch, _ = self.admit(graph, batch, sources,
+                              coverage_target=coverage_target)
+        return batch
+
+    def admit(self, graph: Graph, batch: MessageBatch, sources, *,
+              coverage_target: float = 0.99):
+        """Seed new messages into OPEN lanes — the between-rounds
+        admission seam. Returns ``(batch, lane_ids)`` where ``lane_ids``
+        (numpy i32) are the lanes assigned, in ``sources`` order.
+
+        Host-side by design: lane assignment is control-plane work the
+        serving front-end does between ``run_batch_until_coverage``
+        calls, and the device updates are a handful of scatters. Each
+        lane's seeding matches ``Flood.init`` + the resume loop's
+        ``cov0`` exactly: the seed is masked by ``node_mask``, and a lane
+        already at target (tiny graphs, dead sources never — a dead
+        source seeds nothing and floods nothing, spinning to max_rounds
+        like the single-message run) starts ``done``. Raises when open
+        lanes run out — that is the backpressure signal, not a silent
+        drop."""
+        sources = np.asarray(sources, dtype=np.int32).reshape(-1)
+        if sources.size == 0:  # an idle admission tick is a no-op
+            return batch, np.zeros(0, dtype=np.int32)
+        bad = (sources < 0) | (sources >= graph.n_nodes_padded)
+        if bad.any():  # one canonical error, vectorized check (B is large)
+            base.validate_source(graph, int(sources[bad.argmax()]))
+        open_lanes = np.flatnonzero(~np.asarray(batch.admitted))
+        if sources.size > open_lanes.size:
+            raise ValueError(
+                f"admit of {sources.size} messages into a batch with only "
+                f"{open_lanes.size} open lanes of {batch.capacity} — "
+                "retire completed lanes or grow capacity")
+        lanes = open_lanes[:sources.size].astype(np.int32)
+        src = jnp.asarray(sources)
+        # Seed scatter: bit L of word w at each source node. Two admitted
+        # lanes may share the same (word, source) cell — ``.at[].set``
+        # would keep only one — so fold duplicate cells' bits on the host
+        # first (vectorized: sort by cell, OR-reduce each run; admission
+        # is the serving plane's hot path at B=1024+).
+        w_idx = lanes // bitset.WORD
+        cell_bits = np.uint32(1) << (lanes % bitset.WORD).astype(np.uint32)
+        cell_key = w_idx.astype(np.int64) * graph.n_nodes_padded + sources
+        order = np.argsort(cell_key, kind="stable")
+        starts = np.flatnonzero(
+            np.diff(cell_key[order], prepend=cell_key[order[0]] - 1))
+        folded = np.bitwise_or.reduceat(cell_bits[order], starts)
+        ws = jnp.asarray(w_idx[order][starts])
+        vs = jnp.asarray(sources[order][starts])
+        bits = jnp.where(graph.node_mask[vs], jnp.asarray(folded),
+                         jnp.uint32(0))
+        seen = batch.seen.at[ws, vs].set(batch.seen[ws, vs] | bits)
+        frontier_w = batch.frontier.at[ws, vs].set(
+            batch.frontier[ws, vs] | bits)
+        lanes_j = jnp.asarray(lanes)
+        seeded = graph.node_mask[src]  # dead source seeds nothing
+        count0 = seeded.astype(jnp.int32)
+        n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        cov0 = count0 / n_live
+        tgt = jnp.float32(coverage_target)
+        # sent needs no seeding: the source broadcasts in its first
+        # applied round, where it enters `sent` through the frontier.
+        return dataclasses.replace(
+            batch,
+            seen=seen,
+            frontier=frontier_w,
+            source=batch.source.at[lanes_j].set(src),
+            admitted=batch.admitted.at[lanes_j].set(True),
+            done=batch.done.at[lanes_j].set(cov0 >= tgt),
+            rounds=batch.rounds.at[lanes_j].set(0),
+            seen_count=batch.seen_count.at[lanes_j].set(count0),
+            target=batch.target.at[lanes_j].set(tgt),
+        ), lanes
+
+    def retire(self, batch: MessageBatch, lanes=None) -> MessageBatch:
+        """Release lanes back to OPEN (default: every ``done`` lane),
+        clearing their bits from the packed predicates so the next
+        admit's message starts clean. Read results (:func:`lane_seen`,
+        per-lane metadata) BEFORE retiring — this erases them."""
+        if lanes is None:
+            release = np.asarray(batch.done)
+        else:
+            ids = np.asarray(lanes, dtype=np.int64).reshape(-1)
+            bad = (ids < 0) | (ids >= batch.capacity)
+            if bad.any():  # a wrapped -1 would silently erase the LAST
+                # lane's in-flight state (the _lane_word footgun, write
+                # side) — refuse instead.
+                raise ValueError(
+                    f"retire of lane {int(ids[bad.argmax()])} outside "
+                    f"this batch's capacity {batch.capacity} — stale or "
+                    "foreign lane id?")
+            release = np.zeros(batch.capacity, dtype=bool)
+            release[ids] = True
+        clear = bitset.pack_bits(jnp.asarray(release))  # u32[B_words]
+        keep = ~clear[:, None]
+        rel = jnp.asarray(release)
+        return dataclasses.replace(
+            batch,
+            seen=batch.seen & keep,
+            frontier=batch.frontier & keep,
+            sent=batch.sent & keep,
+            source=jnp.where(rel, -1, batch.source),
+            admitted=batch.admitted & ~rel,
+            done=batch.done & ~rel,
+            rounds=jnp.where(rel, 0, batch.rounds),
+            seen_count=jnp.where(rel, 0, batch.seen_count),
+        )
+
+    # ----------------------------------------------------------------- step
+
+    def refresh(self, graph: Graph, batch: MessageBatch) -> MessageBatch:
+        """Re-derive the mask-dependent per-lane state from the CURRENT
+        graph — the batched analog of the resume loop's ``cov0`` seeding
+        (engine.run_until_coverage_from): node failures applied BETWEEN
+        engine calls change both the masked coverage numerator and the
+        live-node denominator, so a resumed batch must re-count before
+        deciding which lanes are already at target (a lane at target
+        under the new mask applies zero steps, exactly like the
+        single-message resume).
+
+        ``done`` is LATCHED — refresh only ever adds completions, never
+        revokes one. A completed message stays delivered even if later
+        node failures drop its masked coverage back under target: the
+        freeze already cleared its frontier (resuming would flood from
+        nothing), and serving semantics agree — re-broadcast after
+        churn is a NEW message, admitted into a fresh lane. This is the
+        one deliberate divergence from resuming a single-message run of
+        the same state, which would keep flooding.
+
+        The engine entry point calls refresh EAGERLY
+        before dispatching the loop: inside the donated jit the stale
+        ``seen_count`` input would be dead (recomputed), and jax prunes
+        dead array args — silently dropping that leaf's donation (the
+        graftaudit donation gate caught exactly this). Eager, it
+        replaces only the two small metadata leaves, no copies of the
+        packed predicates. Within one compiled run the mask is static,
+        so the step's incremental count stays exact from here."""
+        node_lanes = jnp.where(graph.node_mask, jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
+        seen_count = jax.vmap(bitset.lane_counts)(
+            batch.seen & node_lanes[None, :]).reshape(-1)
+        n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        done = batch.done | (batch.admitted
+                             & (seen_count / n_live >= batch.target))
+        return dataclasses.replace(batch, seen_count=seen_count, done=done)
+
+    def step(self, graph: Graph, batch: MessageBatch, key: jax.Array):
+        """One synchronous round of every RUNNING lane: frozen (done) and
+        open lanes are masked out of the batch frontier, so they pay
+        nothing and change nothing. Per-lane arithmetic mirrors
+        ``Flood.step`` bit for bit. Per-round costs are word-level only:
+        the lane-masked popcount completion check rides the 32x32
+        bit-transpose (bitset.lane_counts — a few u32 passes, no
+        ``[N, 32]`` expansion), the aggregate send count rides a per-NODE
+        ``population_count`` against ``out_degree``, and per-lane send
+        totals are deferred entirely to :func:`lane_messages` via the
+        ``sent`` predicate."""
+        live = batch.admitted & ~batch.done
+        live_mask = bitset.pack_bits(live)  # u32[B_words] lane masks
+        front = batch.frontier & live_mask[:, None]
+        delivered = segment.propagate_or_lanes(
+            graph, front, self.method,
+            frontier_crossover=self.frontier_crossover)
+        new = delivered & ~batch.seen & live_mask[:, None]
+        seen = batch.seen | new
+        sent = batch.sent | front  # every frontier node broadcasts once
+        # Per-lane masked coverage numerator, accumulated incrementally
+        # (transpose-popcount of `new` per word; lanes ride the columns,
+        # b = 32w + L matching the metadata vectors' order). `new` is
+        # already node-masked (the kernels zero dead receivers), and the
+        # mask is STATIC within a compiled run, so incremental equals
+        # Flood's per-round `sum(seen & node_mask)` exactly — provided
+        # the entry state was refreshed (engine calls `refresh` before
+        # dispatch; that is also what keeps this carry leaf live for
+        # donation, see refresh's docstring).
+        new_counts = jax.vmap(bitset.lane_counts)(new).reshape(-1)
+        seen_count = batch.seen_count + new_counts
+        n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        coverage = seen_count / n_live
+        done = batch.done | (batch.admitted & (coverage >= batch.target))
+        rounds = batch.rounds + live.astype(jnp.int32)
+        # Freeze lanes that just completed: their new bits never enter
+        # the next frontier (next round's live_mask would mask them too;
+        # clearing here keeps the carried state canonical).
+        next_mask = bitset.pack_bits(batch.admitted & ~done)
+        frontier_next = new & next_mask[:, None]
+        active = jnp.sum((batch.admitted & ~done).astype(jnp.int32))
+        deg = graph.out_degree.astype(jnp.uint32)
+        stats = {
+            # u32[B_words]: per-word send subtotals via per-node lane
+            # popcounts x out_degree (32 lanes x E each stays under 2^32
+            # for E <= 2^27 edges); the engine folds them into its exact
+            # two-limb total.
+            "messages_words": jax.vmap(lambda f: jnp.sum(
+                deg * jax.lax.population_count(f)))(front),
+            "active_lanes": active,
+            "completed": jnp.sum(done.astype(jnp.int32)),
+            # The union frontier's occupancy — what the shared
+            # compaction budget (ops/frontier.py) is measured against.
+            "batch_occupancy": frontier.occupancy(
+                graph, jnp.any(frontier_next != 0, axis=0)),
+        }
+        return dataclasses.replace(
+            batch, seen=seen, frontier=frontier_next, sent=sent,
+            done=done, rounds=rounds, seen_count=seen_count,
+        ), stats
+
+
+def lane_messages(graph: Graph, batch: MessageBatch) -> jax.Array:
+    """Exact per-lane send totals, derived on demand: ``i32[capacity]``.
+
+    A flood node broadcasts exactly once — the round after it first sees
+    the message — so a lane's total sends are the out-degree-weighted
+    count of its ``sent`` predicate. Deriving the total here (one
+    weighted bit-plane reduction per word, per CALL) instead of
+    accumulating per round keeps the hot loop free of the per-(node,
+    lane) product. Always fits i32: a lane's sends are bounded by the
+    directed edge count, and edge indices are i32 already.
+
+    Totals are priced at the graph's CURRENT ``out_degree``: edges cut
+    between engine calls retro-price the cut-edge sends of earlier
+    rounds (a known divergence from a per-round accumulator under
+    between-call edge failures; mask-static runs — including every
+    in-run failure-free case the parity suite pins — are exact)."""
+    return jax.vmap(
+        lambda s: bitset.lane_counts(s, graph.out_degree))(
+            batch.sent).reshape(-1)

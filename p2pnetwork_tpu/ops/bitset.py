@@ -81,3 +81,104 @@ def set_bits(words: jax.Array, idx: jax.Array, valid: jax.Array) -> jax.Array:
         jnp.where(valid, idx, n)
     ].set(True, mode="drop")
     return words | pack_bits(hit)
+
+
+# --------------------------------------------------------------- lane algebra
+#
+# The functions above pack 32 NODES into one word (one predicate, bit i of
+# word w = node 32w+i). The lane view below is the TRANSPOSE: one uint32
+# PER NODE whose bit L is the predicate of *message lane* L — 32 concurrent
+# broadcast states in the footprint of one (``u32[N]`` instead of 32 ×
+# ``bool[N]``). A batch of B messages stacks ceil(B/32) such lane vectors;
+# lane index ``b = 32*w + L`` matches :func:`pack_bits`'s LSB-first order,
+# so a ``bool[B]`` per-message flag packs into the per-word lane masks with
+# the same function. This is the carry layout of the batched message plane
+# (models/messagebatch.py, engine.run_batch_until_coverage).
+
+
+def expand_lanes(lanes: jax.Array) -> jax.Array:
+    """``u32[...] -> bool[..., 32]``: bit L of each word becomes lane
+    column L — the transient bit-plane view the lane-wide scatter and the
+    per-lane reductions operate on."""
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    return ((lanes[..., None] >> shifts) & jnp.uint32(1)).astype(bool)
+
+
+def collapse_lanes(bits: jax.Array) -> jax.Array:
+    """``bool[..., 32] -> u32[...]`` — inverse of :func:`expand_lanes`."""
+    weights = jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=-1,
+                   dtype=jnp.uint32)
+
+
+#: (shift, mask) schedule of the 32x32 bit-matrix transpose (Hacker's
+#: Delight 7-3, vectorized): 5 masked swap passes, each a few u32 ops per
+#: word — the whole transpose costs ~5 passes over the input, no
+#: expansion.
+_TRANSPOSE_STEPS = (
+    (16, 0x0000FFFF), (8, 0x00FF00FF), (4, 0x0F0F0F0F),
+    (2, 0x33333333), (1, 0x55555555),
+)
+
+
+def transpose_bits32(a: jax.Array) -> jax.Array:
+    """Transpose 32x32 bit blocks: ``u32[..., 32] -> u32[..., 32]`` where
+    output word L's bit i is input word ``31-i``'s bit ``31-L`` (per
+    trailing block) — the Hacker's Delight 7-3 masked-swap transpose,
+    which under the LSB-first lane convention lands both axes REVERSED.
+    Reductions that only COUNT bits (population_count) are order-blind,
+    so callers flip just the lane axis; anything reading individual bits
+    must account for both reversals.
+
+    This converts the lane-packed layout (bit L of node-word i = lane L)
+    into a per-lane layout whose words ``lax.population_count`` can eat —
+    an O(5-passes) alternative to materializing the ``[N, 32]`` bit-plane
+    expansion, which at batch scale is hundreds of MB per round."""
+    shape = a.shape
+    for j, m in _TRANSPOSE_STEPS:
+        m = jnp.uint32(m)
+        pairs = a.reshape(*shape[:-1], 32 // (2 * j), 2, j)
+        top, bot = pairs[..., 0, :], pairs[..., 1, :]
+        t = (top ^ (bot >> j)) & m
+        a = jnp.stack([top ^ t, bot ^ (t << j)], axis=-2).reshape(shape)
+    return a
+
+
+def lane_counts(lanes: jax.Array, weights: jax.Array = None) -> jax.Array:
+    """Per-lane population count across nodes: ``i32[32]`` where entry L
+    counts the nodes whose lane-L bit is set in ``lanes`` (``u32[N]``) —
+    the lane-masked popcount batched completion detection rides. With
+    ``weights`` (``i32[N]``), each set bit contributes its node's weight
+    instead of 1 (per-lane message counts: weights = out_degree).
+
+    The unweighted path rides :func:`transpose_bits32` + population_count
+    (a few u32 passes over N words); the weighted path has to touch a
+    per-(node, lane) product, so it materializes the bit-plane expansion
+    — keep it OUT of per-round loops (the batched engine derives per-lane
+    message totals once per run from the ``sent`` predicate instead)."""
+    if weights is None:
+        n = lanes.shape[0]
+        if n % WORD:  # pad to whole 32-word blocks (zero bits count 0)
+            lanes = jnp.concatenate(
+                [lanes, jnp.zeros(WORD - n % WORD, dtype=jnp.uint32)])
+        blocks = transpose_bits32(lanes.reshape(-1, WORD))
+        counts = jnp.sum(jax.lax.population_count(blocks).astype(jnp.int32),
+                         axis=0)
+        return counts[::-1]  # transpose lands the lane axis reversed
+    planes = expand_lanes(lanes).astype(jnp.int32)
+    return jnp.sum(planes * weights[:, None].astype(jnp.int32), axis=0)
+
+
+def or_scatter_lanes(n: int, idx: jax.Array, vals: jax.Array) -> jax.Array:
+    """32-lane-wide scatter-OR: ``u32[n]`` with ``out[idx[i]] |= vals[i]``.
+
+    The word-level sibling of :func:`set_bits`'s problem — ``.at[].max``
+    cannot OR two different uint32 patterns landing on one receiver — and
+    the same fix lifted to lanes: scatter the transient BIT-PLANE rows
+    (``bool[k, 32]``) with ``.at[].max`` (max ≡ OR per bool lane; duplicate
+    receivers compose correctly), then repack. One scatter op serves all
+    32 message lanes of a word. Out-of-range ``idx`` drops (mask invalid
+    slots by pointing them at ``n``, exactly like :func:`set_bits`)."""
+    planes = jnp.zeros((n, WORD), dtype=bool).at[idx].max(
+        expand_lanes(vals), mode="drop")
+    return collapse_lanes(planes)

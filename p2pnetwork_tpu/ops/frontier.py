@@ -107,6 +107,20 @@ def budget_slots(graph: Graph, crossover=None) -> int:
     return k * max(graph.max_out_span, 1) if k else 0
 
 
+def budget_slots_lanes(graph: Graph, crossover=None, n_words: int = 1) -> int:
+    """The slot bound of one LANE-PACKED sparse round
+    (:func:`propagate_or_lanes_frontier`): the compacted gather is the
+    same ``k · span`` edge slots as the single-message path — one u32
+    gather serves all 32 lanes of a word — but the 32-message-wide
+    scatter moves a bit-plane row per slot, so the scattered element
+    count is ``k · span · 32`` per word (× ``n_words`` under the vmap).
+    graftaudit checks the batched lowerings against exactly this number
+    (0 = sparse disabled)."""
+    from p2pnetwork_tpu.ops import bitset
+
+    return budget_slots(graph, crossover) * bitset.WORD * max(n_words, 1)
+
+
 def occupancy(graph: Graph, frontier: jax.Array) -> jax.Array:
     """Active fraction of live nodes — the device-side stat the sparse/
     dense crossover is measured by (f32 scalar)."""
@@ -158,6 +172,50 @@ def propagate_or_frontier(graph: Graph, signal: jax.Array, dense_fn,
         return out & graph.node_mask
 
     return jax.lax.cond(n_active <= k, sparse, dense_fn, signal)
+
+
+def propagate_or_lanes_frontier(graph: Graph, lanes: jax.Array, dense_fn,
+                                crossover=None) -> jax.Array:
+    """Frontier-compacted LANE-PACKED neighbor-OR: one compaction serves
+    B = 32·W concurrent broadcasts (``lanes`` is ``u32[W, N_pad]``, bit L
+    of word w = message 32w+L — ops/bitset.py lane algebra).
+
+    A node is in the *batch frontier* if ANY lane of ANY word set it —
+    the compaction (``nonzero`` into the same ``k``-slot buffer as the
+    single-message path) runs ONCE on that union, its gathered edge rows
+    are shared by every word, and each word then pays one ``k·span`` u32
+    gather of its lane values plus one 32-message-wide scatter-OR
+    (``bitset.or_scatter_lanes``) — vmapped over words for B > 32. The
+    ``lax.cond`` sits OUTSIDE the vmap on the union count, so the
+    sparse/dense decision is shared (a vmapped cond would lower to a
+    select that executes both branches for every word, wiping out the
+    compaction win); one word with a dense frontier routes the whole
+    batch dense, which costs at most the dense bound it would pay anyway.
+    ``dense_fn(lanes)`` is that fallback."""
+    require_csr(graph)
+    k = budget(graph, crossover)
+    if k == 0:  # sparse can't win on this graph (see budget) — trace-time
+        return dense_fn(lanes)
+    n_active = jnp.sum(jnp.any(lanes != 0, axis=0).astype(jnp.int32))
+
+    def sparse(ln):
+        from p2pnetwork_tpu.ops import bitset
+
+        n_pad = graph.n_nodes_padded
+        f, eid, evalid = _gather_active(
+            graph, jnp.any(ln != 0, axis=0), n_active, k)
+        cand = jnp.where(evalid, graph.receivers[eid], n_pad).reshape(-1)
+
+        def word(wl):
+            vals = jnp.where(evalid, wl[f][:, None],
+                             jnp.uint32(0)).reshape(-1)
+            return bitset.or_scatter_lanes(n_pad, cand, vals)
+
+        out = jax.vmap(word)(ln)
+        return out & jnp.where(graph.node_mask, jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
+
+    return jax.lax.cond(n_active <= k, sparse, dense_fn, lanes)
 
 
 def propagate_max_frontier(graph: Graph, signal: jax.Array,

@@ -170,6 +170,99 @@ def propagate_or(graph: Graph, signal: jax.Array, method: str = "auto", *,
     return (agg > 0) & graph.node_mask
 
 
+def _dynamic_or_lanes(graph: Graph, word: jax.Array) -> jax.Array:
+    """Lane-packed OR over the dynamic edge region (sim/topology.py), one
+    word (``u32[N_pad]``) at a time: bit-plane expand the (small) dynamic
+    region's contributions and segment-max them per lane."""
+    from p2pnetwork_tpu.ops import bitset
+
+    contrib = jnp.where(graph.dyn_mask, word[graph.dyn_senders],
+                        jnp.uint32(0))
+    planes = jax.ops.segment_max(
+        bitset.expand_lanes(contrib).astype(jnp.uint8),
+        graph.dyn_receivers, num_segments=graph.n_nodes_padded,
+    )
+    return bitset.collapse_lanes(planes > 0) & jnp.where(
+        graph.node_mask, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+
+
+def propagate_or_lanes(graph: Graph, lanes: jax.Array,
+                       method: str = "auto", *,
+                       frontier_crossover=None) -> jax.Array:
+    """Lane-packed neighbor-OR: 32·W concurrent boolean signals advanced
+    by one round in one program — ``lanes`` is ``u32[W, N_pad]`` where bit
+    L of word w at node v is message ``32w+L``'s signal (ops/bitset.py
+    lane algebra), and ``out[w, v] = OR(lanes[w, u], u->v)`` word-level.
+
+    This is :func:`propagate_or` batched across messages instead of
+    called B times: the graph traffic (neighbor-row gathers, edge
+    contributions) is priced PER WORD, so 32 messages ride each gathered
+    element. Methods:
+
+    - ``"gather"``: one u32 gather of each node's neighbor row serves all
+      32 lanes of a word; the degree-axis reduce is a word-level bitwise
+      OR. Same complete-table requirement as :func:`propagate_or`.
+    - ``"segment"``: per-edge contributions bit-plane-expanded (uint8
+      ``[E_pad, 32]``) through the sorted-receiver segment-max — the
+      any-graph fallback (no table needed).
+    - ``"frontier"``: union-frontier compaction shared across all words,
+      32-message-wide scatter-OR (ops/frontier.py
+      ``propagate_or_lanes_frontier``); dense fallback is ``auto``.
+    - ``"auto"``: gather under the same waste bound as the scalar path,
+      else segment (the skew/MXU lowerings have no word-level form —
+      degree-skewed tables route to segment).
+
+    Dynamic edges fold in for every method. Padding lanes are harmless:
+    an unused lane's bits are never seeded, and OR propagates nothing
+    from nothing. ``frontier_crossover`` as in :func:`propagate_or`.
+    """
+    if graph.dyn_senders is not None:
+        static = dataclasses.replace(graph, dyn_senders=None,
+                                     dyn_receivers=None, dyn_mask=None)
+        return (propagate_or_lanes(static, lanes, method,
+                                   frontier_crossover=frontier_crossover)
+                | jax.vmap(lambda w: _dynamic_or_lanes(graph, w))(lanes))
+    if method == "frontier":
+        from p2pnetwork_tpu.ops import frontier as FR
+
+        return FR.propagate_or_lanes_frontier(
+            graph, lanes, lambda ln: propagate_or_lanes(graph, ln, "auto"),
+            crossover=frontier_crossover)
+    if method == "auto":
+        method = "gather" if _gather_ok(graph) else "segment"
+    node_lanes = jnp.where(graph.node_mask, jnp.uint32(0xFFFFFFFF),
+                           jnp.uint32(0))
+    if method == "gather":
+        _require_complete_table(graph)
+
+        def word_gather(wl):
+            vals = jnp.where(graph.neighbor_mask, wl[graph.neighbors],
+                             jnp.uint32(0))
+            return jax.lax.reduce(vals, jnp.uint32(0),
+                                  jax.lax.bitwise_or, (1,))
+
+        return jax.vmap(word_gather)(lanes) & node_lanes
+    if method == "segment":
+        from p2pnetwork_tpu.ops import bitset
+
+        def word_segment(wl):
+            contrib = jnp.where(graph.edge_mask, wl[graph.senders],
+                                jnp.uint32(0))
+            planes = jax.ops.segment_max(
+                bitset.expand_lanes(contrib).astype(jnp.uint8),
+                graph.receivers, num_segments=graph.n_nodes_padded,
+                indices_are_sorted=True,
+            )
+            return bitset.collapse_lanes(planes > 0)
+
+        return jax.vmap(word_segment)(lanes) & node_lanes
+    raise ValueError(
+        f"propagate_or_lanes supports method 'segment', 'gather', "
+        f"'frontier' or 'auto', got {method!r} (the skew/MXU lowerings "
+        f"have no word-level form)"
+    )
+
+
 def propagate_sum(graph: Graph, signal: jax.Array, method: str = "auto",
                   exact: bool = True) -> jax.Array:
     """Per-node sum over incoming neighbors: ``out[v] = sum(signal[u], u->v)``.

@@ -13,6 +13,18 @@ invalidated (``donate=False`` opts out; see ``run_from``). Protocols that
 expose a ``frontier_occupancy`` stat (the flood family) get its per-run
 mean packed into the summary and recorded into the
 ``sim_frontier_occupancy`` histogram.
+
+The BATCHED message plane rides the same loop discipline at B messages
+per program: :func:`run_batch_until_coverage` advances a lane-packed
+:class:`~p2pnetwork_tpu.models.messagebatch.MessageBatch` (32 concurrent
+broadcast states per uint32 word — models/messagebatch.py) with one
+donated-carry ``lax.while_loop``, per-message completion detection via
+lane-masked popcounts against per-message coverage targets, completed
+lanes frozen out of the batch frontier, and the whole per-lane summary
+back in ONE packed transfer. Staggered admission happens BETWEEN calls
+through ``BatchFlood.admit`` — the serving front-end's seam. Per-batch
+occupancy and completion land in the ``sim_batch_active_lanes`` gauge
+and ``sim_batch_completion_rounds`` histogram.
 """
 
 from __future__ import annotations
@@ -22,8 +34,10 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from p2pnetwork_tpu import concurrency, telemetry
+from p2pnetwork_tpu.ops import bitset
 from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.telemetry import jaxhooks
 from p2pnetwork_tpu.utils import accum
@@ -383,6 +397,163 @@ _converged_loop_keeping = functools.partial(
                               "steps_per_round"))(_converged_loop)
 
 
+# ------------------------------------------------------------- batch plane
+
+#: Completion-rounds buckets: floods finish in O(diameter) rounds, so
+#: geometric 1..2048 resolves both small-world (~10) and chain-like tails.
+_COMPLETION_BUCKETS = telemetry.exponential_buckets(1.0, 2.0, 12)
+
+
+def _add_words(acc, words: jax.Array):
+    """Fold per-word uint32 subtotals into the two-limb accumulator —
+    each subtotal is < 2^32 by the ``messages_words`` contract
+    (models/messagebatch.py), so ``accum.add``'s single-carry invariant
+    holds per fold. W is tens at most; a fori_loop keeps it carry-exact
+    without widening anything."""
+    return jax.lax.fori_loop(
+        0, words.shape[0], lambda i, a: accum.add(a, words[i]), acc)
+
+
+def _batch_loop(graph, protocol, batch0, key, *, max_rounds):
+    """The batched run-to-coverage loop: advance every running lane per
+    iteration until ALL admitted lanes complete (or ``max_rounds`` more
+    global rounds pass). Per-lane completion/round accounting lives in
+    the protocol's step (lane-masked popcounts vs per-lane targets);
+    this loop only asks "is anything still running" — one i32 reduction
+    per round, no host sync. Callers must hand in a REFRESHED batch
+    (protocol.refresh — run_batch_until_coverage does): refreshing
+    inside this jit would dead-code the stale seen_count input and
+    silently drop its donation."""
+
+    def cond(carry):
+        batch, _, r, _, _, _ = carry
+        return jnp.any(batch.admitted & ~batch.done) & (r < max_rounds)
+
+    def body(carry):
+        batch, k, r, hi, lo, occ = carry
+        k, sub = jax.random.split(k)
+        batch, stats = protocol.step(graph, batch, sub)
+        hi, lo = _add_words((hi, lo), stats["messages_words"])
+        return (batch, k, r + 1, hi, lo,
+                occ + jnp.float32(stats["batch_occupancy"]))
+
+    init = (batch0, key, jnp.int32(0), *accum.zero(), jnp.float32(0.0))
+    batch, _, rounds, hi, lo, occ = jax.lax.while_loop(cond, body, init)
+    packed = accum.pack_batch_summary(
+        rounds,
+        jnp.sum((batch.admitted & ~batch.done).astype(jnp.int32)),
+        jnp.sum(batch.done.astype(jnp.int32)),
+        (hi, lo),
+        occ / jnp.maximum(rounds, 1),
+        bitset.pack_bits(batch.done),
+        batch.rounds,
+    )
+    return batch, packed
+
+
+_batch_loop_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds"),
+    donate_argnames=("batch0",))(_batch_loop)
+_batch_loop_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- the deliberate donate=False escape hatch, same as the single-message twins
+    jax.jit, static_argnames=("protocol", "max_rounds"))(_batch_loop)
+
+
+def _record_batch_summary(wall_s: float, transfer_s: float,
+                          transfer_bytes: int, out: dict,
+                          newly_done_rounds, protocol_name: str) -> None:
+    """Bridge one batched run summary into the registry: the shared
+    sim_* run counters under ``loop="batch"`` plus the batch plane's own
+    gauges — ``sim_batch_active_lanes`` (lanes still running when the
+    loop returned: >0 means max_rounds cut stragglers off) and one
+    ``sim_batch_completion_rounds`` observation per lane that COMPLETED
+    in this call (lanes finished in an earlier call must not re-observe
+    on resume)."""
+    # The shared sim_* run counters register through the one site that
+    # owns their names/help/labels (loop="batch" has no "coverage" key,
+    # so the coverage gauge and occupancy branches there stay idle).
+    _record_run_summary("batch", wall_s, transfer_s, transfer_bytes, out,
+                        protocol_name)
+    reg = telemetry.default_registry()
+    reg.gauge("sim_batch_active_lanes",
+              "Lanes still running (admitted, not at target) when the last "
+              "batched loop returned — nonzero means max_rounds froze "
+              "stragglers.").set(float(out["active_lanes"]))
+    hist = reg.histogram(
+        "sim_batch_completion_rounds",
+        "Rounds each batched message took to reach its coverage target "
+        "(one observation per lane completed in a "
+        "run_batch_until_coverage call).", buckets=_COMPLETION_BUCKETS)
+    for r in newly_done_rounds.tolist():  # host ints (numpy, post-unpack)
+        hist.observe(r)
+    _observe_occupancy("batch", protocol_name,
+                       float(out["occupancy_mean"]))
+
+
+def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
+                             *, max_rounds: int = 1024,
+                             donate: bool = True):
+    """Advance ALL in-flight messages of a lane-packed batch until every
+    admitted lane reaches its coverage target (or ``max_rounds`` global
+    rounds pass) — the B-message sibling of
+    :func:`run_until_coverage_from`, one compiled program per call.
+
+    ``protocol`` is a batched protocol (models/messagebatch.BatchFlood):
+    ``step(graph, batch, key) -> (batch, stats)`` with per-lane
+    completion folded into the state and ``stats`` carrying
+    ``messages_words`` / ``batch_occupancy`` / ``active_lanes``.
+    Completed lanes freeze (masked out of the batch frontier), so
+    stragglers do not pay for finished messages; admission of NEW
+    messages into open lanes happens between calls via
+    ``protocol.admit`` — the serving front-end's seam.
+
+    Returns ``(batch, out)`` where ``out`` carries the aggregates
+    (``rounds`` global rounds this call, exact ``messages``,
+    ``active_lanes``, ``completed``, ``occupancy_mean``) plus per-lane
+    vectors (``lane_done`` bool[B], ``lane_rounds`` i32[B] — TOTAL steps
+    applied per lane, resume-cumulative) and, when any lane completed in
+    this call, ``completion_rounds_p50`` / ``completion_rounds_p99`` over
+    those lanes — the serving-SLO numbers the bench publishes. The whole
+    summary is ONE packed device->host transfer however large B is.
+
+    ``donate=True`` (default) hands the batch's buffers to the loop and
+    invalidates the caller's copy (see :func:`run_from`); pass
+    ``donate=False`` to keep reading the pre-run batch (e.g. to resume
+    it twice)."""
+    t0 = time.perf_counter()
+    _check_not_donated(batch)  # friendly error before refresh reads it
+    # Pre-run done flags, snapshotted BEFORE the refresh: a lane the
+    # refresh itself completes (failures between calls moved its target)
+    # completed in THIS call and must observe into the completion
+    # histogram/percentiles like any other (and the copy must precede
+    # the loop consuming the donated buffers anyway).
+    done0 = np.asarray(batch.done)
+    # Entry-time mask refresh — the batched cov0 seeding: node failures
+    # applied between calls change the masked numerator/denominator, so
+    # lanes re-decide "already done" against the CURRENT graph before
+    # any step runs. Eager on purpose (see BatchFlood.refresh).
+    batch = protocol.refresh(graph, batch)
+    loop_fn = _pick_loop(_batch_loop_donating, _batch_loop_keeping,
+                         donate, batch, graph, key)
+    n_words = int(batch.seen.shape[0])
+    state, packed = loop_fn(graph, protocol, batch, key,
+                            max_rounds=max_rounds)
+    t1 = time.perf_counter()
+    out = accum.unpack_batch_summary(packed, n_words)
+    t2 = time.perf_counter()
+    newly = out["lane_done"] & ~done0
+    newly_rounds = out["lane_rounds"][newly]
+    if newly_rounds.size:
+        out["completion_rounds_p50"] = float(
+            np.percentile(newly_rounds, 50))
+        out["completion_rounds_p99"] = float(
+            np.percentile(newly_rounds, 99))
+    nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                 for leaf in jax.tree_util.tree_leaves(packed))
+    _record_batch_summary(t2 - t0, t2 - t1, nbytes, out, newly_rounds,
+                          type(protocol).__name__)
+    return state, out
+
+
 def donating_carry_loops() -> dict:
     """The donating state-carry loops, by name — the exact jitted objects
     the resume entry points dispatch, exposed as a stable seam for
@@ -394,6 +565,7 @@ def donating_carry_loops() -> dict:
         "run_from": _run_from_donating,
         "coverage_from": _coverage_loop_donating,
         "converged_from": _converged_loop_donating,
+        "batch_from": _batch_loop_donating,
     }
 
 

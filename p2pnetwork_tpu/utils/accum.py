@@ -82,3 +82,57 @@ def unpack_summary(packed) -> dict:
     if arr.size >= 5:
         out["extra"] = float(arr[4:5].view(np.float32)[0])
     return out
+
+
+#: Fixed slots of the batch summary ahead of the per-lane vectors.
+_BATCH_HEAD = 6
+
+
+def pack_batch_summary(rounds: jax.Array, active_lanes: jax.Array,
+                       completed: jax.Array, acc: Acc, occ_mean: jax.Array,
+                       done_words: jax.Array,
+                       lane_rounds: jax.Array) -> jax.Array:
+    """The batch engine's one-transfer run summary: ``i32[6 + W + B]``.
+
+    Head: ``[global_rounds, active_lanes, completed, hi, lo-bits,
+    occupancy-bits]`` — the scalar aggregates in :func:`pack_summary`'s
+    spirit. Tail: the PER-LANE vectors the batched plane adds — the
+    ``done`` lane flags packed as ``u32[W]`` words (ops/bitset.py lane
+    order) and each lane's applied-round count ``i32[B]``. One packed
+    vector = one device->host transfer for the whole B-message summary,
+    however many messages rode the batch (on tunneled backends every
+    extra round trip is milliseconds — B of them would dwarf the run)."""
+    hi, lo = acc
+    head = jnp.stack([
+        rounds.astype(jnp.int32),
+        active_lanes.astype(jnp.int32),
+        completed.astype(jnp.int32),
+        hi,
+        jax.lax.bitcast_convert_type(lo, jnp.int32),
+        jax.lax.bitcast_convert_type(jnp.float32(occ_mean), jnp.int32),
+    ])
+    return jnp.concatenate([
+        head,
+        jax.lax.bitcast_convert_type(done_words, jnp.int32).reshape(-1),
+        lane_rounds.astype(jnp.int32),
+    ])
+
+
+def unpack_batch_summary(packed, n_words: int) -> dict:
+    """Host-side inverse of :func:`pack_batch_summary` (forces the
+    transfer). Returns ``rounds`` / ``active_lanes`` / ``completed`` /
+    ``messages`` (exact int) / ``occupancy_mean`` plus the per-lane
+    ``lane_done`` (bool[B]) and ``lane_rounds`` (i32[B]) vectors."""
+    arr = np.asarray(packed)
+    messages = (int(arr[3]) << 32) + int(arr[4:5].view(np.uint32)[0])
+    done_words = arr[_BATCH_HEAD:_BATCH_HEAD + n_words].view(np.uint32)
+    bits = (done_words[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return {
+        "rounds": int(arr[0]),
+        "active_lanes": int(arr[1]),
+        "completed": int(arr[2]),
+        "messages": messages,
+        "occupancy_mean": float(arr[5:6].view(np.float32)[0]),
+        "lane_done": bits.reshape(-1).astype(bool),
+        "lane_rounds": arr[_BATCH_HEAD + n_words:].astype(np.int32),
+    }

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "race: graftrace deterministic-concurrency tests — scheduler "
         "replay, HB detector twins, scenario battery, CLI gate (select "
         "with -m race; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "batch: batched message plane tests — lane-packed kernels, "
+        "MessageBatch lifecycle, batched-vs-sequential bit parity, the "
+        "slow-marked 20x aggregate-throughput ratchet (select with "
+        "-m batch; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

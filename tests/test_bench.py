@@ -30,6 +30,10 @@ def _env(cache_dir, **extra):
         "JAX_PLATFORMS": "cpu",
         "BENCH_N_1M": "2000",
         "BENCH_N_10M": "3000",
+        # The batched message-plane column rides the 1m stage: tiny B and
+        # graph so orchestration (not throughput) is what the tests pay.
+        "BENCH_BATCH_N": "1500",
+        "BENCH_BATCH_B": "40",
         "BENCH_BACKEND_WINDOW_S": "5",
         "BENCH_PROBE_TIMEOUT_S": "60",
         "BENCH_CACHE_DIR": str(cache_dir),
@@ -210,6 +214,34 @@ class TestStageTelemetry:
             assert model["entries"]["or/frontier@ws1k"]["flops"] > 0
             assert "cov/flood-ppermute@ws1k" in model["entries"]
 
+    def test_batched_column_published_with_p99(self, first_run):
+        # The batched message-plane column (ROADMAP 2a) lands in the 1M
+        # stage artifact: B in-flight floods per compiled program, the
+        # completion-rounds p99, and the aggregate-throughput ratio vs
+        # sequential single-message runs.
+        cache, _, _ = first_run
+        tel = json.loads((cache / "BENCH_TELEMETRY.json").read_text())
+        col = tel["batched"]
+        assert "error" not in col, col
+        assert col["B"] == 40
+        assert col["completed"] + col["active_lanes_end"] >= 1
+        assert col["batch_completion_rounds_p99"] is not None
+        assert col["batch_completion_rounds_p99"] >= 1
+        assert col["aggregate_speedup_vs_sequential"] > 0
+        assert col["best_s"] > 0 and col["messages"] > 0
+        assert col["seq_sample_runs"] >= 1
+
+    def test_batched_column_disabled_is_empty_not_missing(self, tmp_path):
+        # BENCH_BATCH=0 (what the cpu-fallback parent pins) must publish
+        # an EMPTY column, keeping the artifact schema stable.
+        r = subprocess.run(
+            [sys.executable, BENCH, "--stage", "1m"],
+            env=_env(tmp_path, BENCH_BATCH="0"), capture_output=True,
+            text=True, timeout=600, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        tel = json.loads((tmp_path / "BENCH_TELEMETRY.json").read_text())
+        assert tel["batched"] == {}
+
     def test_headline_format_unchanged_by_telemetry(self, first_run):
         # The driver parses the LAST stdout line; the artifact must not
         # perturb its key set.
@@ -226,6 +258,57 @@ class TestStageTelemetry:
         missing = [w for w in warns if w["name"] == "bench_cache_miss"
                    and w["data"]["reason"] == "missing"]
         assert missing, "first run must report its cold cache misses"
+
+
+class TestProbeCap:
+    """The BENCH_r05 regression: 8 x 120 s wedged-backend probes burned
+    the entire window and the round published a null headline. Probes are
+    now capped (default 2) BEFORE the cpu-fallback child runs, so a real
+    record is always published with most of the window left."""
+
+    @pytest.fixture()
+    def wedged(self, monkeypatch):
+        """An always-wedged backend probe, counting attempts."""
+        import bench
+
+        calls = []
+
+        def stub(timeout_s):
+            calls.append(timeout_s)
+            return "JAX backend init hung for 120s (device tunnel wedged?)"
+
+        monkeypatch.setattr(bench, "_probe_backend_once", stub)
+        return bench, calls
+
+    def test_always_wedged_probe_stops_at_cap(self, wedged, monkeypatch):
+        bench, calls = wedged
+        sleeps = []
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: sleeps.append(s))
+        # A wide-open window must NOT be spent probing: the cap decides.
+        err = bench._backend_alive(window_s=600, probe_timeout_s=1)
+        assert len(calls) == 2
+        assert "cap 2" in err and "wedged" in err
+        assert len(sleeps) == 1  # exactly one retry gap, then hand-off
+
+    def test_cap_env_override(self, wedged, monkeypatch):
+        bench, calls = wedged
+        monkeypatch.setenv("BENCH_PROBE_MAX_ATTEMPTS", "1")
+        err = bench._backend_alive(window_s=1, probe_timeout_s=1)
+        assert len(calls) == 1 and "cap 1" in err
+
+    def test_window_still_bounds_when_cap_is_raised(self, wedged):
+        bench, calls = wedged
+        err = bench._backend_alive(window_s=0, probe_timeout_s=1,
+                                   max_attempts=50)
+        assert len(calls) == 1
+        assert "gave up after 1 probes over 0s" in err
+
+    def test_healthy_probe_returns_none_first_try(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "_probe_backend_once", lambda t: None)
+        assert bench._backend_alive(window_s=5, probe_timeout_s=1) is None
 
 
 class TestHangContainment:

@@ -392,6 +392,36 @@ class TestSimInstrumentation:
         assert h is not None and h.labels("coverage").count == 1
         assert h.labels("coverage").sum > 0
 
+    def test_batch_loop_gauges_and_completion_histogram(self, reg):
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine
+        from p2pnetwork_tpu.sim import graph as G
+
+        g = G.watts_strogatz(300, 4, 0.1, seed=0, source_csr=True)
+        proto = BatchFlood()
+        batch = proto.init(g, [0, 5, 9])
+        batch, out = engine.run_batch_until_coverage(
+            g, proto, batch, jax.random.key(0), max_rounds=64,
+            donate=False)
+        assert reg.value("sim_runs_total", loop="batch") == 1
+        assert reg.value("sim_rounds_total", loop="batch") == out["rounds"]
+        assert reg.value("sim_messages_total",
+                         loop="batch") == out["messages"]
+        # per-batch occupancy gauge: all 3 lanes completed -> 0 running
+        assert reg.value("sim_batch_active_lanes") == 0
+        # one completion observation per lane that finished THIS call
+        h = reg.get("sim_batch_completion_rounds")
+        assert h is not None and h._anon().count == 3
+        assert h._anon().sum == float(sum(out["lane_rounds"][:3]))
+        # a resume of the finished batch must not re-observe those lanes
+        engine.run_batch_until_coverage(
+            g, proto, batch, jax.random.key(0), max_rounds=4,
+            donate=False)
+        assert reg.get("sim_batch_completion_rounds")._anon().count == 3
+        # the batch loop also lands in the shared occupancy histogram
+        occ = reg.get("sim_frontier_occupancy")
+        assert occ is not None and occ.labels("batch", "BatchFlood").count
+
     def test_converged_loop_reports_without_coverage_gauge(self, reg):
         from p2pnetwork_tpu.models import LeaderElection
         from p2pnetwork_tpu.sim import engine
