@@ -9,7 +9,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
-	batch-check
+	batch-check ring-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -90,6 +90,14 @@ build-check:
 # CPU; tox env "batch").
 batch-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_messagebatch.py -q
+
+# Comm seam: the ppermute vs Pallas ring-DMA halo backends must be
+# bit-identical on every sharded protocol (interpret mode on the
+# 8-device virtual CPU mesh), the lane-word batched path included, and
+# the ICI accounting must price the DMA hops like the ppermute hops
+# they replace (tox env "ring").
+ring-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_ring.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

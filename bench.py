@@ -448,6 +448,151 @@ def bench_batched():
     return col
 
 
+def _graph_spec_multichip():
+    """(n, cache name, build thunk) for the ``multichip`` column's ring
+    class: plain segment-bucket layout — the ring pass carries its own
+    edge-bucket representation (parallel/sharded.py), so the single-chip
+    tables/MXU layouts would be dead weight in the cache entry."""
+    from p2pnetwork_tpu.sim import graph as G
+
+    n = int(os.environ.get("BENCH_MULTICHIP_N", 65_536))
+    return n, f"ws_n{n}_k10_p0.1_s0_ring", lambda: G.watts_strogatz(
+        n, 10, 0.1, seed=0)
+
+
+def bench_multichip():
+    """The ``multichip`` bench column: the ring-sharded run-to-coverage
+    flood over every visible device (the promoted Makefile
+    ``dryrun_multichip``, measured and published instead of side-channel
+    MULTICHIP_r*.json files) — multi-chip wall-clock, the scaling ratio
+    vs a single-chip engine run of the SAME graph on the SAME backend,
+    and the per-round ICI byte estimates of BOTH halo-exchange backends
+    from the commviz comm census (the pallas ring-DMA traffic is censused
+    like its ppermute twin — a Pallas-comm program must never read as
+    zero ICI bytes). On CPU this is the dryrun-backed record (8 virtual
+    devices); near-linear scaling is the on-device target, not a CI gate
+    — virtual-device "chips" share one socket, so the published ratio is
+    honest about its backend."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pnetwork_tpu.models.flood import Flood
+    from p2pnetwork_tpu.parallel import auto, commviz
+    from p2pnetwork_tpu.parallel import mesh as M
+    from p2pnetwork_tpu.parallel import sharded
+    from p2pnetwork_tpu.sim import engine
+
+    n_devices = min(8, len(jax.devices()))
+    if n_devices < 2:
+        return {"skipped": f"need >= 2 devices, have {n_devices} "
+                           "(set XLA_FLAGS=--xla_force_host_platform_"
+                           "device_count=8 JAX_PLATFORMS=cpu)"}
+    n, name, build = _graph_spec_multichip()
+    g, build_s, cached = _cached_graph(name, build)
+    mesh = M.ring_mesh(n_devices)
+    sg = sharded.shard_graph(g, mesh)
+    comm = auto.resolve_comm(os.environ.get("BENCH_MULTICHIP_COMM", "auto"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    target, max_rounds = 0.99, 64
+
+    def once():
+        _, out = sharded.flood_until_coverage(
+            sg, mesh, source=0, coverage_target=target,
+            max_rounds=max_rounds, comm=comm)
+        return out  # summary transfer = the honest sync point
+
+    t0 = time.perf_counter()
+    out = once()
+    warmup_s = time.perf_counter() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = once()
+        times.append(time.perf_counter() - t0)
+    multi_s = min(times)
+
+    # Single-chip baseline: the same flood on the same backend through
+    # the engine loop — the ratio's denominator runs in THIS process, so
+    # backend and clock are held fixed.
+    proto = Flood(source=0)
+    engine.run_until_coverage(g, proto, jax.random.key(0),
+                              coverage_target=target, max_rounds=max_rounds)
+    single_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, sout = engine.run_until_coverage(
+            g, proto, jax.random.key(0), coverage_target=target,
+            max_rounds=max_rounds)
+        single_times.append(time.perf_counter() - t0)
+    single_s = min(single_times)
+
+    # Per-round ICI bytes per halo backend: static comm census of the
+    # actual compiled-shape program, scan-trip-weighted — all S-1 hops
+    # of the round body's ring pass are priced; the while loop's dynamic
+    # trip count is what the measured `rounds` multiplies back in.
+    seen0, frontier0 = sharded.init_state(sg, proto, None)
+    ici = {}
+    for backend in sharded.COMM_BACKENDS:
+        fn = sharded._flood_cov_fn(mesh, mesh.axis_names[0], sg.n_shards,
+                                   sg.block, max_rounds, sg.diag_pieces,
+                                   sg.mxu_block, backend)
+        args = (jnp.float32(target), sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *sharded._dyn_or_empty(sg), *sharded._mxu_or_empty(sg),
+                sharded._diag_masks_or_empty(sg), sg.node_mask,
+                sg.out_degree, seen0, frontier0)
+        ici[backend] = {
+            "per_round_bytes": commviz.ici_bytes_estimate(fn, args,
+                                                          n_devices),
+            "census": commviz.jaxpr_comm_census(fn, args, n_devices),
+        }
+    rounds = int(out["rounds"])
+    col = {
+        "n_nodes": n,
+        "n_edges": g.n_edges,
+        "n_devices": n_devices,
+        "platform": jax.devices()[0].platform,
+        "comm": comm,
+        "best_s": round(multi_s, 6),
+        "warmup_s": round(warmup_s, 4),
+        "reps": reps,
+        "rounds": rounds,
+        "coverage": round(float(out["coverage"]), 5),
+        "messages": int(out["messages"]),
+        "single_chip_best_s": round(single_s, 6),
+        "scaling_ratio": round(single_s / multi_s, 3),
+        "per_round_ici_bytes": {b: ici[b]["per_round_bytes"] for b in ici},
+        "ici_bytes_total_est": ici[comm]["per_round_bytes"] * rounds,
+        "ici_census": {b: ici[b]["census"] for b in ici},
+        "graph_build_s": round(build_s, 2),
+        "graph_cached": cached,
+    }
+    print(f"# multichip {n_devices}dev comm={comm}: "
+          f"{multi_s*1000:.1f} ms/run vs single {single_s*1000:.1f} ms "
+          f"(ratio {col['scaling_ratio']}), "
+          f"ICI/round {col['per_round_ici_bytes']}",
+          file=sys.stderr, flush=True)
+    return col
+
+
+def _multichip_in_child():
+    """Run the multichip column in its own child process — the measuring
+    stage may sit on a single-device backend (one TPU chip, plain CPU),
+    so the child gets the 8-device virtual CPU platform whenever the
+    current process cannot see >= 2 devices. Bounded by its own timeout;
+    failure degrades to an error-carrying column, never a sunk stage."""
+    import jax
+
+    timeout = int(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S", "420"))
+    extra = None
+    if len(jax.devices()) < 2:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            flags = (flags + " --xla_force_host_platform_device_count=8"
+                     ).strip()
+        extra = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
+    return _stage_in_child("multichip", timeout, extra_env=extra)
+
+
 def _graph_spec_1m():
     """(cache name, build thunk) for the 1M config — one definition shared
     by the measuring stage and ``--stage prebuild``, so the cache they
@@ -534,6 +679,18 @@ def bench_1m(record):
             print(f"# batched column failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
+    # The multichip column (the promoted dryrun_multichip): ring-sharded
+    # flood over 8 devices — real chips when visible, the virtual CPU
+    # mesh otherwise — in its own bounded child, so a wedged multi-device
+    # path cannot sink the measured single-chip headline. BENCH_MULTICHIP
+    # =0 disables.
+    multichip = {}
+    if os.environ.get("BENCH_MULTICHIP", "1") != "0":
+        multichip = _multichip_in_child()
+        if "error" in multichip:
+            print(f"# multichip column failed: {multichip['error']}",
+                  file=sys.stderr, flush=True)
+
     best_method = min(results, key=lambda m: results[m][0])
     secs, out = results[best_method]
     msgs = int(out["messages"])
@@ -554,7 +711,7 @@ def bench_1m(record):
     return {"graph_build_s": round(build_s, 4), "cache_hit": cached,
             "build_phases": build_phases,
             "supervised": supervised, "per_method": per_method,
-            "batched": batched}
+            "batched": batched, "multichip": multichip}
 
 
 def bench_10m():
@@ -635,6 +792,11 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         # runs, batch_completion_rounds_p99 (empty for stages without
         # the column, error-carrying when it failed).
         "batched": tel.get("batched", {}),
+        # The multichip ring column: multi-device run-to-coverage wall,
+        # scaling ratio vs a single-chip run of the same graph, and the
+        # per-round ICI byte estimates of both halo-exchange backends
+        # (commviz comm census — Pallas ring DMAs priced like ppermute).
+        "multichip": tel.get("multichip", {}),
         # The static cost model beside the measured numbers: graftaudit's
         # blessed flops/bytes per lowering for this stage's shape-class,
         # so drift between model and wall-clock is visible per artifact.
@@ -730,6 +892,12 @@ def _run_stage(stage: str) -> int:
                 rec, tel = bench_10m()
             _write_stage_telemetry(stage, tel, time.perf_counter() - t0)
             print(json.dumps(rec))
+            return 0
+        if stage == "multichip":
+            # The multichip column child: measures the ring-sharded flood
+            # on this process's devices and prints the column JSON (the
+            # 1m stage embeds it into BENCH_TELEMETRY.json).
+            print(json.dumps(bench_multichip()))
             return 0
         if stage == "prebuild":
             # Populate the graph cache without measuring — run once on a

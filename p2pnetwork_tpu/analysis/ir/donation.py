@@ -131,10 +131,32 @@ def all_donation_audits() -> List[DonationAudit]:
                 {"max_rounds": 64},
                 len(jax.tree_util.tree_leaves(batch)))
 
+    def sharded_batch_from():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded as SH
+
+        g = shape_class("ws1k")
+        mesh = M.ring_mesh(8)
+        sg = SH.shard_graph(g, mesh)
+        batch = BatchFlood().init(g, np.arange(32, dtype=np.int32) * 11 % 900)
+        fn = SH._batch_cov_fn(mesh, SH.DEFAULT_AXIS, sg.n_shards, sg.block,
+                              64, SH.DEFAULT_COMM, True)
+        args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *SH._dyn_or_empty(sg), sg.node_mask, sg.out_degree,
+                *SH._shard_batch_args(sg, batch))
+        return fn, args, {}, 9  # the 9 MessageBatch carry leaves
+
     return [
         DonationAudit(
             name="engine/run_from", build=run_from,
             doc="fixed-rounds resume loop (engine.run_from)"),
+        DonationAudit(
+            name="sharded/batch_from", build=sharded_batch_from,
+            doc="sharded batched message-plane ring loop "
+                "(parallel/sharded.run_batch_until_coverage)"),
         DonationAudit(
             name="engine/coverage_from", build=coverage_from,
             doc="run-to-coverage resume loop "

@@ -40,9 +40,14 @@ __all__ = ["Lowering", "Trace", "all_lowerings", "shape_class",
 #: Cross-device primitives the census tracks, with the per-occurrence ICI
 #: byte model: bytes moved ≈ operand_bytes × factor(S) on an S-way ring —
 #: ppermute moves each operand once; psum (ring all-reduce) moves
-#: 2·(S-1)/S ≈ 2 copies; all_gather moves (S-1) shard-sized pieces. A
-#: static, documented model feeding the same comm budgets commviz measures
-#: on compiled HLO (parallel/commviz.py) — the ratchet pins both.
+#: 2·(S-1)/S ≈ 2 copies; all_gather moves (S-1) shard-sized pieces. The
+#: model itself lives in parallel/commviz.ring_model_bytes — one model
+#: feeding both this census ratchet and commviz's comm estimates — and
+#: the census ALSO counts Pallas ring-DMA kernels (ops/pallas_ring.py
+#: ``make_async_remote_copy`` halo hops, recognized by kernel name) under
+#: the ``commviz.RING_DMA_KEY`` pseudo-collective: a Pallas-comm lowering
+#: would otherwise read as zero ICI bytes and silently pass the budget
+#: ratchet.
 COLLECTIVE_PRIMS = ("ppermute", "psum", "all_gather", "all_to_all",
                     "reduce_scatter", "pmax", "pmin")
 
@@ -263,6 +268,88 @@ def _engine_cov_entry(cls: str) -> Lowering:
                     parity=False)
 
 
+def _ring_step_entry(variant: str, cls: str) -> Lowering:
+    """One ring OR pass per halo-exchange backend (sharded.propagate's
+    compiled program, ``comm=ppermute`` vs ``comm=pallas``) — a PARITY
+    group: both backends must agree on the abstract signature, and the
+    census prices the ppermute hops and the Pallas ring DMAs through the
+    same byte model, so the ratchet pins the two backends' ICI budgets
+    against each other."""
+
+    def build():
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded as SH
+
+        g = shape_class(cls)
+        mesh = M.ring_mesh(8)
+        sg = SH.shard_graph(g, mesh)
+        fn = SH._propagate_fn(mesh, SH.DEFAULT_AXIS, sg.n_shards, sg.block,
+                              "or", sg.diag_pieces, sg.mxu_block, variant)
+        args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *SH._dyn_or_empty(sg), *SH._mxu_or_empty(sg),
+                SH._diag_masks_or_empty(sg), sg.node_mask,
+                SH._flood_seed(sg, 0))
+        return fn, args
+
+    return Lowering(name=f"ringstep/{variant}@{cls}", op="ringstep",
+                    variant=variant, shape_class=cls, build=build,
+                    needs_devices=8)
+
+
+def _sharded_or_lanes_entry(cls: str) -> Lowering:
+    """The lane-word halo ring pass (sharded.propagate_or_lanes): one
+    ``u32[W, block]`` hop per ring step carries 32·W in-flight messages'
+    boundary state. Layout-specific ``[S, W, block]`` signature —
+    censused and cost-ratcheted, parity=False like the other sharded
+    programs."""
+
+    def build():
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded as SH
+
+        g = shape_class(cls)
+        mesh = M.ring_mesh(8)
+        sg = SH.shard_graph(g, mesh)
+        fn = SH._or_lanes_fn(mesh, SH.DEFAULT_AXIS, sg.n_shards, sg.block)
+        lanes = SH.shard_lanes(
+            sg, jnp.zeros((1, g.n_nodes_padded), jnp.uint32))
+        args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *SH._dyn_or_empty(sg), sg.node_mask, lanes)
+        return fn, args
+
+    return Lowering(name=f"or_lanes/sharded-ring@{cls}", op="or_lanes",
+                    variant="sharded-ring", shape_class=cls, build=build,
+                    parity=False, needs_devices=8)
+
+
+def _sharded_batch_cov_entry(cls: str) -> Lowering:
+    """The sharded batched-flood loop (sharded.run_batch_until_coverage):
+    the lane-word halo inside the run-to-coverage while_loop — the
+    multi-chip batched plane's measured shape."""
+
+    def build():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.parallel import mesh as M
+        from p2pnetwork_tpu.parallel import sharded as SH
+
+        g = shape_class(cls)
+        mesh = M.ring_mesh(8)
+        sg = SH.shard_graph(g, mesh)
+        batch = BatchFlood().init(g, np.arange(32, dtype=np.int32) * 7 % 1000)
+        fn = SH._batch_cov_fn(mesh, SH.DEFAULT_AXIS, sg.n_shards, sg.block,
+                              64)
+        args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+                *SH._dyn_or_empty(sg), sg.node_mask, sg.out_degree,
+                *SH._shard_batch_args(sg, batch))
+        return fn, args
+
+    return Lowering(name=f"cov/batchflood-ring@{cls}", op="cov",
+                    variant="batchflood-ring", shape_class=cls, build=build,
+                    parity=False, needs_devices=8)
+
+
 def _sharded_cov_entry(cls: str) -> Lowering:
     """The multi-chip ppermute coverage loop (parallel/sharded.py): the
     ring pass whose collective census — ppermute/psum occurrences and
@@ -317,6 +404,13 @@ def all_lowerings() -> List[Lowering]:
     entries.append(_engine_cov_entry("ws1k"))
     entries.append(_engine_batch_cov_entry("ws1k"))
     entries.append(_sharded_cov_entry("ws1k"))
+    # The halo-exchange seam: ppermute vs pallas ring DMAs as
+    # signature-parity peers, plus the lane-word halo programs the
+    # batched plane rides multi-chip.
+    entries.append(_ring_step_entry("ppermute", "ws1k"))
+    entries.append(_ring_step_entry("pallas", "ws1k"))
+    entries.append(_sharded_or_lanes_entry("ws1k"))
+    entries.append(_sharded_batch_cov_entry("ws1k"))
     # The degree-skewed class: the three lowerings whose crossover the
     # routing actually arbitrates there (segment vs skew vs frontier) —
     # and the batched kernels' own arbitrated pair (lanes-auto routes to
@@ -365,18 +459,14 @@ def _collective_bytes(eqn, prim: str, axis_size: int) -> int:
     """The ring-model byte estimate of one collective eqn. ``axis_size``
     is the entry's mesh width — static registry knowledge (the entry
     builds its own mesh), not a runtime axis-env lookup, which is not
-    available when walking a finished jaxpr."""
+    available when walking a finished jaxpr. The model itself is
+    commviz.ring_model_bytes (shared with the runtime comm estimates)."""
+    from p2pnetwork_tpu.parallel import commviz
+
     nbytes = sum(int(getattr(v.aval, "size", 0))
                  * jnp.dtype(v.aval.dtype).itemsize
                  for v in eqn.invars if hasattr(v, "aval"))
-    s = max(axis_size, 2)
-    if prim == "ppermute":
-        return nbytes
-    if prim in ("psum", "pmax", "pmin"):
-        return int(nbytes * 2 * (s - 1) / s)
-    if prim in ("all_gather", "all_to_all", "reduce_scatter"):
-        return nbytes * (s - 1)
-    return nbytes
+    return commviz.ring_model_bytes(prim, nbytes, axis_size)
 
 
 def trace_lowering(entry: Lowering) -> Trace:
@@ -395,6 +485,8 @@ def trace_lowering(entry: Lowering) -> Trace:
     except Exception as e:  # noqa: BLE001 — any failure is the finding
         trace.error = f"{type(e).__name__}: {e}"
         return trace
+    from p2pnetwork_tpu.parallel import commviz
+
     for eqn in iter_eqns(closed):
         prim = eqn.primitive.name
         trace.prims[prim] = trace.prims.get(prim, 0) + 1
@@ -402,4 +494,14 @@ def trace_lowering(entry: Lowering) -> Trace:
             trace.collectives[prim] = trace.collectives.get(prim, 0) + 1
             trace.ici_bytes_est += _collective_bytes(
                 eqn, prim, entry.needs_devices)
+        else:
+            # Pallas ring-DMA halo hops (ops/pallas_ring.py) — censused
+            # as a pseudo-collective so a Pallas-comm lowering's ICI
+            # traffic is budgeted like its ppermute twin's.
+            payload = commviz.ring_dma_payload_bytes(eqn)
+            if payload:
+                key = commviz.RING_DMA_KEY
+                trace.collectives[key] = trace.collectives.get(key, 0) + 1
+                trace.ici_bytes_est += commviz.ring_model_bytes(
+                    key, payload, entry.needs_devices)
     return trace

@@ -81,6 +81,12 @@ class TopologyConfig:
     seed: int = 0
 
 
+#: Valid MeshConfig.comm values — parallel/sharded.COMM_BACKENDS plus
+#: "auto". A literal on purpose (config stays importable without jax);
+#: pinned equal to sharded's tuple by tests/test_ring.py.
+COMM_CHOICES = ("ppermute", "pallas", "auto")
+
+
 @dataclasses.dataclass
 class MeshConfig:
     """TPU mesh layout for the sharded propagation path.
@@ -92,6 +98,18 @@ class MeshConfig:
 
     shards: int = 1
     axis_name: str = "shards"
+    #: Halo-exchange backend of the ring path: "ppermute" (XLA
+    #: collective-permute), "pallas" (async remote-copy DMA kernels,
+    #: ops/pallas_ring.py — overlaps the ICI hop with shard-local
+    #: propagation), or "auto" (pallas on TPU, ppermute elsewhere —
+    #: parallel/auto.resolve_comm).
+    comm: str = "ppermute"
+
+    def __post_init__(self):
+        if self.comm not in COMM_CHOICES:
+            raise ValueError(
+                f"unknown comm backend: {self.comm!r} "
+                f"(choose one of {COMM_CHOICES})")
 
 
 @dataclasses.dataclass

@@ -44,6 +44,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
 from p2pnetwork_tpu.sim.graph import Graph
 
+#: The explicit ring's halo-exchange backends. resolve_comm validates
+#: against parallel/sharded.COMM_BACKENDS itself (lazy import — sharded
+#: pulls in jax); this literal only serves the docstring/error text and
+#: is pinned equal to sharded's by tests/test_ring.py.
+COMM_BACKENDS = ("ppermute", "pallas")
+
+
+def resolve_comm(comm: str = "auto") -> str:
+    """Route the ring path's halo-exchange backend (``comm=`` knob on every
+    parallel/sharded.py entry point, ``MeshConfig.comm`` in config.py).
+
+    - ``"ppermute"``: XLA collective-permute — the portable default; the
+      compiler's latency-hiding scheduler may overlap it with the bucket
+      compute the ring bodies issue after it.
+    - ``"pallas"``: ``pltpu.make_async_remote_copy`` ring-DMA kernels
+      (ops/pallas_ring.py). On the MXU bucket layout the hop is FUSED
+      under the blocked segment sum (genuine in-kernel overlap); on the
+      segment layouts today's hop kernel is start+wait in one call —
+      measure before preferring it there (sharded._RingComm's overlap
+      note). Native on TPU; on CPU it runs the Pallas interpreter
+      (orders of magnitude slower — kept for the bit-identity parity
+      CI, tests/test_ring.py).
+    - ``"auto"``: pallas on a TPU backend, ppermute elsewhere — the same
+      shape of routing ``ops/segment.py`` does for kernel methods.
+    """
+    if comm == "auto":
+        import jax
+
+        return "pallas" if jax.default_backend() == "tpu" else "ppermute"
+    from p2pnetwork_tpu.parallel.sharded import COMM_BACKENDS as _BACKENDS
+
+    if comm not in _BACKENDS:
+        raise ValueError(
+            f"comm must be one of {_BACKENDS + ('auto',)}, got {comm!r}")
+    return comm
+
 
 def shard_graph_auto(graph: Graph, mesh: Mesh,
                      axis_name: str = DEFAULT_AXIS) -> Graph:

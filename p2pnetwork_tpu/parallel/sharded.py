@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -64,6 +65,96 @@ from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
 from p2pnetwork_tpu.sim.graph import Graph, _round_up
 from p2pnetwork_tpu.utils import accum
 
+
+# ------------------------------------------------------ halo-exchange seam
+
+#: The ring's swappable halo-exchange backends. ``"ppermute"`` is the XLA
+#: collective-permute formulation; ``"pallas"`` moves the same block as a
+#: ``pltpu.make_async_remote_copy`` issued from a Pallas kernel
+#: (ops/pallas_ring.py) — the DMA engine carries the halo while the
+#: shard-local bucket compute runs. Both are bit-identical peers
+#: (tests/test_ring.py parity sweep); ``"auto"`` routes via
+#: parallel/auto.resolve_comm (pallas on TPU, ppermute elsewhere — on CPU
+#: the pallas backend runs the interpreter, kept for parity CI).
+COMM_BACKENDS = ("ppermute", "pallas")
+DEFAULT_COMM = "ppermute"
+
+
+def _resolve_comm(comm: str) -> str:
+    from p2pnetwork_tpu.parallel.auto import resolve_comm
+
+    return resolve_comm(comm)
+
+
+class _RingComm:
+    """One ring's halo-exchange backend: ``shift`` moves a per-shard block
+    to the NEXT ring shard (``_ring_perm``), ``shift_back`` to the
+    previous (the remask Horner accumulation). The ring bodies issue the
+    shift BEFORE the bucket compute that consumes the resident block —
+    both only read it — so the transfer's issue point precedes the
+    overlap window on either backend (XLA's async collective-permute
+    scheduling for ppermute; the in-kernel DMA for pallas).
+
+    ``fused_segment_sum`` is non-None on backends that can carry the halo
+    UNDER the blocked one-hot segment sum itself
+    (ops/pallas_ring.ring_segment_sum: DMA started at grid step 0, the
+    whole MXU edge aggregation in flight, recv-semaphore wait at the
+    last step) — the fully fused ring step the MXU bucket path rides.
+
+    Overlap honesty: on the SEGMENT bucket layouts the pallas backend's
+    hop is the bare ``ring_shift`` kernel, whose start+wait both live
+    inside one opaque pallas_call — no overlap with the XLA bucket
+    compute outside it (ppermute, which XLA can split into
+    cp-start/cp-done around independent work, can overlap there). The
+    in-flight window the issue-before-compute ordering buys is real for
+    ppermute everywhere and for pallas on the fused MXU path; a
+    split-phase / double-buffered pallas hop for the segment layouts is
+    the on-device follow-up (ROADMAP item 1).
+    """
+
+    __slots__ = ("backend", "axis_name", "axis_size")
+
+    def __init__(self, backend: str, axis_name: str, axis_size: int):
+        if backend not in COMM_BACKENDS:
+            raise ValueError(
+                f"comm must be one of {COMM_BACKENDS} (or 'auto'), got "
+                f"{backend!r}")
+        self.backend = backend
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+
+    def shift(self, x):
+        if self.backend == "pallas":
+            from p2pnetwork_tpu.ops import pallas_ring as PR
+
+            return PR.ring_shift(x, self.axis_name, self.axis_size)
+        return jax.lax.ppermute(x, self.axis_name,
+                                perm=_ring_perm(self.axis_size))
+
+    def shift_back(self, x):
+        if self.backend == "pallas":
+            from p2pnetwork_tpu.ops import pallas_ring as PR
+
+            return PR.ring_shift(x, self.axis_name, self.axis_size,
+                                 reverse=True)
+        S = self.axis_size
+        return jax.lax.ppermute(x, self.axis_name,
+                                perm=[((i + 1) % S, i) for i in range(S)])
+
+    def fused_segment_sum(self, rot, contrib, local_dst, block, exact):
+        """``(rot_next, out)`` — the halo hop fused under the blocked
+        segment sum, or None when this backend has no fused form (the
+        caller then shifts and applies separately)."""
+        if self.backend != "pallas":
+            return None
+        from p2pnetwork_tpu.ops import pallas_ring as PR
+
+        return PR.ring_segment_sum(rot, contrib, local_dst, self.axis_name,
+                                   self.axis_size, block, exact=exact)
+
+
+def _make_ring_comm(comm: str, axis_name: str, S: int) -> _RingComm:
+    return _RingComm(comm, axis_name, S)
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -459,7 +550,7 @@ def _mesh_of(sg: ShardedGraph) -> Mesh:
     return mesh
 
 
-def _remask_body(axis_name, S, block, pieces, mxu_block,
+def _remask_body(axis_name, S, block, pieces, mxu_block, comm,
                  bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                  mxu_src, mxu_dst, mxu_mask, diag_masks,
                  neighbors, neighbors_mask, node_mask, alive):
@@ -467,17 +558,19 @@ def _remask_body(axis_name, S, block, pieces, mxu_block,
 
     Runs under shard_map. The source block of bucket ``t`` is the block
     resident after ``t`` ring rotations, so the per-step source liveness is
-    collected with the same ppermute ring the propagation uses. Out-degree
+    collected with the same halo-exchange ring the propagation uses
+    (``comm`` seam — ppermute or the Pallas DMA kernel). Out-degree
     counts are computed per bucket on the receiver's shard, then carried
     back to the sender's shard with a reverse-rotating Horner accumulation:
     ``out[s] = sum_t cnt[(s+t) mod S, t]``.
     """
+    comm_obj = _make_ring_comm(comm, axis_name, S)
     nm = node_mask[0] & alive[0]  # [B]
 
     # masks_by_t[t] = liveness of the block resident at ring step t
     # (= shard (d - t) mod S's block, exactly what bkt_src[t] indexes).
     def collect(rot, _):
-        return jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S)), rot
+        return comm_obj.shift(rot), rot
 
     _, masks_by_t = jax.lax.scan(collect, nm, None, length=S)
 
@@ -508,10 +601,8 @@ def _remask_body(axis_name, S, block, pieces, mxu_block,
 
     # Horner: acc <- cnt_t + rot_back(acc), t = S-1 .. 0, where rot_back
     # moves each block one shard backward along the ring.
-    back = [((i + 1) % S, i) for i in range(S)]
-
     def horner(acc, cnt_t):
-        return cnt_t + jax.lax.ppermute(acc, axis_name, perm=back), None
+        return cnt_t + comm_obj.shift_back(acc), None
 
     if S > 1:
         out_degree, _ = jax.lax.scan(horner, cnt[S - 1], cnt[: S - 1],
@@ -566,19 +657,24 @@ def _remask_body(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _remask_fn(mesh: Mesh, axis_name: str, S: int, block: int, pieces=(),
-               mxu_block: int = 128):
+               mxu_block: int = 128, comm: str = DEFAULT_COMM):
     body = functools.partial(_remask_body, axis_name, S, block, pieces,
-                             mxu_block)
+                             mxu_block, comm)
     spec = P(axis_name)
+    # check_vma=False under the pallas backend: see the note on the
+    # ring-body factories (the DMA kernel's lowering and vma typing).
+    kw = {} if comm == "ppermute" else {"check_vma": False}
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(spec,) * 14,
         out_specs=(spec,) * 8,
+        **kw,
     )
     return jax.jit(fn)
 
 
-def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
+def with_node_liveness(sg: ShardedGraph, alive: jax.Array, *,
+                       comm: str = DEFAULT_COMM) -> ShardedGraph:
     """Apply a liveness mask (False = failed) to the sharded graph —
     the sharded mirror of sim/failures.with_node_liveness. ``alive`` is
     bool, global ``[S*block]`` or already-blocked ``[S, block]``.
@@ -586,6 +682,9 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
     Entirely device-side, shapes unchanged: the compiled flood/SIR/coverage
     programs are NOT recompiled, the next round simply routes around the
     damage — same no-recompile property as the single-device path.
+    ``comm`` selects the halo-exchange backend of the liveness-collection
+    ring (see :data:`COMM_BACKENDS`); the re-masked graph is backend-
+    independent, so churn and propagation may mix backends freely.
     """
     alive = jnp.asarray(alive).reshape(sg.n_shards, sg.block)
     mesh = _mesh_of(sg)
@@ -597,7 +696,7 @@ def with_node_liveness(sg: ShardedGraph, alive: jax.Array) -> ShardedGraph:
         neighbors = jnp.zeros((sg.n_shards, sg.block, 0), jnp.int32)
         neighbors_mask = jnp.zeros((sg.n_shards, sg.block, 0), bool)
     fn = _remask_fn(mesh, mesh.axis_names[0], sg.n_shards, sg.block,
-                    sg.diag_pieces, sg.mxu_block)
+                    sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
     (bkt_mask, dyn_mask, mxu_mask, diag_masks, node_mask, out_degree,
      in_degree, nbr_mask) = fn(
         sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
@@ -991,21 +1090,27 @@ def _ring_perm(S: int):
     return [(i, (i + 1) % S) for i in range(S)]
 
 
-def _ring_pass_unrolled(axis_name, S, rot, groups, diag, acc0, combine):
+
+
+def _ring_pass_unrolled(axis_name, S, rot, groups, diag, acc0, combine,
+                        comm: _RingComm):
     """Unrolled ring rotation (used when diagonal pieces are present: each
     piece applies at a STATIC step with a STATIC shift, which a lax.scan
     body cannot express). S is small; the unroll is the same structure the
-    single-chip hybrid uses for its diagonal stack."""
+    single-chip hybrid uses for its diagonal stack. The halo hop is issued
+    through the comm seam BEFORE the step's applies — transfer and
+    shard-local compute both only read the resident block, so the hop is
+    in flight across the whole step on overlap-capable backends."""
     pieces, masks, apply_diag = diag
     acc = acc0
     for t in range(S):
+        rot_next = comm.shift(rot) if t < S - 1 else rot
         for fn, *arrs in groups:
             acc = combine(acc, fn(rot, *(a[t] for a in arrs)))
         for pi, (tp, r) in enumerate(pieces):
             if tp == t:
                 acc = combine(acc, apply_diag(rot, r, masks[pi]))
-        if t < S - 1:
-            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
+        rot = rot_next
     return acc
 
 
@@ -1024,7 +1129,12 @@ def _diag_max_piece(rot, r, mask):
     return jnp.where(mask, jnp.roll(rot, -r), neutral_min(rot.dtype))
 
 
-def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None):
+def _diag_minplus_piece(rot, r, mask):
+    return jnp.where(mask, jnp.roll(rot, -r) + 1.0, jnp.inf)
+
+
+def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None,
+               comm: Optional[_RingComm] = None):
     """One full ring rotation. ``groups`` is a sequence of ``(apply_fn,
     *arrays)`` bucket groups, every array carrying a leading ring-step axis
     ``[S, ...]`` — static (dst-sorted segment or MXU-blocked) and dynamic
@@ -1032,33 +1142,59 @@ def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None):
     bucket ``t`` consumes the resident block, folding results with
     ``combine``.
 
+    The halo hop rides the comm seam (``_RingComm``): it is ISSUED before
+    the step's bucket applies — hop and applies both only read the
+    resident block — so the transfer overlaps the shard-local compute on
+    overlap-capable backends. When the static group is the MXU one-hot
+    layout and the backend has a fused form (pallas), the hop and the
+    bucket's blocked segment sum run as ONE kernel
+    (ops/pallas_ring.ring_segment_sum): DMA started at grid step 0, the
+    whole edge aggregation as the in-flight window, recv wait at the
+    last grid step.
+
     The last bucket is peeled out of the scan: after it is applied there is
-    nothing left to rotate, so running its ppermute would be one wasted ICI
-    collective per pass. Zero-width groups (unused dynamic capacity,
+    nothing left to rotate, so running its hop would be one wasted ICI
+    transfer per pass. Zero-width groups (unused dynamic capacity,
     absent MXU layout) are skipped at trace time.
     """
+    comm = comm or _make_ring_comm(DEFAULT_COMM, axis_name, S)
     groups = [g for g in groups if g[1].shape[-1] > 0]
     if diag is not None and diag[0]:
         return _ring_pass_unrolled(axis_name, S, frontier, groups, diag,
-                                   acc0, combine)
+                                   acc0, combine, comm)
     meta = []
     arrays = []
     for fn, *arrs in groups:
         meta.append((fn, len(arrs)))
         arrays += arrs
 
-    def apply_all(acc, rot, xs):
+    def apply_all(acc, rot, xs, skip_first=False):
         i = 0
-        for fn, n in meta:
-            acc = combine(acc, fn(rot, *xs[i: i + n]))
+        for gi, (fn, n) in enumerate(meta):
+            if not (skip_first and gi == 0):
+                acc = combine(acc, fn(rot, *xs[i: i + n]))
             i += n
         return acc
 
+    # The MXU static group's fused form (contrib gather, post-process,
+    # exact flag, kernel block) — present only on the one-hot bucket
+    # appliers (_bucket_*_mxu), consumed only by fusing backends.
+    fused = getattr(meta[0][0], "fused", None) if meta else None
+    use_fused = fused is not None and comm.backend == "pallas"
+
     def ring_step(rc, bkt_arrays):
         rot, acc = rc  # rot: frontier block resident this step
-        acc = apply_all(acc, rot, bkt_arrays)
-        rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
-        return (rot, acc), None
+        if use_fused:
+            contrib_fn, post, exact, kblock = fused
+            arrs0 = bkt_arrays[: meta[0][1]]
+            rot_next, out = comm.fused_segment_sum(
+                rot, contrib_fn(rot, *arrs0), arrs0[1], kblock, exact)
+            acc = combine(acc, post(out))
+            acc = apply_all(acc, rot, bkt_arrays, skip_first=True)
+        else:
+            rot_next = comm.shift(rot)
+            acc = apply_all(acc, rot, bkt_arrays)
+        return (rot_next, acc), None
 
     if S > 1:
         (rot, acc), _ = jax.lax.scan(
@@ -1103,28 +1239,60 @@ def _bucket_max(block, sorted_dst=True):
     return apply
 
 
+def _bucket_minplus(block, sorted_dst=True):
+    """Unit-hop min-plus bucket: ``out[v] = min(rot[u] + 1)`` over the
+    bucket's live edges — the sharded ring layouts carry no weight
+    channel, so every hop costs 1, exactly
+    ops/segment.propagate_min_plus on an unweighted graph (and its
+    ``DYNAMIC_LINK_COST`` for the dynamic region)."""
+
+    def apply(rot, src, dst, m):
+        contrib = jnp.where(m, rot[src] + 1.0, jnp.inf)
+        return jax.ops.segment_min(
+            contrib, dst, num_segments=block, indices_are_sorted=sorted_dst
+        )
+
+    return apply
+
+
 def _bucket_or_mxu(block, mxu_block):
     """Bucket OR via the fused Pallas one-hot-matmul kernel
     (ops/pallas_edge.py — the one-hot never touches HBM); 0/1
     contributions are exact in the single-pass MXU mode."""
     from p2pnetwork_tpu.ops.pallas_edge import segment_sum_pallas_impl
 
-    def apply(rot, src, dst, m):  # [NB, W] each
-        contrib = (rot[src] & m).astype(jnp.float32)
-        out = segment_sum_pallas_impl(contrib, dst, mxu_block, exact=False)
+    def contrib_of(rot, src, dst, m):
+        return (rot[src] & m).astype(jnp.float32)
+
+    def post(out):
         return out.reshape(-1)[:block] > 0
 
+    def apply(rot, src, dst, m):  # [NB, W] each
+        out = segment_sum_pallas_impl(contrib_of(rot, src, dst, m), dst,
+                                      mxu_block, exact=False)
+        return post(out)
+
+    # Fused-ring form (comm="pallas"): same gather, same kernel math, the
+    # halo DMA carried under the segment-sum grid (_ring_pass).
+    apply.fused = (contrib_of, post, False, mxu_block)
     return apply
 
 
 def _bucket_sum_mxu(block, mxu_block):
     from p2pnetwork_tpu.ops.pallas_edge import segment_sum_pallas_impl
 
-    def apply(rot, src, dst, m):  # rot f32[B]; src/dst i32[NB, W]
-        contrib = rot[src] * m  # 0/1 pressure: exact in single-pass mode
-        out = segment_sum_pallas_impl(contrib, dst, mxu_block, exact=False)
+    def contrib_of(rot, src, dst, m):
+        return rot[src] * m  # 0/1 pressure: exact in single-pass mode
+
+    def post(out):
         return out.reshape(-1)[:block]
 
+    def apply(rot, src, dst, m):  # rot f32[B]; src/dst i32[NB, W]
+        out = segment_sum_pallas_impl(contrib_of(rot, src, dst, m), dst,
+                                      mxu_block, exact=False)
+        return post(out)
+
+    apply.fused = (contrib_of, post, False, mxu_block)
     return apply
 
 
@@ -1149,13 +1317,13 @@ def _groups_sum(block, mxu_block, buckets, dyn_buckets, mxu_buckets):
 # -------------------------------------------------------------------- flood
 
 
-def _ring_rounds_or(axis_name, S, block, pieces, mxu_block,
+def _ring_rounds_or(axis_name, S, block, pieces, mxu_block, comm,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     mxu_src, mxu_dst, mxu_mask, diag_masks,
                     node_mask, out_degree, seen0, frontier0, rounds):
     """Per-shard body (runs under shard_map): ``rounds`` flood rounds, each a
     full ring pass. All blocks carry a leading length-1 shard axis."""
-    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -1187,10 +1355,11 @@ def _ring_rounds_or(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _flood_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-              pieces=(), mxu_block: int = 128):
+              pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     """Build (and cache) the compiled sharded flood program for this shape."""
     body = functools.partial(_ring_rounds_or, axis_name, S, block, pieces,
-                             mxu_block)
+                             mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: the body may invoke the Pallas bucket kernel, whose
     # vma-typed lowering trips a cache bug in current JAX (see
@@ -1213,7 +1382,7 @@ def _flood_seed(sg: ShardedGraph, source: int):
 
 def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
           axis_name: str = DEFAULT_AXIS, state0=None,
-          return_state: bool = False):
+          return_state: bool = False, comm: str = DEFAULT_COMM):
     """Run ``rounds`` of single-source flood on the sharded graph.
 
     Returns ``(seen [S, block] bool, stats dict of [rounds] arrays)`` — the
@@ -1232,7 +1401,7 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
         state0 = init_state(sg, Flood(source=source), None)
     seen0, frontier0 = state0
     fn = _flood_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
-                   sg.mxu_block)
+                   sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     seen, frontier, stats = fn(
@@ -1248,7 +1417,7 @@ def flood(sg: ShardedGraph, mesh: Mesh, source: int, rounds: int,
 # --------------------------------------------------- flood-to-coverage
 
 
-def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
+def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
                       coverage_target,
                       max_rounds,
                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
@@ -1260,7 +1429,7 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
     identical on every shard, so the loop condition is replicated-consistent
     by construction. Messages accumulate in the two-limb counter
     (utils/accum.py) — multi-chip totals wrap int32 even sooner."""
-    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -1314,9 +1483,10 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                  max_rounds: int, pieces=(), mxu_block: int = 128):
+                  max_rounds: int, pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_coverage_or, axis_name, S, block, pieces,
-                             mxu_block)
+                             mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factory.
     fn = shard_map(
@@ -1333,7 +1503,7 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                          max_rounds: int = 1024,
                          axis_name: str = DEFAULT_AXIS,
                          state0=None, return_state: bool = False,
-                         adaptive_k: int = 0):
+                         adaptive_k: int = 0, comm: str = DEFAULT_COMM):
     """Flood until coverage of the LIVE population reaches the target —
     the north-star run-to-99% measurement (engine.run_until_coverage), on
     the multi-chip path. One XLA program, zero host round-trips per round.
@@ -1377,6 +1547,7 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
         fn = _flood_adaptive_cov_fn(
             mesh, axis_name, S, block, max_rounds, adaptive_k,
             max(sg.csr_span, 1), sg.diag_pieces, sg.mxu_block,
+            _resolve_comm(comm),
         )
         seen, frontier, packed = fn(
             jnp.float32(coverage_target), *common,
@@ -1384,7 +1555,7 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
         )
     else:
         fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
-                           sg.diag_pieces, sg.mxu_block)
+                           sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
         seen, frontier, packed = fn(
             jnp.float32(coverage_target), *common, seen0, frontier0,
         )
@@ -1403,7 +1574,7 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
 # ------------------------------------------------------------------- gossip
 
 
-def _ring_rounds_gossip(axis_name, S, block, rng,
+def _ring_rounds_gossip(axis_name, S, block, rng, comm,
                         neighbors, neighbors_mask, node_mask,
                         values0, round_keys, alpha, rounds):
     """Per-shard body: ``rounds`` push-pull gossip rounds (models/gossip.py).
@@ -1427,6 +1598,7 @@ def _ring_rounds_gossip(axis_name, S, block, rng,
         jax.lax.psum(jnp.sum(nm.astype(jnp.int32)), axis_name), 1
     )
     csum = jnp.cumsum(nmask, axis=1)
+    comm_obj = _make_ring_comm(comm, axis_name, S)
     draw_u = _make_draw(
         axis_name, S, block, rng, my,
         sample=lambda k, shape: jax.random.randint(
@@ -1453,10 +1625,12 @@ def _ring_rounds_gossip(axis_name, S, block, rng,
 
         def ring_step(rc, t):
             rot, acc = rc
+            # Halo hop issued first (comm seam): the pull below only READS
+            # the resident block, so the transfer is in flight across it.
+            rot_next = comm_obj.shift(rot)
             resident = (my - t) % S
             acc = acc + jnp.where(p_shard == resident, rot[p_local], 0.0)
-            rot = jax.lax.ppermute(rot, axis_name, perm=_ring_perm(S))
-            return (rot, acc), None
+            return (rot_next, acc), None
 
         if S > 1:
             (rot, pulled), _ = jax.lax.scan(
@@ -1490,14 +1664,18 @@ def _ring_rounds_gossip(axis_name, S, block, rng,
 
 @functools.lru_cache(maxsize=64)
 def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-               rng: str):
-    body = functools.partial(_ring_rounds_gossip, axis_name, S, block, rng)
+               rng: str, comm: str = DEFAULT_COMM):
+    body = functools.partial(_ring_rounds_gossip, axis_name, S, block,
+                             rng, comm)
     spec = P(axis_name)
+    # check_vma=False under the pallas backend: see the ring-body factories.
+    kw = {} if comm == "ppermute" else {"check_vma": False}
     fn = shard_map(
         lambda *args: body(*args, rounds=rounds),
         mesh=mesh,
         in_specs=(spec,) * 4 + (P(), P()),
         out_specs=(spec, P()),
+        **kw,
     )
     return jax.jit(fn)
 
@@ -1505,7 +1683,7 @@ def _gossip_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
            rounds: int, axis_name: str = DEFAULT_AXIS,
            exact_rng: bool = False, rng: Optional[str] = None,
-           values0=None):
+           values0=None, comm: str = DEFAULT_COMM):
     """Run ``rounds`` of push-pull gossip averaging (models/gossip.py) on
     the sharded graph — randomized consensus, the second protocol family
     reference users build on ``node_message`` [ref: README.md:20].
@@ -1527,7 +1705,7 @@ def gossip(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
         jax.random.split(jax.random.fold_in(key, 1), rounds)
     )
     fn = _gossip_fn(mesh, axis_name, S, block, rounds,
-                    _resolve_rng(sg, exact_rng, rng))
+                    _resolve_rng(sg, exact_rng, rng), _resolve_comm(comm))
     values, stats = fn(
         sg.neighbors, sg.neighbors_mask, sg.node_mask, values0,
         round_keys, jnp.float32(protocol.alpha),
@@ -1594,7 +1772,7 @@ def _resolve_rng(sg: ShardedGraph, exact_rng: bool, rng: Optional[str]) -> str:
     return "tile" if sg.block % RNG_TILE == 0 else "fold"
 
 
-def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
+def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block, comm,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     mxu_src, mxu_dst, mxu_mask, diag_masks,
                     node_mask, out_degree, one_minus_beta, gamma):
@@ -1607,7 +1785,7 @@ def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
     """
     from p2pnetwork_tpu.models.sir import INFECTED, RECOVERED, SUSCEPTIBLE
 
-    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block, comm,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -1651,7 +1829,7 @@ def _make_sir_round(axis_name, S, block, rng, pieces, mxu_block,
     return one_round
 
 
-def _ring_rounds_sir(axis_name, S, block, rng, pieces, mxu_block,
+def _ring_rounds_sir(axis_name, S, block, rng, pieces, mxu_block, comm,
                      bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                      mxu_src, mxu_dst, mxu_mask, diag_masks,
                      node_mask, out_degree,
@@ -1659,7 +1837,7 @@ def _ring_rounds_sir(axis_name, S, block, rng, pieces, mxu_block,
     """Per-shard body: ``rounds`` SIR rounds (scan over replicated raw key
     data, engine.run key-schedule parity)."""
     one_round = _make_sir_round(
-        axis_name, S, block, rng, pieces, mxu_block,
+        axis_name, S, block, rng, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask,
         dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, one_minus_beta, gamma,
@@ -1672,7 +1850,7 @@ def _ring_rounds_sir(axis_name, S, block, rng, pieces, mxu_block,
     return status[None], stats
 
 
-def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block,
+def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block, comm,
                        coverage_target, max_rounds,
                        bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                        mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -1682,7 +1860,7 @@ def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block,
     (engine.run_until_coverage's key schedule: split the carried key each
     round). Messages accumulate in the two-limb counter."""
     one_round = _make_sir_round(
-        axis_name, S, block, rng, pieces, mxu_block,
+        axis_name, S, block, rng, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask,
         dyn_src, dyn_dst, dyn_mask, mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, one_minus_beta, gamma,
@@ -1718,9 +1896,10 @@ def _ring_coverage_sir(axis_name, S, block, rng, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _sir_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                max_rounds: int, rng: str, pieces=(), mxu_block: int = 128):
+                max_rounds: int, rng: str, pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_coverage_sir, axis_name, S, block, rng,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factory.
     fn = shard_map(
@@ -1738,7 +1917,7 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
                        max_rounds: int = 1024,
                        axis_name: str = DEFAULT_AXIS,
                        exact_rng: bool = False, rng: Optional[str] = None,
-                       status0=None):
+                       status0=None, comm: str = DEFAULT_COMM):
     """Run SIR until the ever-infected coverage of the LIVE population
     reaches the target — engine.run_until_coverage's measurement for the
     epidemic protocol, on the multi-chip path. Same key schedule as the
@@ -1753,7 +1932,7 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
         status0 = init_state(sg, protocol, key)
     fn = _sir_cov_fn(mesh, axis_name, S, block, max_rounds,
                      _resolve_rng(sg, exact_rng, rng), sg.diag_pieces,
-                     sg.mxu_block)
+                     sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, packed = fn(
@@ -1769,9 +1948,10 @@ def sir_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
 
 @functools.lru_cache(maxsize=64)
 def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-            rng: str, pieces=(), mxu_block: int = 128):
+            rng: str, pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_rounds_sir, axis_name, S, block, rng,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: the body may invoke the Pallas bucket kernel, whose
     # vma-typed lowering trips a cache bug in current JAX (see
@@ -1787,7 +1967,8 @@ def _sir_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
         axis_name: str = DEFAULT_AXIS, exact_rng: bool = False,
-        rng: Optional[str] = None, status0=None):
+        rng: Optional[str] = None, status0=None,
+        comm: str = DEFAULT_COMM):
     """Run ``rounds`` of SIR (models/sir.py) on the sharded graph.
 
     Returns ``(status [S, block] i32, stats dict of [rounds] arrays)``. The
@@ -1807,7 +1988,7 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
     )
     fn = _sir_fn(mesh, axis_name, S, block, rounds,
                  _resolve_rng(sg, exact_rng, rng), sg.diag_pieces,
-                 sg.mxu_block)
+                 sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     status, stats = fn(
@@ -1823,7 +2004,7 @@ def sir(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array, rounds: int,
 # ------------------------------------------- generic value propagation
 
 
-def _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+def _make_sum_pass(axis_name, S, block, pieces, mxu_block, comm,
                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                    mxu_src, mxu_dst, mxu_mask, diag_masks):
     """Build ``pass_(x) -> f32[block]``: one full ring rotation summing a
@@ -1836,15 +2017,17 @@ def _make_sum_pass(axis_name, S, block, pieces, mxu_block,
         (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
     diag = (pieces, diag_masks[0], _diag_sum_piece)
+    comm_obj = _make_ring_comm(comm, axis_name, S)
 
     def pass_(x):
         return _ring_pass(axis_name, S, x, groups,
-                          jnp.zeros((block,), x.dtype), jnp.add, diag=diag)
+                          jnp.zeros((block,), x.dtype), jnp.add, diag=diag,
+                          comm=comm_obj)
 
     return pass_
 
 
-def _make_or_pass(axis_name, S, block, pieces, mxu_block,
+def _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                   bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                   mxu_src, mxu_dst, mxu_mask, diag_masks):
     """Build ``pass_(frontier) -> bool[block]``: one ring rotation OR-ing a
@@ -1857,16 +2040,17 @@ def _make_or_pass(axis_name, S, block, pieces, mxu_block,
         (mxu_src[0], mxu_dst[0], mxu_mask[0]),
     )
     diag = (pieces, diag_masks[0], _diag_or_piece)
+    comm_obj = _make_ring_comm(comm, axis_name, S)
 
     def pass_(frontier):
         return _ring_pass(axis_name, S, frontier, groups,
                           jnp.zeros((block,), bool), jnp.logical_or,
-                          diag=diag)
+                          diag=diag, comm=comm_obj)
 
     return pass_
 
 
-def _make_max_pass(axis_name, S, block, pieces, mxu_block,
+def _make_max_pass(axis_name, S, block, pieces, mxu_block, comm,
                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                    mxu_src, mxu_dst, mxu_mask, diag_masks):
     """Build ``pass_(x) -> x.dtype[block]``: one full ring rotation taking
@@ -1882,22 +2066,50 @@ def _make_max_pass(axis_name, S, block, pieces, mxu_block,
          dyn_src[0], dyn_dst[0], dyn_mask[0]),
     ]
     diag = (pieces, diag_masks[0], _diag_max_piece)
+    comm_obj = _make_ring_comm(comm, axis_name, S)
 
     def pass_(x):
         return _ring_pass(axis_name, S, x, groups,
                           jnp.full((block,), neutral_min(x.dtype), x.dtype),
-                          jnp.maximum, diag=diag)
+                          jnp.maximum, diag=diag, comm=comm_obj)
 
     return pass_
 
 
-def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
+def _make_minplus_pass(axis_name, S, block, pieces, mxu_block, comm,
+                       bkt_src, bkt_dst, bkt_mask,
+                       dyn_src, dyn_dst, dyn_mask,
+                       mxu_src, mxu_dst, mxu_mask, diag_masks):
+    """Build ``pass_(dist) -> f32[block]``: one full ring rotation taking
+    the per-node MIN of ``dist[u] + 1`` over every incoming edge — one
+    unit-weight Bellman-Ford round, the tropical-semiring sibling of
+    :func:`_make_max_pass` (segment buckets only: min cannot ride the
+    one-hot-matmul MXU layout, and the ring layouts carry no weight
+    channel — ops/segment.propagate_min_plus's unweighted case)."""
+    groups = [
+        (_bucket_minplus(block, sorted_dst=True),
+         bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (_bucket_minplus(block, sorted_dst=False),
+         dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    ]
+    diag = (pieces, diag_masks[0], _diag_minplus_piece)
+    comm_obj = _make_ring_comm(comm, axis_name, S)
+
+    def pass_(x):
+        return _ring_pass(axis_name, S, x, groups,
+                          jnp.full((block,), jnp.inf, x.dtype),
+                          jnp.minimum, diag=diag, comm=comm_obj)
+
+    return pass_
+
+
+def _propagate_body(axis_name, S, block, pieces, mxu_block, comm, op,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     mxu_src, mxu_dst, mxu_mask, diag_masks,
                     node_mask, signal):
     node_mask_b = node_mask[0]
     if op == "or":
-        pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+        pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                               bkt_src, bkt_dst, bkt_mask,
                               dyn_src, dyn_dst, dyn_mask,
                               mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -1905,13 +2117,20 @@ def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
     if op == "max":
         from p2pnetwork_tpu.ops.segment import neutral_min
 
-        pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block,
+        pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block, comm,
                                bkt_src, bkt_dst, bkt_mask,
                                dyn_src, dyn_dst, dyn_mask,
                                mxu_src, mxu_dst, mxu_mask, diag_masks)
         out = pass_(signal[0])
         return jnp.where(node_mask_b, out, neutral_min(out.dtype))[None]
-    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+    if op == "minplus":
+        pass_ = _make_minplus_pass(axis_name, S, block, pieces, mxu_block,
+                                   comm, bkt_src, bkt_dst, bkt_mask,
+                                   dyn_src, dyn_dst, dyn_mask,
+                                   mxu_src, mxu_dst, mxu_mask, diag_masks)
+        out = pass_(signal[0])
+        return jnp.where(node_mask_b, out, jnp.inf)[None]
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block, comm,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -1921,9 +2140,10 @@ def _propagate_body(axis_name, S, block, pieces, mxu_block, op,
 
 @functools.lru_cache(maxsize=64)
 def _propagate_fn(mesh: Mesh, axis_name: str, S: int, block: int, op: str,
-                  pieces=(), mxu_block: int = 128):
+                  pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_propagate_body, axis_name, S, block, pieces,
-                             mxu_block, op)
+                             mxu_block, comm, op)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(body, mesh=mesh, check_vma=False,
@@ -1932,7 +2152,8 @@ def _propagate_fn(mesh: Mesh, axis_name: str, S: int, block: int, op: str,
 
 
 def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
-              op: str = "sum", axis_name: str = DEFAULT_AXIS) -> jax.Array:
+              op: str = "sum", axis_name: str = DEFAULT_AXIS,
+              comm: str = DEFAULT_COMM) -> jax.Array:
     """One aggregation pass over every edge of the sharded graph: the
     multi-chip mirror of ``ops.segment.propagate_or`` / ``propagate_sum``,
     and the extension seam for protocols the library does not ship — the
@@ -1941,24 +2162,30 @@ def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
     call and it runs at ring-sharded scale.
 
     ``signal`` is ``[S, block]`` (bool for ``op="or"``, float for
-    ``op="sum"``, float/int for ``op="max"``); returns the per-node
-    aggregate in the same layout, masked to live nodes (``max`` masks to
-    the dtype's -inf/int-min identity). Static + dynamic
+    ``op="sum"``, float/int for ``op="max"``, f32 distances for
+    ``op="minplus"``); returns the per-node aggregate in the same layout,
+    masked to live nodes (``max`` masks to the dtype's -inf/int-min
+    identity, ``minplus`` to ``+inf``). Static + dynamic
     (runtime-connected) edges and the ring-decomposed diagonals all
     contribute, exactly as in the shipped protocol bodies. ``op="max"``
-    needs the segment layout: shard the graph without the MXU remainder
-    (no ``hybrid=True``/``min_count``) — one-hot matmuls compute sums,
-    not maxima.
+    and ``op="minplus"`` need the segment layout: shard the graph
+    without the MXU remainder (no ``hybrid=True``/``min_count``) —
+    one-hot matmuls compute sums, not maxima/minima. ``minplus`` is one
+    unit-weight Bellman-Ford round — the ring layouts carry no weight
+    channel, so it matches ``ops.segment.propagate_min_plus`` on
+    UNWEIGHTED graphs (weighted routing rides the GSPMD auto path).
+    ``comm`` selects the halo-exchange backend (:data:`COMM_BACKENDS`).
     """
-    if op not in ("or", "sum", "max"):
-        raise ValueError(f"op must be 'or', 'sum' or 'max', got {op!r}")
-    if op == "max" and sg.mxu_src is not None:
+    if op not in ("or", "sum", "max", "minplus"):
         raise ValueError(
-            "op='max' cannot ride the MXU one-hot layout — shard_graph "
-            "without hybrid/min_count for max-aggregating protocols"
+            f"op must be 'or', 'sum', 'max' or 'minplus', got {op!r}")
+    if op in ("max", "minplus") and sg.mxu_src is not None:
+        raise ValueError(
+            f"op={op!r} cannot ride the MXU one-hot layout — shard_graph "
+            "without hybrid/min_count for max/min-aggregating protocols"
         )
     fn = _propagate_fn(mesh, axis_name, sg.n_shards, sg.block, op,
-                       sg.diag_pieces, sg.mxu_block)
+                       sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     return fn(sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
@@ -1969,7 +2196,7 @@ def propagate(sg: ShardedGraph, mesh: Mesh, signal: jax.Array,
 # ---------------------------------------------------- pagerank / pushsum
 
 
-def _make_pagerank_round(axis_name, S, block, pieces, mxu_block,
+def _make_pagerank_round(axis_name, S, block, pieces, mxu_block, comm,
                          bkt_src, bkt_dst, bkt_mask,
                          dyn_src, dyn_dst, dyn_mask,
                          mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -1980,7 +2207,7 @@ def _make_pagerank_round(axis_name, S, block, pieces, mxu_block,
     rides as a replicated runtime operand so a damping sweep does not
     recompile; ``one_minus_damping`` arrives precomputed in f64 then cast,
     matching the engine's constant folding."""
-    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block, comm,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2016,7 +2243,7 @@ def _make_pagerank_round(axis_name, S, block, pieces, mxu_block,
     return one_round
 
 
-def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
+def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2024,7 +2251,7 @@ def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
                           ranks0, damping, one_minus_damping, rounds):
     """Per-shard body: ``rounds`` damped power-iteration rounds."""
     one_round = _make_pagerank_round(
-        axis_name, S, block, pieces, mxu_block,
+        axis_name, S, block, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, damping, one_minus_damping,
@@ -2036,9 +2263,10 @@ def _ring_rounds_pagerank(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _pagerank_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-                 pieces=(), mxu_block: int = 128):
+                 pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_rounds_pagerank, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2051,7 +2279,8 @@ def _pagerank_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 
 def pagerank(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
-             axis_name: str = DEFAULT_AXIS, ranks0=None):
+             axis_name: str = DEFAULT_AXIS, ranks0=None,
+             comm: str = DEFAULT_COMM):
     """Run ``rounds`` of PageRank power iteration (models/pagerank.py) on
     the sharded graph. Deterministic — no RNG. Returns ``(ranks [S, block]
     f32, stats dict of [rounds] arrays)``; agrees with the single-device
@@ -2061,7 +2290,7 @@ def pagerank(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
     if ranks0 is None:
         ranks0 = init_state(sg, protocol, None)
     fn = _pagerank_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
-                      sg.mxu_block)
+                      sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     return fn(
@@ -2126,7 +2355,7 @@ def _freeze_while(state0, value0, one_step, keep_going,
     return state, rounds, value, (hi, lo)
 
 
-def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
+def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block, comm,
                             steps_per_round, tol, max_rounds,
                             bkt_src, bkt_dst, bkt_mask,
                             dyn_src, dyn_dst, dyn_mask,
@@ -2138,7 +2367,7 @@ def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
     path, with the packed single-transfer summary. ``steps_per_round``
     batches iterations per while step (bit-exact vs 1; _freeze_while)."""
     one_round = _make_pagerank_round(
-        axis_name, S, block, pieces, mxu_block,
+        axis_name, S, block, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks,
         node_mask, out_degree, damping, one_minus_damping,
@@ -2157,9 +2386,10 @@ def _ring_residual_pagerank(axis_name, S, block, pieces, mxu_block,
 @functools.lru_cache(maxsize=64)
 def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                           max_rounds: int, pieces=(), mxu_block: int = 128,
-                          steps_per_round: int = 1):
+                          steps_per_round: int = 1,
+                          comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_residual_pagerank, axis_name, S, block,
-                             pieces, mxu_block, steps_per_round)
+                             pieces, mxu_block, comm, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2174,7 +2404,8 @@ def _pagerank_residual_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
                             tol: float = 1e-6, max_rounds: int = 1024,
                             steps_per_round: int = 1,
-                            axis_name: str = DEFAULT_AXIS, ranks0=None):
+                            axis_name: str = DEFAULT_AXIS, ranks0=None,
+                            comm: str = DEFAULT_COMM):
     """Run PageRank until the L1 residual drops below ``tol`` — the
     convergence measurement (engine.run_until_converged with
     stat="residual"), multi-chip, as one device-side while_loop. Returns
@@ -2188,7 +2419,7 @@ def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
         ranks0 = init_state(sg, protocol, None)
     fn = _pagerank_residual_fn(mesh, axis_name, S, block, max_rounds,
                                sg.diag_pieces, sg.mxu_block,
-                               int(steps_per_round))
+                               int(steps_per_round), _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     ranks, packed = fn(
@@ -2203,7 +2434,8 @@ def pagerank_until_residual(sg: ShardedGraph, mesh: Mesh, protocol, *,
     return ranks, out
 
 
-def _ring_leader_quiet(axis_name, S, block, pieces, mxu_block, max_rounds,
+def _ring_leader_quiet(axis_name, S, block, pieces, mxu_block, comm,
+                       max_rounds,
                        bkt_src, bkt_dst, bkt_mask,
                        dyn_src, dyn_dst, dyn_mask,
                        mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2216,7 +2448,7 @@ def _ring_leader_quiet(axis_name, S, block, pieces, mxu_block, max_rounds,
     round (which is executed and message-counted, matching the engine)."""
     from p2pnetwork_tpu.ops.segment import neutral_min
 
-    pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_max_pass(axis_name, S, block, pieces, mxu_block, comm,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2259,9 +2491,10 @@ def _ring_leader_quiet(axis_name, S, block, pieces, mxu_block, max_rounds,
 
 @functools.lru_cache(maxsize=64)
 def _leader_quiet_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                     max_rounds: int, pieces=(), mxu_block: int = 128):
+                     max_rounds: int, pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_leader_quiet, axis_name, S, block,
-                             pieces, mxu_block, max_rounds)
+                             pieces, mxu_block, comm, max_rounds)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(body, mesh=mesh, check_vma=False,
@@ -2271,7 +2504,8 @@ def _leader_quiet_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 
 def leader_until_quiet(sg: ShardedGraph, mesh: Mesh, *,
                        max_rounds: int = 1024,
-                       axis_name: str = DEFAULT_AXIS):
+                       axis_name: str = DEFAULT_AXIS,
+                       comm: str = DEFAULT_COMM):
     """Highest-live-id leader election run until no node learns anything —
     the multi-chip convergence loop of models/leader.py. Returns
     ``(known [S, block] i32, dict(rounds, coverage, messages))`` where
@@ -2286,7 +2520,7 @@ def leader_until_quiet(sg: ShardedGraph, mesh: Mesh, *,
         )
     S, block = sg.n_shards, sg.block
     fn = _leader_quiet_fn(mesh, axis_name, S, block, max_rounds,
-                          sg.diag_pieces, sg.mxu_block)
+                          sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     known, packed = fn(
@@ -2297,7 +2531,7 @@ def leader_until_quiet(sg: ShardedGraph, mesh: Mesh, *,
     return known, accum.unpack_summary(packed)
 
 
-def _make_pushsum_round(axis_name, S, block, pieces, mxu_block,
+def _make_pushsum_round(axis_name, S, block, pieces, mxu_block, comm,
                         bkt_src, bkt_dst, bkt_mask,
                         dyn_src, dyn_dst, dyn_mask,
                         mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2305,7 +2539,7 @@ def _make_pushsum_round(axis_name, S, block, pieces, mxu_block,
     """Build the per-shard push-sum round closure (models/pushsum.py
     arithmetic — mass split over out-edges, two ring sums per round),
     shared by the fixed-rounds scan and the run-to-variance while_loop."""
-    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_sum_pass(axis_name, S, block, pieces, mxu_block, comm,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
                            mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2342,14 +2576,14 @@ def _make_pushsum_round(axis_name, S, block, pieces, mxu_block,
     return one_round
 
 
-def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
+def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block, comm,
                          bkt_src, bkt_dst, bkt_mask,
                          dyn_src, dyn_dst, dyn_mask,
                          mxu_src, mxu_dst, mxu_mask, diag_masks,
                          node_mask, out_degree, s0, w0, rounds):
     """Per-shard body: ``rounds`` push-sum rounds."""
     one_round = _make_pushsum_round(
-        axis_name, S, block, pieces, mxu_block,
+        axis_name, S, block, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
     )
@@ -2362,7 +2596,7 @@ def _ring_rounds_pushsum(axis_name, S, block, pieces, mxu_block,
     return s[None], w[None], stats
 
 
-def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block,
+def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block, comm,
                            steps_per_round, tol, max_rounds,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
@@ -2374,7 +2608,7 @@ def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block,
     batches rounds per while step (bit-exact vs 1; _freeze_while —
     push-sum's ring rounds are deterministic, no key chain)."""
     one_round = _make_pushsum_round(
-        axis_name, S, block, pieces, mxu_block,
+        axis_name, S, block, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
     )
@@ -2393,9 +2627,10 @@ def _ring_variance_pushsum(axis_name, S, block, pieces, mxu_block,
 @functools.lru_cache(maxsize=64)
 def _pushsum_variance_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                          max_rounds: int, pieces=(), mxu_block: int = 128,
-                         steps_per_round: int = 1):
+                         steps_per_round: int = 1,
+                         comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_variance_pushsum, axis_name, S, block,
-                             pieces, mxu_block, steps_per_round)
+                             pieces, mxu_block, comm, steps_per_round)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2411,7 +2646,8 @@ def pushsum_until_variance(sg: ShardedGraph, mesh: Mesh, protocol,
                            key: jax.Array, *,
                            tol: float = 1e-9, max_rounds: int = 1024,
                            steps_per_round: int = 1,
-                           axis_name: str = DEFAULT_AXIS, state0=None):
+                           axis_name: str = DEFAULT_AXIS, state0=None,
+                           comm: str = DEFAULT_COMM):
     """Run push-sum until the estimate variance drops below ``tol`` — the
     consensus-reached measurement (engine.run_until_converged with
     stat="variance"), multi-chip. Returns ``((s, w), dict(rounds, value,
@@ -2427,7 +2663,7 @@ def pushsum_until_variance(sg: ShardedGraph, mesh: Mesh, protocol,
     s0, w0 = state0
     fn = _pushsum_variance_fn(mesh, axis_name, S, block, max_rounds,
                               sg.diag_pieces, sg.mxu_block,
-                              int(steps_per_round))
+                              int(steps_per_round), _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     s, w, packed = fn(
@@ -2443,9 +2679,10 @@ def pushsum_until_variance(sg: ShardedGraph, mesh: Mesh, protocol,
 
 @functools.lru_cache(maxsize=64)
 def _pushsum_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-                pieces=(), mxu_block: int = 128):
+                pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_rounds_pushsum, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2458,7 +2695,8 @@ def _pushsum_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 
 def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
-            rounds: int, axis_name: str = DEFAULT_AXIS, state0=None):
+            rounds: int, axis_name: str = DEFAULT_AXIS, state0=None,
+            comm: str = DEFAULT_COMM):
     """Run ``rounds`` of push-sum consensus (models/pushsum.py) on the
     sharded graph. ``key`` seeds the initial values exactly as the engine
     path does (Gossip-init parity); pass ``state0 = (s, w)`` to continue a
@@ -2470,7 +2708,7 @@ def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
         state0 = init_state(sg, protocol, key)
     s0, w0 = state0
     fn = _pushsum_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
-                     sg.mxu_block)
+                     sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     s, w, stats = fn(
@@ -2484,14 +2722,14 @@ def pushsum(sg: ShardedGraph, mesh: Mesh, protocol, key: jax.Array,
 # ------------------------------------------------------------ hop distance
 
 
-def _make_hopdist_round(axis_name, S, block, pieces, mxu_block,
+def _make_hopdist_round(axis_name, S, block, pieces, mxu_block, comm,
                         bkt_src, bkt_dst, bkt_mask,
                         dyn_src, dyn_dst, dyn_mask,
                         mxu_src, mxu_dst, mxu_mask, diag_masks,
                         node_mask, out_degree):
     """Per-shard BFS round closure (models/hopdist.py arithmetic): the wave
     is the flood wave; nodes record the first round that reaches them."""
-    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2522,14 +2760,14 @@ def _make_hopdist_round(axis_name, S, block, pieces, mxu_block,
     return one_round
 
 
-def _ring_rounds_hopdist(axis_name, S, block, pieces, mxu_block,
+def _ring_rounds_hopdist(axis_name, S, block, pieces, mxu_block, comm,
                          bkt_src, bkt_dst, bkt_mask,
                          dyn_src, dyn_dst, dyn_mask,
                          mxu_src, mxu_dst, mxu_mask, diag_masks,
                          node_mask, out_degree,
                          dist0, frontier0, round0, rounds):
     one_round = _make_hopdist_round(
-        axis_name, S, block, pieces, mxu_block,
+        axis_name, S, block, pieces, mxu_block, comm,
         bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
         mxu_src, mxu_dst, mxu_mask, diag_masks, node_mask, out_degree,
     )
@@ -2547,9 +2785,10 @@ def _ring_rounds_hopdist(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _hopdist_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
-                pieces=(), mxu_block: int = 128):
+                pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_rounds_hopdist, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2562,7 +2801,8 @@ def _hopdist_fn(mesh: Mesh, axis_name: str, S: int, block: int, rounds: int,
 
 
 def hopdist(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
-            axis_name: str = DEFAULT_AXIS, state0=None):
+            axis_name: str = DEFAULT_AXIS, state0=None,
+            comm: str = DEFAULT_COMM):
     """Run ``rounds`` of BFS hop-distance (models/hopdist.py) on the sharded
     graph. Deterministic; integer state, so parity with the single-device
     engine is bit-exact. Returns ``((dist, frontier, round), stats)`` with
@@ -2572,7 +2812,7 @@ def hopdist(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
         state0 = init_state(sg, protocol, None)
     dist0, frontier0, round0 = state0
     fn = _hopdist_fn(mesh, axis_name, S, block, rounds, sg.diag_pieces,
-                     sg.mxu_block)
+                     sg.mxu_block, _resolve_comm(comm))
     dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
     mxu_src, mxu_dst, mxu_mask = _mxu_or_empty(sg)
     dist, frontier, rnd, stats = fn(
@@ -2583,7 +2823,7 @@ def hopdist(sg: ShardedGraph, mesh: Mesh, protocol, rounds: int,
     return (dist, frontier, rnd), stats
 
 
-def _ring_coverage_hopdist(axis_name, S, block, pieces, mxu_block,
+def _ring_coverage_hopdist(axis_name, S, block, pieces, mxu_block, comm,
                            coverage_target, max_rounds,
                            bkt_src, bkt_dst, bkt_mask,
                            dyn_src, dyn_dst, dyn_mask,
@@ -2594,7 +2834,7 @@ def _ring_coverage_hopdist(axis_name, S, block, pieces, mxu_block,
     the packed single-transfer summary. Lean: only the collectives the
     loop consumes (messages, live frontier count, covered count) run per
     round; eccentricity is a single reduction after the loop."""
-    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2636,9 +2876,10 @@ def _ring_coverage_hopdist(axis_name, S, block, pieces, mxu_block,
 
 @functools.lru_cache(maxsize=64)
 def _hopdist_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
-                    max_rounds: int, pieces=(), mxu_block: int = 128):
+                    max_rounds: int, pieces=(), mxu_block: int = 128,
+              comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_coverage_hopdist, axis_name, S, block,
-                             pieces, mxu_block)
+                             pieces, mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2654,7 +2895,7 @@ def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
                            coverage_target: float = 0.99,
                            max_rounds: int = 1024,
                            axis_name: str = DEFAULT_AXIS, state0=None,
-                           adaptive_k: int = 0):
+                           adaptive_k: int = 0, comm: str = DEFAULT_COMM):
     """BFS until the reached fraction of the LIVE population hits the
     target — engine.run_until_coverage's measurement for HopDistance,
     multi-chip — with an extra early exit the engine loop lacks: if the
@@ -2682,6 +2923,7 @@ def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
         fn = _hopdist_adaptive_cov_fn(
             mesh, axis_name, S, block, max_rounds, adaptive_k,
             max(sg.csr_span, 1), sg.diag_pieces, sg.mxu_block,
+            _resolve_comm(comm),
         )
         dist, frontier, packed = fn(
             jnp.float32(coverage_target),
@@ -2692,7 +2934,7 @@ def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
         )
     else:
         fn = _hopdist_cov_fn(mesh, axis_name, S, block, max_rounds,
-                             sg.diag_pieces, sg.mxu_block)
+                             sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
         dist, frontier, packed = fn(
             jnp.float32(coverage_target),
             sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
@@ -2707,7 +2949,7 @@ def hopdist_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol, *,
 def hopdist_until_done(sg: ShardedGraph, mesh: Mesh, protocol, *,
                        max_rounds: int = 1024,
                        axis_name: str = DEFAULT_AXIS, state0=None,
-                       adaptive_k: int = 0):
+                       adaptive_k: int = 0, comm: str = DEFAULT_COMM):
     """BFS until the wave dies out (or ``max_rounds``): the complete
     single-source reachability / eccentricity measurement — the
     coverage loop with an unreachable target, so only frontier death
@@ -2719,6 +2961,7 @@ def hopdist_until_done(sg: ShardedGraph, mesh: Mesh, protocol, *,
     return hopdist_until_coverage(
         sg, mesh, protocol, coverage_target=2.0, max_rounds=max_rounds,
         axis_name=axis_name, state0=state0, adaptive_k=adaptive_k,
+        comm=comm,
     )
 
 
@@ -2748,7 +2991,7 @@ def _pack_global_frontier(axis_name, S, k, local_ids, local_count, pad_id):
     return out, jnp.sum(counts).astype(jnp.int32)
 
 
-def _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+def _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, comm, k, span,
                         bkt_src, bkt_dst, bkt_mask,
                         dyn_src, dyn_dst, dyn_mask,
                         mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2769,7 +3012,7 @@ def _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
     Returns ``(sparse_round, dense_round, my_new_ids, item_budget,
     n_live)`` — both rounds map ``(seen, frontier, F, fncount, ficount)
     -> (seen, frontier, F, fncount, ficount, msgs)``."""
-    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block,
+    pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
@@ -2905,7 +3148,7 @@ def _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
     return sparse_round, dense_round, my_new_ids, item_budget, n_live
 
 
-def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
+def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, comm, k, span,
                           coverage_target, max_rounds,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
@@ -2915,7 +3158,7 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
     """Per-shard body: run-to-coverage flood on the adaptive wave rounds
     (see :func:`_make_adaptive_wave` for the work-item machinery)."""
     sparse_round, dense_round, my_new_ids, item_budget, n_live = (
-        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, comm, k, span,
                             bkt_src, bkt_dst, bkt_mask,
                             dyn_src, dyn_dst, dyn_mask,
                             mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -2969,9 +3212,10 @@ def _ring_adaptive_cov_or(axis_name, S, block, pieces, mxu_block, k, span,
 @functools.lru_cache(maxsize=64)
 def _flood_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                            max_rounds: int, k: int, span: int, pieces=(),
-                           mxu_block: int = 128):
+                           mxu_block: int = 128,
+                           comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_adaptive_cov_or, axis_name, S, block,
-                             pieces, mxu_block, k, span)
+                             pieces, mxu_block, comm, k, span)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -2983,7 +3227,7 @@ def _flood_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     return jax.jit(fn)
 
 
-def _ring_adaptive_cov_hopdist(axis_name, S, block, pieces, mxu_block, k,
+def _ring_adaptive_cov_hopdist(axis_name, S, block, pieces, mxu_block, comm, k,
                                span, coverage_target, max_rounds,
                                bkt_src, bkt_dst, bkt_mask,
                                dyn_src, dyn_dst, dyn_mask,
@@ -2997,7 +3241,7 @@ def _ring_adaptive_cov_hopdist(axis_name, S, block, pieces, mxu_block, k,
     shared with the flood loop; the two are linked by ``seen == (dist >=
     0)`` at every step."""
     sparse_round, dense_round, my_new_ids, item_budget, n_live = (
-        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, k, span,
+        _make_adaptive_wave(axis_name, S, block, pieces, mxu_block, comm, k, span,
                             bkt_src, bkt_dst, bkt_mask,
                             dyn_src, dyn_dst, dyn_mask,
                             mxu_src, mxu_dst, mxu_mask, diag_masks,
@@ -3047,9 +3291,10 @@ def _ring_adaptive_cov_hopdist(axis_name, S, block, pieces, mxu_block, k,
 @functools.lru_cache(maxsize=64)
 def _hopdist_adaptive_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                              max_rounds: int, k: int, span: int, pieces=(),
-                             mxu_block: int = 128):
+                             mxu_block: int = 128,
+                             comm: str = DEFAULT_COMM):
     body = functools.partial(_ring_adaptive_cov_hopdist, axis_name, S,
-                             block, pieces, mxu_block, k, span)
+                             block, pieces, mxu_block, comm, k, span)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
@@ -3379,3 +3624,362 @@ def walk_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     if return_state:
         return (pos, start0, visited), out
     return visited, out
+
+
+# --------------------------------------------- lane-word batched plane
+#
+# The PR-10 batched message plane packs 32 concurrent broadcast states per
+# uint32 word (ops/bitset.py lane algebra; models/messagebatch.py). Here
+# those lane words are the HALO PAYLOAD: the ring's resident block becomes
+# ``u32[W, block]``, so ONE halo hop per ring step moves the boundary
+# state of every in-flight message at once — 32·W messages per DMA — and
+# the batched plane goes multi-chip without any new per-message traffic.
+
+
+def _bucket_or_lanes(block, sorted_dst=True):
+    """Word-level OR bucket for lane-packed payloads: the resident block
+    is ``u32[W, block]``; one gather per word serves its 32 message
+    lanes, and the per-edge OR is the bit-plane uint8 segment-max of
+    ``ops/segment.propagate_or_lanes``'s segment method (word-level
+    ``.at[].max`` cannot OR two different patterns landing on one
+    receiver)."""
+    from p2pnetwork_tpu.ops import bitset
+
+    def apply(rot, src, dst, m):
+        def word(wl):
+            contrib = jnp.where(m, wl[src], jnp.uint32(0))
+            planes = jax.ops.segment_max(
+                bitset.expand_lanes(contrib).astype(jnp.uint8), dst,
+                num_segments=block, indices_are_sorted=sorted_dst,
+            )
+            return bitset.collapse_lanes(planes > 0)
+
+        return jax.vmap(word)(rot)
+
+    return apply
+
+
+def _make_or_lanes_pass(axis_name, S, block, comm,
+                        bkt_src, bkt_dst, bkt_mask,
+                        dyn_src, dyn_dst, dyn_mask):
+    """Build ``pass_(lanes u32[W, block]) -> u32[W, block]``: one full
+    ring rotation OR-ing every lane of every word over every incoming
+    edge — :func:`_make_or_pass` lifted to the lane-packed carrier. The
+    halo payload is the whole ``[W, block]`` word stack, so each ring
+    step's single hop carries 32·W messages' boundary state. Segment
+    buckets only (the MXU one-hot and diagonal layouts have no
+    word-level form — callers gate)."""
+    groups = [
+        (_bucket_or_lanes(block, sorted_dst=True),
+         bkt_src[0], bkt_dst[0], bkt_mask[0]),
+        (_bucket_or_lanes(block, sorted_dst=False),
+         dyn_src[0], dyn_dst[0], dyn_mask[0]),
+    ]
+    comm_obj = _make_ring_comm(comm, axis_name, S)
+
+    def pass_(lanes):
+        return _ring_pass(axis_name, S, lanes, groups,
+                          jnp.zeros_like(lanes), jnp.bitwise_or,
+                          comm=comm_obj)
+
+    return pass_
+
+
+def _require_lanes_layout(sg: ShardedGraph, what: str) -> None:
+    if sg.mxu_src is not None:
+        raise ValueError(
+            f"{what} cannot ride the MXU one-hot layout — shard_graph "
+            "without hybrid/min_count for the lane-packed batched path "
+            "(word-level OR has no one-hot-matmul form)"
+        )
+
+
+def _or_lanes_body(axis_name, S, block, comm,
+                   bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                   node_mask, lanes):
+    pass_ = _make_or_lanes_pass(axis_name, S, block, comm,
+                                bkt_src, bkt_dst, bkt_mask,
+                                dyn_src, dyn_dst, dyn_mask)
+    nm = node_mask[0]
+    node_lanes = jnp.where(nm, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    return (pass_(lanes[0]) & node_lanes[None, :])[None]
+
+
+@functools.lru_cache(maxsize=64)
+def _or_lanes_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                 comm: str = DEFAULT_COMM):
+    body = functools.partial(_or_lanes_body, axis_name, S, block, comm)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = shard_map(body, mesh=mesh, check_vma=False,
+                   in_specs=(spec,) * 8, out_specs=spec)
+    return jax.jit(fn)
+
+
+def shard_lanes(sg: ShardedGraph, lanes) -> jax.Array:
+    """Place a lane-word stack ``u32[W, N_pad]`` (the single-device
+    layout of ops/segment.propagate_or_lanes / MessageBatch predicates)
+    on the mesh as ``[S, W, block]`` — node-blocked like every other
+    sharded per-node array, zero-padding the node axis when the shard
+    grid rounds it up."""
+    lanes = jnp.asarray(lanes)
+    w = lanes.shape[0]
+    pad = sg.n_nodes_padded - lanes.shape[1]
+    if pad:
+        lanes = jnp.pad(lanes, ((0, 0), (0, pad)))
+    blocked = lanes.reshape(w, sg.n_shards, sg.block).transpose(1, 0, 2)
+    shard = NamedSharding(_mesh_of(sg), P(_mesh_of(sg).axis_names[0]))
+    return jax.device_put(blocked, shard)
+
+
+def unshard_lanes(sg: ShardedGraph, lanes: jax.Array,
+                  n_pad: Optional[int] = None) -> jax.Array:
+    """Inverse of :func:`shard_lanes`: ``[S, W, block] -> u32[W, n_pad]``
+    (``n_pad`` defaults to the full shard grid ``S·block``)."""
+    w = lanes.shape[1]
+    flat = lanes.transpose(1, 0, 2).reshape(w, -1)
+    return flat if n_pad is None else flat[:, :n_pad]
+
+
+def propagate_or_lanes(sg: ShardedGraph, mesh: Mesh, lanes: jax.Array,
+                       axis_name: str = DEFAULT_AXIS,
+                       comm: str = DEFAULT_COMM) -> jax.Array:
+    """Lane-packed neighbor-OR over the sharded graph: the multi-chip
+    mirror of ``ops.segment.propagate_or_lanes`` — 32·W concurrent
+    boolean signals advanced by one ring pass, the lane words as the
+    halo payload. ``lanes`` is ``[S, W, block]`` (see
+    :func:`shard_lanes`); returns the same layout, masked to live
+    nodes. Dynamic (runtime-connected) edges fold in; requires the
+    segment layout (no ``hybrid``/``min_count``)."""
+    _require_lanes_layout(sg, "propagate_or_lanes")
+    fn = _or_lanes_fn(mesh, axis_name, sg.n_shards, sg.block,
+                      _resolve_comm(comm))
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    return fn(sg.bkt_src, sg.bkt_dst, sg.bkt_mask,
+              dyn_src, dyn_dst, dyn_mask, sg.node_mask, lanes)
+
+
+def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
+                    bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
+                    node_mask, out_degree,
+                    seen0, frontier0, sent0, source, admitted, done0,
+                    rounds0, seen_count0, target):
+    """Per-shard body: advance EVERY running lane of a lane-packed batch
+    until all admitted lanes complete (or ``max_rounds``) — the
+    multi-chip mirror of ``engine._batch_loop`` + ``BatchFlood.step``,
+    arithmetic-identical per lane: same ``new = delivered & ~seen &
+    live`` dedup against node-masked kernels, same incremental
+    transpose-popcount coverage numerator (psum'd across shards), same
+    freeze/latch semantics, same per-word u32 send subtotals folded into
+    the two-limb counter, same union-frontier occupancy ints. The ring's
+    halo payload is the whole ``[W, block]`` word stack — one hop per
+    ring step moves every in-flight message's boundary state."""
+    from p2pnetwork_tpu.ops import bitset
+
+    pass_ = _make_or_lanes_pass(axis_name, S, block, comm,
+                                bkt_src, bkt_dst, bkt_mask,
+                                dyn_src, dyn_dst, dyn_mask)
+    nm = node_mask[0]
+    node_lanes = jnp.where(nm, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    deg_u = out_degree[0].astype(jnp.uint32)
+    n_live = jnp.maximum(
+        jax.lax.psum(jnp.sum(nm.astype(jnp.int32)), axis_name), 1
+    )
+
+    def lane_counts_psum(words):  # u32[W, block] -> global i32[capacity]
+        per = jax.vmap(bitset.lane_counts)(words).reshape(-1)
+        return jax.lax.psum(per, axis_name)
+
+    def cond(carry):
+        _, _, _, done, _, _, r, _, _, _ = carry
+        return jnp.any(admitted & ~done) & (r < max_rounds)
+
+    def body(carry):
+        seen, frontier, sent, done, rounds_l, seen_count, r, hi, lo, occ = \
+            carry
+        live = admitted & ~done
+        live_mask = bitset.pack_bits(live)  # u32[W] replicated
+        front = frontier & live_mask[:, None]
+        delivered = pass_(front) & node_lanes[None, :]
+        new = delivered & ~seen & live_mask[:, None]
+        seen = seen | new
+        sent = sent | front  # every frontier node broadcasts once
+        # Per-word aggregate sends (u32-safe to E <= 2^27 globally, the
+        # messagebatch contract) — psum'd per word, folded per word into
+        # the exact two-limb total like engine._add_words.
+        msgs_words = jax.lax.psum(
+            jax.vmap(lambda f: jnp.sum(deg_u * jax.lax.population_count(f))
+                     )(front),
+            axis_name,
+        )
+
+        def fold(i, a):
+            return accum.add(a, msgs_words[i])
+
+        hi2, lo2 = jax.lax.fori_loop(0, msgs_words.shape[0], fold, (hi, lo))
+        new_counts = lane_counts_psum(new)
+        seen_count = seen_count + new_counts
+        coverage = seen_count / n_live
+        done = done | (admitted & (coverage >= target))
+        rounds_l = rounds_l + live.astype(jnp.int32)
+        next_mask = bitset.pack_bits(admitted & ~done)
+        frontier = new & next_mask[:, None]
+        # Union-frontier occupancy: the engine's exact ints
+        # (ops/frontier.occupancy of the across-words OR), psum'd.
+        union = jnp.any(frontier != 0, axis=0)
+        occ_cnt = jax.lax.psum(
+            jnp.sum((union & nm).astype(jnp.int32)), axis_name
+        )
+        occ = occ + (occ_cnt / n_live).astype(jnp.float32)
+        return (seen, frontier, sent, done, rounds_l, seen_count, r + 1,
+                hi2, lo2, occ)
+
+    init = (seen0[0], frontier0[0], sent0[0], done0, rounds0, seen_count0,
+            jnp.int32(0), *accum.zero(), jnp.float32(0.0))
+    (seen, frontier, sent, done, rounds_l, seen_count, r, hi, lo, occ) = \
+        jax.lax.while_loop(cond, body, init)
+    packed = accum.pack_batch_summary(
+        r,
+        jnp.sum((admitted & ~done).astype(jnp.int32)),
+        jnp.sum(done.astype(jnp.int32)),
+        (hi, lo),
+        occ / jnp.maximum(r, 1),
+        bitset.pack_bits(done),
+        rounds_l,
+    )
+    return (seen[None], frontier[None], sent[None], source, admitted, done,
+            rounds_l, seen_count, target, packed)
+
+
+@functools.lru_cache(maxsize=64)
+def _batch_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
+                  max_rounds: int, comm: str = DEFAULT_COMM,
+                  donate: bool = False):
+    """The compiled sharded batched-flood loop. ``donate=True`` builds
+    the carry-donating variant (the 9 MessageBatch leaves alias the
+    loop's buffers — the same contract engine's ``batch_from`` audits;
+    graftaudit's donation audit covers this seam too)."""
+    body = functools.partial(_ring_batch_cov, axis_name, S, block, comm,
+                             max_rounds)
+    spec = P(axis_name)
+    # check_vma=False: see the note on the sibling ring-body factories.
+    fn = shard_map(
+        body, mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 11 + (P(),) * 6,
+        out_specs=(spec,) * 3 + (P(),) * 6 + (P(),),
+    )
+    donate_argnums = tuple(range(8, 17)) if donate else ()
+    return jax.jit(fn, donate_argnums=donate_argnums)
+
+
+def _shard_batch_args(sg: ShardedGraph, batch):
+    """Marshal a MessageBatch onto the mesh: packed predicates blocked
+    ``[S, W, block]`` (node axis zero-padded to the shard grid), per-lane
+    metadata replicated."""
+    mesh = _mesh_of(sg)
+    rep = NamedSharding(mesh, P())
+    put = lambda x: jax.device_put(jnp.asarray(x), rep)  # noqa: E731
+    return (
+        shard_lanes(sg, batch.seen), shard_lanes(sg, batch.frontier),
+        shard_lanes(sg, batch.sent),
+        put(batch.source), put(batch.admitted), put(batch.done),
+        put(batch.rounds), put(batch.seen_count), put(batch.target),
+    )
+
+
+def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
+                             batch, key=None, *,
+                             max_rounds: int = 1024,
+                             axis_name: str = DEFAULT_AXIS,
+                             comm: str = DEFAULT_COMM,
+                             donate: bool = True):
+    """Advance ALL in-flight messages of a lane-packed batch on the
+    SHARDED graph until every admitted lane reaches its coverage target —
+    ``engine.run_batch_until_coverage`` on the multi-chip ring, one XLA
+    program, the lane words as the halo payload (one hop per ring step
+    moves 32·W messages' boundary state; ``comm`` picks ppermute or the
+    Pallas ring-DMA kernels).
+
+    ``batch`` is a plain single-device
+    :class:`~p2pnetwork_tpu.models.messagebatch.MessageBatch` (built by
+    ``protocol.init`` / ``admit`` against the UNSHARDED graph — the
+    admission control plane stays host-side); it is marshalled onto the
+    mesh per call and the returned batch is back in the single-device
+    layout, so ``admit``/``retire``/``lane_seen`` and the engine loop
+    interoperate freely. Per-lane results, round counts and the summary
+    dict are BIT-IDENTICAL to the engine loop on the same batch
+    (tests/test_ring.py pins the sweep). ``protocol`` supplies the
+    entry-refresh semantics; its ``method`` is not consulted — the
+    sharded path has exactly one lane lowering (segment buckets over the
+    ring), like :func:`flood` vs ``Flood.method``. ``key`` is accepted
+    for engine-signature symmetry and unused (the batched flood is
+    deterministic). Requires the segment layout (no
+    ``hybrid``/``min_count``).
+
+    ``donate=True`` donates the loop's mesh-resident carry buffers —
+    and, exactly like the engine loop's contract, treats the passed-in
+    ``batch`` as CONSUMED (marshalling may alias rather than copy a
+    leaf, e.g. replicated metadata on a host-backed mesh, so a donated
+    run can invalidate it; resuming it raises the engine's friendly
+    deleted-buffer error). Pass ``donate=False`` to keep reading the
+    pre-run batch or to run the same batch through several loops — the
+    parity tests do.
+    """
+    from p2pnetwork_tpu.sim import engine as _engine
+
+    _require_lanes_layout(sg, "sharded run_batch_until_coverage")
+    del key  # engine-signature symmetry; the batched flood draws nothing
+    t0 = time.perf_counter()
+    _engine._check_not_donated(batch)
+    done0 = np.asarray(batch.done)
+    # Entry-time refresh — the batched cov0 seeding (BatchFlood.refresh),
+    # against the sharded graph's CURRENT node mask, host-fetched once:
+    # eager jnp on mesh-sharded operands outside a mesh context trips
+    # sharding propagation (the _walk_state0 rule), and refresh replaces
+    # only the two small metadata leaves.
+    from p2pnetwork_tpu.ops import bitset
+
+    nm_host = _host_fetch(sg.node_mask).reshape(-1)[: batch.seen.shape[1]]
+    node_lanes = jnp.where(jnp.asarray(nm_host), jnp.uint32(0xFFFFFFFF),
+                           jnp.uint32(0))
+    seen_count = jax.vmap(bitset.lane_counts)(
+        batch.seen & node_lanes[None, :]).reshape(-1)
+    n_live = jnp.maximum(jnp.int32(int(nm_host.sum())), 1)
+    done = batch.done | (batch.admitted
+                         & (seen_count / n_live >= batch.target))
+    batch = dataclasses.replace(batch, seen_count=seen_count, done=done)
+
+    fn = _batch_cov_fn(mesh, axis_name, sg.n_shards, sg.block, max_rounds,
+                       _resolve_comm(comm), bool(donate))
+    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+    (seen, frontier, sent, source, admitted, done, rounds_l, seen_count,
+     target, packed) = fn(
+        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
+        sg.node_mask, sg.out_degree, *_shard_batch_args(sg, batch),
+    )
+    t1 = time.perf_counter()
+    n_pad = batch.seen.shape[1]
+    out = accum.unpack_batch_summary(packed, int(batch.seen.shape[0]))
+    batch = dataclasses.replace(
+        batch,
+        seen=unshard_lanes(sg, seen, n_pad),
+        frontier=unshard_lanes(sg, frontier, n_pad),
+        sent=unshard_lanes(sg, sent, n_pad),
+        source=source, admitted=admitted, done=done, rounds=rounds_l,
+        seen_count=seen_count, target=target,
+    )
+    t2 = time.perf_counter()
+    newly = out["lane_done"] & ~done0
+    newly_rounds = out["lane_rounds"][newly]
+    if newly_rounds.size:
+        out["completion_rounds_p50"] = float(
+            np.percentile(newly_rounds, 50))
+        out["completion_rounds_p99"] = float(
+            np.percentile(newly_rounds, 99))
+    nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                 for leaf in jax.tree_util.tree_leaves(packed))
+    # One summary-bridging site (engine's): shared sim_* counters under
+    # loop="batch", batch gauges/histograms, occupancy recency pruning.
+    _engine._record_batch_summary(t2 - t0, t2 - t1, nbytes, out,
+                                  newly_rounds, type(protocol).__name__)
+    return batch, out

@@ -64,6 +64,12 @@ def pytest_configure(config):
         "MessageBatch lifecycle, batched-vs-sequential bit parity, the "
         "slow-marked 20x aggregate-throughput ratchet (select with "
         "-m batch; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "ring: comm-seam tests — ppermute vs Pallas ring-DMA halo "
+        "backends bit-identical across the sharded protocol sweep and "
+        "the lane-word batched path, plus the ICI byte accounting "
+        "(select with -m ring; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -34,6 +34,10 @@ def _env(cache_dir, **extra):
         # graph so orchestration (not throughput) is what the tests pay.
         "BENCH_BATCH_N": "1500",
         "BENCH_BATCH_B": "40",
+        # The multichip ring column spawns its own 8-virtual-device
+        # child: tiny graph so the tests pay orchestration, not the
+        # interpret/compile bill.
+        "BENCH_MULTICHIP_N": "1024",
         "BENCH_BACKEND_WINDOW_S": "5",
         "BENCH_PROBE_TIMEOUT_S": "60",
         "BENCH_CACHE_DIR": str(cache_dir),
@@ -230,6 +234,37 @@ class TestStageTelemetry:
         assert col["aggregate_speedup_vs_sequential"] > 0
         assert col["best_s"] > 0 and col["messages"] > 0
         assert col["seq_sample_runs"] >= 1
+
+    def test_multichip_column_published_with_ici_bytes(self, first_run):
+        # The multichip ring column (the promoted dryrun_multichip): the
+        # ring-sharded flood's wall, the single-chip scaling ratio, and
+        # the per-round ICI byte estimates of BOTH halo backends — a
+        # Pallas-comm program must never read as zero ICI bytes.
+        cache, _, _ = first_run
+        tel = json.loads((cache / "BENCH_TELEMETRY.json").read_text())
+        col = tel["multichip"]
+        assert "error" not in col and "skipped" not in col, col
+        assert col["n_devices"] >= 2
+        assert col["best_s"] > 0 and col["single_chip_best_s"] > 0
+        assert col["scaling_ratio"] > 0
+        assert col["rounds"] >= 1 and col["coverage"] > 0
+        per_round = col["per_round_ici_bytes"]
+        assert per_round["ppermute"] > 0
+        assert per_round["pallas"] > 0
+        # the acceptance bound: pallas within 20% of ppermute
+        assert 0.8 <= per_round["pallas"] / per_round["ppermute"] <= 1.2
+        assert col["ici_census"]["pallas"]["ring_dma"]["count"] >= 1
+        assert col["ici_bytes_total_est"] == \
+            per_round[col["comm"]] * col["rounds"]
+
+    def test_multichip_column_disabled_is_empty_not_missing(self, tmp_path):
+        r = subprocess.run(
+            [sys.executable, BENCH, "--stage", "1m"],
+            env=_env(tmp_path, BENCH_MULTICHIP="0"), capture_output=True,
+            text=True, timeout=600, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        tel = json.loads((tmp_path / "BENCH_TELEMETRY.json").read_text())
+        assert tel["multichip"] == {}
 
     def test_batched_column_disabled_is_empty_not_missing(self, tmp_path):
         # BENCH_BATCH=0 (what the cpu-fallback parent pins) must publish
