@@ -99,6 +99,14 @@ batch-check:
 ring-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_ring.py -q
 
+# graftscope observability plane: flight-recorder bit-parity across
+# engine/batch/sharded (both comm backends), trace-plane span trees +
+# Perfetto export schema, history ring + /history endpoint, and the
+# probe_log / profiler satellites (tox env "scope"; the slow-marked
+# 1.10x overhead ratchet runs with -m 'scope and slow').
+scope-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_graftscope.py -q
+
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when
 # the device tunnel is wedged.

@@ -76,6 +76,7 @@ simulating this workload there would take hours — it publishes no numbers
 (BASELINE.md), so the driver-set 1 s target is the baseline.
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -785,6 +786,10 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
             "transfer_s": round(reg.value("sim_transfer_seconds_total"), 6),
             "transfer_bytes": int(reg.value("sim_transfer_bytes_total")),
         },
+        # Structured probe-failure diagnostics (the `# probe N: ...`
+        # stderr lines, now artifact-resident): empty on clean rounds,
+        # the outage story on wedged ones (_PROBE_LOG docstring).
+        "probe_log": _probe_log_for_artifact(),
         "supervised": tel.get("supervised", {}),
         "per_method": tel.get("per_method", {}),
         # The batched message-plane column: B in-flight floods per
@@ -862,6 +867,41 @@ def _on_stage_breach(guard) -> None:
                 compiles=guard.compiles, budget=guard.budget)
 
 
+@contextlib.contextmanager
+def _maybe_profile(stage: str):
+    """Opt-in ``jax.profiler.trace`` bracket around a measuring stage
+    (graftscope profiler wiring): BENCH_PROFILE_DIR=<dir> writes the
+    XLA/TraceMe profile for stage ``<dir>/<stage>`` — load it in
+    TensorBoard's profile plugin or Perfetto. Off by default (profiling
+    is not free), and failure-tolerant both ways: an unavailable
+    profiler degrades to a structured warning, never a failed bench."""
+    pdir = os.environ.get("BENCH_PROFILE_DIR")
+    if not pdir:
+        yield
+        return
+    outdir = os.path.join(pdir, stage)
+    try:
+        import jax
+
+        os.makedirs(outdir, exist_ok=True)
+        jax.profiler.start_trace(outdir)
+    except Exception as e:
+        _warn_event("bench_profile_unavailable", stage=stage,
+                    error=f"{type(e).__name__}: {e}")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+            print(f"# stage {stage}: profiler trace written to {outdir}",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            _warn_event("bench_profile_stop_failed", stage=stage,
+                        error=f"{type(e).__name__}: {e}")
+
+
 def _run_stage(stage: str) -> int:
     """Child-process entry (``--stage 1m|10m``): init the backend, run one
     stage, print ONE JSON line on stdout. Comments go to stderr, which the
@@ -879,16 +919,19 @@ def _run_stage(stage: str) -> int:
             t0 = time.perf_counter()
             # The guard closes before the telemetry write, so a breach's
             # counter is already in the registry snapshot it publishes.
-            with retrace_guard("1m", budget=_stage_compile_budget("1m"),
-                               on_breach=_on_stage_breach):
+            with _maybe_profile("1m"), \
+                    retrace_guard("1m", budget=_stage_compile_budget("1m"),
+                                  on_breach=_on_stage_breach):
                 tel = bench_1m(record)
             _write_stage_telemetry(stage, tel, time.perf_counter() - t0)
             print(json.dumps(record))
             return 0
         if stage == "10m":
             t0 = time.perf_counter()
-            with retrace_guard("10m", budget=_stage_compile_budget("10m"),
-                               on_breach=_on_stage_breach):
+            with _maybe_profile("10m"), \
+                    retrace_guard("10m",
+                                  budget=_stage_compile_budget("10m"),
+                                  on_breach=_on_stage_breach):
                 rec, tel = bench_10m()
             _write_stage_telemetry(stage, tel, time.perf_counter() - t0)
             print(json.dumps(rec))
@@ -925,7 +968,11 @@ def _stage_in_child(stage: str, timeout_s: int, extra_env: dict = None):
     ``extra_env`` overlays the child's environment (the cpu-fallback path
     pins JAX_PLATFORMS=cpu there)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
-    env = {**os.environ, **extra_env} if extra_env else None
+    env = {**os.environ, **(extra_env or {})}
+    if _PROBE_LOG:
+        # The child writes the telemetry artifact; hand it the parent's
+        # probe diagnostics so outage rounds are explained in-artifact.
+        env["BENCH_PROBE_LOG"] = json.dumps(_PROBE_LOG)
     t0 = time.perf_counter()
     try:
         r = subprocess.run(cmd, stdout=subprocess.PIPE, timeout=timeout_s,
@@ -960,6 +1007,32 @@ def _stage_in_child(stage: str, timeout_s: int, extra_env: dict = None):
 
 
 # ----------------------------------------------------------- backend probing
+
+#: Structured probe-failure diagnostics, in parent-process order. The
+#: `# probe N: ... wedged` stderr comment lines were the ONLY trail the
+#: BENCH_r03–r05 null rounds left — stdout-only, gone unless someone kept
+#: the driver log. Every probe outcome now also lands here and rides into
+#: the measuring child's BENCH_TELEMETRY artifact as ``probe_log``
+#: (via the BENCH_PROBE_LOG env seam, _stage_in_child), so an outage
+#: round is diagnosable from artifacts alone.
+_PROBE_LOG: list = []
+
+
+def _probe_log_for_artifact() -> list:
+    """The probe log as the measuring CHILD sees it: the parent's
+    _PROBE_LOG serialized through the BENCH_PROBE_LOG env seam (the
+    parent probes, the child writes the artifact), merged with any
+    probes this process ran itself."""
+    entries = list(_PROBE_LOG)
+    raw = os.environ.get("BENCH_PROBE_LOG")
+    if raw:
+        try:
+            entries = list(json.loads(raw)) + entries
+        except ValueError:
+            entries = [{"error": "unparseable BENCH_PROBE_LOG",
+                        "raw": raw[:200]}] + entries
+    return entries
+
 
 def _probe_backend_once(timeout_s: int):
     """Probe JAX backend init in a CHILD process. A wedged device tunnel
@@ -1024,16 +1097,25 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
         err = _probe_backend_once(probe_timeout_s)
         if err is None:
             if attempt > 1:
+                _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
+                                   "recovered": True})
                 print(f"# backend recovered on probe attempt {attempt}",
                       file=sys.stderr, flush=True)
             return None
         remaining = deadline - time.monotonic()
+        _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
+                           "error": err,
+                           "window_remaining_s": round(max(remaining, 0), 1)})
         print(f"# probe {attempt}: {err}; {max(remaining, 0):.0f}s left in "
               f"window", file=sys.stderr, flush=True)
         if attempt >= max_attempts:
+            _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
+                               "gave_up": f"probe cap {max_attempts}"})
             return (f"{err} [gave up after {attempt} probes "
                     f"(cap {max_attempts}); handing off to fallback]")
         if remaining <= 0:
+            _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
+                               "gave_up": f"window {window_s}s"})
             return f"{err} [gave up after {attempt} probes over {window_s}s]"
         time.sleep(min(sleep_s, max(remaining, 1.0)))
         sleep_s = min(sleep_s * 1.5, 120.0)

@@ -131,6 +131,46 @@ def all_donation_audits() -> List[DonationAudit]:
                 {"max_rounds": 64},
                 len(jax.tree_util.tree_leaves(batch)))
 
+    def _ring():
+        from p2pnetwork_tpu.sim import flightrec
+
+        return flightrec.FlightRecorder(capacity=64).init()
+
+    def run_from_rec():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        state = _flood_resume_state(g)
+        args = (g, Flood(source=0), state, jax.random.key(0), 4, _ring())
+        return engine.donating_carry_loops()["run_from_rec"], args, {}, (
+            len(jax.tree_util.tree_leaves(state)) + 1)
+
+    def coverage_from_rec():
+        from p2pnetwork_tpu.models.flood import Flood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        state = _flood_resume_state(g)
+        args = (g, Flood(source=0), state, jax.random.key(0), _ring())
+        kwargs = {"coverage_target": 0.99, "max_rounds": 64}
+        return (engine.donating_carry_loops()["coverage_from_rec"], args,
+                kwargs, len(jax.tree_util.tree_leaves(state)) + 1)
+
+    def batch_from_rec():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        proto = BatchFlood(method="auto")
+        batch = proto.init(g, np.arange(32, dtype=np.int32) * 11 % 900)
+        args = (g, proto, batch, jax.random.key(0), _ring())
+        return (engine.donating_carry_loops()["batch_from_rec"], args,
+                {"max_rounds": 64},
+                len(jax.tree_util.tree_leaves(batch)) + 1)
+
     def sharded_batch_from():
         import numpy as np
 
@@ -169,6 +209,22 @@ def all_donation_audits() -> List[DonationAudit]:
             name="engine/batch_from", build=batch_from,
             doc="batched message-plane loop "
                 "(engine.run_batch_until_coverage)"),
+        # The graftscope flight-recorder twins: the ring is one MORE
+        # donated carry leaf — a recorder whose ring silently
+        # double-buffers would tax every recorded run, so the alias is
+        # audited like the state's.
+        DonationAudit(
+            name="engine/run_from_rec", build=run_from_rec,
+            doc="fixed-rounds resume loop with the flight-recorder ring "
+                "(engine.run_from(recorder=...))"),
+        DonationAudit(
+            name="engine/coverage_from_rec", build=coverage_from_rec,
+            doc="run-to-coverage resume loop with the flight-recorder "
+                "ring (engine.run_until_coverage_from(recorder=...))"),
+        DonationAudit(
+            name="engine/batch_from_rec", build=batch_from_rec,
+            doc="batched message-plane loop with the flight-recorder "
+                "ring (engine.run_batch_until_coverage(recorder=...))"),
     ]
 
 

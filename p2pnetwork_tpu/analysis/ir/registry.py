@@ -245,6 +245,65 @@ def _engine_batch_cov_entry(cls: str) -> Lowering:
                     build=build, parity=False)
 
 
+def _engine_cov_rec_entry(cls: str) -> Lowering:
+    """The run-to-coverage resume loop with the graftscope flight
+    recorder in the carry (engine._coverage_loop_rec): the ring-row
+    write must stay one dynamic_update_slice per round — censused and
+    cost-ratcheted so recorder overhead cannot silently grow."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from p2pnetwork_tpu.models.flood import Flood, FloodState
+        from p2pnetwork_tpu.sim import engine, flightrec
+
+        g = shape_class(cls)
+        proto = Flood(source=0)
+        seed = jnp.zeros(g.n_nodes_padded, dtype=bool).at[0].set(True)
+        seed = seed & g.node_mask
+        state = FloodState(seen=seed | jnp.zeros_like(seed),
+                           frontier=jnp.zeros_like(seed).at[1].set(True))
+        ring = flightrec.FlightRecorder(capacity=64).init()
+
+        def cov(graph, st, key, rg):
+            return engine._coverage_loop_rec_keeping(
+                graph, proto, st, key, rg, coverage_target=0.99,
+                max_rounds=64)
+
+        return cov, (g, state, jax.random.key(0), ring)
+
+    return Lowering(name=f"cov/flood-engine-rec@{cls}", op="cov",
+                    variant="flood-engine-rec", shape_class=cls,
+                    build=build, parity=False)
+
+
+def _engine_batch_cov_rec_entry(cls: str) -> Lowering:
+    """The batched run-to-coverage loop with the flight recorder
+    (engine._batch_loop_rec) — the recorder-enabled twin of
+    ``cov/batchflood-engine``."""
+
+    def build():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine, flightrec
+
+        g = shape_class(cls)
+        proto = BatchFlood(method="auto")
+        batch = proto.init(g, np.arange(32, dtype=np.int32) * 7 % 1000)
+        ring = flightrec.FlightRecorder(capacity=64).init()
+
+        def cov(graph, b, key, rg):
+            return engine._batch_loop_rec_keeping(graph, proto, b, key, rg,
+                                                  max_rounds=64)
+
+        return cov, (g, batch, jax.random.key(0), ring)
+
+    return Lowering(name=f"cov/batchflood-engine-rec@{cls}", op="cov",
+                    variant="batchflood-engine-rec", shape_class=cls,
+                    build=build, parity=False)
+
+
 def _engine_cov_entry(cls: str) -> Lowering:
     """The single-chip run-to-coverage loop (engine._coverage_with_init):
     init + early-exit while_loop + packed summary in one program — the
@@ -403,6 +462,11 @@ def all_lowerings() -> List[Lowering]:
         entries.append(_lanes_kernel_entry(v, "ws1k"))
     entries.append(_engine_cov_entry("ws1k"))
     entries.append(_engine_batch_cov_entry("ws1k"))
+    # The graftscope flight-recorder twins of the engine loops: same
+    # programs plus one ring-row write per round, censused so recorder
+    # overhead stays visible in the cost ratchet.
+    entries.append(_engine_cov_rec_entry("ws1k"))
+    entries.append(_engine_batch_cov_rec_entry("ws1k"))
     entries.append(_sharded_cov_entry("ws1k"))
     # The halo-exchange seam: ppermute vs pallas ring DMAs as
     # signature-parity peers, plus the lane-word halo programs the

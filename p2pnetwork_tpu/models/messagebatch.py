@@ -41,6 +41,7 @@ import numpy as np
 from p2pnetwork_tpu.models import base
 from p2pnetwork_tpu.ops import bitset, frontier, segment
 from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.telemetry import spans
 
 
 @jax.tree_util.register_dataclass
@@ -221,6 +222,15 @@ class BatchFlood:
         n_live = jnp.maximum(jnp.sum(graph.node_mask), 1)
         cov0 = count0 / n_live
         tgt = jnp.float32(coverage_target)
+        if spans.current_tracer() is not None:
+            # Trace plane: one lane_submit event per admitted message —
+            # the control-plane timestamp a serving front-end's
+            # submit→completion latency starts from (the engine's
+            # batch_run span later emits lane_admit when the lane first
+            # advances). NB: `src` above is the device source array the
+            # scatter below consumes — don't shadow it here.
+            for lane_id, src_id in zip(lanes.tolist(), sources.tolist()):
+                spans.emit("lane_submit", lane=lane_id, source=src_id)
         # sent needs no seeding: the source broadcasts in its first
         # applied round, where it enters `sent` through the frontier.
         return dataclasses.replace(
@@ -254,6 +264,9 @@ class BatchFlood:
                     "foreign lane id?")
             release = np.zeros(batch.capacity, dtype=bool)
             release[ids] = True
+        if spans.current_tracer() is not None:
+            for lane in np.flatnonzero(release).tolist():
+                spans.emit("lane_retire", lane=lane)
         clear = bitset.pack_bits(jnp.asarray(release))  # u32[B_words]
         keep = ~clear[:, None]
         rel = jnp.asarray(release)

@@ -62,7 +62,9 @@ except AttributeError:  # this image's jax 0.4.x: experimental namespace,
         return _shard_map_exp(f, **kw)
 
 from p2pnetwork_tpu.parallel.mesh import DEFAULT_AXIS
+from p2pnetwork_tpu.sim import flightrec
 from p2pnetwork_tpu.sim.graph import Graph, _round_up
+from p2pnetwork_tpu.telemetry import spans
 from p2pnetwork_tpu.utils import accum
 
 
@@ -1422,13 +1424,20 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
                       max_rounds,
                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                       mxu_src, mxu_dst, mxu_mask, diag_masks,
-                      node_mask, out_degree, seen0, frontier0):
+                      node_mask, out_degree, seen0, frontier0,
+                      ring0=None, ici_round=None):
     """Per-shard body: flood until the psum'd live coverage reaches the
     target — the device-side early-exit ``lax.while_loop`` of
     engine.run_until_coverage, multi-chip. The psum makes ``covered``
     identical on every shard, so the loop condition is replicated-consistent
     by construction. Messages accumulate in the two-limb counter
-    (utils/accum.py) — multi-chip totals wrap int32 even sooner."""
+    (utils/accum.py) — multi-chip totals wrap int32 even sooner.
+
+    ``ring0``/``ici_round`` (both or neither — the flight-recorder
+    variant) append the per-round ring to the carry: every row is built
+    from the psum'd replicated scalars, so the ring is replicated too
+    and rides back as a fourth output. Results are bit-identical either
+    way — the ring never feeds the loop's math."""
     pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
@@ -1437,13 +1446,14 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
     n_live = jnp.maximum(
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
     )
+    rec = ring0 is not None
 
     def cond(carry):
-        _, _, rounds, covered, _, _, _ = carry
+        rounds, covered = carry[2], carry[3]
         return (covered / n_live < coverage_target) & (rounds < max_rounds)
 
     def body(carry):
-        seen, frontier, rounds, prev_covered, hi, lo, occ = carry
+        seen, frontier, rounds, prev_covered, hi, lo, occ = carry[:7]
         delivered = pass_(frontier)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
@@ -1459,8 +1469,15 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
         # bit-for-bit — run-summary parity the mesh JaxSimNode tests pin.
         # `new` is disjoint from the prior seen and pre-masked, so its
         # live count IS the coverage delta — no extra psum per round.
-        occ = occ + ((covered - prev_covered) / n_live).astype(jnp.float32)
-        return seen, new, rounds + 1, covered, hi, lo, occ
+        occ_delta = ((covered - prev_covered) / n_live).astype(jnp.float32)
+        occ = occ + occ_delta
+        out = (seen, new, rounds + 1, covered, hi, lo, occ)
+        if not rec:
+            return out
+        return out + (flightrec.write_row(
+            carry[7], rounds, occupancy=occ_delta, new=msgs,
+            total=flightrec.total_f32(hi, lo), coverage=covered,
+            active_lanes=1, ici_bytes=ici_round),)
 
     seen0_b = seen0[0]
     covered0 = jax.lax.psum(
@@ -1468,34 +1485,66 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
     )
     init = (seen0_b, frontier0[0], jnp.int32(0), covered0, *accum.zero(),
             jnp.float32(0.0))
-    seen, frontier, rounds, covered, hi, lo, occ = jax.lax.while_loop(
-        cond, body, init
-    )
+    if rec:
+        init = init + (ring0,)
+    final = jax.lax.while_loop(cond, body, init)
+    seen, frontier, rounds, covered, hi, lo, occ = final[:7]
     # One packed i32[5] (replicated) carries the whole summary back — the
     # engine's single-transfer trick; separate scalars each cost a
     # device->host round trip on tunneled backends. The fifth slot is the
     # mean per-round frontier occupancy (engine _stat_while parity).
-    return seen[None], frontier[None], accum.pack_summary(
+    packed = accum.pack_summary(
         rounds, covered / n_live, (hi, lo),
         extra=occ / jnp.maximum(rounds, 1)
     )
+    if rec:
+        return seen[None], frontier[None], packed, final[7]
+    return seen[None], frontier[None], packed
 
 
 @functools.lru_cache(maxsize=64)
 def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                   max_rounds: int, pieces=(), mxu_block: int = 128,
-              comm: str = DEFAULT_COMM):
+              comm: str = DEFAULT_COMM, rec: bool = False):
     body = functools.partial(_ring_coverage_or, axis_name, S, block, pieces,
                              mxu_block, comm)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factory.
+    # The recorder variant (rec=True) appends the replicated flight ring
+    # and the static per-round ICI byte estimate to the arguments and the
+    # ring to the outputs.
     fn = shard_map(
         lambda target, *args: body(target, max_rounds, *args),
         mesh=mesh, check_vma=False,
-        in_specs=(P(),) + (spec,) * 14,
-        out_specs=(spec, spec, P()),
+        in_specs=(P(),) + (spec,) * 14 + ((P(), P()) if rec else ()),
+        out_specs=(spec, spec, P()) + ((P(),) if rec else ()),
     )
     return jax.jit(fn)
+
+
+#: Cached per-round ICI byte estimates for the flight recorder's
+#: ``ici_bytes`` column, keyed on the compiled-shape config — the commviz
+#: census is an abstract trace (tens of ms), not something to pay per
+#: recorded run.
+_REC_ICI_CACHE: dict = {}
+
+
+def _rec_ici_round_bytes(key: tuple, build) -> int:
+    """The per-round ICI byte estimate of one compiled loop config:
+    ``commviz.ici_bytes_estimate`` of the loop fn (while-loop bodies are
+    censused once = per round, ring passes scan-trip-weighted — the
+    same pricing the bench multichip column publishes). ``build()``
+    returns ``(fn, args, axis_size)``; the result is cached under
+    ``key`` (shape-config identity — the estimate depends on block
+    sizes and mesh width, not on graph contents)."""
+    est = _REC_ICI_CACHE.get(key)
+    if est is None:
+        from p2pnetwork_tpu.parallel import commviz
+
+        fn, args, axis_size = build()
+        est = _REC_ICI_CACHE[key] = int(
+            commviz.ici_bytes_estimate(fn, args, axis_size))
+    return est
 
 
 def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
@@ -1503,7 +1552,8 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                          max_rounds: int = 1024,
                          axis_name: str = DEFAULT_AXIS,
                          state0=None, return_state: bool = False,
-                         adaptive_k: int = 0, comm: str = DEFAULT_COMM):
+                         adaptive_k: int = 0, comm: str = DEFAULT_COMM,
+                         recorder=None):
     """Flood until coverage of the LIVE population reaches the target —
     the north-star run-to-99% measurement (engine.run_until_coverage), on
     the multi-chip path. One XLA program, zero host round-trips per round.
@@ -1524,6 +1574,14 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     :func:`flood`): pass ``state0 = (seen, frontier)`` to continue a run
     (``source`` is then ignored) and ``return_state=True`` to get the full
     ``((seen, frontier), dict)`` back.
+
+    ``recorder`` (a :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`,
+    default off; dense loop only — the adaptive path refuses it) rides
+    the per-round flight ring in the replicated carry and attaches
+    ``out["flight_record"]``; the ``ici_bytes`` column carries this
+    config's static per-round comm-census estimate (the same pricing the
+    bench multichip column publishes, per backend). Results stay
+    bit-identical to recorder-off runs on BOTH comm backends.
     """
     from p2pnetwork_tpu.models.flood import Flood
 
@@ -1538,7 +1596,13 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
         mxu_src, mxu_dst, mxu_mask, _diag_masks_or_empty(sg),
         sg.node_mask, sg.out_degree,
     )
+    ring = None
     if adaptive_k > 0:
+        if recorder is not None:
+            raise ValueError(
+                "the flight recorder is not supported on the adaptive "
+                "frontier-sparse path — record the dense loop "
+                "(adaptive_k=0)")
         if sg.csr_pos is None:
             raise ValueError(
                 "adaptive_k requires a sender-CSR sharded graph — build "
@@ -1553,13 +1617,32 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
             jnp.float32(coverage_target), *common,
             sg.csr_pos, sg.csr_offsets, seen0, frontier0,
         )
-    else:
+    elif recorder is None:
         fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
                            sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
         seen, frontier, packed = fn(
             jnp.float32(coverage_target), *common, seen0, frontier0,
         )
+    else:
+        resolved = _resolve_comm(comm)
+        fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                           sg.diag_pieces, sg.mxu_block, resolved, rec=True)
+        base_fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                                sg.diag_pieces, sg.mxu_block, resolved)
+        ici = _rec_ici_round_bytes(
+            ("flood", mesh, axis_name, S, block, resolved,
+             sg.diag_pieces, sg.mxu_block),
+            lambda: (base_fn,
+                     (jnp.float32(coverage_target), *common, seen0,
+                      frontier0), S))
+        seen, frontier, packed, ring = fn(
+            jnp.float32(coverage_target), *common, seen0, frontier0,
+            recorder.init(), jnp.float32(ici),
+        )
+        packed, ring = jax.device_get((packed, ring))
     out = accum.unpack_summary(packed)
+    if ring is not None:
+        out["flight_record"] = flightrec.trim(ring, out["rounds"])
     # The packed fifth slot is the mean per-round frontier occupancy —
     # surface it under the engine's summary key (run-summary parity:
     # engine.run_until_coverage on a flood returns the same dict).
@@ -3763,7 +3846,8 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
                     bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                     node_mask, out_degree,
                     seen0, frontier0, sent0, source, admitted, done0,
-                    rounds0, seen_count0, target):
+                    rounds0, seen_count0, target,
+                    ring0=None, ici_round=None):
     """Per-shard body: advance EVERY running lane of a lane-packed batch
     until all admitted lanes complete (or ``max_rounds``) — the
     multi-chip mirror of ``engine._batch_loop`` + ``BatchFlood.step``,
@@ -3790,13 +3874,15 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
         per = jax.vmap(bitset.lane_counts)(words).reshape(-1)
         return jax.lax.psum(per, axis_name)
 
+    rec = ring0 is not None
+
     def cond(carry):
-        _, _, _, done, _, _, r, _, _, _ = carry
+        done, r = carry[3], carry[6]
         return jnp.any(admitted & ~done) & (r < max_rounds)
 
     def body(carry):
         seen, frontier, sent, done, rounds_l, seen_count, r, hi, lo, occ = \
-            carry
+            carry[:10]
         live = admitted & ~done
         live_mask = bitset.pack_bits(live)  # u32[W] replicated
         front = frontier & live_mask[:, None]
@@ -3831,13 +3917,28 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
             jnp.sum((union & nm).astype(jnp.int32)), axis_name
         )
         occ = occ + (occ_cnt / n_live).astype(jnp.float32)
-        return (seen, frontier, sent, done, rounds_l, seen_count, r + 1,
-                hi2, lo2, occ)
+        out = (seen, frontier, sent, done, rounds_l, seen_count, r + 1,
+               hi2, lo2, occ)
+        if not rec:
+            return out
+        # Flight-recorder row: every value psum'd/replicated, so the
+        # ring stays replicated (engine._batch_loop_rec's columns).
+        return out + (flightrec.write_row(
+            carry[10], r,
+            occupancy=(occ_cnt / n_live).astype(jnp.float32),
+            new=jnp.sum(msgs_words.astype(jnp.float32)),
+            total=flightrec.total_f32(hi2, lo2),
+            coverage=jnp.sum(seen_count.astype(jnp.float32)),
+            active_lanes=jnp.sum((admitted & ~done).astype(jnp.int32)),
+            ici_bytes=ici_round),)
 
     init = (seen0[0], frontier0[0], sent0[0], done0, rounds0, seen_count0,
             jnp.int32(0), *accum.zero(), jnp.float32(0.0))
+    if rec:
+        init = init + (ring0,)
+    final = jax.lax.while_loop(cond, body, init)
     (seen, frontier, sent, done, rounds_l, seen_count, r, hi, lo, occ) = \
-        jax.lax.while_loop(cond, body, init)
+        final[:10]
     packed = accum.pack_batch_summary(
         r,
         jnp.sum((admitted & ~done).astype(jnp.int32)),
@@ -3847,28 +3948,39 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
         bitset.pack_bits(done),
         rounds_l,
     )
-    return (seen[None], frontier[None], sent[None], source, admitted, done,
-            rounds_l, seen_count, target, packed)
+    out = (seen[None], frontier[None], sent[None], source, admitted, done,
+           rounds_l, seen_count, target, packed)
+    if rec:
+        return out + (final[10],)
+    return out
 
 
 @functools.lru_cache(maxsize=64)
 def _batch_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
                   max_rounds: int, comm: str = DEFAULT_COMM,
-                  donate: bool = False):
+                  donate: bool = False, rec: bool = False):
     """The compiled sharded batched-flood loop. ``donate=True`` builds
     the carry-donating variant (the 9 MessageBatch leaves alias the
     loop's buffers — the same contract engine's ``batch_from`` audits;
-    graftaudit's donation audit covers this seam too)."""
+    graftaudit's donation audit covers this seam too). ``rec=True``
+    appends the replicated flight ring + static per-round ICI estimate
+    to the arguments and the ring to the outputs; the ring joins the
+    donated carry."""
     body = functools.partial(_ring_batch_cov, axis_name, S, block, comm,
                              max_rounds)
     spec = P(axis_name)
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
         body, mesh=mesh, check_vma=False,
-        in_specs=(spec,) * 11 + (P(),) * 6,
-        out_specs=(spec,) * 3 + (P(),) * 6 + (P(),),
+        in_specs=(spec,) * 11 + (P(),) * 6 + ((P(), P()) if rec else ()),
+        out_specs=(spec,) * 3 + (P(),) * 6 + (P(),)
+        + ((P(),) if rec else ()),
     )
-    donate_argnums = tuple(range(8, 17)) if donate else ()
+    donate_argnums = ()
+    if donate:
+        # The 9 MessageBatch carry leaves — plus the flight ring when
+        # recording (arg 17; the trailing ICI scalar is not a carry).
+        donate_argnums = tuple(range(8, 17)) + ((17,) if rec else ())
     return jax.jit(fn, donate_argnums=donate_argnums)
 
 
@@ -3892,7 +4004,7 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
                              max_rounds: int = 1024,
                              axis_name: str = DEFAULT_AXIS,
                              comm: str = DEFAULT_COMM,
-                             donate: bool = True):
+                             donate: bool = True, recorder=None):
     """Advance ALL in-flight messages of a lane-packed batch on the
     SHARDED graph until every admitted lane reaches its coverage target —
     ``engine.run_batch_until_coverage`` on the multi-chip ring, one XLA
@@ -3924,6 +4036,13 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     deleted-buffer error). Pass ``donate=False`` to keep reading the
     pre-run batch or to run the same batch through several loops — the
     parity tests do.
+
+    ``recorder`` rides the per-round flight ring in the donated
+    replicated carry (``ici_bytes`` column = this config's static
+    per-round comm-census estimate) and attaches
+    ``out["flight_record"]``; results stay bit-identical on both comm
+    backends. The trace plane's ``batch_run`` span and per-lane
+    lifecycle events mirror the engine loop's (``loop="sharded"``).
     """
     from p2pnetwork_tpu.sim import engine as _engine
 
@@ -3932,54 +4051,82 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     t0 = time.perf_counter()
     _engine._check_not_donated(batch)
     done0 = np.asarray(batch.done)
-    # Entry-time refresh — the batched cov0 seeding (BatchFlood.refresh),
-    # against the sharded graph's CURRENT node mask, host-fetched once:
-    # eager jnp on mesh-sharded operands outside a mesh context trips
-    # sharding propagation (the _walk_state0 rule), and refresh replaces
-    # only the two small metadata leaves.
-    from p2pnetwork_tpu.ops import bitset
+    tracer = spans.current_tracer()
+    admitted0 = np.asarray(batch.admitted) if tracer is not None else None
+    rounds0 = np.asarray(batch.rounds) if tracer is not None else None
+    with spans.span("batch_run", loop="sharded", max_rounds=max_rounds):
+        if tracer is not None:
+            _engine._emit_batch_entry_events(admitted0, done0, rounds0)
+        # Entry-time refresh — the batched cov0 seeding
+        # (BatchFlood.refresh), against the sharded graph's CURRENT node
+        # mask, host-fetched once: eager jnp on mesh-sharded operands
+        # outside a mesh context trips sharding propagation (the
+        # _walk_state0 rule), and refresh replaces only the two small
+        # metadata leaves.
+        from p2pnetwork_tpu.ops import bitset
 
-    nm_host = _host_fetch(sg.node_mask).reshape(-1)[: batch.seen.shape[1]]
-    node_lanes = jnp.where(jnp.asarray(nm_host), jnp.uint32(0xFFFFFFFF),
-                           jnp.uint32(0))
-    seen_count = jax.vmap(bitset.lane_counts)(
-        batch.seen & node_lanes[None, :]).reshape(-1)
-    n_live = jnp.maximum(jnp.int32(int(nm_host.sum())), 1)
-    done = batch.done | (batch.admitted
-                         & (seen_count / n_live >= batch.target))
-    batch = dataclasses.replace(batch, seen_count=seen_count, done=done)
+        nm_host = _host_fetch(sg.node_mask).reshape(-1)[: batch.seen.shape[1]]
+        node_lanes = jnp.where(jnp.asarray(nm_host), jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
+        seen_count = jax.vmap(bitset.lane_counts)(
+            batch.seen & node_lanes[None, :]).reshape(-1)
+        n_live = jnp.maximum(jnp.int32(int(nm_host.sum())), 1)
+        done = batch.done | (batch.admitted
+                             & (seen_count / n_live >= batch.target))
+        batch = dataclasses.replace(batch, seen_count=seen_count, done=done)
 
-    fn = _batch_cov_fn(mesh, axis_name, sg.n_shards, sg.block, max_rounds,
-                       _resolve_comm(comm), bool(donate))
-    dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
-    (seen, frontier, sent, source, admitted, done, rounds_l, seen_count,
-     target, packed) = fn(
-        sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst, dyn_mask,
-        sg.node_mask, sg.out_degree, *_shard_batch_args(sg, batch),
-    )
-    t1 = time.perf_counter()
-    n_pad = batch.seen.shape[1]
-    out = accum.unpack_batch_summary(packed, int(batch.seen.shape[0]))
-    batch = dataclasses.replace(
-        batch,
-        seen=unshard_lanes(sg, seen, n_pad),
-        frontier=unshard_lanes(sg, frontier, n_pad),
-        sent=unshard_lanes(sg, sent, n_pad),
-        source=source, admitted=admitted, done=done, rounds=rounds_l,
-        seen_count=seen_count, target=target,
-    )
-    t2 = time.perf_counter()
-    newly = out["lane_done"] & ~done0
-    newly_rounds = out["lane_rounds"][newly]
-    if newly_rounds.size:
-        out["completion_rounds_p50"] = float(
-            np.percentile(newly_rounds, 50))
-        out["completion_rounds_p99"] = float(
-            np.percentile(newly_rounds, 99))
-    nbytes = sum(int(getattr(leaf, "nbytes", 0))
-                 for leaf in jax.tree_util.tree_leaves(packed))
-    # One summary-bridging site (engine's): shared sim_* counters under
-    # loop="batch", batch gauges/histograms, occupancy recency pruning.
-    _engine._record_batch_summary(t2 - t0, t2 - t1, nbytes, out,
-                                  newly_rounds, type(protocol).__name__)
+        resolved = _resolve_comm(comm)
+        fn = _batch_cov_fn(mesh, axis_name, sg.n_shards, sg.block,
+                           max_rounds, resolved, bool(donate),
+                           rec=recorder is not None)
+        dyn_src, dyn_dst, dyn_mask = _dyn_or_empty(sg)
+        args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst,
+                dyn_mask, sg.node_mask, sg.out_degree,
+                *_shard_batch_args(sg, batch))
+        ring = None
+        if recorder is None:
+            (seen, frontier, sent, source, admitted, done, rounds_l,
+             seen_count, target, packed) = fn(*args)
+        else:
+            n_words = int(batch.seen.shape[0])
+            base_fn = _batch_cov_fn(mesh, axis_name, sg.n_shards, sg.block,
+                                    max_rounds, resolved, False)
+            ici = _rec_ici_round_bytes(
+                ("batch", mesh, axis_name, sg.n_shards, sg.block, resolved,
+                 n_words),
+                lambda: (base_fn, args, sg.n_shards))
+            (seen, frontier, sent, source, admitted, done, rounds_l,
+             seen_count, target, packed, ring) = fn(
+                *args, recorder.init(), jnp.float32(ici))
+        t1 = time.perf_counter()
+        n_pad = batch.seen.shape[1]
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                     for leaf in jax.tree_util.tree_leaves((packed, ring)))
+        if ring is not None:
+            packed, ring = jax.device_get((packed, ring))
+        out = accum.unpack_batch_summary(packed, int(batch.seen.shape[0]))
+        if ring is not None:
+            out["flight_record"] = flightrec.trim(ring, out["rounds"])
+        batch = dataclasses.replace(
+            batch,
+            seen=unshard_lanes(sg, seen, n_pad),
+            frontier=unshard_lanes(sg, frontier, n_pad),
+            sent=unshard_lanes(sg, sent, n_pad),
+            source=source, admitted=admitted, done=done, rounds=rounds_l,
+            seen_count=seen_count, target=target,
+        )
+        t2 = time.perf_counter()
+        newly = out["lane_done"] & ~done0
+        newly_rounds = out["lane_rounds"][newly]
+        if newly_rounds.size:
+            out["completion_rounds_p50"] = float(
+                np.percentile(newly_rounds, 50))
+            out["completion_rounds_p99"] = float(
+                np.percentile(newly_rounds, 99))
+        if tracer is not None:
+            _engine._emit_batch_exit_events(admitted0, done0, out)
+        # One summary-bridging site (engine's): shared sim_* counters under
+        # loop="batch", batch gauges/histograms, occupancy recency pruning.
+        _engine._record_batch_summary(t2 - t0, t2 - t1, nbytes, out,
+                                      newly_rounds, type(protocol).__name__)
     return batch, out

@@ -25,6 +25,17 @@ back in ONE packed transfer. Staggered admission happens BETWEEN calls
 through ``BatchFlood.admit`` — the serving front-end's seam. Per-batch
 occupancy and completion land in the ``sim_batch_active_lanes`` gauge
 and ``sim_batch_completion_rounds`` histogram.
+
+graftscope rides the resume/batch loops: ``recorder=`` on
+:func:`run_from`, :func:`run_until_coverage_from` and
+:func:`run_batch_until_coverage` (a
+:class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`) accumulates a
+bounded per-round record ring INSIDE the compiled carry — donated like
+the state, bit-identical results, one extra fetch per run — and, when a
+trace plane is installed (telemetry/spans.py), batched runs emit
+``batch_run`` spans with per-lane lifecycle events. Run summaries also
+sample the default history ring (telemetry/history.py) so ``/history``
+serves per-run gauge series with zero extra wiring.
 """
 
 from __future__ import annotations
@@ -38,8 +49,9 @@ import numpy as np
 
 from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.ops import bitset
+from p2pnetwork_tpu.sim import flightrec
 from p2pnetwork_tpu.sim.graph import Graph
-from p2pnetwork_tpu.telemetry import jaxhooks
+from p2pnetwork_tpu.telemetry import history, jaxhooks, spans
 from p2pnetwork_tpu.utils import accum
 
 # Compile/recompile accounting rides jax.monitoring's lowering-duration
@@ -132,35 +144,62 @@ def _record_run_summary(loop: str, wall_s: float, transfer_s: float,
 
 
 def _timed_summary(loop: str, t0: float, state, packed,
-                   protocol_name: str = "", has_occupancy: bool = False):
+                   protocol_name: str = "", has_occupancy: bool = False,
+                   ring=None):
     """Unpack the packed one-transfer summary, timing the transfer, and
     record the whole invocation into the registry. ``has_occupancy`` says
     whether the protocol's stats carried ``frontier_occupancy`` — only
     then does the packed fifth slot mean anything (it is zero-filled for
-    protocols without the stat, which must not pollute the histogram)."""
+    protocols without the stat, which must not pollute the histogram).
+    ``ring`` is the flight-recorder carry when the run recorded one —
+    fetched in the SAME blocking ``device_get`` as the summary (still
+    one sync point per run) and attached as ``out["flight_record"]``."""
     t1 = time.perf_counter()
+    nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                 for leaf in jax.tree_util.tree_leaves((packed, ring)))
+    if ring is not None:
+        packed, ring = jax.device_get((packed, ring))
     out = _unpack_summary(packed)
     extra = out.pop("extra", None)
     if has_occupancy and extra is not None:
         out["frontier_occupancy_mean"] = extra
+    if ring is not None:
+        out["flight_record"] = flightrec.trim(ring, out["rounds"])
     t2 = time.perf_counter()
-    nbytes = sum(int(getattr(leaf, "nbytes", 0))
-                 for leaf in jax.tree_util.tree_leaves(packed))
     _record_run_summary(loop, t2 - t0, t2 - t1, nbytes, out, protocol_name)
+    history.default_history().sample()
     return state, out
 
 
-def _scan_rounds(graph: Graph, protocol, state, key: jax.Array, rounds: int):
-    """The shared scan body of :func:`run` / :func:`run_from`."""
+def _scan_rounds(graph: Graph, protocol, state, key: jax.Array, rounds: int,
+                 ring=None):
+    """The shared scan body of :func:`run` / :func:`run_from`. One body
+    for the recording and non-recording forms (trace-time ``ring``
+    branch, the ``_stat_while`` pattern) so the RNG chain and state math
+    CANNOT diverge between them: ``ring`` (sim/flightrec.py) adds a
+    per-round row write to the carry and a third return value."""
 
     def body(carry, round_key):
-        st, = carry
+        st = carry[0]
         st, stats = protocol.step(graph, st, round_key)
-        return (st,), stats
+        if ring is None:
+            return (st,), stats
+        _, rg, r, tot = carry
+        msgs = jnp.float32(stats.get("messages", 0.0))
+        tot = tot + msgs
+        rg = flightrec.write_row(
+            rg, r, occupancy=stats.get("frontier_occupancy", 0.0),
+            new=msgs, total=tot, coverage=stats.get("coverage", 0.0),
+            active_lanes=1, ici_bytes=0.0)
+        return (st, rg, r + 1, tot), stats
 
     keys = jax.random.split(jax.random.fold_in(key, 1), rounds)
-    (state,), stats = jax.lax.scan(body, (state,), keys)
-    return state, stats
+    init = (state,) if ring is None \
+        else (state, ring, jnp.int32(0), jnp.float32(0.0))
+    carry, stats = jax.lax.scan(body, init, keys)
+    if ring is None:
+        return carry[0], stats
+    return carry[0], stats, carry[1]
 
 
 @functools.partial(jax.jit, static_argnames=("protocol", "rounds"))
@@ -180,6 +219,22 @@ _run_from_donating = functools.partial(
     donate_argnames=("state",))(_scan_rounds)
 _run_from_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- the deliberate donate=False escape hatch (aliased-leaf states, double-resume); the donating twin is the default
     jax.jit, static_argnames=("protocol", "rounds"))(_scan_rounds)
+
+
+def _scan_rounds_rec(graph: Graph, protocol, state, key: jax.Array,
+                     rounds: int, ring: jax.Array):
+    """The recording form of :func:`_scan_rounds` (same body — this
+    wrapper only exists so the jit variants can name ``ring`` in
+    ``donate_argnames``): the ring is a donated carry leaf of the
+    donating variant, like the state."""
+    return _scan_rounds(graph, protocol, state, key, rounds, ring)
+
+
+_run_from_rec_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "rounds"),
+    donate_argnames=("state", "ring"))(_scan_rounds_rec)
+_run_from_rec_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- same donate=False escape hatch as the non-recording twin
+    jax.jit, static_argnames=("protocol", "rounds"))(_scan_rounds_rec)
 
 
 def _donatable(state, *others) -> bool:
@@ -227,7 +282,7 @@ def _pick_loop(donating, keeping, donate, state, graph, key):
 
 
 def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int, *,
-             donate: bool = True):
+             donate: bool = True, recorder=None):
     """Run ``rounds`` rounds continuing from an existing ``state`` (resume
     path — e.g. after loading a checkpoint, or incremental stepping from
     JaxSimNode).
@@ -242,10 +297,22 @@ def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int, *,
     so save-then-run is safe, run-then-save-the-old-state is not. A
     state whose leaves alias one buffer (fresh protocol inits do) skips
     donation automatically rather than trip XLA's double-donate check.
+
+    ``recorder`` (a :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`,
+    default off) accumulates the per-round flight ring inside the scan
+    carry — results stay bit-identical — and changes the return to
+    ``(state, stats, FlightRecord)`` (the record fetch is the one extra
+    sync the recorder adds, at the END of the run).
     """
-    fn = _pick_loop(_run_from_donating, _run_from_keeping, donate,
+    if recorder is None:
+        fn = _pick_loop(_run_from_donating, _run_from_keeping, donate,
+                        state, graph, key)
+        return fn(graph, protocol, state, key, rounds)
+    fn = _pick_loop(_run_from_rec_donating, _run_from_rec_keeping, donate,
                     state, graph, key)
-    return fn(graph, protocol, state, key, rounds)
+    state, stats, ring = fn(graph, protocol, state, key, rounds,
+                            recorder.init())
+    return state, stats, flightrec.trim(np.asarray(ring), rounds)
 
 
 def run_until_coverage(
@@ -294,6 +361,7 @@ def run_until_coverage_from(
     max_rounds: int = 1024,
     steps_per_round: int = 1,
     donate: bool = True,
+    recorder=None,
 ):
     """Run-to-coverage continuing from an existing ``state0`` (resume path).
 
@@ -312,20 +380,38 @@ def run_until_coverage_from(
     invalidates the caller's copy (see :func:`run_from` for the full
     donation contract); pass ``donate=False`` to resume the same state
     more than once.
+
+    ``recorder`` (a :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`,
+    default off) rides the per-round flight ring in the while carry
+    (donated alongside the state) and attaches the host-side
+    :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecord` as
+    ``out["flight_record"]`` — run results stay bit-identical to
+    recorder-off runs, still with zero per-round host sync.
     """
     keys = _require_stats(graph, protocol, state0, key,
                           ("coverage", "messages"))
     t0 = time.perf_counter()
-    loop_fn = _pick_loop(_coverage_loop_donating, _coverage_loop_keeping,
-                         donate, state0, graph, key)
-    state, packed = loop_fn(
-        graph, protocol, state0, key,
-        coverage_target=coverage_target, max_rounds=max_rounds,
-        steps_per_round=steps_per_round,
-    )
+    if recorder is None:
+        loop_fn = _pick_loop(_coverage_loop_donating, _coverage_loop_keeping,
+                             donate, state0, graph, key)
+        state, packed = loop_fn(
+            graph, protocol, state0, key,
+            coverage_target=coverage_target, max_rounds=max_rounds,
+            steps_per_round=steps_per_round,
+        )
+        ring = None
+    else:
+        loop_fn = _pick_loop(_coverage_loop_rec_donating,
+                             _coverage_loop_rec_keeping, donate, state0,
+                             graph, key)
+        state, packed, ring = loop_fn(
+            graph, protocol, state0, key, recorder.init(),
+            coverage_target=coverage_target, max_rounds=max_rounds,
+            steps_per_round=steps_per_round,
+        )
     return _timed_summary("coverage_from", t0, state, packed,
                           type(protocol).__name__,
-                          "frontier_occupancy" in keys)
+                          "frontier_occupancy" in keys, ring=ring)
 
 
 # One-transfer run summaries, shared with the sharded coverage loops.
@@ -414,7 +500,7 @@ def _add_words(acc, words: jax.Array):
         0, words.shape[0], lambda i, a: accum.add(a, words[i]), acc)
 
 
-def _batch_loop(graph, protocol, batch0, key, *, max_rounds):
+def _batch_body(graph, protocol, batch0, key, *, max_rounds, ring=None):
     """The batched run-to-coverage loop: advance every running lane per
     iteration until ALL admitted lanes complete (or ``max_rounds`` more
     global rounds pass). Per-lane completion/round accounting lives in
@@ -423,22 +509,42 @@ def _batch_loop(graph, protocol, batch0, key, *, max_rounds):
     per round, no host sync. Callers must hand in a REFRESHED batch
     (protocol.refresh — run_batch_until_coverage does): refreshing
     inside this jit would dead-code the stale seen_count input and
-    silently drop its donation."""
+    silently drop its donation.
+
+    One body for the recording and non-recording forms (trace-time
+    ``ring`` branch, the ``_stat_while`` pattern) so the RNG chain and
+    accumulation math CANNOT diverge between them. A ring row per
+    global round: union-frontier occupancy, this round's aggregate
+    sends, the running total, the masked seen-count sum over lanes (the
+    batch plane's coverage numerator), and the active-lane count."""
 
     def cond(carry):
-        batch, _, r, _, _, _ = carry
+        batch, r = carry[0], carry[2]
         return jnp.any(batch.admitted & ~batch.done) & (r < max_rounds)
 
     def body(carry):
-        batch, k, r, hi, lo, occ = carry
+        batch, k, r, hi, lo, occ = carry[:6]
         k, sub = jax.random.split(k)
         batch, stats = protocol.step(graph, batch, sub)
         hi, lo = _add_words((hi, lo), stats["messages_words"])
-        return (batch, k, r + 1, hi, lo,
-                occ + jnp.float32(stats["batch_occupancy"]))
+        out = (batch, k, r + 1, hi, lo,
+               occ + jnp.float32(stats["batch_occupancy"]))
+        if ring is None:
+            return out
+        return out + (flightrec.write_row(
+            carry[6], r,
+            occupancy=stats["batch_occupancy"],
+            new=jnp.sum(stats["messages_words"].astype(jnp.float32)),
+            total=flightrec.total_f32(hi, lo),
+            coverage=jnp.sum(batch.seen_count.astype(jnp.float32)),
+            active_lanes=stats["active_lanes"],
+            ici_bytes=0.0),)
 
     init = (batch0, key, jnp.int32(0), *accum.zero(), jnp.float32(0.0))
-    batch, _, rounds, hi, lo, occ = jax.lax.while_loop(cond, body, init)
+    if ring is not None:
+        init = init + (ring,)
+    final = jax.lax.while_loop(cond, body, init)
+    batch, _, rounds, hi, lo, occ = final[:6]
     packed = accum.pack_batch_summary(
         rounds,
         jnp.sum((batch.admitted & ~batch.done).astype(jnp.int32)),
@@ -448,7 +554,13 @@ def _batch_loop(graph, protocol, batch0, key, *, max_rounds):
         bitset.pack_bits(batch.done),
         batch.rounds,
     )
-    return batch, packed
+    if ring is None:
+        return batch, packed
+    return batch, packed, final[6]
+
+
+def _batch_loop(graph, protocol, batch0, key, *, max_rounds):
+    return _batch_body(graph, protocol, batch0, key, max_rounds=max_rounds)
 
 
 _batch_loop_donating = functools.partial(
@@ -456,6 +568,22 @@ _batch_loop_donating = functools.partial(
     donate_argnames=("batch0",))(_batch_loop)
 _batch_loop_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- the deliberate donate=False escape hatch, same as the single-message twins
     jax.jit, static_argnames=("protocol", "max_rounds"))(_batch_loop)
+
+
+def _batch_loop_rec(graph, protocol, batch0, key, ring, *, max_rounds):
+    """The recording form of :func:`_batch_body` (this wrapper only
+    exists so the jit variants can name ``ring`` in
+    ``donate_argnames``) — same RNG chain and state math by
+    construction, so per-lane results stay bit-identical."""
+    return _batch_body(graph, protocol, batch0, key, max_rounds=max_rounds,
+                       ring=ring)
+
+
+_batch_loop_rec_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds"),
+    donate_argnames=("batch0", "ring"))(_batch_loop_rec)
+_batch_loop_rec_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- same donate=False escape hatch as the non-recording twin
+    jax.jit, static_argnames=("protocol", "max_rounds"))(_batch_loop_rec)
 
 
 def _record_batch_summary(wall_s: float, transfer_s: float,
@@ -487,11 +615,42 @@ def _record_batch_summary(wall_s: float, transfer_s: float,
         hist.observe(r)
     _observe_occupancy("batch", protocol_name,
                        float(out["occupancy_mean"]))
+    # One history-ring sample per batched run, taken AFTER the batch
+    # gauges are set so /history's sim_batch_active_lanes series tracks
+    # run boundaries (telemetry/history.py).
+    history.default_history().sample()
+
+
+def _emit_batch_entry_events(admitted0, done0, rounds0) -> None:
+    """Per-lane lifecycle events at batch-run entry (trace plane,
+    telemetry/spans.py): ``lane_admit`` for lanes this run advances for
+    the first time, ``lane_resume`` for lanes resuming from an earlier
+    call. No-ops unless a tracer is installed (the callers gate)."""
+    running = admitted0 & ~done0
+    for lane in np.flatnonzero(running & (rounds0 == 0)).tolist():
+        spans.emit("lane_admit", lane=lane)
+    for lane in np.flatnonzero(running & (rounds0 > 0)).tolist():
+        spans.emit("lane_resume", lane=lane)
+
+
+def _emit_batch_exit_events(admitted0, done0, out) -> None:
+    """Per-lane lifecycle events at batch-run exit: ``lane_complete``
+    for lanes that reached target in this call (with their cumulative
+    round count), ``lane_freeze`` for running lanes the loop returned
+    still unfinished (max_rounds cut the stragglers off)."""
+    lane_done = out["lane_done"]
+    newly = np.flatnonzero(lane_done & ~done0)
+    rounds = out["lane_rounds"][newly]
+    for lane, r in zip(newly.tolist(), rounds.tolist()):
+        spans.emit("lane_complete", lane=lane, rounds=r)
+    frozen = np.flatnonzero(admitted0 & ~done0 & ~lane_done)
+    for lane in frozen.tolist():
+        spans.emit("lane_freeze", lane=lane)
 
 
 def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
                              *, max_rounds: int = 1024,
-                             donate: bool = True):
+                             donate: bool = True, recorder=None):
     """Advance ALL in-flight messages of a lane-packed batch until every
     admitted lane reaches its coverage target (or ``max_rounds`` global
     rounds pass) — the B-message sibling of
@@ -518,7 +677,15 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
     ``donate=True`` (default) hands the batch's buffers to the loop and
     invalidates the caller's copy (see :func:`run_from`); pass
     ``donate=False`` to keep reading the pre-run batch (e.g. to resume
-    it twice)."""
+    it twice).
+
+    ``recorder`` (a :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`,
+    default off) rides the per-round flight ring in the donated carry
+    and attaches ``out["flight_record"]`` — per-lane results stay
+    bit-identical to recorder-off runs. When a trace plane is installed
+    (telemetry/spans.py), the whole call runs under a ``batch_run`` span
+    carrying per-lane ``lane_admit`` / ``lane_resume`` /
+    ``lane_complete`` / ``lane_freeze`` events."""
     t0 = time.perf_counter()
     _check_not_donated(batch)  # friendly error before refresh reads it
     # Pre-run done flags, snapshotted BEFORE the refresh: a lane the
@@ -527,30 +694,54 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
     # histogram/percentiles like any other (and the copy must precede
     # the loop consuming the donated buffers anyway).
     done0 = np.asarray(batch.done)
-    # Entry-time mask refresh — the batched cov0 seeding: node failures
-    # applied between calls change the masked numerator/denominator, so
-    # lanes re-decide "already done" against the CURRENT graph before
-    # any step runs. Eager on purpose (see BatchFlood.refresh).
-    batch = protocol.refresh(graph, batch)
-    loop_fn = _pick_loop(_batch_loop_donating, _batch_loop_keeping,
-                         donate, batch, graph, key)
-    n_words = int(batch.seen.shape[0])
-    state, packed = loop_fn(graph, protocol, batch, key,
-                            max_rounds=max_rounds)
-    t1 = time.perf_counter()
-    out = accum.unpack_batch_summary(packed, n_words)
-    t2 = time.perf_counter()
-    newly = out["lane_done"] & ~done0
-    newly_rounds = out["lane_rounds"][newly]
-    if newly_rounds.size:
-        out["completion_rounds_p50"] = float(
-            np.percentile(newly_rounds, 50))
-        out["completion_rounds_p99"] = float(
-            np.percentile(newly_rounds, 99))
-    nbytes = sum(int(getattr(leaf, "nbytes", 0))
-                 for leaf in jax.tree_util.tree_leaves(packed))
-    _record_batch_summary(t2 - t0, t2 - t1, nbytes, out, newly_rounds,
-                          type(protocol).__name__)
+    tracer = spans.current_tracer()
+    # Lane lifecycle snapshot for the trace plane, read pre-refresh
+    # (refresh-completed lanes still count as completing in this run).
+    admitted0 = np.asarray(batch.admitted) if tracer is not None else None
+    rounds0 = np.asarray(batch.rounds) if tracer is not None else None
+    with spans.span("batch_run", loop="engine", max_rounds=max_rounds):
+        if tracer is not None:
+            _emit_batch_entry_events(admitted0, done0, rounds0)
+        # Entry-time mask refresh — the batched cov0 seeding: node
+        # failures applied between calls change the masked
+        # numerator/denominator, so lanes re-decide "already done"
+        # against the CURRENT graph before any step runs. Eager on
+        # purpose (see BatchFlood.refresh).
+        batch = protocol.refresh(graph, batch)
+        n_words = int(batch.seen.shape[0])
+        if recorder is None:
+            loop_fn = _pick_loop(_batch_loop_donating, _batch_loop_keeping,
+                                 donate, batch, graph, key)
+            state, packed = loop_fn(graph, protocol, batch, key,
+                                    max_rounds=max_rounds)
+            ring = None
+        else:
+            loop_fn = _pick_loop(_batch_loop_rec_donating,
+                                 _batch_loop_rec_keeping, donate, batch,
+                                 graph, key)
+            state, packed, ring = loop_fn(graph, protocol, batch, key,
+                                          recorder.init(),
+                                          max_rounds=max_rounds)
+        t1 = time.perf_counter()
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                     for leaf in jax.tree_util.tree_leaves((packed, ring)))
+        if ring is not None:
+            packed, ring = jax.device_get((packed, ring))
+        out = accum.unpack_batch_summary(packed, n_words)
+        if ring is not None:
+            out["flight_record"] = flightrec.trim(ring, out["rounds"])
+        t2 = time.perf_counter()
+        newly = out["lane_done"] & ~done0
+        newly_rounds = out["lane_rounds"][newly]
+        if newly_rounds.size:
+            out["completion_rounds_p50"] = float(
+                np.percentile(newly_rounds, 50))
+            out["completion_rounds_p99"] = float(
+                np.percentile(newly_rounds, 99))
+        if tracer is not None:
+            _emit_batch_exit_events(admitted0, done0, out)
+        _record_batch_summary(t2 - t0, t2 - t1, nbytes, out, newly_rounds,
+                              type(protocol).__name__)
     return state, out
 
 
@@ -566,6 +757,13 @@ def donating_carry_loops() -> dict:
         "coverage_from": _coverage_loop_donating,
         "converged_from": _converged_loop_donating,
         "batch_from": _batch_loop_donating,
+        # The flight-recorder twins: the ring is an extra donated carry
+        # leaf, and the audit must prove it stays aliased (a recorder
+        # that double-buffers its ring would silently tax every
+        # recorded run).
+        "run_from_rec": _run_from_rec_donating,
+        "coverage_from_rec": _coverage_loop_rec_donating,
+        "batch_from_rec": _batch_loop_rec_donating,
     }
 
 
@@ -606,7 +804,7 @@ def _require_stats(graph, protocol, state0, key, required):
 
 
 def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
-                steps_per_round=1):
+                steps_per_round=1, ring=None):
     """The shared device-side early-exit loop: run protocol rounds while
     ``keep_going(stats[stat], rounds)`` holds, accumulating messages in the
     two-limb counter and returning the packed one-transfer summary. Both
@@ -629,7 +827,14 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
     family), its per-round values accumulate device-side and the packed
     summary carries their mean in the fifth slot — zero for protocols
     without the stat (the entry points know which is which and drop the
-    meaningless zeros)."""
+    meaningless zeros).
+
+    ``ring`` (optional ``f32[capacity, K]``, sim/flightrec.py) appends
+    the flight-recorder ring to the carry: one row write per APPLIED
+    round — frozen sub-steps of a batched super-step write nothing —
+    and the final ring comes back as a third return value. The ring
+    never feeds the loop's math, so results are bit-identical either
+    way."""
     T = int(steps_per_round)
     if T < 1:
         raise ValueError(f"steps_per_round must be >= 1, got {T}")
@@ -637,21 +842,31 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
     def _occ(stats):
         return jnp.float32(stats.get("frontier_occupancy", 0.0))
 
+    def _row(rg, rounds_before, stats, hi, lo):
+        # Per-round flight record: the loop's tracked stat rides the
+        # coverage column (a coverage fraction for the flood loops).
+        return flightrec.write_row(
+            rg, rounds_before, occupancy=_occ(stats),
+            new=stats["messages"], total=flightrec.total_f32(hi, lo),
+            coverage=stats[stat], active_lanes=1, ici_bytes=0.0)
+
     def cond(carry):
-        _, _, rounds, value, _, _, _ = carry
-        return keep_going(value, rounds)
+        return keep_going(carry[3], carry[2])
 
     def body(carry):
-        state, k, rounds, _, hi, lo, occ = carry
+        state, k, rounds, _, hi, lo, occ = carry[:7]
         k, sub = jax.random.split(k)
         state, stats = protocol.step(graph, state, sub)
         hi, lo = accum.add((hi, lo), stats["messages"])
-        return (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo,
-                occ + _occ(stats))
+        out = (state, k, rounds + 1, jnp.float32(stats[stat]), hi, lo,
+               occ + _occ(stats))
+        if ring is None:
+            return out
+        return out + (_row(carry[7], rounds, stats, hi, lo),)
 
     def batched_body(carry):
         def substep(c, _):
-            state, k, rounds, value, hi, lo, occ = c
+            state, k, rounds, value, hi, lo, occ = c[:7]
             live = keep_going(value, rounds)
             # k advances unconditionally: the while carry never exposes
             # it, and frozen sub-steps discard everything drawn from it,
@@ -664,24 +879,35 @@ def _stat_while(graph, protocol, state0, key, *, stat, keep_going, value0,
                 (hi, lo),
                 jnp.where(live, stats["messages"],
                           jnp.zeros_like(stats["messages"])))
-            rounds = jnp.where(live, rounds + 1, rounds)
+            new_rounds = jnp.where(live, rounds + 1, rounds)
             value = jnp.where(live, jnp.float32(stats[stat]), value)
             occ = occ + jnp.where(live, _occ(stats), jnp.float32(0.0))
-            return (state, k, rounds, value, hi, lo, occ), None
+            out = (state, k, new_rounds, value, hi, lo, occ)
+            if ring is None:
+                return out, None
+            # Frozen sub-steps keep the ring untouched (their discarded
+            # step would otherwise overwrite the last applied row).
+            return out + (jnp.where(live, _row(c[7], rounds, stats, hi, lo),
+                                    c[7]),), None
 
         carry, _ = jax.lax.scan(substep, carry, None, length=T)
         return carry
 
     init = (state0, key, jnp.int32(0), value0, *accum.zero(),
             jnp.float32(0.0))
-    state, _, rounds, value, hi, lo, occ = jax.lax.while_loop(
-        cond, body if T == 1 else batched_body, init)
+    if ring is not None:
+        init = init + (ring,)
+    final = jax.lax.while_loop(cond, body if T == 1 else batched_body, init)
+    state, _, rounds, value, hi, lo, occ = final[:7]
     occ_mean = occ / jnp.maximum(rounds, 1)
-    return state, _pack_summary(rounds, value, (hi, lo), extra=occ_mean)
+    packed = _pack_summary(rounds, value, (hi, lo), extra=occ_mean)
+    if ring is None:
+        return state, packed
+    return state, packed, final[7]
 
 
 def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds,
-                   steps_per_round=1):
+                   steps_per_round=1, ring=None):
     cov0 = (
         jnp.float32(protocol.coverage(graph, state0))
         if hasattr(protocol, "coverage")
@@ -690,7 +916,7 @@ def _coverage_body(graph, protocol, state0, key, coverage_target, max_rounds,
     return _stat_while(
         graph, protocol, state0, key, stat="coverage",
         keep_going=lambda v, r: (v < coverage_target) & (r < max_rounds),
-        value0=cov0, steps_per_round=steps_per_round,
+        value0=cov0, steps_per_round=steps_per_round, ring=ring,
     )
 
 
@@ -716,3 +942,21 @@ _coverage_loop_donating = functools.partial(
 _coverage_loop_keeping = functools.partial(
     jax.jit, static_argnames=("protocol", "max_rounds",
                               "steps_per_round"))(_coverage_loop)
+
+
+def _coverage_loop_rec(graph, protocol, state0, key, ring, *,
+                       coverage_target, max_rounds, steps_per_round=1):
+    """The run-to-coverage resume loop with the flight-recorder ring in
+    the carry (sim/flightrec.py) — returns ``(state, packed, ring)``;
+    the ring is a donated carry leaf of the donating variant exactly
+    like the state (graftaudit's donation audit covers this seam)."""
+    return _coverage_body(graph, protocol, state0, key, coverage_target,
+                          max_rounds, steps_per_round, ring=ring)
+
+
+_coverage_loop_rec_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds", "steps_per_round"),
+    donate_argnames=("state0", "ring"))(_coverage_loop_rec)
+_coverage_loop_rec_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- same donate=False escape hatch as the non-recording twin
+    jax.jit, static_argnames=("protocol", "max_rounds",
+                              "steps_per_round"))(_coverage_loop_rec)

@@ -1,0 +1,156 @@
+"""graftscope flight recorder: a device-side per-round ring buffer.
+
+The run-to-* loops are single compiled programs with zero host
+synchronization per round — exactly what makes them fast, and exactly
+what makes "why was round 37 slow/stuck" unanswerable after the fact:
+the packed summary (utils/accum.py) carries per-RUN aggregates only.
+This module adds the flight-recorder middle ground: a bounded
+``f32[capacity, K]`` ring of per-round records accumulated INSIDE the
+compiled ``lax.while_loop``/``lax.scan`` carries (one
+``dynamic_update_slice`` row write per round — no host sync, no shape
+growth with round count) and transferred once per run alongside the
+packed summary. Off by default; when enabled the ring is an explicit
+donated carry leaf (the graftaudit donation audit covers the
+recorder-enabled loops), and run RESULTS are bit-identical to
+recorder-off runs — the recorder only ever writes its own ring.
+
+Column schema (``REC_COLS``, one row per executed round):
+
+- ``round``     — 1-based global round index of this call (the wrap
+  key: with ``rounds > capacity`` the ring keeps the LAST ``capacity``
+  rounds; :func:`trim` re-orders oldest-first on the host).
+- ``occupancy`` — frontier occupancy (ops/frontier.py ints; the batch
+  loops record the union frontier's occupancy).
+- ``new``       — messages sent this round.
+- ``total``     — running message total (two-limb fold, f32 view — the
+  EXACT total stays in the packed summary; past 2^24 this column is an
+  approximation by construction).
+- ``coverage``  — the coverage numerator's loop-native form: the
+  engine's single-message loops record the coverage FRACTION (their
+  stat), the sharded flood loop the psum'd covered-node COUNT, the
+  batch loops the masked seen-count total over lanes.
+- ``active_lanes`` — running lanes (1 while a single-message loop
+  runs; the batch loops' admitted-and-unfinished count).
+- ``ici_bytes`` — the per-round ICI byte estimate of the loop's comm
+  backend (commviz census model; 0 on single-chip loops). Static per
+  compiled program — recorded in-row so a ring row is self-describing
+  after export.
+
+Everything here is shape-static: ``FlightRecorder`` is a frozen
+hashable config (a jit static argument), the ring an ordinary array
+leaf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["REC_COLS", "FlightRecorder", "FlightRecord", "write_row",
+           "trim"]
+
+#: Column order of one per-round record (module docstring).
+REC_COLS = ("round", "occupancy", "new", "total", "coverage",
+            "active_lanes", "ici_bytes")
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightRecorder:
+    """Static flight-recorder configuration: hashable, so the
+    recorder-enabled loop variants key jit caches on it like any other
+    static hyperparameter. ``capacity`` bounds the ring — a run longer
+    than it keeps the last ``capacity`` rounds (oldest rows
+    overwritten; ``FlightRecord.dropped`` reports how many)."""
+
+    capacity: int = 256
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(
+                f"flight-recorder capacity must be >= 1, got "
+                f"{self.capacity}")
+
+    def init(self) -> jax.Array:
+        """A fresh zeroed ring — built EAGERLY by the entry points so
+        the ring is a real donated input of the recorder-enabled loops
+        (a ring born inside the jit would be invisible to the donation
+        audit and double-buffer in HBM for the run)."""
+        return jnp.zeros((self.capacity, len(REC_COLS)), dtype=jnp.float32)
+
+
+def write_row(ring: jax.Array, round_index, *, occupancy, new, total,
+              coverage, active_lanes, ici_bytes) -> jax.Array:
+    """Write one per-round record at ``round_index % capacity``
+    (jittable; ``round_index`` is the 0-based count of rounds executed
+    BEFORE this one — the row's ``round`` column is 1-based). All
+    values are cast to f32 — this is telemetry, the exact counters stay
+    in the packed summary."""
+    row = jnp.stack([
+        jnp.float32(round_index + 1),
+        jnp.float32(occupancy),
+        jnp.float32(new),
+        jnp.float32(total),
+        jnp.float32(coverage),
+        jnp.float32(active_lanes),
+        jnp.float32(ici_bytes),
+    ])
+    slot = jnp.mod(jnp.int32(round_index), ring.shape[0])
+    return jax.lax.dynamic_update_slice(ring, row[None, :],
+                                        (slot, jnp.int32(0)))
+
+
+def total_f32(hi, lo) -> jax.Array:
+    """The two-limb message accumulator as one f32 (the ``total``
+    column's view — approximate past 2^24 by construction)."""
+    return (hi.astype(jnp.float32) * jnp.float32(2.0 ** 32)
+            + lo.astype(jnp.float32))
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightRecord:
+    """Host-side view of one run's ring: rows oldest-first, trimmed to
+    the rounds actually executed. ``dropped`` counts rounds whose rows
+    were overwritten (``rounds > capacity``)."""
+
+    rows: np.ndarray        # f32[min(rounds, capacity), len(REC_COLS)]
+    rounds: int             # rounds executed this call
+    capacity: int
+    dropped: int
+
+    @property
+    def columns(self):
+        return REC_COLS
+
+    def column(self, name: str) -> np.ndarray:
+        return self.rows[:, REC_COLS.index(name)]
+
+    def as_dict(self) -> dict:
+        """JSON-able form (artifacts, /trace tooling): column lists
+        keyed by name plus the wrap accounting."""
+        return {
+            "rounds": self.rounds,
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "columns": {name: self.column(name).tolist()
+                        for name in REC_COLS},
+        }
+
+
+def trim(ring: np.ndarray, rounds: int) -> FlightRecord:
+    """Re-order a transferred ring oldest-first and trim to the rounds
+    executed (host-side inverse of the in-loop wrap)."""
+    ring = np.asarray(ring)
+    capacity = int(ring.shape[0])
+    rounds = int(rounds)
+    if rounds <= capacity:
+        rows = np.array(ring[:rounds])
+        dropped = 0
+    else:
+        start = rounds % capacity
+        rows = np.roll(ring, -start, axis=0)
+        dropped = rounds - capacity
+    return FlightRecord(rows=rows, rounds=rounds, capacity=capacity,
+                        dropped=dropped)

@@ -50,6 +50,7 @@ from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim import engine
 from p2pnetwork_tpu.supervise.store import CheckpointStore
 from p2pnetwork_tpu.supervise.watchdog import Watchdog
+from p2pnetwork_tpu.telemetry import spans
 
 __all__ = ["SupervisedRun", "Preempted"]
 
@@ -237,8 +238,22 @@ class SupervisedRun:
     def _drive(self, mode: str, key, total_target: int, *,
                coverage_target: float = 0.99, steps_per_round: int = 1,
                resume: bool = True) -> tuple:
+        # graftscope trace plane: one supervised_run span per drive,
+        # chunk boundaries / checkpoints / resumes as point events under
+        # it (telemetry/spans.py; no-ops when no tracer is installed).
+        with spans.span("supervised_run", mode=mode):
+            return self._drive_under_span(
+                mode, key, total_target, coverage_target=coverage_target,
+                steps_per_round=steps_per_round, resume=resume)
+
+    def _drive_under_span(self, mode: str, key, total_target: int, *,
+                          coverage_target: float = 0.99,
+                          steps_per_round: int = 1,
+                          resume: bool = True) -> tuple:
         state, base_key, total, messages, resumed_from = \
             self._restore_or_init(key, resume)
+        if resumed_from is not None:
+            spans.emit("resume", round=total)
         last_ckpt_round, t_last_ckpt = total, time.monotonic()
         coverage = None
         chunks = n_ckpts = 0
@@ -319,6 +334,9 @@ class SupervisedRun:
                     last_ckpt_round, t_last_ckpt = total, time.monotonic()
                     n_ckpts += 1
                     checkpointed = True
+                    spans.emit("checkpoint", round=total, path=last_path)
+                spans.emit("chunk", round=total, executed=executed,
+                           checkpointed=checkpointed)
                 if self.on_chunk is not None:
                     self.on_chunk(self, {
                         "round": total, "executed": executed,

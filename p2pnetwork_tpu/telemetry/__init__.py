@@ -5,10 +5,15 @@
   process-wide plane every instrumentation site reports to.
 - :mod:`~p2pnetwork_tpu.telemetry.export` — Prometheus text exposition and
   the shared JSONL schema (metric samples and EventLog events interleave).
-- :mod:`~p2pnetwork_tpu.telemetry.httpd` — ``/metrics`` scrape endpoint on
-  a stdlib HTTP server.
+- :mod:`~p2pnetwork_tpu.telemetry.httpd` — ``/metrics`` / ``/history`` /
+  ``/trace`` scrape endpoints on a stdlib HTTP server.
 - :mod:`~p2pnetwork_tpu.telemetry.jaxhooks` — jit compile count / wall-time
   bridged from ``jax.monitoring`` (gated: works without jax installed).
+- :mod:`~p2pnetwork_tpu.telemetry.spans` — the graftscope trace plane:
+  trace ids + spans with parent links, per-lane lifecycle events,
+  Chrome/Perfetto and JSONL exporters.
+- :mod:`~p2pnetwork_tpu.telemetry.history` — the graftscope history ring:
+  a bounded gauge time-series sampled once per engine run summary.
 """
 
 from p2pnetwork_tpu.telemetry.registry import (
@@ -19,12 +24,20 @@ from p2pnetwork_tpu.telemetry.registry import (
 from p2pnetwork_tpu.telemetry.export import (
     event_record, metric_records, to_prometheus, write_jsonl,
 )
+from p2pnetwork_tpu.telemetry.history import (
+    History, default_history, set_default_history,
+)
 from p2pnetwork_tpu.telemetry.httpd import MetricsServer
+from p2pnetwork_tpu.telemetry.spans import (
+    Tracer, current_tracer, install_tracer, uninstall_tracer,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
     "DEFAULT_LATENCY_BUCKETS", "DEFAULT_SIZE_BUCKETS",
     "default_registry", "set_default_registry", "exponential_buckets",
     "event_record", "metric_records", "to_prometheus", "write_jsonl",
+    "History", "default_history", "set_default_history",
     "MetricsServer",
+    "Tracer", "current_tracer", "install_tracer", "uninstall_tracer",
 ]

@@ -2,7 +2,13 @@
 
 ``GET /metrics`` serves Prometheus text exposition (what a Prometheus
 scraper or ``curl`` reads); ``GET /metrics.json`` serves the registry
-snapshot as JSON for ad-hoc tooling. Zero dependencies —
+snapshot as JSON for ad-hoc tooling; ``GET /history`` serves the
+graftscope history ring (the sampled gauge time-series,
+:mod:`p2pnetwork_tpu.telemetry.history`); ``GET /trace`` serves the
+installed trace plane as Chrome/Perfetto trace-event JSON
+(:mod:`p2pnetwork_tpu.telemetry.spans` — save it and load at
+https://ui.perfetto.dev; an empty ``traceEvents`` array when no tracer
+is installed, so the endpoint is always parseable). Zero dependencies —
 ``http.server.ThreadingHTTPServer`` on one daemon thread — so a live
 sockets deployment can be watched without installing anything
 (GETTING_STARTED.md "Observability").
@@ -16,13 +22,15 @@ from typing import Any, Optional
 
 from p2pnetwork_tpu import concurrency
 from p2pnetwork_tpu.telemetry.registry import Registry, default_registry
-from p2pnetwork_tpu.telemetry import export
+from p2pnetwork_tpu.telemetry import export, history, spans
 
 __all__ = ["MetricsServer"]
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
-    registry: Registry  # stamped onto the subclass by MetricsServer
+    registry: Registry      # stamped onto the subclass by MetricsServer
+    history: Optional[Any]  # History or None (None = process default)
+    tracer: Optional[Any]   # Tracer or None (None = installed tracer)
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
         path = self.path.split("?", 1)[0]
@@ -31,6 +39,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/metrics.json":
             body = json.dumps(self.registry.snapshot()).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/history":
+            hist = self.history if self.history is not None \
+                else history.default_history()
+            body = json.dumps(hist.snapshot()).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/trace":
+            tracer = self.tracer if self.tracer is not None \
+                else spans.current_tracer()
+            doc = tracer.to_chrome() if tracer is not None \
+                else {"traceEvents": [], "displayTimeUnit": "ms"}
+            body = json.dumps(doc).encode("utf-8")
             ctype = "application/json"
         else:
             self.send_error(404)
@@ -49,15 +69,25 @@ class MetricsServer:
     """Serve ``registry`` over HTTP on a background daemon thread.
 
     ``port=0`` binds an ephemeral port (read it back from ``.port`` after
-    :meth:`start`). Usable as a context manager::
+    :meth:`start`). ``history``/``tracer`` bind a specific history ring /
+    trace collector to ``/history`` and ``/trace``; by default those
+    endpoints follow the process-wide
+    :func:`~p2pnetwork_tpu.telemetry.history.default_history` and the
+    tracer installed via
+    :func:`~p2pnetwork_tpu.telemetry.spans.install_tracer`, resolved per
+    request. Usable as a context manager::
 
         with MetricsServer(port=0) as srv:
             print(f"curl http://127.0.0.1:{srv.port}/metrics")
     """
 
     def __init__(self, registry: Optional[Registry] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 history: Optional[Any] = None,
+                 tracer: Optional[Any] = None):
         self.registry = registry or default_registry()
+        self.history = history
+        self.tracer = tracer
         self.host = host
         self.port = port
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
@@ -67,7 +97,8 @@ class MetricsServer:
         if self._httpd is not None:
             return self
         handler = type("BoundHandler", (_Handler,),
-                       {"registry": self.registry})
+                       {"registry": self.registry, "history": self.history,
+                        "tracer": self.tracer})
         self._httpd = http.server.ThreadingHTTPServer(
             (self.host, self.port), handler)
         self.port = self._httpd.server_address[1]

@@ -70,6 +70,12 @@ def pytest_configure(config):
         "backends bit-identical across the sharded protocol sweep and "
         "the lane-word batched path, plus the ICI byte accounting "
         "(select with -m ring; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "scope: graftscope observability tests — flight-recorder parity "
+        "+ overhead ratchet, trace-plane span trees / Perfetto export, "
+        "history ring + /history endpoint, probe_log and profiler "
+        "wiring (select with -m scope; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")
