@@ -9,7 +9,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
-	batch-check ring-check
+	batch-check ring-check scope-check serve-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -106,6 +106,14 @@ ring-check:
 # 1.10x overhead ratchet runs with -m 'scope and slow').
 scope-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_graftscope.py -q
+
+# graftserve serving plane: submit/poll/stream lifecycle, admission
+# pacing + quotas + structured load shedding, seeded-traffic
+# determinism, preempt/resume bit-identity, and the HTTP endpoints
+# riding the telemetry httpd (tox env "serve"; the slow-marked
+# 1k-concurrent-lane 100k-node soak runs with -m 'serve and slow').
+serve-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_serve.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

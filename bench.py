@@ -449,6 +449,79 @@ def bench_batched():
     return col
 
 
+def bench_serving():
+    """The ``serving`` bench column: seeded open-loop traffic
+    (serve/traffic.py — Poisson arrivals, hot-key skew, diurnal bursts)
+    through the admission-controlled SimService on the batched column's
+    100k-node WS class, driven synchronously (deterministic). Publishes
+    the serving-SLO numbers ROADMAP item 2 asks for: sustained lanes/s
+    (completed tickets over the drive wall), submit→completion p50/p99
+    in engine rounds (queue wait included), peak concurrent lanes, and
+    the shed rate of the structured load-shedding path. Env seams:
+    BENCH_SERVE_CAP (lane capacity, default 1024), BENCH_SERVE_TICKS,
+    BENCH_SERVE_RATE (arrivals/tick; default oversubscribes capacity so
+    the queue and shed path engage), BENCH_SERVE_CHUNK (engine rounds
+    per tick). Failure must not sink the stage — callers catch and
+    record the error."""
+    from p2pnetwork_tpu.serve import SimService, TrafficPattern
+    from p2pnetwork_tpu.serve import drive as serve_drive
+    from p2pnetwork_tpu.serve import generate as serve_generate
+
+    cap = int(os.environ.get("BENCH_SERVE_CAP", 1024))
+    ticks = int(os.environ.get("BENCH_SERVE_TICKS", 16))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", cap / 3.0))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", 4))
+    _, name, build = _graph_spec_batch()
+    g, build_s, cached = _cached_graph(name, build)
+    pattern = TrafficPattern(
+        ticks=ticks, rate=rate, hot_fraction=0.5, hot_keys=32,
+        diurnal_amplitude=0.3, diurnal_period=max(ticks / 2.0, 1.0),
+        burst_prob=0.125, burst_mult=3.0, coverage_target=0.99)
+    sched = serve_generate(pattern, g.n_nodes, seed=0)
+    # Warm the (capacity, chunk_rounds) engine program on a scratch
+    # service first — the batched column warms up the same way; a cold
+    # drive would charge one-time XLA compile to the SLO headline.
+    warm = SimService(g, capacity=cap, queue_depth=cap, chunk_rounds=chunk,
+                      seed=0)
+    warm.submit(0)
+    warm.tick()
+    warm.close()
+    svc = SimService(g, capacity=cap, queue_depth=cap, chunk_rounds=chunk,
+                     seed=0)
+    t0 = time.perf_counter()
+    out = serve_drive(svc, sched)
+    wall = time.perf_counter() - t0
+    stats = svc.stats()
+    offered = out["submitted"] + len(out["shed"])
+    col = {
+        "capacity": svc.capacity,
+        "n_nodes": g.n_nodes,
+        "ticks": ticks + out["drain_ticks"],
+        "chunk_rounds": chunk,
+        "wall_s": round(wall, 4),
+        "offered": offered,
+        "submitted": out["submitted"],
+        "completed": out["completed"],
+        "shed": len(out["shed"]),
+        "shed_rate": round(len(out["shed"]) / max(offered, 1), 4),
+        "peak_concurrent_lanes": out["peak_concurrent_lanes"],
+        "executed_rounds": out["executed_rounds"],
+        "sustained_lanes_per_s": round(out["completed"] / wall, 1),
+        "submit_to_completion_rounds_p50":
+            stats.get("completion_rounds_p50"),
+        "submit_to_completion_rounds_p99":
+            stats.get("completion_rounds_p99"),
+        "graph_build_s": round(build_s, 2),
+        "graph_cached": cached,
+    }
+    print(f"# serving cap={svc.capacity}: {col['sustained_lanes_per_s']} "
+          f"lanes/s sustained, peak {col['peak_concurrent_lanes']} "
+          f"concurrent, p99={col['submit_to_completion_rounds_p99']} "
+          f"rounds, shed_rate={col['shed_rate']}",
+          file=sys.stderr, flush=True)
+    return col
+
+
 def _graph_spec_multichip():
     """(n, cache name, build thunk) for the ``multichip`` column's ring
     class: plain segment-bucket layout — the ring pass carries its own
@@ -680,6 +753,21 @@ def bench_1m(record):
             print(f"# batched column failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
+    # The serving column (ROADMAP 2): seeded open-loop traffic through
+    # the admission-controlled service on the batched class — sustained
+    # lanes/s, submit→completion p50/p99, shed rate. Own try, same
+    # failure isolation as the batched column. BENCH_SERVE=0 disables
+    # (the cpu-fallback parent does: cap=1024 service ticks on the CPU
+    # backend would eat the stage timeout).
+    serving = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            serving = bench_serving()
+        except Exception as e:
+            serving = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# serving column failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+
     # The multichip column (the promoted dryrun_multichip): ring-sharded
     # flood over 8 devices — real chips when visible, the virtual CPU
     # mesh otherwise — in its own bounded child, so a wedged multi-device
@@ -712,7 +800,8 @@ def bench_1m(record):
     return {"graph_build_s": round(build_s, 4), "cache_hit": cached,
             "build_phases": build_phases,
             "supervised": supervised, "per_method": per_method,
-            "batched": batched, "multichip": multichip}
+            "batched": batched, "serving": serving,
+            "multichip": multichip}
 
 
 def bench_10m():
@@ -797,6 +886,12 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         # runs, batch_completion_rounds_p99 (empty for stages without
         # the column, error-carrying when it failed).
         "batched": tel.get("batched", {}),
+        # The serving column: seeded open-loop traffic through the
+        # admission-controlled SimService — sustained lanes/s,
+        # submit→completion p50/p99 rounds, peak concurrent lanes, shed
+        # rate (empty for stages without the column, error-carrying
+        # when it failed).
+        "serving": tel.get("serving", {}),
         # The multichip ring column: multi-device run-to-coverage wall,
         # scaling ratio vs a single-chip run of the same graph, and the
         # per-round ICI byte estimates of both halo-exchange backends
@@ -1160,6 +1255,8 @@ def main():
             # B=1024 on the CPU backend is minutes of extra wall — the
             # fallback's job is a real headline within the timeout.
             "BENCH_BATCH": os.environ.get("BENCH_BATCH", "0"),
+            # Same reasoning for the serving column's 1024-lane drive.
+            "BENCH_SERVE": os.environ.get("BENCH_SERVE", "0"),
         })
         if "error" in r1m:
             record["error"] = f"{err}; cpu fallback also failed: {r1m['error']}"

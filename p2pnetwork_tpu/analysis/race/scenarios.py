@@ -382,6 +382,83 @@ def _watchdog_emergency_checkpoint():
 
 
 @scenario(
+    "serve_admit_storm",
+    "The serving front-end's control plane under exploration: foreign "
+    "threads submit/poll/cancel/stream while the driver-role thread "
+    "runs admission ticks (retire → admit → engine chunk → harvest) — "
+    "the submit/poll/driver interleavings SimService._cond exists for, "
+    "driven from the exact thread roles the serve API documents.")
+def _serve_admit_storm():
+    try:
+        import jax  # noqa: F401
+        from p2pnetwork_tpu.serve.service import (  # noqa: F401
+            Rejected, SimService)
+        from p2pnetwork_tpu.sim import graph as G
+    except Exception as e:  # pragma: no cover - jax-less image
+        raise ScenarioUnavailable(f"needs jax/serve: {e}") from e
+    # Built OUTSIDE the managed world: the graph is immutable input, and
+    # its construction (native sorts, jit warmup) is not under test.
+    g = G.watts_strogatz(24, 4, 0.1, seed=1, source_csr=True)
+    # Warm the engine path outside the managed world too: the first
+    # batched run lazily registers the default-registry sim_* families
+    # (and compiles the batch loop). Registered under an installed
+    # provider, those PROCESS-GLOBAL metric locks would be bound to one
+    # schedule's scheduler and explode in the next ("graftrace
+    # primitives are confined to managed tasks"); warmed here they are
+    # raw stdlib locks, and every explored schedule starts compile-hot.
+    warm = SimService(g, capacity=8, queue_depth=3, chunk_rounds=4, seed=0)
+    warm.submit(1)
+    warm.tick()
+    warm.close()
+
+    def body():
+        from p2pnetwork_tpu.serve.service import Rejected, SimService
+        reg = _fresh_registry()
+        svc = watch(SimService(
+            g, capacity=8, queue_depth=3, chunk_rounds=4, seed=0,
+            quotas={"metered": (1.0, 2.0)}, registry=reg))
+
+        def driver_role():
+            # The admission-control loop's share, run synchronously so
+            # a wedged schedule is a graftrace deadlock, not a hang.
+            for _ in range(3):
+                svc.tick()
+
+        def submitter_a():
+            for s in (1, 2, 3):
+                try:
+                    svc.submit(s)
+                except Rejected:
+                    pass  # load shed is a designed outcome, not a bug
+
+        def submitter_b():
+            for s in (4, 5):
+                try:
+                    svc.submit(s, tenant="metered")
+                except Rejected:
+                    pass
+
+        def prober():
+            svc.poll("t00000000")
+            svc.stats()
+            svc.busy()
+            svc.tickets()
+            svc.cancel("t00000001")
+            svc.poll("t-unknown")
+
+        ts = [concurrency.thread(target=f, name=nm)
+              for nm, f in (("driver", driver_role),
+                            ("sub-a", submitter_a), ("sub-b", submitter_b),
+                            ("probe", prober))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+        svc.close()
+    return body
+
+
+@scenario(
     "partition_heal",
     "The PR 2 partition-heal soak's control plane under exploration: "
     "partition, concurrent traffic probing link_ok on both sides, heal, "

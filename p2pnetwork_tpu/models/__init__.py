@@ -32,7 +32,9 @@ from p2pnetwork_tpu.models.components import (
 from p2pnetwork_tpu.models.flood import Flood, FloodState
 from p2pnetwork_tpu.models.messagebatch import (
     BatchFlood,
+    LaneExhausted,
     MessageBatch,
+    free_lane_count,
     lane_frontier,
     lane_messages,
     lane_seen,
@@ -80,6 +82,7 @@ __all__ = [
     "transitivity",
     "transitivity_sample",
     "triangles_per_node",
+    "free_lane_count",
     "lane_frontier",
     "lane_messages",
     "lane_seen",
@@ -88,6 +91,7 @@ __all__ = [
     "AntiEntropy",
     "AntiEntropyState",
     "BatchFlood",
+    "LaneExhausted",
     "MessageBatch",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",

@@ -44,6 +44,27 @@ from p2pnetwork_tpu.sim.graph import Graph
 from p2pnetwork_tpu.telemetry import spans
 
 
+class LaneExhausted(ValueError):
+    """Admission refused: more messages than open lanes.
+
+    Lane exhaustion is the batch plane's DESIGNED backpressure signal
+    (PR 10) — but a bare ``ValueError`` forced the serving front-end to
+    string-match to distinguish "back off and queue" from a genuine
+    usage error. This subclass keeps every existing ``except ValueError``
+    working (back-compat pinned in tests) while carrying the numbers an
+    admission controller acts on: how many lanes were ``requested``, how
+    many are ``free``, and the batch ``capacity``."""
+
+    def __init__(self, requested: int, free_lanes: int, capacity: int):
+        self.requested = int(requested)
+        self.free_lanes = int(free_lanes)
+        self.capacity = int(capacity)
+        super().__init__(
+            f"admit of {self.requested} messages into a batch with only "
+            f"{self.free_lanes} open lanes of {self.capacity} — "
+            "retire completed lanes or grow capacity")
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class MessageBatch:
@@ -180,9 +201,10 @@ class BatchFlood:
         ``cov0`` exactly: the seed is masked by ``node_mask``, and a lane
         already at target (tiny graphs, dead sources never — a dead
         source seeds nothing and floods nothing, spinning to max_rounds
-        like the single-message run) starts ``done``. Raises when open
-        lanes run out — that is the backpressure signal, not a silent
-        drop."""
+        like the single-message run) starts ``done``. Raises
+        :class:`LaneExhausted` when open lanes run out — that is the
+        backpressure signal, not a silent drop (its fields carry what an
+        admission controller needs to back off)."""
         sources = np.asarray(sources, dtype=np.int32).reshape(-1)
         if sources.size == 0:  # an idle admission tick is a no-op
             return batch, np.zeros(0, dtype=np.int32)
@@ -191,10 +213,8 @@ class BatchFlood:
             base.validate_source(graph, int(sources[bad.argmax()]))
         open_lanes = np.flatnonzero(~np.asarray(batch.admitted))
         if sources.size > open_lanes.size:
-            raise ValueError(
-                f"admit of {sources.size} messages into a batch with only "
-                f"{open_lanes.size} open lanes of {batch.capacity} — "
-                "retire completed lanes or grow capacity")
+            raise LaneExhausted(sources.size, open_lanes.size,
+                                batch.capacity)
         lanes = open_lanes[:sources.size].astype(np.int32)
         src = jnp.asarray(sources)
         # Seed scatter: bit L of word w at each source node. Two admitted
@@ -380,6 +400,17 @@ class BatchFlood:
             batch, seen=seen, frontier=frontier_next, sent=sent,
             done=done, rounds=rounds, seen_count=seen_count,
         ), stats
+
+
+def free_lane_count(batch: MessageBatch) -> int:
+    """How many lanes :meth:`BatchFlood.admit` can still seed, read from
+    the device state (one small host transfer — admission is
+    control-plane work between engine calls, so the sync is off the hot
+    loop). NB: graftserve's SimService deliberately does NOT use this —
+    it tracks lane occupancy host-side so it can exclude cancel-pending
+    lanes the device still shows admitted (serve/service.py tick()); this
+    helper is for direct users of the admit/retire seam."""
+    return int(batch.capacity - np.count_nonzero(np.asarray(batch.admitted)))
 
 
 def lane_messages(graph: Graph, batch: MessageBatch) -> jax.Array:

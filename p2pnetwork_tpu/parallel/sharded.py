@@ -4117,6 +4117,9 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
         )
         t2 = time.perf_counter()
         newly = out["lane_done"] & ~done0
+        # Engine-contract parity: the lanes completed in THIS call (the
+        # serving front-end's harvest set) ride the summary here too.
+        out["newly_completed_lanes"] = np.flatnonzero(newly).astype(np.int32)
         newly_rounds = out["lane_rounds"][newly]
         if newly_rounds.size:
             out["completion_rounds_p50"] = float(

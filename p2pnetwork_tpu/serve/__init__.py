@@ -1,0 +1,43 @@
+"""graftserve: simulation-as-a-service over the batched message plane.
+
+The serving front-end ROADMAP item 2 asks for — a submit/poll/stream
+request plane (:class:`SimService`, also mountable on the telemetry
+httpd as ``/submit`` / ``/poll/<ticket>`` / ``/cancel/<ticket>`` /
+``/stats`` via ``MetricsServer(service=...)``), an admission-control
+driver pacing ``BatchFlood.admit`` off live lane occupancy and observed
+completion percentiles, bounded queueing with structured load shedding
+and per-tenant token-bucket quotas, supervise-plane crash tolerance
+(checkpointed batch + sidecar ticket table, bit-identical resume), and
+a seeded open-loop traffic generator (:mod:`~p2pnetwork_tpu.serve.traffic`:
+Poisson arrivals, hot-key skew, diurnal bursts — byte-replayable) that
+makes "heavy traffic" a reproducible workload. See GETTING_STARTED.md
+"Simulation as a service".
+"""
+
+from p2pnetwork_tpu.serve.service import (
+    QueueFull,
+    QuotaExceeded,
+    Rejected,
+    ServiceClosed,
+    SimService,
+    TERMINAL_STATES,
+)
+from p2pnetwork_tpu.serve.traffic import (
+    TrafficPattern,
+    TrafficSchedule,
+    drive,
+    generate,
+)
+
+__all__ = [
+    "QueueFull",
+    "QuotaExceeded",
+    "Rejected",
+    "ServiceClosed",
+    "SimService",
+    "TERMINAL_STATES",
+    "TrafficPattern",
+    "TrafficSchedule",
+    "drive",
+    "generate",
+]

@@ -1,0 +1,1234 @@
+"""SimService: the batched message plane as a long-lived service.
+
+PR 10 built the engine room — ``engine.run_batch_until_coverage``
+advances B in-flight floods per compiled round, lane exhaustion is the
+designed backpressure signal, ``BatchFlood.admit``/``retire`` are the
+staggered-admission seam — but nothing *served* it: the north-star
+"heavy traffic from millions of users" (ROADMAP item 2) needs a
+front-end that owns queueing, admission pacing, quotas, load shedding
+and crash recovery. This module is that front-end, composing four
+existing planes into one stateful process:
+
+- **request plane** — :meth:`SimService.submit` /
+  :meth:`~SimService.poll` / :meth:`~SimService.cancel` plus the
+  blocking :meth:`~SimService.wait` / :meth:`~SimService.stream` APIs;
+  the same surface rides the telemetry httpd as ``/submit``,
+  ``/poll/<ticket>``, ``/cancel/<ticket>``, ``/stats`` next to
+  ``/metrics``/``/history``/``/trace`` (``MetricsServer(service=...)``);
+- **admission control** — a driver loop (:meth:`~SimService.tick`, run
+  by a background thread or driven synchronously for deterministic
+  tests) that paces ``BatchFlood.admit`` off the live active-lane count
+  (the host-side twin of the ``sim_batch_active_lanes`` gauge) and the
+  engine's observed completion-rounds percentiles (AIMD: a p99 past
+  ``slo_rounds`` halves the per-tick admit budget, a healthy tick grows
+  it back), runs the batch loop in ``chunk_rounds``-round chunks,
+  harvests completed lanes back into a bounded FIFO of results, and
+  load-sheds with a STRUCTURED reject (:class:`QueueFull` /
+  :class:`QuotaExceeded`, counted into ``serve_rejected_total{reason}``)
+  instead of erroring when lanes and queue exhaust;
+- **crash tolerance** — the supervise-plane patterns over the donatable
+  :class:`~p2pnetwork_tpu.models.messagebatch.MessageBatch` pytree:
+  chunk keys are ``fold_in(base_key, round + 1)`` so resumed chunks walk
+  the identical RNG/boundary schedule, the batch checkpoints into a
+  :class:`~p2pnetwork_tpu.supervise.store.CheckpointStore` at tick
+  boundaries with the control-plane ticket table in an atomically
+  rename-published sidecar (``service_state.json``, referencing the
+  exact checkpoint entry it describes), and a mid-flight kill
+  (:class:`~p2pnetwork_tpu.supervise.runner.Preempted` via
+  :meth:`~SimService.arm_preemption`, or a real SIGKILL) resumes with
+  zero lost admitted lanes and per-lane results bit-identical to an
+  uninterrupted run (tests/test_serve.py pins it);
+- **determinism** — every control decision is a function of (tick,
+  round, queue order, seed): quota buckets refill per tick, not per
+  wall-second; ticket ids are a persisted counter; records store ticks
+  and rounds, never wall timestamps (wall-clock latency lives only in
+  the ``serve_latency_seconds`` histogram) — so a seeded traffic replay
+  (serve/traffic.py) produces byte-identical per-ticket summaries.
+
+Threading: control-plane state (tickets, queue, quotas, counters) is
+guarded by one condition; the device-side batch is confined to the
+single driver (whoever calls :meth:`~SimService.tick` — the background
+thread in production, the test/bench harness in deterministic mode).
+All service threads go through the concurrency seam, so graftrace can
+explore submit/poll/driver interleavings (the ``serve_admit_storm``
+scenario in the race battery).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from p2pnetwork_tpu import concurrency, telemetry
+from p2pnetwork_tpu.models.messagebatch import BatchFlood
+from p2pnetwork_tpu.sim import checkpoint as ckpt
+from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.supervise.runner import Preempted
+from p2pnetwork_tpu.supervise.store import (CheckpointStore,
+                                             atomic_write_json)
+from p2pnetwork_tpu.supervise.watchdog import Watchdog
+from p2pnetwork_tpu.telemetry import spans
+
+__all__ = [
+    "SimService", "Rejected", "QueueFull", "QuotaExceeded",
+    "ServiceClosed", "TERMINAL_STATES",
+]
+
+_SIDECAR = "service_state.json"
+
+#: Ticket states a record never leaves.
+TERMINAL_STATES = frozenset({"done", "cancelled", "timeout"})
+
+#: Submit→completion latency buckets (rounds, queue wait included):
+#: floods complete in O(diameter) rounds, queue wait adds chunk-sized
+#: steps, so geometric 1..4096 covers both.
+_LATENCY_ROUND_BUCKETS = telemetry.exponential_buckets(1.0, 2.0, 13)
+
+
+class Rejected(RuntimeError):
+    """Structured load-shed: the service refused an admission and says
+    why, with the numbers the client needs to back off. Subclasses pin
+    the reason; :meth:`to_dict` is the HTTP 429 payload."""
+
+    reason = "rejected"
+
+    def __init__(self, message: str, **details):
+        self.details = dict(details)
+        super().__init__(message)
+
+    def to_dict(self) -> dict:
+        return {"error": "rejected", "reason": self.reason, **self.details}
+
+
+class QueueFull(Rejected):
+    """The bounded submit FIFO is at ``queue_depth`` — the surfaced form
+    of lane backpressure (the queue only builds while admission runs
+    behind arrivals); carries the occupancy numbers to back off on."""
+
+    reason = "queue_full"
+
+
+class QuotaExceeded(Rejected):
+    """The tenant's token bucket is empty this tick."""
+
+    reason = "quota"
+
+
+class ServiceClosed(RuntimeError):
+    """The service was closed (or its driver died); no more admissions."""
+
+
+class SimService:
+    """Simulation-as-a-service over ``engine.run_batch_until_coverage``.
+
+    Parameters
+    ----------
+    graph, protocol:
+        The graph to serve broadcasts on and the batched protocol
+        (default :class:`~p2pnetwork_tpu.models.messagebatch.BatchFlood`).
+    capacity:
+        Lane capacity of the batch (rounded up to a whole 32-lane word —
+        the real capacity is ``service.capacity``).
+    queue_depth:
+        Strict bound of the submit FIFO: a submit arriving with the
+        queue at this depth is shed with :class:`QueueFull`. The queue
+        drains only at tick boundaries, so it builds exactly when
+        admission (lanes + pacing) runs behind arrivals — and
+        ``queue_depth=0`` sheds every submit (a deliberate
+        drain/maintenance mode; the smallest useful depth is 1).
+    chunk_rounds:
+        Engine rounds per driver tick (one compiled dispatch); smaller
+        chunks mean finer admission/checkpoint granularity.
+    max_ticket_rounds:
+        A lane still unfinished after this many applied rounds is cut
+        off: its ticket ends ``"timeout"`` (disconnected sources would
+        otherwise hold a lane forever).
+    seed:
+        Base PRNG seed; chunk keys are ``fold_in(key(seed), round + 1)``
+        (the supervise-plane schedule, so resume re-walks it).
+    store / resume / checkpoint_every_ticks / retain:
+        Crash tolerance: a :class:`CheckpointStore` (or directory path)
+        the driver checkpoints the batch into every
+        ``checkpoint_every_ticks`` ticks, with the ticket table in an
+        atomic sidecar. ``resume=True`` (default) restores the newest
+        consistent (checkpoint, sidecar) pair at construction;
+        ``resume=False`` clears any previous trail.
+    quotas:
+        Per-tenant token buckets: ``{tenant: (refill_per_tick, burst)}``.
+        Unlisted tenants are unlimited. Buckets refill at tick
+        boundaries (deterministic), not per wall-second.
+    max_active_lanes / slo_rounds:
+        Admission pacing. ``max_active_lanes`` caps concurrently running
+        lanes (default: full capacity). ``slo_rounds`` arms the AIMD
+        controller: a chunk whose completion-rounds p99 exceeds it
+        halves the per-tick admit budget; a healthy chunk adds
+        ``capacity/16`` back (floor 1, ceiling the active-lane cap).
+    done_retention:
+        Terminal ticket records kept pollable (oldest evicted past the
+        bound, so a long-lived service's table — and its sidecar — stay
+        bounded).
+    record_seen_hash:
+        When True, each completed ticket's summary carries a sha256 of
+        its lane's packed ``seen`` bits — the bit-identity witness the
+        chaos-soak comparison uses (costs one host pull of the packed
+        words per harvesting tick; off by default).
+    deadline_s / on_stall:
+        Optional supervise-plane watchdog over driver ticks (heartbeat
+        per tick; see supervise/watchdog.py for the stall modes).
+    idle_wait_s:
+        Background-driver poll interval while idle.
+    """
+
+    def __init__(self, graph, protocol: Optional[BatchFlood] = None, *,
+                 capacity: int = 64, queue_depth: int = 256,
+                 chunk_rounds: int = 16, max_ticket_rounds: int = 1024,
+                 seed: int = 0,
+                 store: Union[CheckpointStore, str, None] = None,
+                 resume: bool = True, checkpoint_every_ticks: int = 1,
+                 retain: int = 3,
+                 quotas: Optional[Dict[str, Tuple[float, float]]] = None,
+                 max_active_lanes: Optional[int] = None,
+                 slo_rounds: Optional[float] = None,
+                 done_retention: int = 4096,
+                 record_seen_hash: bool = False,
+                 deadline_s: Optional[float] = None,
+                 on_stall: Union[str, Callable] = "raise",
+                 idle_wait_s: float = 0.05,
+                 registry: Optional[telemetry.Registry] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if queue_depth < 0:
+            raise ValueError("queue_depth must be >= 0")
+        if chunk_rounds < 1:
+            raise ValueError("chunk_rounds must be >= 1")
+        if checkpoint_every_ticks < 1:
+            raise ValueError("checkpoint_every_ticks must be >= 1")
+        if done_retention < 1:
+            raise ValueError("done_retention must be >= 1")
+        self.graph = graph
+        self._protocol = protocol if protocol is not None else BatchFlood()
+        self._batch = self._protocol.empty(graph, capacity)
+        #: Real lane capacity (requested, rounded up to a whole word).
+        self.capacity = self._batch.capacity
+        self.queue_depth = int(queue_depth)
+        self.chunk_rounds = int(chunk_rounds)
+        self.max_ticket_rounds = int(max_ticket_rounds)
+        self.checkpoint_every_ticks = int(checkpoint_every_ticks)
+        self.done_retention = int(done_retention)
+        self.seed = int(seed)
+        self._base_key = jax.random.key(self.seed)
+        self._n_live = int(np.sum(np.asarray(graph.node_mask)))
+        self._quotas = {str(t): (float(r), float(b))
+                        for t, (r, b) in (quotas or {}).items()}
+        for t, (r, b) in self._quotas.items():
+            if r < 0 or b <= 0:
+                raise ValueError(f"quota for {t!r} needs rate >= 0, burst > 0")
+        # `is not None`, not truthiness: max_active_lanes=0 must be a
+        # loud error, not a silent full-capacity default, and
+        # slo_rounds=0.0 (the strictest possible SLO) must not silently
+        # DISABLE pacing.
+        if max_active_lanes is not None:
+            max_active_lanes = int(max_active_lanes)
+            if max_active_lanes < 1:
+                raise ValueError("max_active_lanes must be >= 1 "
+                                 "(use close() or quotas to pause intake)")
+            self._target_active = min(max_active_lanes, self.capacity)
+        else:
+            self._target_active = self.capacity
+        if slo_rounds is not None:
+            slo_rounds = float(slo_rounds)
+            if slo_rounds <= 0:
+                raise ValueError("slo_rounds must be > 0 (None disables "
+                                 "the AIMD controller)")
+        self.slo_rounds = slo_rounds
+        self._record_seen_hash = bool(record_seen_hash)
+        self.idle_wait_s = float(idle_wait_s)
+        self.deadline_s = deadline_s
+        self.on_stall = on_stall
+        self._registry = registry
+
+        # ---- control plane (everything below _cond is guarded by it) --
+        self._cond = concurrency.condition()
+        self._tickets: Dict[str, dict] = {}
+        self._queue: List[str] = []          # pending ticket ids, FIFO
+        self._lane_ticket: Dict[int, str] = {}   # running lanes only
+        self._cancel_lanes: List[int] = []   # cancelled mid-flight lanes
+        self._done_order: List[str] = []     # terminal tids, oldest first
+        self._buckets: Dict[str, float] = {
+            t: b for t, (_, b) in self._quotas.items()}
+        self._admit_budget = self._target_active
+        self._round = 0        # cumulative engine rounds
+        self._tick = 0         # completed driver ticks
+        self._next_ticket = 0
+        self._messages = 0     # cumulative exact message total
+        self._latencies: List[float] = []   # rolling completion rounds
+        self._counts = {"submitted": 0, "completed": 0, "cancelled": 0,
+                        "rejected": 0, "timeout": 0}
+        self._submit_walls: Dict[str, float] = {}
+        #: Anything the sidecar records changed since the last published
+        #: pair — gates checkpointing so an IDLE background driver
+        #: (ticking every idle_wait_s for quota refill) does not
+        #: re-serialize the full batch 20x a second forever.
+        self._dirty = False
+        self._closed = False
+        self._driver_error: Optional[str] = None
+        self._preempt_at: Optional[int] = None
+
+        # ---- driver-confined (only the tick() caller touches these) ---
+        self._retire_ready: List[int] = []   # harvested lanes to recycle
+        self._thread: Optional[Any] = None
+        self._watchdog: Optional[Watchdog] = None
+
+        reg = registry if registry is not None \
+            else telemetry.default_registry()
+        self._m_submitted = reg.counter(
+            "serve_submitted_total",
+            "Broadcast submissions accepted by the serving front-end.",
+            ("tenant",))
+        self._m_rejected = reg.counter(
+            "serve_rejected_total",
+            "Submissions load-shed by the serving front-end, by reason "
+            "(queue_full = lanes busy and the bounded FIFO at depth; "
+            "quota = tenant token bucket empty this tick).", ("reason",))
+        self._m_completed = reg.counter(
+            "serve_completed_total",
+            "Tickets whose broadcast reached its coverage target.")
+        self._m_cancelled = reg.counter(
+            "serve_cancelled_total", "Tickets cancelled by the client.")
+        self._m_timeout = reg.counter(
+            "serve_timeouts_total",
+            "Tickets cut off at max_ticket_rounds before reaching target.")
+        self._m_ticks = reg.counter(
+            "serve_ticks_total", "Driver admission-loop iterations.")
+        self._m_queue = reg.gauge(
+            "serve_queue_depth",
+            "Submissions waiting for a lane in the bounded FIFO.")
+        self._m_active = reg.gauge(
+            "serve_active_lanes",
+            "Lanes currently running a ticket's broadcast (the host-side "
+            "twin of sim_batch_active_lanes, sampled at tick boundaries).")
+        self._m_budget = reg.gauge(
+            "serve_admit_budget",
+            "Current per-tick admission budget (AIMD-paced when "
+            "slo_rounds is set).")
+        self._m_latency_rounds = reg.histogram(
+            "serve_completion_rounds",
+            "Submit-to-completion latency in engine rounds (queue wait "
+            "included), one observation per completed ticket.",
+            buckets=_LATENCY_ROUND_BUCKETS)
+        self._m_latency_s = reg.histogram(
+            "serve_latency_seconds",
+            "Submit-to-completion wall latency per completed ticket.")
+
+        self._store: Optional[CheckpointStore] = None
+        if store is not None:
+            self._store = store if isinstance(store, CheckpointStore) \
+                else CheckpointStore(store, retain=retain, registry=registry)
+            if self._store.retain < 2:
+                # retain=1 has a trail-losing window: save() of pair N+1
+                # prunes entry N BEFORE the new sidecar publishes, so a
+                # kill between the two leaves the surviving sidecar
+                # pointing at a deleted entry — resume would discard
+                # everything. Two entries guarantee the referenced one
+                # survives its successor's prune.
+                raise ValueError(
+                    "graftserve needs a checkpoint store with retain >= 2 "
+                    "(retain=1 can prune the entry the current sidecar "
+                    "references before the next sidecar lands)")
+            if resume:
+                self._try_resume()
+            else:
+                self._clear_trail()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SimService":
+        """Spawn the background driver thread (production mode). The
+        deterministic alternative is calling :meth:`tick` yourself —
+        serve/traffic.py's :func:`~p2pnetwork_tpu.serve.traffic.drive`
+        does, which is what makes seeded runs replayable."""
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed("service is closed")
+            if self._thread is not None:
+                return self
+            self._thread = concurrency.thread(  # graftlint: ignore[lock-open-call] -- the seam factory only constructs; start/close must agree on ONE driver
+                target=self._driver_loop, name="SimService-driver",
+                daemon=True)
+            self._thread.start()  # graftlint: ignore[lock-open-call] -- same single-driver atomicity; start() does not block
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the driver and refuse further submissions (idempotent).
+        Queued tickets stay ``queued``; a later service constructed on
+        the same store resumes them — which is why a clean close takes
+        one FINAL checkpoint after the driver has stopped: submissions
+        accepted since the last tick's boundary would otherwise be
+        absent from the trail (and their persisted ticket counter
+        rolled back, re-issuing their ids to different requests). The
+        final checkpoint is skipped when the driver died or cannot be
+        joined (the batch may be mid-mutation) and after a
+        :class:`Preempted` kill (resume semantics want the PRE-kill
+        durable pair)."""
+        with self._cond:
+            first_close = not self._closed
+            self._closed = True
+            thread = self._thread
+            self._thread = None
+            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        joined = True
+        if thread is not None:
+            thread.join(timeout=timeout)
+            joined = not thread.is_alive()
+        if self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
+        # Re-read the driver's fate AFTER the join: a tick in flight
+        # when close() started may still die (or fire an armed
+        # preemption) before it observes _closed — a pre-join snapshot
+        # would miss that and publish the forbidden post-kill pair.
+        with self._cond:
+            err = self._driver_error
+            dirty = self._dirty
+        if not joined:
+            warnings.warn(
+                "graftserve: close() timed out joining the driver thread "
+                "— it may still be mid-tick and could publish one more "
+                "checkpoint pair; do not resume a new service on the "
+                "same store until it exits", RuntimeWarning, stacklevel=2)
+        if (first_close and joined and err is None and dirty
+                and self._store is not None):
+            try:
+                self._checkpoint()
+            except Exception as e:  # a failing final save must not mask
+                # the close; the trail just ends at the last boundary.
+                warnings.warn(
+                    f"graftserve: final close checkpoint failed "
+                    f"({type(e).__name__}: {e}); the trail ends at the "
+                    "last tick boundary", RuntimeWarning, stacklevel=2)
+
+    def __enter__(self) -> "SimService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def arm_preemption(self, at_tick: int) -> None:
+        """Arm a one-shot deterministic kill: :class:`Preempted` raises
+        out of the tick whose completed-tick count reaches ``at_tick``,
+        BEFORE the checkpoint due at that boundary — exactly the damage
+        a real SIGKILL there inflicts (supervise-plane semantics). A new
+        service on the same store resumes from the last durable pair."""
+        with self._cond:
+            self._preempt_at = int(at_tick)
+
+    # ---------------------------------------------------------- request API
+
+    def submit(self, source: int, *, target_coverage: float = 0.99,
+               tenant: str = "default") -> str:
+        """Accept one broadcast request; returns its ticket id.
+
+        Sheds instead of erroring when the service is saturated: every
+        lane busy and the FIFO at ``queue_depth`` raises
+        :class:`QueueFull`; an empty tenant token bucket raises
+        :class:`QuotaExceeded` — both carry the backpressure numbers and
+        count into ``serve_rejected_total{reason}``. A bad ``source`` is
+        a caller error (plain ``ValueError``), not a shed."""
+        source = int(source)
+        if not 0 <= source < self.graph.n_nodes_padded:
+            raise ValueError(
+                f"source {source} outside node range "
+                f"[0, {self.graph.n_nodes_padded})")
+        target = float(target_coverage)
+        if not 0.0 < target <= 1.0:
+            raise ValueError(f"target_coverage must be in (0, 1], "
+                             f"got {target}")
+        tenant = str(tenant)
+        reject: Optional[Rejected] = None
+        # Wall timestamp taken before the lock, recorded inside it (in
+        # the same critical section that publishes the ticket): a
+        # second acquisition after publication would race a fast
+        # driver completing the ticket first, losing the
+        # serve_latency_seconds observation and leaking the entry.
+        # It feeds ONLY that histogram — records stay wall-free.
+        now = time.perf_counter()
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(
+                    self._driver_error or "service is closed")
+            if tenant in self._quotas and self._buckets.get(tenant, 0.0) < 1.0:
+                reject = QuotaExceeded(
+                    f"tenant {tenant!r} out of quota this tick "
+                    f"(refills at the next driver tick)",
+                    tenant=tenant,
+                    tokens=self._buckets.get(tenant, 0.0),
+                    refill_per_tick=self._quotas[tenant][0])
+            elif len(self._queue) >= self.queue_depth:
+                # The FIFO is strictly bounded: it only builds when
+                # admission (lanes + pacing) runs behind arrivals, so a
+                # full queue IS the lane-exhaustion backpressure signal,
+                # surfaced with the occupancy numbers a client backs
+                # off on.
+                reject = QueueFull(
+                    f"queue at depth {len(self._queue)}/"
+                    f"{self.queue_depth} with "
+                    f"{len(self._lane_ticket)}/{self.capacity} lanes "
+                    "busy — back off and retry",
+                    queue_depth=len(self._queue),
+                    queue_limit=self.queue_depth,
+                    active_lanes=len(self._lane_ticket),
+                    capacity=self.capacity)
+            else:
+                if tenant in self._quotas:
+                    self._buckets[tenant] -= 1.0
+                tid = f"t{self._next_ticket:08d}"
+                self._next_ticket += 1
+                self._tickets[tid] = {
+                    "ticket": tid, "tenant": tenant, "source": source,
+                    "target": target, "status": "queued",
+                    "submitted_tick": self._tick,
+                    "submitted_round": self._round,
+                    "admitted_tick": None, "admitted_round": None,
+                    "lane": None, "rounds": None, "seen_count": None,
+                    "coverage": None, "latency_rounds": None,
+                }
+                self._queue.append(tid)
+                self._submit_walls[tid] = now
+                self._dirty = True
+                self._counts["submitted"] += 1
+                depth = len(self._queue)
+                self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        if reject is not None:
+            with self._cond:
+                self._counts["rejected"] += 1
+                self._dirty = True  # shed counts survive resume too
+            self._m_rejected.labels(reject.reason).inc()
+            raise reject
+        # Bound metric cardinality: only configured tenants (and the
+        # default) get their own label child — arbitrary client-supplied
+        # tenant strings from the HTTP surface collapse to "other"
+        # (ticket records keep the raw tenant either way).
+        label = tenant if (tenant == "default" or tenant in self._quotas) \
+            else "other"
+        self._m_submitted.labels(label).inc()
+        self._m_queue.set(float(depth))
+        if spans.current_tracer() is not None:
+            spans.emit("ticket_submit", ticket=tid, source=source,
+                       tenant=tenant)
+        return tid
+
+    def poll(self, ticket: str) -> Optional[dict]:
+        """The ticket's current record (a copy), or ``None`` for an
+        unknown/evicted id. Records are fully deterministic — ticks,
+        rounds, counts; never wall timestamps."""
+        with self._cond:
+            rec = self._tickets.get(str(ticket))
+            return dict(rec) if rec is not None else None
+
+    def cancel(self, ticket: str) -> bool:
+        """Cancel a queued or running ticket; True when this call
+        transitioned it. A running lane is recycled at the next tick
+        boundary (its partial broadcast is abandoned)."""
+        cancelled = False
+        with self._cond:
+            if self._closed:
+                # Symmetric with submit(): after close nothing can reach
+                # the durable trail, so a cancellation must not be
+                # "accepted" and then silently lost on resume.
+                return False
+            rec = self._tickets.get(str(ticket))
+            if rec is not None and rec["status"] == "queued":
+                rec["status"] = "cancelled"
+                self._queue = [t for t in self._queue if t != rec["ticket"]]
+                self._mark_terminal_locked(rec["ticket"])
+                cancelled = True
+            elif rec is not None and rec["status"] == "running":
+                rec["status"] = "cancelled"
+                lane = rec["lane"]
+                if lane is not None:
+                    self._lane_ticket.pop(lane, None)
+                    self._cancel_lanes.append(lane)
+                # lane is None while the ticket is mid-admission (the
+                # driver popped it from the queue but has not assigned
+                # its lane yet): _admit_on_device sees the terminal
+                # status when it records the mapping and routes the
+                # freshly assigned lane to _cancel_lanes itself —
+                # appending None here would crash the next tick's
+                # retire and kill the driver.
+                self._mark_terminal_locked(rec["ticket"])
+                cancelled = True
+            if cancelled:
+                self._counts["cancelled"] += 1
+                self._dirty = True
+                self._submit_walls.pop(str(ticket), None)
+                self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        if cancelled:
+            self._m_cancelled.inc()
+        return cancelled
+
+    def wait(self, ticket: str, timeout: Optional[float] = None) -> dict:
+        """Block until the ticket reaches a terminal state; returns its
+        record. The await side of the API — ``/poll`` is the polling
+        side. Raises ``KeyError`` for unknown ids, ``TimeoutError`` on
+        deadline, :class:`ServiceClosed` if the driver dies first."""
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        snap, _, _ = self._await_ticket(ticket, deadline, timeout,
+                                        until_tick_change=False)
+        return snap
+
+    def stream(self, ticket: str, timeout: Optional[float] = None):
+        """Yield the ticket's record after every driver tick until it
+        goes terminal (the last yield) — the streaming view of
+        :meth:`wait`. Same error contract as :meth:`wait`."""
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        last_tick = -1
+        seen_once = False
+        while True:
+            snap, last_tick, seen_once = self._await_ticket(
+                ticket, deadline, timeout, until_tick_change=True,
+                last_tick=last_tick, seen_once=seen_once)
+            yield snap
+            if snap["status"] in TERMINAL_STATES:
+                return
+
+    def _await_ticket(self, ticket: str, deadline: Optional[float],
+                      timeout: Optional[float], *,
+                      until_tick_change: bool, last_tick: int = -1,
+                      seen_once: bool = False):
+        """The shared condition-wait core of :meth:`wait` /
+        :meth:`stream` (ONE copy of the error contract both promise):
+        block until the ticket goes terminal — or, when
+        ``until_tick_change``, until the driver tick advances — and
+        return ``(snapshot, tick, seen_once)``."""
+        with self._cond:
+            while True:
+                rec = self._tickets.get(str(ticket))
+                if rec is None:
+                    # A ticket that WAS visible and then vanished was
+                    # evicted past done_retention before this waiter
+                    # woke — its result is gone, but say so honestly
+                    # instead of claiming the id never existed.
+                    raise KeyError(
+                        f"ticket {ticket!r} evicted past done_retention="
+                        f"{self.done_retention} before the waiter "
+                        "observed its result — raise done_retention"
+                        if seen_once else f"unknown ticket {ticket!r}")
+                seen_once = True
+                if (rec["status"] in TERMINAL_STATES
+                        or (until_tick_change and self._tick != last_tick)):
+                    return dict(rec), self._tick, seen_once
+                if self._closed:
+                    raise ServiceClosed(
+                        self._driver_error or "service closed while waiting")
+                remaining = 1.0 if deadline is None \
+                    else deadline - time.monotonic()  # graftlint: ignore[lock-open-call] -- pure stdlib clock read; the deadline re-check must be atomic with the state re-check
+                if remaining <= 0:
+                    raise TimeoutError(  # graftlint: ignore[lock-open-call] -- exception construction unwinds the with block; nothing foreign runs under the lock after it
+                        f"ticket {ticket} not terminal after {timeout}s")
+                self._cond.wait(timeout=min(remaining, 1.0))  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+
+    def tickets(self) -> Dict[str, dict]:
+        """Copies of every retained ticket record (determinism probes,
+        the chaos-soak comparison)."""
+        with self._cond:
+            return {tid: dict(rec) for tid, rec in self._tickets.items()}
+
+    def busy(self) -> bool:
+        """True while anything is queued or running."""
+        with self._cond:
+            return bool(self._queue or self._lane_ticket)
+
+    @property
+    def driver_running(self) -> bool:
+        """True while the background driver thread owns :meth:`tick` —
+        synchronous drivers (serve/traffic.drive) must refuse to run
+        concurrently with it (the batch is driver-confined)."""
+        with self._cond:
+            return self._thread is not None
+
+    @property
+    def tick_index(self) -> int:
+        """Completed driver ticks (what traffic replay aligns on)."""
+        with self._cond:
+            return self._tick
+
+    @property
+    def round_index(self) -> int:
+        """Cumulative engine rounds executed."""
+        with self._cond:
+            return self._round
+
+    def stats(self) -> dict:
+        """The ``/stats`` document: queue/lane occupancy, admission
+        budget, lifetime counts and completion-rounds percentiles (over
+        a rolling window of recent completions)."""
+        with self._cond:
+            lat = list(self._latencies)
+            doc = {
+                "capacity": self.capacity,
+                "queue_depth": len(self._queue),
+                "queue_limit": self.queue_depth,
+                "active_lanes": len(self._lane_ticket),
+                # cancel-pending lanes left the running map but stay
+                # admitted on device until the next retire — not free.
+                "free_lanes": max(0, self.capacity - len(self._lane_ticket)
+                                  - len(self._cancel_lanes)),
+                "admit_budget": self._admit_budget,
+                "target_active_lanes": self._target_active,
+                "tick": self._tick,
+                "round": self._round,
+                "messages": self._messages,
+                "tickets_retained": len(self._tickets),
+                "closed": self._closed,
+                "quota_tokens": dict(self._buckets),
+                **self._counts,
+            }
+        if lat:
+            doc["completion_rounds_p50"] = float(np.percentile(lat, 50))
+            doc["completion_rounds_p99"] = float(np.percentile(lat, 99))
+        return doc
+
+    # ------------------------------------------------------------- the tick
+
+    def tick(self) -> dict:
+        """One driver iteration: retire recycled lanes, admit from the
+        queue under the pacing budget, advance every running lane one
+        ``chunk_rounds`` engine chunk, harvest completions, checkpoint.
+        Synchronous and deterministic — the background driver just calls
+        this in a loop. Returns ``{"admitted", "completed",
+        "executed_rounds", "running", "active"}`` for harness
+        bookkeeping (``running`` = lanes in flight during this tick's
+        engine chunk, ``active`` = still running after harvest)."""
+        if self._watchdog is None and self.deadline_s is not None:
+            self._watchdog = Watchdog(
+                self.deadline_s, name="serve-driver",
+                on_stall=self.on_stall, registry=self._registry).start()
+        if self._watchdog is not None:
+            self._watchdog.heartbeat()
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(self._driver_error or "service is closed")
+            for tenant, (rate, burst) in self._quotas.items():
+                self._buckets[tenant] = min(
+                    burst, self._buckets.get(tenant, burst) + rate)
+            retire = list(self._cancel_lanes)
+            self._cancel_lanes = []
+        retire.extend(self._retire_ready)
+        self._retire_ready = []
+        if retire:
+            self._batch = self._protocol.retire(self._batch, sorted(retire))
+
+        # Admission under the pacing budget: free lanes are the
+        # non-running ones (every harvested/cancelled lane was just
+        # retired above) MINUS any cancel that landed since that retire
+        # snapshot — its lane left _lane_ticket but is still admitted
+        # on the device until the NEXT tick's retire, so counting it
+        # free would over-admit and trip admit()'s LaneExhausted. No
+        # device sync needed either way.
+        admits: List[Tuple[str, int, float]] = []
+        with self._cond:
+            free = max(0, self.capacity - len(self._lane_ticket)
+                       - len(self._cancel_lanes))
+            budget = min(
+                free, self._admit_budget,
+                max(0, self._target_active - len(self._lane_ticket)))
+            while self._queue and len(admits) < budget:
+                tid = self._queue.pop(0)
+                rec = self._tickets[tid]
+                rec["status"] = "running"
+                rec["admitted_tick"] = self._tick
+                rec["admitted_round"] = self._round
+                admits.append((tid, rec["source"], rec["target"]))
+            round0 = self._round
+        if admits:
+            self._admit_on_device(admits)
+
+        # One compiled chunk for every running lane (skipped when idle).
+        with self._cond:
+            running = len(self._lane_ticket)
+        executed = 0
+        out: dict = {}
+        if running:
+            chunk_key = jax.random.fold_in(self._base_key, round0 + 1)
+            self._batch, out = engine.run_batch_until_coverage(
+                self.graph, self._protocol, self._batch, chunk_key,
+                max_rounds=self.chunk_rounds, donate=True)
+            executed = int(out["rounds"])
+        completed = self._harvest(out, executed)
+        if self._watchdog is not None:
+            self._watchdog.heartbeat()
+
+        # Checkpoint AFTER the preemption gate: an armed kill fires
+        # before the checkpoint due at this boundary, like a real
+        # SIGKILL (supervise-plane semantics).
+        with self._cond:
+            fire_preempt = (self._preempt_at is not None
+                            and self._tick >= self._preempt_at)
+            if fire_preempt:
+                self._preempt_at = None
+            if admits or retire or completed or executed:
+                self._dirty = True
+            dirty = self._dirty
+            tick_now = self._tick
+            active = len(self._lane_ticket)
+            qdepth = len(self._queue)
+        self._m_ticks.inc()
+        self._m_active.set(float(active))
+        self._m_queue.set(float(qdepth))
+        if fire_preempt:
+            # The kill closes the service like the SIGKILL it simulates:
+            # further ticks/submits refuse, and close() must NOT take a
+            # final checkpoint (resume wants the PRE-kill durable pair).
+            with self._cond:
+                self._closed = True
+                self._driver_error = f"preempted at tick {tick_now}"
+                self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+            raise Preempted(tick_now)
+        if (self._store is not None and dirty
+                and tick_now % self.checkpoint_every_ticks == 0):
+            self._checkpoint()
+        return {"admitted": len(admits), "completed": completed,
+                "executed_rounds": executed, "running": running,
+                "active": active}
+
+    def _admit_on_device(self, admits: List[Tuple[str, int, float]]) -> None:
+        """Seed the popped submissions into open lanes, grouped by
+        coverage target (``admit`` takes one target per call), and
+        record the lane→ticket mapping. Group order is first-appearance,
+        so lane assignment is deterministic."""
+        groups: Dict[float, List[Tuple[str, int]]] = {}
+        for tid, source, target in admits:
+            groups.setdefault(target, []).append((tid, source))
+        assigned: List[Tuple[int, str]] = []
+        for target, entries in groups.items():
+            sources = [source for _, source in entries]
+            # messagebatch.LaneExhausted is unreachable by
+            # construction here (the budget is capped at the free-lane
+            # count, cancel-pending lanes excluded); if the invariant
+            # ever breaks it propagates loudly rather than silently
+            # dropping tickets.
+            self._batch, lanes = self._protocol.admit(
+                self.graph, self._batch, sources, coverage_target=target)
+            assigned.extend(zip(lanes.tolist(), [tid for tid, _ in entries]))
+        # Lanes whose SEED already meets the target start done at
+        # admission (tiny coverage targets, near-single-node graphs).
+        # The engine excludes pre-run-done lanes from
+        # ``newly_completed_lanes``, so the chunk harvest would never
+        # see them — complete their tickets HERE, or they would pin
+        # "running" forever while their lanes leak.
+        done_list = np.asarray(self._batch.done).tolist()
+        seen_list = np.asarray(self._batch.seen_count).tolist()
+        instant = [lane for lane, _ in assigned if done_list[lane]]
+        hashes = self._hash_lanes(instant) \
+            if (self._record_seen_hash and instant) else {}
+        completions: List[Tuple[str, dict]] = []
+        with self._cond:
+            for lane, tid in assigned:
+                rec = self._tickets.get(tid)
+                if rec is None:
+                    # Cancelled AND evicted past done_retention inside
+                    # the unlocked admission gap: nothing left to
+                    # record — just recycle the lane.
+                    self._cancel_lanes.append(lane)
+                    continue
+                rec["lane"] = lane
+                if rec["status"] in TERMINAL_STATES:
+                    # Cancelled while mid-admission (status flipped
+                    # between the queue pop and this lock): never runs —
+                    # recycle the lane instead of mapping it, or the
+                    # harvest would flip a terminal ticket back to done.
+                    self._cancel_lanes.append(lane)
+                elif done_list[lane]:
+                    rec["status"] = "done"
+                    rec["rounds"] = 0
+                    rec["seen_count"] = seen_list[lane]
+                    rec["coverage"] = seen_list[lane] / max(self._n_live, 1)
+                    rec["latency_rounds"] = (rec["admitted_round"]
+                                             - rec["submitted_round"])
+                    if lane in hashes:
+                        rec["seen_sha256"] = hashes[lane]
+                    self._mark_terminal_locked(tid)
+                    self._counts["completed"] += 1
+                    self._latencies.append(rec["latency_rounds"])
+                    self._cancel_lanes.append(lane)  # recycle next tick
+                    completions.append((tid, dict(rec)))
+                else:
+                    self._lane_ticket[lane] = tid
+            walls = [(tid, self._submit_walls.pop(tid, None))
+                     for tid, _ in completions]
+            if completions:
+                self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        self._report_completions(completions, walls)
+
+    def _report_completions(self, completions: List[Tuple[str, dict]],
+                            walls: List[Tuple[str, Optional[float]]]) -> None:
+        """Post-lock completion reporting shared by the chunk harvest
+        and the instant-done admission path: the completed counter, both
+        latency histograms, the ``ticket_done`` trace event."""
+        now = time.perf_counter()
+        tracer = spans.current_tracer()
+        for (tid, rec), (_, t_sub) in zip(completions, walls):
+            self._m_completed.inc()
+            self._m_latency_rounds.observe(rec["latency_rounds"])
+            if t_sub is not None:
+                self._m_latency_s.observe(now - t_sub)
+            if tracer is not None:
+                spans.emit("ticket_done", ticket=tid,
+                           rounds=rec["rounds"],
+                           latency_rounds=rec["latency_rounds"])
+
+    def _harvest(self, out: dict, executed: int) -> int:
+        """Fold one chunk's results back into the ticket table: newly
+        completed lanes become ``done`` records (with their latency),
+        stragglers past ``max_ticket_rounds`` become ``timeout``; both
+        kinds queue for recycling at the next tick's retire."""
+        newly = out.get("newly_completed_lanes")
+        newly = newly.tolist() if newly is not None else []
+        rounds_list = out["lane_rounds"].tolist() if out else []
+        seen_hash: Dict[int, str] = {}
+        seen_list: List[int] = []
+        if out:
+            seen_np = np.asarray(self._batch.seen_count)
+            seen_list = seen_np.tolist()
+            if self._record_seen_hash and newly:
+                seen_hash = self._hash_lanes(newly)
+        completions: List[Tuple[str, dict]] = []
+        recycled: List[int] = []  # folded into the driver-confined
+        # _retire_ready AFTER the lock (it is not lock-guarded state)
+        with self._cond:
+            self._round += executed
+            self._messages += int(out["messages"]) if out else 0
+            for lane in newly:
+                tid = self._lane_ticket.pop(lane, None)
+                recycled.append(lane)
+                if tid is None:
+                    continue  # cancelled mid-chunk; lane already recycled
+                rec = self._tickets[tid]
+                rec["status"] = "done"
+                rec["rounds"] = rounds_list[lane]
+                rec["seen_count"] = seen_list[lane]
+                rec["coverage"] = seen_list[lane] / max(self._n_live, 1)
+                rec["latency_rounds"] = (
+                    (rec["admitted_round"] - rec["submitted_round"])
+                    + rounds_list[lane])
+                if lane in seen_hash:
+                    rec["seen_sha256"] = seen_hash[lane]
+                self._mark_terminal_locked(tid)
+                self._counts["completed"] += 1
+                self._latencies.append(rec["latency_rounds"])
+                completions.append((tid, dict(rec)))
+            if len(self._latencies) > 4096:
+                del self._latencies[:-2048]
+            # Stragglers past the per-ticket round bound: cut off.
+            timed_out: List[Tuple[int, str]] = []
+            if rounds_list:
+                for lane, tid in list(self._lane_ticket.items()):
+                    if rounds_list[lane] >= self.max_ticket_rounds:
+                        timed_out.append((lane, tid))
+            for lane, tid in timed_out:
+                self._lane_ticket.pop(lane, None)
+                recycled.append(lane)
+                rec = self._tickets[tid]
+                rec["status"] = "timeout"
+                rec["rounds"] = rounds_list[lane]
+                rec["seen_count"] = seen_list[lane]
+                rec["coverage"] = seen_list[lane] / max(self._n_live, 1)
+                self._mark_terminal_locked(tid)
+                self._submit_walls.pop(tid, None)  # never completes
+                self._counts["timeout"] += 1
+            # AIMD pacing off the chunk's observed completion
+            # percentiles: over-SLO p99 halves the budget, a healthy
+            # COMPLETING chunk claws back additively. A chunk that
+            # completed nothing carries no p99 — if its oldest running
+            # lane is already past the SLO that silence IS the overload
+            # signal (halve); otherwise it is no evidence either way
+            # (hold, never grow — a fully stalled system must not earn
+            # additive increase from rounds that finished nothing).
+            if self.slo_rounds is not None and out:
+                p99 = out.get("completion_rounds_p99")
+                oldest = max((rounds_list[lane]
+                              for lane in self._lane_ticket), default=0)
+                if ((p99 is not None and p99 > self.slo_rounds)
+                        or (p99 is None and oldest > self.slo_rounds)):
+                    self._admit_budget = max(1, self._admit_budget // 2)
+                elif p99 is not None:
+                    self._admit_budget = min(
+                        self._target_active,
+                        self._admit_budget + max(1, self.capacity // 16))
+            self._tick += 1
+            walls = [(tid, self._submit_walls.pop(tid, None))
+                     for tid, _ in completions]
+            budget_now = self._admit_budget
+            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        self._retire_ready.extend(recycled)
+        self._report_completions(completions, walls)
+        for _ in timed_out:
+            self._m_timeout.inc()
+        self._m_budget.set(float(budget_now))
+        return len(completions)
+
+    def _hash_lanes(self, lanes: List[int]) -> Dict[int, str]:
+        """sha256 of each lane's packed seen bits — one host pull of the
+        u32 words, then pure-numpy per-lane extraction."""
+        import hashlib
+
+        words = np.asarray(self._batch.seen)  # u32[W, N_pad], one pull
+        out = {}
+        for lane in lanes:
+            w, b = divmod(lane, 32)
+            bits = ((words[w] >> np.uint32(b)) & np.uint32(1)).astype(np.uint8)
+            out[lane] = hashlib.sha256(np.packbits(bits).tobytes()).hexdigest()
+        return out
+
+    def _mark_terminal_locked(self, tid: str) -> None:
+        """Bound the terminal-record table (caller holds the lock):
+        oldest terminal tickets past ``done_retention`` are evicted (a
+        later poll returns None, documented)."""
+        self._done_order.append(tid)
+        while len(self._done_order) > self.done_retention:
+            old = self._done_order.pop(0)
+            self._tickets.pop(old, None)
+            self._submit_walls.pop(old, None)
+
+    # ------------------------------------------------------------- driver
+
+    def _driver_loop(self) -> None:
+        """Background production driver: tick whenever there is work (or
+        on the idle cadence, which keeps tick-based quota refill
+        advancing). Any escape — Preempted included — closes the service
+        with the error recorded for submitters/waiters."""
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                if not (self._queue or self._lane_ticket
+                        or self._cancel_lanes):
+                    self._cond.wait(timeout=self.idle_wait_s)  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+                if self._closed:
+                    return
+            try:
+                self.tick()
+            except ServiceClosed:
+                return  # close() landed between the wait and the tick
+            except BaseException as e:
+                with self._cond:
+                    self._closed = True
+                    if self._driver_error is None:
+                        # tick() may have recorded a deliberate cause
+                        # already (a fired preemption) — keep it, so
+                        # both driver modes report the event the same.
+                        self._driver_error = f"driver died: " \
+                            f"{type(e).__name__}: {e}"
+                    self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+                if isinstance(e, Preempted):
+                    return  # deterministic kill: resume via a new service
+                raise
+
+    # -------------------------------------------------------- checkpointing
+
+    def _snapshot_locked(self) -> dict:
+        # The pair being built covers everything recorded so far; any
+        # mutation after this point re-dirties and re-checkpoints.
+        self._dirty = False
+        return {
+            "version": 1,
+            "seed": self.seed,
+            "round": self._round,
+            "tick": self._tick,
+            "next_ticket": self._next_ticket,
+            "messages": self._messages,
+            "queue": list(self._queue),
+            "lanes": {str(k): v for k, v in self._lane_ticket.items()},
+            "buckets": dict(self._buckets),
+            "admit_budget": self._admit_budget,
+            "counts": dict(self._counts),
+            "done_order": list(self._done_order),
+            "latencies": list(self._latencies),
+            "tickets": {tid: dict(rec)
+                        for tid, rec in self._tickets.items()},
+        }
+
+    def _checkpoint(self) -> str:
+        """Durably publish the (batch, ticket-table) pair: the batch
+        lands as a content-hashed store entry, then the sidecar is
+        rename-published REFERENCING that exact entry — a kill between
+        the two leaves the previous consistent pair (the sidecar is the
+        resume authority, pointing at a never-rewritten entry within the
+        retention window)."""
+        with self._cond:
+            snap = self._snapshot_locked()
+        try:
+            path = self._store.save(self._batch, self._base_key,
+                                    snap["round"], snap["messages"])
+            snap["checkpoint_file"] = os.path.basename(path)
+            atomic_write_json(
+                os.path.join(self._store.directory, _SIDECAR), snap,
+                suffix=".side.tmp")
+        except BaseException:
+            # The pair did NOT publish: put the dirty bit back, or a
+            # later clean close() would skip its final checkpoint and
+            # silently lose everything since the last successful pair.
+            with self._cond:
+                self._dirty = True
+            raise
+        if spans.current_tracer() is not None:
+            spans.emit("serve_checkpoint", tick=snap["tick"],
+                       round=snap["round"])
+        return path
+
+    def _clear_trail(self) -> None:
+        self._store.clear()
+        side = os.path.join(self._store.directory, _SIDECAR)
+        try:
+            os.unlink(side)
+        except OSError:
+            pass
+
+    def _template(self):
+        shapes = jax.eval_shape(
+            lambda g: self._protocol.empty(g, self.capacity), self.graph)
+        return jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype), shapes)
+
+    def _try_resume(self) -> bool:
+        """Restore the newest consistent (checkpoint, sidecar) pair; a
+        missing or unloadable pair is a fresh start (stale trails
+        cleared, runner semantics)."""
+        side_path = os.path.join(self._store.directory, _SIDECAR)
+        try:
+            with open(side_path, "r", encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            if self._store.entries():
+                self._clear_trail()
+            return False
+        entry = snap.get("checkpoint_file")
+        path = os.path.join(self._store.directory, str(entry))
+        template = self._template()
+        try:
+            state, key, rnd, msgs = ckpt.load(path, template)
+        except (ckpt.CheckpointCorrupt, OSError):
+            # The referenced entry is damaged/missing: the sidecar pair
+            # is unusable as a unit — fresh start. (A ValueError —
+            # treedef mismatch, i.e. a different protocol — propagates
+            # as the caller error it is, like the shape check below.)
+            self._clear_trail()
+            return False
+        # ckpt.load validates the treedef only, and MessageBatch is
+        # all-array fields — a trail written at a DIFFERENT capacity or
+        # graph size would load "successfully" with wrong shapes and
+        # wedge the service later (host budget vs device lanes disagree,
+        # XLA shape errors mid-chunk). A config mismatch is a caller
+        # error; silently discarding the trail would lose real tickets.
+        for got, want in zip(jax.tree_util.tree_leaves(state),
+                             jax.tree_util.tree_leaves(template)):
+            if got.shape != want.shape or got.dtype != want.dtype:
+                raise ValueError(
+                    f"checkpoint trail at {self._store.directory!r} was "
+                    "written by a service with a different capacity or "
+                    f"graph (stored leaf {got.shape}/{got.dtype} vs "
+                    f"configured {want.shape}/{want.dtype}) — construct "
+                    "with the same config, or pass resume=False to "
+                    "discard the trail")
+        self._batch = jax.device_put(state)
+        self._base_key = key
+        # Construction is single-threaded, but the control-plane state
+        # restored here is lock-guarded everywhere else — keep the
+        # discipline uniform rather than special-casing __init__.
+        with self._cond:
+            self._round = int(rnd)
+            self._messages = int(msgs)
+            self._tick = int(snap.get("tick", 0))
+            self._next_ticket = int(snap.get("next_ticket", 0))
+            self._queue = [str(t) for t in snap.get("queue", [])]
+            self._lane_ticket = {int(k): str(v)
+                                 for k, v in snap.get("lanes", {}).items()}
+            # Merge, don't replace: tenants added to quotas AFTER the
+            # trail was written must start at their configured burst
+            # (absent from the snapshot), and restored levels never
+            # exceed a since-shrunk burst.
+            restored = {str(k): float(v)
+                        for k, v in snap.get("buckets", {}).items()}
+            buckets = {t: b for t, (_, b) in self._quotas.items()}
+            for k, v in restored.items():
+                buckets[k] = min(v, buckets[k]) if k in buckets else v
+            self._buckets = buckets
+            self._admit_budget = int(snap.get("admit_budget",
+                                              self._admit_budget))
+            self._counts.update({k: int(v)
+                                 for k, v in snap.get("counts", {}).items()})
+            self._done_order = [str(t) for t in snap.get("done_order", [])]
+            self._latencies = [float(x) for x in snap.get("latencies", [])]
+            self._tickets = {str(tid): dict(rec)
+                             for tid, rec in snap.get("tickets", {}).items()}
+            running = dict(self._lane_ticket)
+        # Lanes admitted in the checkpoint but not running (harvested
+        # done / cancelled, not yet recycled when the checkpoint landed)
+        # queue for the first tick's retire — zero lanes leak.
+        admitted = np.flatnonzero(np.asarray(self._batch.admitted)).tolist()
+        self._retire_ready = [lane for lane in admitted
+                              if lane not in running]
+        return True
+
+    # ---------------------------------------------------------------- HTTP
+
+    def handle_http(self, method: str, path: str,
+                    body: Optional[dict]) -> Optional[Tuple[int, dict]]:
+        """The duck-typed httpd seam (telemetry/httpd.py): claim the
+        serving endpoints, return ``None`` for everything else.
+
+        - ``POST /submit`` (JSON body) or ``GET /submit?source=N`` —
+          202 ``{"ticket", "status"}``, 429 with the structured reject
+          on shed, 400 on caller errors, 503 when closed;
+        - ``GET /poll/<ticket>`` — the record, or 404;
+        - ``POST /cancel/<ticket>`` — ``{"cancelled": bool}``;
+        - ``GET /stats`` — the :meth:`stats` document.
+        """
+        parsed = urllib.parse.urlparse(path)
+        route = parsed.path.rstrip("/") or "/"
+        if route == "/stats" and method == "GET":
+            return 200, self.stats()
+        if route == "/submit" and method in ("GET", "POST"):
+            args: Dict[str, Any] = {}
+            if method == "GET":
+                q = urllib.parse.parse_qs(parsed.query)
+                if "source" in q:
+                    args["source"] = q["source"][0]
+                if "target_coverage" in q:
+                    args["target_coverage"] = q["target_coverage"][0]
+                if "tenant" in q:
+                    args["tenant"] = q["tenant"][0]
+            else:
+                args = dict(body or {})
+            if "source" not in args:
+                return 400, {"error": "submit needs a source node id"}
+            try:
+                tid = self.submit(
+                    int(args["source"]),
+                    target_coverage=float(
+                        args.get("target_coverage", 0.99)),
+                    tenant=str(args.get("tenant", "default")))
+            except Rejected as e:
+                return 429, e.to_dict()
+            except ServiceClosed as e:
+                return 503, {"error": str(e)}
+            except (TypeError, ValueError) as e:
+                return 400, {"error": str(e)}
+            return 202, {"ticket": tid, "status": "queued"}
+        if route.startswith("/poll/") and method == "GET":
+            rec = self.poll(route[len("/poll/"):])
+            if rec is None:
+                return 404, {"error": "unknown ticket"}
+            return 200, rec
+        if route.startswith("/cancel/") and method == "POST":
+            return 200, {"cancelled": self.cancel(route[len("/cancel/"):])}
+        return None

@@ -732,6 +732,10 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
             out["flight_record"] = flightrec.trim(ring, out["rounds"])
         t2 = time.perf_counter()
         newly = out["lane_done"] & ~done0
+        # Which lanes completed in THIS call (pre-run done excluded) —
+        # the serving front-end's harvest set: map these back to tickets
+        # without re-deriving done-flag deltas caller-side.
+        out["newly_completed_lanes"] = np.flatnonzero(newly).astype(np.int32)
         newly_rounds = out["lane_rounds"][newly]
         if newly_rounds.size:
             out["completion_rounds_p50"] = float(

@@ -34,9 +34,28 @@ from typing import Any, Dict, List, Optional, Tuple
 from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim import checkpoint as ckpt
 
-__all__ = ["CheckpointStore"]
+__all__ = ["CheckpointStore", "atomic_write_json"]
 
 _MANIFEST = "manifest.json"
+
+
+def atomic_write_json(path: str, doc: Any, *,
+                      suffix: str = ".json.tmp") -> None:
+    """Rename-publish ``doc`` as JSON at ``path``: tmp file in the same
+    directory, ``os.replace``, tmp unlinked on failure. The ONE home of
+    this crash-safety pattern — the manifest below and graftserve's
+    sidecar (serve/service.py) both publish through it, so a future
+    hardening (fsync-before-rename, say) lands everywhere at once."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)), suffix=suffix)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def _file_sha256(path: str) -> str:
@@ -150,15 +169,8 @@ class CheckpointStore:
         doc = {"version": 1,
                "latest": entries[-1]["file"] if entries else None,
                "entries": entries}
-        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".manifest.tmp")
-        try:
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                json.dump(doc, f, indent=1)
-            os.replace(tmp, os.path.join(self.directory, _MANIFEST))
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        atomic_write_json(os.path.join(self.directory, _MANIFEST), doc,
+                          suffix=".manifest.tmp")
 
     # -------------------------------------------------------------- reading
 

@@ -12,6 +12,15 @@ is installed, so the endpoint is always parseable). Zero dependencies —
 ``http.server.ThreadingHTTPServer`` on one daemon thread — so a live
 sockets deployment can be watched without installing anything
 (GETTING_STARTED.md "Observability").
+
+An application can mount its own endpoints NEXT TO the telemetry ones
+via ``service=``: any object with ``handle_http(method, path, body)
+-> (status, payload_dict) | None`` gets every request the built-in
+routes don't claim (``None`` means "not mine" and falls through to 404).
+The one real implementation is the serving front-end
+(:class:`p2pnetwork_tpu.serve.SimService`: ``/submit``, ``/poll/<t>``,
+``/cancel/<t>``, ``/stats``) — duck-typed here so this module stays
+stdlib-only and importable without jax.
 """
 
 from __future__ import annotations
@@ -31,35 +40,80 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     registry: Registry      # stamped onto the subclass by MetricsServer
     history: Optional[Any]  # History or None (None = process default)
     tracer: Optional[Any]   # Tracer or None (None = installed tracer)
+    service: Optional[Any] = None  # handle_http provider or None
+
+    def _respond(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _respond_json(self, status: int, payload: Any) -> None:
+        self._respond(status, json.dumps(payload).encode("utf-8"),
+                      "application/json")
+
+    def _dispatch_service(self, method: str, body: Optional[dict]) -> bool:
+        """Offer the request to the bound service; True when it claimed
+        it. Service errors become a 500 with the error named — a buggy
+        handler must not wedge the scrape thread."""
+        if self.service is None:
+            return False
+        try:
+            resp = self.service.handle_http(method, self.path, body)
+        except Exception as e:
+            self._respond_json(
+                500, {"error": f"{type(e).__name__}: {e}"})
+            return True
+        if resp is None:
+            return False
+        status, payload = resp
+        self._respond_json(int(status), payload)
+        return True
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
         path = self.path.split("?", 1)[0]
         if path in ("/metrics", "/"):
             body = export.to_prometheus(self.registry).encode("utf-8")
-            ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif path == "/metrics.json":
-            body = json.dumps(self.registry.snapshot()).encode("utf-8")
-            ctype = "application/json"
-        elif path == "/history":
+            self._respond(200, body,
+                          "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if path == "/metrics.json":
+            self._respond_json(200, self.registry.snapshot())
+            return
+        if path == "/history":
             hist = self.history if self.history is not None \
                 else history.default_history()
-            body = json.dumps(hist.snapshot()).encode("utf-8")
-            ctype = "application/json"
-        elif path == "/trace":
+            self._respond_json(200, hist.snapshot())
+            return
+        if path == "/trace":
             tracer = self.tracer if self.tracer is not None \
                 else spans.current_tracer()
             doc = tracer.to_chrome() if tracer is not None \
                 else {"traceEvents": [], "displayTimeUnit": "ms"}
-            body = json.dumps(doc).encode("utf-8")
-            ctype = "application/json"
-        else:
-            self.send_error(404)
+            self._respond_json(200, doc)
             return
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        if self._dispatch_service("GET", None):
+            return
+        self.send_error(404)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        raw = self.rfile.read(length) if length > 0 else b""
+        body: Optional[dict] = None
+        if raw:
+            try:
+                parsed = json.loads(raw.decode("utf-8"))
+                body = parsed if isinstance(parsed, dict) else None
+            except ValueError:
+                self._respond_json(400, {"error": "body is not JSON"})
+                return
+        if self._dispatch_service("POST", body):
+            return
+        self.send_error(404)
 
     def log_message(self, fmt, *args):  # scrapes must not spam stdout
         pass
@@ -69,13 +123,20 @@ class MetricsServer:
     """Serve ``registry`` over HTTP on a background daemon thread.
 
     ``port=0`` binds an ephemeral port (read it back from ``.port`` after
-    :meth:`start`). ``history``/``tracer`` bind a specific history ring /
-    trace collector to ``/history`` and ``/trace``; by default those
-    endpoints follow the process-wide
+    :meth:`start` — the OS-assigned port is reported, so test fixtures
+    and co-located services never race over fixed ports).
+    ``history``/``tracer`` bind a specific history ring / trace collector
+    to ``/history`` and ``/trace``; by default those endpoints follow the
+    process-wide
     :func:`~p2pnetwork_tpu.telemetry.history.default_history` and the
     tracer installed via
     :func:`~p2pnetwork_tpu.telemetry.spans.install_tracer`, resolved per
-    request. Usable as a context manager::
+    request. ``service`` mounts application endpoints beside the
+    telemetry ones (module docstring). ``start``/:meth:`close` are
+    idempotent and safe to race from several threads — the whole
+    lifecycle is serialized by one lock, so concurrent start/close pairs
+    settle into a consistent state instead of leaking a server or
+    double-binding a port. Usable as a context manager::
 
         with MetricsServer(port=0) as srv:
             print(f"curl http://127.0.0.1:{srv.port}/metrics")
@@ -84,42 +145,66 @@ class MetricsServer:
     def __init__(self, registry: Optional[Registry] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  history: Optional[Any] = None,
-                 tracer: Optional[Any] = None):
+                 tracer: Optional[Any] = None,
+                 service: Optional[Any] = None):
         self.registry = registry or default_registry()
         self.history = history
         self.tracer = tracer
+        self.service = service
         self.host = host
         self.port = port
+        #: The port asked for at construction: a close() must rebind the
+        #: SAME ephemeral request (0 = "any"), not the port the previous
+        #: start happened to get (which may be taken by then).
+        self._requested_port = port
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[Any] = None
+        # Serializes the whole start/stop lifecycle: concurrent starts
+        # must agree on ONE bound server, and a close racing a start must
+        # observe either the unstarted or the fully-started state.
+        self._lifecycle_lock = concurrency.lock()
 
     def start(self) -> "MetricsServer":
-        if self._httpd is not None:
-            return self
-        handler = type("BoundHandler", (_Handler,),
-                       {"registry": self.registry, "history": self.history,
-                        "tracer": self.tracer})
-        self._httpd = http.server.ThreadingHTTPServer(
-            (self.host, self.port), handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = concurrency.thread(
-            target=self._httpd.serve_forever,
-            name=f"MetricsServer({self.host}:{self.port})", daemon=True)
-        self._thread.start()
+        with self._lifecycle_lock:
+            if self._httpd is not None:
+                return self
+            handler = type("BoundHandler", (_Handler,),
+                           {"registry": self.registry,
+                            "history": self.history,
+                            "tracer": self.tracer,
+                            "service": self.service})
+            self._httpd = http.server.ThreadingHTTPServer(  # graftlint: ignore[lock-open-call] -- the bind must be atomic with the started-state publish, or two racing starts double-bind
+                (self.host, self._requested_port), handler)
+            self.port = self._httpd.server_address[1]
+            self._thread = concurrency.thread(  # graftlint: ignore[lock-open-call] -- same lifecycle atomicity; the seam factory only constructs
+                target=self._httpd.serve_forever,
+                name=f"MetricsServer({self.host}:{self.port})", daemon=True)
+            self._thread.start()  # graftlint: ignore[lock-open-call] -- same lifecycle atomicity; start() does not block on the serve loop
         return self
 
     def stop(self) -> None:
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-        self._httpd = self._thread = None
+        """Shut the server down and release the port. Idempotent — a
+        second (or concurrent) call is a no-op; :meth:`close` is the
+        same operation under the conventional resource name."""
+        with self._lifecycle_lock:
+            httpd, thread = self._httpd, self._thread
+            self._httpd = self._thread = None
+            if httpd is None:
+                return
+            httpd.shutdown()  # graftlint: ignore[lock-open-call] -- teardown must be atomic with the stopped-state publish; bounded (serve loop poll interval)
+            httpd.server_close()  # graftlint: ignore[lock-open-call] -- same teardown atomicity
+            if thread is not None:
+                thread.join(timeout=5.0)  # graftlint: ignore[lock-open-call] -- same teardown atomicity; bounded join
+
+    def close(self) -> None:
+        """Alias of :meth:`stop` (idempotent)."""
+        self.stop()
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}/metrics"
+        with self._lifecycle_lock:
+            port = self.port
+        return f"http://{self.host}:{port}/metrics"
 
     def __enter__(self) -> "MetricsServer":
         return self.start()

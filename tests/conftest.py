@@ -76,6 +76,13 @@ def pytest_configure(config):
         "+ overhead ratchet, trace-plane span trees / Perfetto export, "
         "history ring + /history endpoint, probe_log and profiler "
         "wiring (select with -m scope; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "serve: graftserve serving front-end tests — submit/poll/stream "
+        "lifecycle, admission pacing, quotas + structured load shedding, "
+        "seeded-traffic determinism, preempt/resume bit-identity, the "
+        "HTTP endpoints, and the slow-marked 1k-concurrent-lane soak "
+        "(select with -m serve; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

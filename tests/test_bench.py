@@ -34,6 +34,12 @@ def _env(cache_dir, **extra):
         # graph so orchestration (not throughput) is what the tests pay.
         "BENCH_BATCH_N": "1500",
         "BENCH_BATCH_B": "40",
+        # The serving column drives open-loop traffic through SimService
+        # on the batched class: tiny capacity/rate so orchestration (not
+        # sustained throughput) is what the tests pay.
+        "BENCH_SERVE_CAP": "40",
+        "BENCH_SERVE_TICKS": "4",
+        "BENCH_SERVE_RATE": "15",
         # The multichip ring column spawns its own 8-virtual-device
         # child: tiny graph so the tests pay orchestration, not the
         # interpret/compile bill.
@@ -234,6 +240,35 @@ class TestStageTelemetry:
         assert col["aggregate_speedup_vs_sequential"] > 0
         assert col["best_s"] > 0 and col["messages"] > 0
         assert col["seq_sample_runs"] >= 1
+
+    def test_serving_column_published_with_percentiles(self, first_run):
+        # The serving column (ROADMAP 2): seeded open-loop traffic
+        # through the admission-controlled service — sustained lanes/s,
+        # submit→completion p50/p99 rounds, peak concurrency, shed rate.
+        cache, _, _ = first_run
+        tel = json.loads((cache / "BENCH_TELEMETRY.json").read_text())
+        col = tel["serving"]
+        assert "error" not in col, col
+        assert col["capacity"] == 64  # 40 requested, rounded to words
+        assert col["completed"] >= 1
+        assert col["submit_to_completion_rounds_p50"] >= 1
+        assert col["submit_to_completion_rounds_p99"] >= \
+            col["submit_to_completion_rounds_p50"]
+        assert col["sustained_lanes_per_s"] > 0
+        assert col["peak_concurrent_lanes"] >= 1
+        assert 0.0 <= col["shed_rate"] <= 1.0
+        assert col["offered"] == col["submitted"] + col["shed"]
+
+    def test_serving_column_disabled_is_empty_not_missing(self, tmp_path):
+        # BENCH_SERVE=0 (what the cpu-fallback parent pins) must publish
+        # an EMPTY column, keeping the artifact schema stable.
+        r = subprocess.run(
+            [sys.executable, BENCH, "--stage", "1m"],
+            env=_env(tmp_path, BENCH_SERVE="0"), capture_output=True,
+            text=True, timeout=600, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        tel = json.loads((tmp_path / "BENCH_TELEMETRY.json").read_text())
+        assert tel["serving"] == {}
 
     def test_multichip_column_published_with_ici_bytes(self, first_run):
         # The multichip ring column (the promoted dryrun_multichip): the
