@@ -9,7 +9,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
-	batch-check ring-check scope-check serve-check
+	batch-check ring-check scope-check serve-check query-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -114,6 +114,14 @@ scope-check:
 # 1k-concurrent-lane 100k-node soak runs with -m 'serve and slow').
 serve-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_serve.py -q
+
+# Batched query lanes: byte-budget gate, lane-kernel parity, the three
+# family identity sweeps (min-plus vs Bellman-Ford reference, DHT vs the
+# numpy greedy walk, push-sum float-op-order vs models/pushsum.py), the
+# query engine loop + observability pins (tox env "query"; the
+# slow-marked 10x aggregate ratchets run with -m 'query and slow').
+query-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_querybatch.py -q
 
 # North-star benchmark on the real TPU chip. bench.py probes the backend
 # in a subprocess first and emits an error JSON instead of hanging when

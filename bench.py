@@ -63,7 +63,14 @@ column: B concurrent floods advanced by ONE compiled program per round
 (models/messagebatch.py lane packing + engine.run_batch_until_coverage)
 on the 100k-node WS class, with ``batch_completion_rounds_p99`` and the
 aggregate-throughput ratio vs sequential single-message runs
-(BENCH_BATCH_B=1024 / BENCH_BATCH_N=100000 / BENCH_BATCH=0 to disable). Each measuring stage runs inside an ``analysis.retrace_guard``
+(BENCH_BATCH_B=1024 / BENCH_BATCH_N=100000 / BENCH_BATCH=0 to disable),
+and the ``queries`` column: the three non-boolean batched query families
+(models/querybatch.py — min-plus route lookups and push-sum aggregations
+on the batched WS class, DHT greedy lookups on a 100k-node chord
+overlay), each with lanes/s, completion-rounds p50/p99 and the aggregate
+speedup vs warm sequential capacity-1 runs (BENCH_QUERY_K_MINPLUS=64 /
+_PUSHSUM=32 / _DHT=2048, BENCH_QUERY_DHT_N=100000, BENCH_QUERIES=0 to
+disable). Each measuring stage runs inside an ``analysis.retrace_guard``
 with a per-stage jit compile budget (BENCH_COMPILE_BUDGET_1M/_10M):
 a breach — something retracing mid-measurement — emits a structured
 ``bench_recompile_budget_breach`` warning plus the
@@ -522,6 +529,146 @@ def bench_serving():
     return col
 
 
+def _graph_spec_query_dht():
+    """(n, cache name, build thunk) for the query column's DHT overlay:
+    a chord graph — the structured topology whose fingers the greedy
+    lookup lanes actually chase (a lookup on the WS class would mostly
+    measure stalls)."""
+    from p2pnetwork_tpu.sim import graph as G
+
+    n = int(os.environ.get("BENCH_QUERY_DHT_N", 100_000))
+    return n, f"chord_n{n}_querycol", lambda: G.chord(n)
+
+
+def time_query_family(graph, proto, make_batch, make_single, *, K: int,
+                      max_rounds: int = 256, reps: int = None,
+                      seq_sample: int = 3) -> dict:
+    """One query family's bench row: run the K-lane batch through
+    ``engine.run_queries_until_done`` (one compiled program per round)
+    and price the same K queries as WARM sequential capacity-1 runs of
+    the SAME family — one query per engine call, what a serving loop
+    without lane batching would pay — extrapolated from ``seq_sample``
+    measured runs. ``make_batch()`` / ``make_single(i)`` build the
+    admitted batches (each run re-admits, so donation invalidating the
+    carry between reps is fine)."""
+    import jax
+
+    from p2pnetwork_tpu.sim import engine
+
+    if reps is None:
+        reps = int(os.environ.get("BENCH_REPS", "5"))
+    key = jax.random.key(0)
+
+    def once():
+        return engine.run_queries_until_done(
+            graph, proto, make_batch(), key, max_rounds=max_rounds)
+
+    t0 = time.perf_counter()
+    _, out = once()  # compile + warm up
+    warmup_s = time.perf_counter() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, out = once()
+        times.append(time.perf_counter() - t0)
+    batch_s = min(times)
+
+    # Warm the capacity-1 program once untimed (its own compile), then
+    # measure the sequential sample (clamped to K — a tiny lane-count
+    # knob must shrink the sample, not index past the query list).
+    engine.run_queries_until_done(graph, proto, make_single(0), key,
+                                  max_rounds=max_rounds)
+    seq = []
+    for i in range(max(min(seq_sample, int(K)), 1)):
+        t0 = time.perf_counter()
+        engine.run_queries_until_done(graph, proto, make_single(i), key,
+                                      max_rounds=max_rounds)
+        seq.append(time.perf_counter() - t0)
+    seq_per_run = sum(seq) / len(seq)
+    return {
+        "K": int(K),
+        "n_nodes": graph.n_nodes,
+        "best_s": round(batch_s, 6),
+        "warmup_s": round(warmup_s, 4),
+        "reps": reps,
+        "rounds": int(out["rounds"]),
+        "completed": int(out["completed"]),
+        "active_lanes_end": int(out["active_lanes"]),
+        "messages": int(out["messages"]),
+        "completion_rounds_p50": out.get("completion_rounds_p50"),
+        "completion_rounds_p99": out.get("completion_rounds_p99"),
+        "lanes_per_s": round(int(out["completed"]) / batch_s, 1),
+        "seq_sample_runs": len(seq),
+        "seq_per_run_s": round(seq_per_run, 6),
+        "aggregate_speedup_vs_sequential": round(
+            seq_per_run * K / batch_s, 2),
+    }
+
+
+def bench_queries():
+    """The ``queries`` bench column (ROADMAP item 3): the three
+    non-boolean batched query families — min-plus route lookups and
+    push-sum aggregations on the batched column's 100k-node WS class,
+    DHT greedy lookups on a 100k-node chord overlay — each publishing
+    aggregate speedup vs warm sequential capacity-1 runs, lanes/s, and
+    completion-rounds p50/p99. Env seams: BENCH_QUERY_K_MINPLUS /
+    _PUSHSUM / _DHT (lane counts), BENCH_QUERY_DHT_N (chord size).
+    Failure must not sink the stage — callers catch and record."""
+    import numpy as np
+
+    from p2pnetwork_tpu.models.querybatch import (DhtLookups,
+                                                  MinPlusQueries,
+                                                  PushSumQueries)
+
+    rng = np.random.default_rng(0)
+    col = {}
+    _, name, build = _graph_spec_batch()
+    g, build_s, cached = _cached_graph(name, build)
+    col["graph_build_s"] = round(build_s, 2)
+    col["graph_cached"] = cached
+
+    k_mp = int(os.environ.get("BENCH_QUERY_K_MINPLUS", 64))
+    mp = MinPlusQueries(method="auto")
+    srcs = rng.integers(0, g.n_nodes, k_mp).astype(np.int32)
+    tgts = rng.integers(0, g.n_nodes, k_mp).astype(np.int32)
+    col["minplus"] = time_query_family(
+        g, mp,
+        lambda: mp.init(g, srcs, tgts),
+        lambda i: mp.init(g, srcs[i:i + 1], tgts[i:i + 1]),
+        K=k_mp)
+
+    k_ps = int(os.environ.get("BENCH_QUERY_K_PUSHSUM", 32))
+    ps = PushSumQueries(method="auto")
+    seeds = (np.arange(k_ps) * 7 + 1).astype(np.int32)
+    col["pushsum"] = time_query_family(
+        g, ps,
+        lambda: ps.init(g, seeds, threshold=1e-4),
+        lambda i: ps.init(g, seeds[i:i + 1], threshold=1e-4),
+        K=k_ps, max_rounds=512)
+
+    k_dht = int(os.environ.get("BENCH_QUERY_K_DHT", 2048))
+    _, dname, dbuild = _graph_spec_query_dht()
+    gd, dbuild_s, dcached = _cached_graph(dname, dbuild)
+    dht = DhtLookups(metric="ring")
+    orgs = rng.integers(0, gd.n_nodes, k_dht).astype(np.int32)
+    keys = rng.integers(0, gd.n_nodes, k_dht).astype(np.int32)
+    col["dht"] = time_query_family(
+        gd, dht,
+        lambda: dht.init(gd, orgs, keys),
+        lambda i: dht.init(gd, orgs[i:i + 1], keys[i:i + 1]),
+        K=k_dht, max_rounds=128)
+    col["dht"]["graph_build_s"] = round(dbuild_s, 2)
+    col["dht"]["graph_cached"] = dcached
+
+    for fam in ("minplus", "dht", "pushsum"):
+        f = col[fam]
+        print(f"# queries {fam} K={f['K']}: {f['best_s']*1000:.1f} ms/run"
+              f", rounds={f['rounds']}, p99={f['completion_rounds_p99']},"
+              f" aggregate x{f['aggregate_speedup_vs_sequential']} vs "
+              f"sequential", file=sys.stderr, flush=True)
+    return col
+
+
 def _graph_spec_multichip():
     """(n, cache name, build thunk) for the ``multichip`` column's ring
     class: plain segment-bucket layout — the ring pass carries its own
@@ -768,6 +915,19 @@ def bench_1m(record):
             print(f"# serving column failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
+    # The queries column (ROADMAP item 3): the three non-boolean batched
+    # query families with their aggregate-vs-sequential ratios. Own try,
+    # same failure isolation. BENCH_QUERIES=0 disables (the cpu-fallback
+    # parent does: three 100k-node families would eat its timeout).
+    queries = {}
+    if os.environ.get("BENCH_QUERIES", "1") != "0":
+        try:
+            queries = bench_queries()
+        except Exception as e:
+            queries = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# queries column failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+
     # The multichip column (the promoted dryrun_multichip): ring-sharded
     # flood over 8 devices — real chips when visible, the virtual CPU
     # mesh otherwise — in its own bounded child, so a wedged multi-device
@@ -800,7 +960,7 @@ def bench_1m(record):
     return {"graph_build_s": round(build_s, 4), "cache_hit": cached,
             "build_phases": build_phases,
             "supervised": supervised, "per_method": per_method,
-            "batched": batched, "serving": serving,
+            "batched": batched, "serving": serving, "queries": queries,
             "multichip": multichip}
 
 
@@ -892,6 +1052,12 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         # rate (empty for stages without the column, error-carrying
         # when it failed).
         "serving": tel.get("serving", {}),
+        # The queries column: the three non-boolean batched query
+        # families (min-plus routing, DHT lookups, push-sum) — per-family
+        # aggregate speedup vs warm sequential capacity-1 runs, lanes/s,
+        # completion-rounds p50/p99 (empty for stages without the
+        # column, error-carrying when it failed).
+        "queries": tel.get("queries", {}),
         # The multichip ring column: multi-device run-to-coverage wall,
         # scaling ratio vs a single-chip run of the same graph, and the
         # per-round ICI byte estimates of both halo-exchange backends
@@ -1257,6 +1423,8 @@ def main():
             "BENCH_BATCH": os.environ.get("BENCH_BATCH", "0"),
             # Same reasoning for the serving column's 1024-lane drive.
             "BENCH_SERVE": os.environ.get("BENCH_SERVE", "0"),
+            # And the query column's three 100k-node families.
+            "BENCH_QUERIES": os.environ.get("BENCH_QUERIES", "0"),
         })
         if "error" in r1m:
             record["error"] = f"{err}; cpu fallback also failed: {r1m['error']}"

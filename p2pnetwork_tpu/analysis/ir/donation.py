@@ -131,6 +131,36 @@ def all_donation_audits() -> List[DonationAudit]:
                 {"max_rounds": 64},
                 len(jax.tree_util.tree_leaves(batch)))
 
+    def _query_batch(g):
+        import numpy as np
+
+        from p2pnetwork_tpu.models.querybatch import MinPlusQueries
+
+        proto = MinPlusQueries(method="auto")
+        return proto, proto.init(
+            g, np.arange(8, dtype=np.int32) * 11 % 900,
+            np.arange(8, dtype=np.int32) * 37 % 900)
+
+    def query_from():
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        proto, qb = _query_batch(g)
+        args = (g, proto, qb, jax.random.key(0))
+        return (engine.donating_carry_loops()["query_from"], args,
+                {"max_rounds": 64},
+                len(jax.tree_util.tree_leaves(qb)))
+
+    def query_from_rec():
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class("ws1k")
+        proto, qb = _query_batch(g)
+        args = (g, proto, qb, jax.random.key(0), _ring())
+        return (engine.donating_carry_loops()["query_from_rec"], args,
+                {"max_rounds": 64},
+                len(jax.tree_util.tree_leaves(qb)) + 1)
+
     def _ring():
         from p2pnetwork_tpu.sim import flightrec
 
@@ -209,6 +239,17 @@ def all_donation_audits() -> List[DonationAudit]:
             name="engine/batch_from", build=batch_from,
             doc="batched message-plane loop "
                 "(engine.run_batch_until_coverage)"),
+        # The query plane's donating carry: f32 lane matrices are the
+        # HBM-heavy leaves byte-budgeting exists for — a silently
+        # double-buffered query carry would double exactly the cost
+        # lane_budget gates.
+        DonationAudit(
+            name="engine/query_from", build=query_from,
+            doc="batched query loop (engine.run_queries_until_done)"),
+        DonationAudit(
+            name="engine/query_from_rec", build=query_from_rec,
+            doc="batched query loop with the flight-recorder ring "
+                "(engine.run_queries_until_done(recorder=...))"),
         # The graftscope flight-recorder twins: the ring is one MORE
         # donated carry leaf — a recorder whose ring silently
         # double-buffers would tax every recorded run, so the alias is

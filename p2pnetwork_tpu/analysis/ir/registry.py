@@ -218,6 +218,75 @@ def _lanes_kernel_entry(variant: str, cls: str) -> Lowering:
                     slot_budget=slot)
 
 
+def _query_lanes_entry(op: str, variant: str, cls: str) -> Lowering:
+    """A non-boolean query-lane kernel × method entry (ops/lanes.py):
+    ``f32[N_pad, 8]`` node-major lane matrices — the K axis is
+    shape-polymorphic (every op is lane-elementwise or a per-lane
+    reduction), so 8 lanes audit the program every width runs. The
+    gather/segment pair per shape-class is a PARITY group, like the
+    scalar kernels'."""
+
+    def build():
+        from p2pnetwork_tpu.ops import lanes as L
+
+        g = shape_class(cls)
+        kernel = {"minplus_lanes": L.propagate_min_plus_lanes,
+                  "sum_lanes": L.propagate_sum_lanes}[op]
+        mat = jnp.zeros((g.n_nodes_padded, 8), dtype=jnp.float32)
+        return functools.partial(kernel, g, method=variant), (mat,)
+
+    return Lowering(name=f"{op}/{variant}@{cls}", op=op, variant=variant,
+                    shape_class=cls, build=build)
+
+
+def _dht_hop_entry(cls: str) -> Lowering:
+    """The batched DHT hop kernel (ops/lanes.dht_hop_lanes): one
+    neighbor-row gather + metric argmin serving K greedy lookups —
+    i32[16] cursors/keys (K shape-polymorphic like the other lane
+    kernels)."""
+
+    def build():
+        from p2pnetwork_tpu.ops import lanes as L
+
+        g = shape_class(cls)
+        cur = jnp.zeros(16, dtype=jnp.int32)
+        keys = jnp.arange(16, dtype=jnp.int32)
+        return functools.partial(L.dht_hop_lanes, g,
+                                 metric="ring"), (cur, keys)
+
+    return Lowering(name=f"dht_hop/ring@{cls}", op="dht_hop",
+                    variant="ring", shape_class=cls, build=build,
+                    parity=False)
+
+
+def _engine_query_entry(cls: str) -> Lowering:
+    """The batched query loop (engine._query_loop): K=8 min-plus route
+    lookups with per-lane freeze and the packed per-lane answer
+    summary — the queries bench column's measured shape, censused and
+    cost-ratcheted like the batched flood loop."""
+
+    def build():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.querybatch import MinPlusQueries
+        from p2pnetwork_tpu.sim import engine
+
+        g = shape_class(cls)
+        proto = MinPlusQueries(method="auto")
+        qb = proto.init(g, np.arange(8, dtype=np.int32) * 11 % 900,
+                        np.arange(8, dtype=np.int32) * 37 % 900)
+
+        def run(graph, b, key):
+            return engine._query_loop_keeping(graph, proto, b, key,
+                                              max_rounds=64)
+
+        return run, (g, qb, jax.random.key(0))
+
+    return Lowering(name=f"done/queries-engine@{cls}", op="done",
+                    variant="queries-engine", shape_class=cls,
+                    build=build, parity=False)
+
+
 def _engine_batch_cov_entry(cls: str) -> Lowering:
     """The batched run-to-coverage loop (engine._batch_loop): B=32
     lane-packed floods, per-lane completion detection, packed per-lane
@@ -460,6 +529,16 @@ def all_lowerings() -> List[Lowering]:
     # batched engine loop — the message plane's compiled surface.
     for v in ("segment", "gather", "frontier"):
         entries.append(_lanes_kernel_entry(v, "ws1k"))
+    # The non-boolean query-lane kernels (f32/i32 lane carriers,
+    # ops/lanes.py) and the batched query engine loop — PR 14's
+    # compiled surface. The gather/segment pairs are parity groups on
+    # ws1k; ba1k registers the auto-dispatch answer there (the gather
+    # waste bound trips, no skew lane form exists -> segment).
+    for v in ("gather", "segment"):
+        entries.append(_query_lanes_entry("minplus_lanes", v, "ws1k"))
+        entries.append(_query_lanes_entry("sum_lanes", v, "ws1k"))
+    entries.append(_dht_hop_entry("ws1k"))
+    entries.append(_engine_query_entry("ws1k"))
     entries.append(_engine_cov_entry("ws1k"))
     entries.append(_engine_batch_cov_entry("ws1k"))
     # The graftscope flight-recorder twins of the engine loops: same
@@ -483,6 +562,8 @@ def all_lowerings() -> List[Lowering]:
         entries.append(_kernel_entry("or", v, "ba1k", dtype=bool))
     for v in ("segment", "frontier"):
         entries.append(_lanes_kernel_entry(v, "ba1k"))
+    for op in ("minplus_lanes", "sum_lanes"):
+        entries.append(_query_lanes_entry(op, "segment", "ba1k"))
     return entries
 
 

@@ -39,6 +39,15 @@ from p2pnetwork_tpu.models.messagebatch import (
     lane_messages,
     lane_seen,
 )
+from p2pnetwork_tpu.models.querybatch import (
+    DhtLookups,
+    LaneBudgetExceeded,
+    MinPlusQueries,
+    PushSumQueries,
+    QueryBatch,
+    free_query_lanes,
+    lane_dist,
+)
 from p2pnetwork_tpu.models.gossip import Gossip, GossipState
 from p2pnetwork_tpu.models.hits import HITS, HITSState
 from p2pnetwork_tpu.models.hopdist import (
@@ -83,6 +92,8 @@ __all__ = [
     "transitivity_sample",
     "triangles_per_node",
     "free_lane_count",
+    "free_query_lanes",
+    "lane_dist",
     "lane_frontier",
     "lane_messages",
     "lane_seen",
@@ -91,8 +102,13 @@ __all__ = [
     "AntiEntropy",
     "AntiEntropyState",
     "BatchFlood",
+    "DhtLookups",
+    "LaneBudgetExceeded",
     "LaneExhausted",
     "MessageBatch",
+    "MinPlusQueries",
+    "PushSumQueries",
+    "QueryBatch",
     "AdaptiveHopDistance",
     "AdaptiveHopDistanceState",
     "BipartiteCheck",

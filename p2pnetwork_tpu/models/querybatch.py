@@ -1,0 +1,729 @@
+"""Batched query lanes: routing lookups, DHT chases and aggregations as
+one lane-packed state per family.
+
+The batched message plane (models/messagebatch.py) proved the economics
+of advancing B in-flight requests with ONE compiled program per round —
+but it only speaks boolean OR-flood, while production traffic is also
+*queries*: "how far / which way to this peer" (routing), "who owns this
+key" (DHT lookup), "what is the network-wide mean of X" (aggregation).
+This module extends the lane template across that protocol zoo with
+NON-BOOLEAN lane carriers (ops/lanes.py):
+
+- :class:`MinPlusQueries` — K concurrent single-source shortest-path
+  queries as a node-major ``f32[N_pad, K]`` min-plus carry; per-lane
+  kernel = ``ops/segment.propagate_min_plus``, per-lane freeze when the
+  target's distance settles (first arrival on unweighted graphs — BFS
+  semantics — or the lane's Bellman-Ford fixpoint otherwise). The
+  batched "route lookup" service primitive.
+- :class:`DhtLookups` — Chord/Kademlia greedy successor chases over the
+  structured overlays (sim/graph.py ``chord``/``kademlia``): one
+  ``i32[K]`` cursor per lookup, one neighbor-row gather per compiled
+  round resolving thousands of key lookups in O(log n) rounds.
+- :class:`PushSumQueries` — B independent push-sum aggregation queries
+  (per-lane kernel semantics exactly models/pushsum.py) sharing one
+  edge gather per round; per-lane freeze when the lane's estimate
+  variance drops under its threshold.
+
+Template semantics are the PR-10 batch plane's, carried over verbatim:
+per-lane results identical to an independent single-query run
+(bit-identical int/f32-min lanes; bit-identical float op order for the
+push-sum sums — tests/test_querybatch.py pins the sweeps), completed
+lanes FREEZE — a correctness LATCH (a settled lane stops changing,
+counting rounds, and sending), not a compute saving: the dense
+``[N_pad, K]`` kernels pay the full batch width each round, so one
+straggler prices the whole batch until the loop exits (unlike the flood
+plane's frontier compaction) — staggered admission between engine calls
+through ``admit``/``retire`` (:class:`~p2pnetwork_tpu.models.
+messagebatch.LaneExhausted` is the backpressure signal, shared with the
+flood plane), and the whole per-lane summary returns in one packed
+transfer (``engine.run_queries_until_done``).
+
+What is NEW versus boolean lanes is the cost model: an f32/i32 lane has
+no 32-per-word packing, so K is budgeted **by bytes** —
+``ops/lanes.lane_budget`` gates every family's ``init``/``admit`` and
+refuses an over-HBM K with a loud
+:class:`~p2pnetwork_tpu.ops.lanes.LaneBudgetExceeded` instead of an OOM
+three rounds into a run (the PR-10 400 MB/round expansion lesson,
+promoted to an API contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pnetwork_tpu.models import base
+from p2pnetwork_tpu.models.messagebatch import LaneExhausted
+from p2pnetwork_tpu.ops import lanes as L
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.ops.lanes import LaneBudgetExceeded  # re-export
+from p2pnetwork_tpu.sim.graph import Graph
+from p2pnetwork_tpu.telemetry import spans
+
+__all__ = [
+    "QueryBatch",
+    "MinPlusQueries",
+    "DhtLookups",
+    "PushSumQueries",
+    "LaneBudgetExceeded",
+    "lane_dist",
+    "free_query_lanes",
+]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class QueryBatch:
+    """Lane-packed state of up to ``capacity`` concurrent queries of ONE
+    family. ``payload`` holds the family's lane carriers (node-major —
+    the lane axis is innermost, so one gathered node row moves K
+    contiguous lane values, the f32 analog of 32 bit lanes riding one
+    u32 word): ``{"dist": f32[N_pad, K]}`` for min-plus,
+    ``{"cur": i32[K]}`` for DHT chases, ``{"s","w": f32[N_pad, K]}`` for
+    push-sum. The metadata vectors mirror MessageBatch's lifecycle: a
+    lane is OPEN while ``~admitted``, RUNNING while
+    ``admitted & ~done``, FROZEN once ``done``; ``rounds`` counts steps
+    APPLIED to the lane (identical to an independent single-query run's
+    round count). ``target`` is the query argument (target node /
+    lookup key; -1 where the family takes none) and ``threshold`` the
+    convergence knob (push-sum's variance target; 0 elsewhere)."""
+
+    payload: dict          # family lane carriers (see class docstring)
+    source: jax.Array      # i32[K] — origin node / seed (-1 = open lane)
+    target: jax.Array      # i32[K] — target node / lookup key (-1 = none)
+    threshold: jax.Array   # f32[K] — convergence target (push-sum)
+    admitted: jax.Array    # bool[K]
+    done: jax.Array        # bool[K] — frozen (query settled)
+    rounds: jax.Array      # i32[K] — steps applied per lane
+
+    @property
+    def capacity(self) -> int:
+        return self.admitted.shape[0]
+
+
+def _check_lane(qb: QueryBatch, lane: int) -> int:
+    """Bounds-check a lane id on the poll side — an out-of-range lane
+    would silently clamp into another query's column (the same footgun
+    messagebatch._lane_word guards)."""
+    lane = int(lane)
+    if not 0 <= lane < qb.capacity:
+        raise ValueError(
+            f"lane {lane} outside this batch's capacity {qb.capacity} — "
+            f"stale or foreign lane id?")
+    return lane
+
+
+def lane_dist(qb: QueryBatch, lane: int) -> jax.Array:
+    """One min-plus lane's full distance field ``f32[N_pad]`` — the
+    route-potential view (next hop toward the target from any node v is
+    its neighbor minimizing ``dist``; the per-target scalar answer rides
+    the packed summary's ``lane_values`` instead)."""
+    return qb.payload["dist"][:, _check_lane(qb, lane)]
+
+
+def free_query_lanes(qb: QueryBatch) -> int:
+    """Open-lane count (one small host transfer — admission is
+    control-plane work between engine calls)."""
+    return int(qb.capacity - np.count_nonzero(np.asarray(qb.admitted)))
+
+
+def _assign_lanes(qb: QueryBatch, count: int) -> np.ndarray:
+    """Host-side open-lane assignment (the admission seam's control
+    plane). Raises :class:`LaneExhausted` — the same typed backpressure
+    signal the flood plane's admission controller already speaks."""
+    open_lanes = np.flatnonzero(~np.asarray(qb.admitted))
+    if count > open_lanes.size:
+        raise LaneExhausted(count, open_lanes.size, qb.capacity)
+    return open_lanes[:count].astype(np.int32)
+
+
+def _validate_node_ids(graph: Graph, ids: np.ndarray) -> None:
+    """Vectorized range check with the one canonical error message
+    (base.validate_source) — K is large on the admission hot path."""
+    bad = (ids < 0) | (ids >= graph.n_nodes_padded)
+    if bad.any():
+        base.validate_source(graph, int(ids[bad.argmax()]))
+
+
+def _emit_submits(lanes_np: np.ndarray, sources: np.ndarray) -> None:
+    """One ``lane_submit`` trace event per admitted query (the
+    control-plane timestamp a serving front-end's latency starts from —
+    the engine's ``query_run`` span later emits ``lane_admit`` when the
+    lane first advances). No-op without an installed tracer."""
+    if spans.current_tracer() is not None:
+        for lane_id, src_id in zip(lanes_np.tolist(), sources.tolist()):
+            spans.emit("lane_submit", lane=lane_id, source=src_id)
+
+
+def _emit_retires(release: np.ndarray) -> None:
+    if spans.current_tracer() is not None:
+        for lane in np.flatnonzero(release).tolist():
+            spans.emit("lane_retire", lane=lane)
+
+
+def _release_mask(qb: QueryBatch, lanes_arg) -> np.ndarray:
+    """The bool[K] release set of a retire call (default: every done
+    lane), bounds-checked like messagebatch.retire — a numpy-wrapped -1
+    would silently erase the LAST lane's in-flight query."""
+    if lanes_arg is None:
+        return np.asarray(qb.done)
+    ids = np.asarray(lanes_arg, dtype=np.int64).reshape(-1)
+    bad = (ids < 0) | (ids >= qb.capacity)
+    if bad.any():
+        raise ValueError(
+            f"retire of lane {int(ids[bad.argmax()])} outside this "
+            f"batch's capacity {qb.capacity} — stale or foreign lane id?")
+    release = np.zeros(qb.capacity, dtype=bool)
+    release[ids] = True
+    return release
+
+
+def _retire_metadata(qb: QueryBatch, payload: dict,
+                     release: np.ndarray) -> QueryBatch:
+    """The metadata half of retire, shared by all three families."""
+    rel = jnp.asarray(release)
+    return dataclasses.replace(
+        qb,
+        payload=payload,
+        source=jnp.where(rel, -1, qb.source),
+        target=jnp.where(rel, -1, qb.target),
+        threshold=jnp.where(rel, 0.0, qb.threshold),
+        admitted=qb.admitted & ~rel,
+        done=qb.done & ~rel,
+        rounds=jnp.where(rel, 0, qb.rounds),
+    )
+
+
+def _lane_sum(weights: jax.Array, mat: jax.Array) -> jax.Array:
+    """``sum_n weights[n] * mat[n, k]`` per lane, as a GEMV: XLA CPU's
+    strided axis-0 reduce runs single-threaded AND inlines the whole
+    producer chain into its fusion (measured ~75-100x on the query
+    steps); the dot lowering is multi-threaded and materializes its
+    operands. ``Precision.HIGHEST`` keeps the TPU lowering in full f32 —
+    these sums decide completion and price messages, and the default MXU
+    precision would bf16-round them."""
+    return jnp.einsum("n,nk->k", weights, mat,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _live_messages(live: jax.Array, per_lane: jax.Array) -> jax.Array:
+    """Aggregate this round's sends across live lanes as u32 — exact
+    while ``K * E < 2^32`` (the engine's two-limb fold consumes one
+    sub-2^32 subtotal per round, the ``messages_words`` contract)."""
+    return jnp.sum(jnp.where(live, per_lane, 0).astype(jnp.uint32))
+
+
+def _empty_metadata(capacity: int) -> dict:
+    cap = int(capacity)
+    if cap < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    return dict(
+        source=jnp.full(cap, -1, dtype=jnp.int32),
+        target=jnp.full(cap, -1, dtype=jnp.int32),
+        threshold=jnp.zeros(cap, dtype=jnp.float32),
+        admitted=jnp.zeros(cap, dtype=bool),
+        done=jnp.zeros(cap, dtype=bool),
+        rounds=jnp.zeros(cap, dtype=jnp.int32),
+    )
+
+
+# --------------------------------------------------------------- min-plus
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class MinPlusQueries:
+    """K concurrent shortest-path/route lookups: lane k asks "what is
+    the cheapest cost from ``source[k]`` to ``target[k]``" and relaxes a
+    full distance column per round (``ops/lanes.
+    propagate_min_plus_lanes`` — per lane exactly ``propagate_min_plus``,
+    so weights/unit hops follow the graph).
+
+    Completion is "the target's distance settled": on UNWEIGHTED graphs
+    a finite distance is final the round it appears (BFS first arrival),
+    so the lane freezes at first touch; on weighted graphs — and for
+    unreachable targets — the lane freezes at its Bellman-Ford fixpoint
+    (a round that changed nothing), where every distance is exact.
+    The per-lane answer (``dist[target]``; +inf = unreachable) rides the
+    packed summary as ``lane_values``; the full route-potential field
+    stays readable per lane via :func:`lane_dist`."""
+
+    method: str = "auto"          # ops/lanes.py lowering
+    budget_bytes: int = None      # lane_budget override (None = default)
+
+    VALUES_FLOAT = True           # lane_values dtype (engine pack hint)
+
+    def _budget(self, graph: Graph, capacity: int) -> None:
+        L.lane_budget(capacity, jnp.float32, graph.n_nodes_padded,
+                      carriers=1, budget_bytes=self.budget_bytes)
+
+    def empty(self, graph: Graph, capacity: int) -> QueryBatch:
+        """An all-open batch of ``capacity`` route-lookup lanes —
+        byte-budget-gated (f32 lanes pay full width; there is no
+        32-per-word discount here)."""
+        self._budget(graph, capacity)
+        n_pad = graph.n_nodes_padded
+        return QueryBatch(
+            payload={"dist": jnp.full((n_pad, int(capacity)), jnp.inf,
+                                      dtype=jnp.float32)},
+            **_empty_metadata(capacity))
+
+    def init(self, graph: Graph, sources, targets, *,
+             capacity: int = None) -> QueryBatch:
+        """A fresh batch with one lane admitted per (source, target)
+        pair; ``capacity`` reserves open lanes for later admit waves."""
+        sources = np.asarray(sources, dtype=np.int32).reshape(-1)
+        targets = np.asarray(targets, dtype=np.int32).reshape(-1)
+        if sources.size == 0:
+            raise ValueError("init needs at least one query")
+        if sources.size != targets.size:
+            raise ValueError(
+                f"{sources.size} sources vs {targets.size} targets — "
+                "route lookups are (source, target) pairs")
+        cap = capacity if capacity is not None else sources.size
+        if cap < sources.size:
+            raise ValueError(f"capacity {cap} < {sources.size} queries")
+        qb = self.empty(graph, cap)
+        qb, _ = self.admit(graph, qb, sources, targets)
+        return qb
+
+    def admit(self, graph: Graph, qb: QueryBatch, sources, targets):
+        """Seed new route lookups into OPEN lanes; returns
+        ``(batch, lane_ids)``. A query whose source IS its (live) target
+        starts ``done`` with distance 0 (the admission-time completion,
+        like a flood already at coverage); a dead source seeds an all-inf
+        lane that settles to "unreachable" in one round. Raises
+        :class:`LaneExhausted` when lanes run out and
+        :class:`LaneBudgetExceeded` when the batch itself is over the
+        byte budget (hand-built batches bypass ``empty``'s gate)."""
+        self._budget(graph, qb.capacity)
+        sources = np.asarray(sources, dtype=np.int32).reshape(-1)
+        targets = np.asarray(targets, dtype=np.int32).reshape(-1)
+        if sources.size != targets.size:
+            raise ValueError(
+                f"{sources.size} sources vs {targets.size} targets — "
+                "route lookups are (source, target) pairs")
+        if sources.size == 0:
+            return qb, np.zeros(0, dtype=np.int32)
+        _validate_node_ids(graph, sources)
+        _validate_node_ids(graph, targets)
+        lanes_np = _assign_lanes(qb, sources.size)
+        src = jnp.asarray(sources)
+        tgt = jnp.asarray(targets)
+        lanes_j = jnp.asarray(lanes_np)
+        seeded = graph.node_mask[src]          # dead source seeds nothing
+        seed_val = jnp.where(seeded, 0.0, jnp.inf).astype(jnp.float32)
+        dist = qb.payload["dist"].at[src, lanes_j].set(seed_val)
+        _emit_submits(lanes_np, sources)
+        return dataclasses.replace(
+            qb,
+            payload={"dist": dist},
+            source=qb.source.at[lanes_j].set(src),
+            target=qb.target.at[lanes_j].set(tgt),
+            admitted=qb.admitted.at[lanes_j].set(True),
+            done=qb.done.at[lanes_j].set(seeded & (src == tgt)),
+            rounds=qb.rounds.at[lanes_j].set(0),
+        ), lanes_np
+
+    def retire(self, qb: QueryBatch, lanes=None) -> QueryBatch:
+        """Release lanes back to OPEN (default: every done lane),
+        resetting their distance columns to +inf. Read results first —
+        this erases them."""
+        release = _release_mask(qb, lanes)
+        _emit_retires(release)
+        rel = jnp.asarray(release)
+        dist = jnp.where(rel[None, :], jnp.inf, qb.payload["dist"])
+        return _retire_metadata(qb, {"dist": dist}, release)
+
+    def refresh(self, graph: Graph, qb: QueryBatch) -> QueryBatch:
+        """Completion is LATCHED, like the flood plane's: a settled
+        route answer stays answered when later failures change the graph
+        (its lane froze; re-resolving after churn is a NEW query via
+        admit). Running lanes relax against the CURRENT mask from the
+        next step on. Nothing here is mask-derived, so refresh is the
+        identity — the hook exists for engine-template parity (the
+        entry calls it eagerly, where a recomputing refresh would
+        otherwise dead-code a donated input leaf)."""
+        return qb
+
+    def step(self, graph: Graph, qb: QueryBatch, key: jax.Array):
+        """One Bellman-Ford round of every RUNNING lane; frozen/open
+        lanes are masked out of the column update and pay nothing."""
+        dist = qb.payload["dist"]
+        live = qb.admitted & ~qb.done
+        relaxed = jnp.minimum(
+            dist, L.propagate_min_plus_lanes(graph, dist, self.method))
+        new_dist = jnp.where(live[None, :], relaxed, dist)
+        # One improvement field serves the fixpoint check AND the
+        # message count; reduced via einsum (see stats below).
+        improved_f = (new_dist != dist).astype(jnp.float32)
+        ones = jnp.ones(graph.n_nodes_padded, jnp.float32)
+        changed = _lane_sum(ones, improved_f) > 0          # bool[K]
+        k_idx = jnp.arange(qb.capacity)
+        tgt = jnp.clip(qb.target, 0, graph.n_nodes_padded - 1)
+        at_target = new_dist[tgt, k_idx]                  # f32[K]
+        settled = ~changed                                 # lane fixpoint
+        if graph.edge_weight is None:
+            # Unit hops: first arrival IS the shortest distance (BFS) —
+            # the target settles the round it turns finite.
+            finished = jnp.isfinite(at_target) | settled
+        else:
+            # Weighted: only the fixpoint certifies the target's
+            # distance (a cheaper multi-hop path may still be in
+            # flight).
+            finished = settled
+        done = qb.done | (live & finished)
+        rounds = qb.rounds + live.astype(jnp.int32)
+        # Message model: nodes whose distance IMPROVED advertise along
+        # their out-edges (the distance-vector frontier semantics,
+        # models/routing.py), priced off the same improvement field the
+        # fixpoint check reads, via the _lane_sum GEMV. The f32 dot is
+        # exact while a lane's per-round sum stays under 2^24 — i.e.
+        # E < ~16.7M directed edges; past that this TELEMETRY count is
+        # approximate (the completion math never rides it, and the
+        # engine's two-limb fold stays exact in what it is fed).
+        per_lane = _lane_sum(graph.out_degree.astype(jnp.float32),
+                             improved_f).astype(jnp.int32)
+        stats = {
+            "messages": _live_messages(live, per_lane),
+            "changed_lanes": jnp.sum((live & changed).astype(jnp.int32)),
+        }
+        return dataclasses.replace(
+            qb, payload={"dist": new_dist}, done=done, rounds=rounds,
+        ), stats
+
+    def lane_values(self, graph: Graph, qb: QueryBatch) -> jax.Array:
+        """Per-lane answer for the packed summary: ``dist[target]``
+        (f32[K]; +inf = unreachable or open lane)."""
+        k_idx = jnp.arange(qb.capacity)
+        tgt = jnp.clip(qb.target, 0, graph.n_nodes_padded - 1)
+        return jnp.where(qb.admitted, qb.payload["dist"][tgt, k_idx],
+                         jnp.inf)
+
+
+# -------------------------------------------------------------- DHT chase
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class DhtLookups:
+    """K concurrent DHT key lookups as greedy successor chases: lane k
+    holds a cursor that hops, each round, to its closest live neighbor
+    under the overlay metric (``ring`` = Chord's clockwise identifier
+    distance, ``xor`` = Kademlia's) — ``ops/lanes.dht_hop_lanes``, one
+    neighbor-row gather serving every lookup. On the structured overlays
+    (sim/graph.py ``chord``/``kademlia``) a lookup resolves in O(log n)
+    hops; the lane freezes when the cursor ARRIVES (cursor == key's
+    node) or STALLS (no strictly closer neighbor — dead responsible
+    node, partitioned overlay: the lookup's honest failure mode).
+
+    Keys live in the real id space ``[0, n_nodes)`` — the overlay
+    geometry's modulus. The per-lane answer (final cursor, i32) rides
+    the packed summary; ``found`` is ``lane_values == target``."""
+
+    metric: str = "ring"          # ops/lanes.DHT_METRICS
+    budget_bytes: int = None
+
+    VALUES_FLOAT = False          # lane_values are raw i32 node ids
+
+    def __post_init__(self):
+        if self.metric not in L.DHT_METRICS:
+            raise ValueError(
+                f"unknown DHT metric {self.metric!r} — one of "
+                f"{L.DHT_METRICS}")
+
+    def _budget(self, graph: Graph, capacity: int) -> None:
+        # The cursor state is O(1) per lane (i32 cursor; n_pad plays no
+        # part) — budgeted all the same so a million-lookup admit on a
+        # tight budget still fails loudly instead of surprising later.
+        L.lane_budget(capacity, jnp.int32, 1, carriers=1,
+                      budget_bytes=self.budget_bytes)
+
+    def empty(self, graph: Graph, capacity: int) -> QueryBatch:
+        self._budget(graph, capacity)
+        return QueryBatch(
+            payload={"cur": jnp.zeros(int(capacity), dtype=jnp.int32)},
+            **_empty_metadata(capacity))
+
+    def init(self, graph: Graph, origins, keys, *,
+             capacity: int = None) -> QueryBatch:
+        """A fresh batch with one lookup admitted per (origin, key)
+        pair."""
+        origins = np.asarray(origins, dtype=np.int32).reshape(-1)
+        keys = np.asarray(keys, dtype=np.int32).reshape(-1)
+        if origins.size == 0:
+            raise ValueError("init needs at least one lookup")
+        if origins.size != keys.size:
+            raise ValueError(
+                f"{origins.size} origins vs {keys.size} keys — DHT "
+                "lookups are (origin, key) pairs")
+        cap = capacity if capacity is not None else origins.size
+        if cap < origins.size:
+            raise ValueError(f"capacity {cap} < {origins.size} lookups")
+        qb = self.empty(graph, cap)
+        qb, _ = self.admit(graph, qb, origins, keys)
+        return qb
+
+    def admit(self, graph: Graph, qb: QueryBatch, origins, keys):
+        """Seed new lookups into OPEN lanes; returns ``(batch,
+        lane_ids)``. An origin already AT the key completes at admission
+        (0 hops); a dead origin completes immediately as a failed lookup
+        (a crashed node issues nothing). Keys must live in
+        ``[0, n_nodes)`` — the metric's modulus."""
+        self._budget(graph, qb.capacity)
+        origins = np.asarray(origins, dtype=np.int32).reshape(-1)
+        keys = np.asarray(keys, dtype=np.int32).reshape(-1)
+        if origins.size != keys.size:
+            raise ValueError(
+                f"{origins.size} origins vs {keys.size} keys — DHT "
+                "lookups are (origin, key) pairs")
+        if origins.size == 0:
+            return qb, np.zeros(0, dtype=np.int32)
+        _validate_node_ids(graph, origins)
+        bad = (keys < 0) | (keys >= graph.n_nodes)
+        if bad.any():
+            raise ValueError(
+                f"lookup key {int(keys[bad.argmax()])} outside the "
+                f"overlay id space [0, {graph.n_nodes}) — keys speak "
+                "the ring/xor metric's modulus, not the padded space")
+        lanes_np = _assign_lanes(qb, origins.size)
+        org = jnp.asarray(origins)
+        key_ids = jnp.asarray(keys)
+        lanes_j = jnp.asarray(lanes_np)
+        alive = graph.node_mask[org]
+        _emit_submits(lanes_np, origins)
+        return dataclasses.replace(
+            qb,
+            payload={"cur": qb.payload["cur"].at[lanes_j].set(org)},
+            source=qb.source.at[lanes_j].set(org),
+            target=qb.target.at[lanes_j].set(key_ids),
+            admitted=qb.admitted.at[lanes_j].set(True),
+            done=qb.done.at[lanes_j].set((org == key_ids) | ~alive),
+            rounds=qb.rounds.at[lanes_j].set(0),
+        ), lanes_np
+
+    def retire(self, qb: QueryBatch, lanes=None) -> QueryBatch:
+        release = _release_mask(qb, lanes)
+        _emit_retires(release)
+        rel = jnp.asarray(release)
+        cur = jnp.where(rel, 0, qb.payload["cur"])
+        return _retire_metadata(qb, {"cur": cur}, release)
+
+    def refresh(self, graph: Graph, qb: QueryBatch) -> QueryBatch:
+        """Identity — an arrived lookup stays arrived (latched, like
+        every lane completion); a running chase re-routes around nodes
+        that died between calls at its next hop, since hop validity
+        reads the CURRENT mask."""
+        return qb
+
+    def step(self, graph: Graph, qb: QueryBatch, key: jax.Array):
+        """One greedy hop of every RUNNING lookup (one message per hop);
+        frozen/open lanes keep their cursor and send nothing."""
+        cur = qb.payload["cur"]
+        live = qb.admitted & ~qb.done
+        nxt, hopped = L.dht_hop_lanes(graph, cur, qb.target, self.metric)
+        new_cur = jnp.where(live, nxt, cur)
+        arrived = new_cur == qb.target
+        finished = arrived | ~hopped       # stalled = no closer neighbor
+        done = qb.done | (live & finished)
+        rounds = qb.rounds + live.astype(jnp.int32)
+        stats = {
+            "messages": _live_messages(live & hopped,
+                                       jnp.ones_like(qb.rounds)),
+            "arrived_lanes": jnp.sum((live & arrived).astype(jnp.int32)),
+        }
+        return dataclasses.replace(
+            qb, payload={"cur": new_cur}, done=done, rounds=rounds,
+        ), stats
+
+    def lane_values(self, graph: Graph, qb: QueryBatch) -> jax.Array:
+        """Per-lane answer: the final cursor (i32[K]; -1 on open lanes).
+        ``found`` is ``lane_values == target`` — a stalled chase's
+        cursor names the closest reachable node, the overlay's honest
+        "who should own it" fallback."""
+        return jnp.where(qb.admitted, qb.payload["cur"], -1)
+
+
+# --------------------------------------------------------------- push-sum
+
+
+@dataclasses.dataclass(frozen=True, unsafe_hash=True)
+class PushSumQueries:
+    """B independent push-sum aggregation queries sharing one edge
+    gather per round: lane k runs models/pushsum.py's mass-splitting
+    consensus over its OWN seeded value field (``s``/``w`` lane columns)
+    and freezes when its estimate variance drops under the lane's
+    threshold — "what is the network mean of X" as a batched, admitted,
+    retirable query.
+
+    Per-lane float semantics are EXACTLY the single
+    :class:`~p2pnetwork_tpu.models.pushsum.PushSum` run's: the lane
+    kernels accumulate in ``propagate_sum(method="segment")``'s edge
+    order and the share multiply is the same two-f32 product, so a
+    lane's s/w trajectory matches an independent run from the same seed
+    float op for float op — the order contract
+    tests/test_querybatch.py pins bitwise step-for-step (eager; the
+    compiled loop may fuse the share multiply-add, a documented
+    last-ulp freedom the same-K isolation pin bounds).
+    Each lane's seed field is ``normal(fold_in(key(seed_salt), seed))``
+    masked to live nodes, exactly ``PushSum.init``'s recipe with the
+    lane's seed folded in."""
+
+    method: str = "auto"          # ops/lanes.py lowering
+    seed_salt: int = 0            # base key of the per-lane value fields
+    budget_bytes: int = None
+
+    VALUES_FLOAT = True           # lane_values are f32 mean estimates
+
+    def _budget(self, graph: Graph, capacity: int) -> None:
+        L.lane_budget(capacity, jnp.float32, graph.n_nodes_padded,
+                      carriers=2, budget_bytes=self.budget_bytes)
+
+    def empty(self, graph: Graph, capacity: int) -> QueryBatch:
+        """An all-open batch — byte-budget-gated at TWO f32 carriers
+        per lane (s and w both ride the loop)."""
+        self._budget(graph, capacity)
+        n_pad = graph.n_nodes_padded
+        zeros = jnp.zeros((n_pad, int(capacity)), dtype=jnp.float32)
+        return QueryBatch(payload={"s": zeros, "w": zeros},
+                          **_empty_metadata(capacity))
+
+    def init(self, graph: Graph, seeds, *, threshold: float = 1e-4,
+             capacity: int = None) -> QueryBatch:
+        """A fresh batch with one aggregation query admitted per seed."""
+        seeds = np.asarray(seeds, dtype=np.int32).reshape(-1)
+        if seeds.size == 0:
+            raise ValueError("init needs at least one query")
+        cap = capacity if capacity is not None else seeds.size
+        if cap < seeds.size:
+            raise ValueError(f"capacity {cap} < {seeds.size} queries")
+        qb = self.empty(graph, cap)
+        qb, _ = self.admit(graph, qb, seeds, threshold=threshold)
+        return qb
+
+    def admit(self, graph: Graph, qb: QueryBatch, seeds, *,
+              threshold: float = 1e-4):
+        """Seed new aggregation queries into OPEN lanes; returns
+        ``(batch, lane_ids)``. Every admitted lane runs at least one
+        round before its variance is consulted — matching
+        ``run_until_converged``'s value0=inf contract, so a batched lane
+        and an independent single run apply identical step counts."""
+        self._budget(graph, qb.capacity)
+        if not threshold > 0:
+            raise ValueError(
+                f"threshold must be > 0, got {threshold} (push-sum "
+                "variance has an f32 floor — see run_until_converged)")
+        seeds = np.asarray(seeds, dtype=np.int32).reshape(-1)
+        if seeds.size == 0:
+            return qb, np.zeros(0, dtype=np.int32)
+        lanes_np = _assign_lanes(qb, seeds.size)
+        lanes_j = jnp.asarray(lanes_np)
+        base_key = jax.random.key(self.seed_salt)
+        keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
+            jnp.asarray(seeds))
+        n_pad = graph.n_nodes_padded
+        values = jax.vmap(
+            lambda k: jax.random.normal(k, (n_pad,), dtype=jnp.float32)
+        )(keys)                                     # f32[count, N_pad]
+        mask_f = graph.node_mask.astype(jnp.float32)
+        s_cols = (values * mask_f[None, :]).T       # node-major columns
+        w_cols = jnp.broadcast_to(mask_f[:, None],
+                                  (n_pad, int(seeds.size)))
+        _emit_submits(lanes_np, seeds)
+        return dataclasses.replace(
+            qb,
+            payload={"s": qb.payload["s"].at[:, lanes_j].set(s_cols),
+                     "w": qb.payload["w"].at[:, lanes_j].set(w_cols)},
+            source=qb.source.at[lanes_j].set(jnp.asarray(seeds)),
+            threshold=qb.threshold.at[lanes_j].set(
+                jnp.float32(threshold)),
+            admitted=qb.admitted.at[lanes_j].set(True),
+            done=qb.done.at[lanes_j].set(False),
+            rounds=qb.rounds.at[lanes_j].set(0),
+        ), lanes_np
+
+    def retire(self, qb: QueryBatch, lanes=None) -> QueryBatch:
+        release = _release_mask(qb, lanes)
+        _emit_retires(release)
+        rel = jnp.asarray(release)
+        payload = {k: jnp.where(rel[None, :], 0.0, v)
+                   for k, v in qb.payload.items()}
+        return _retire_metadata(qb, payload, release)
+
+    def refresh(self, graph: Graph, qb: QueryBatch) -> QueryBatch:
+        """Identity — converged estimates latch; running lanes keep
+        consenting over the CURRENT mask (mass conservation holds per
+        compiled run, where the mask is static)."""
+        return qb
+
+
+    def _variance(self, graph: Graph, s: jax.Array,
+                  w: jax.Array) -> jax.Array:
+        """Per-lane estimate variance over live nodes — the same
+        ``est``/``mean``/``var`` math as models/pushsum.py's stats (the
+        mask multiply replaces its ``where``: identical f32 values).
+        The [N, K] -> [K] reductions ride einsum (GEMV): XLA CPU's
+        strided axis-0 reduce runs single-threaded and drags the whole
+        producer chain into its fusion (measured ~100x on this step)."""
+        mask_f = graph.node_mask.astype(jnp.float32)
+        est = jnp.where(w > 0, s / jnp.maximum(w, 1e-30), 0.0)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        mean = _lane_sum(mask_f, est) / n_real
+        return _lane_sum(mask_f, (est - mean[None, :]) ** 2) / n_real
+
+    def step(self, graph: Graph, qb: QueryBatch, key: jax.Array):
+        """One mass-splitting round of every RUNNING lane — two shared
+        edge gathers advance all of them (models/pushsum.py's step, per
+        column). Frozen lanes keep their masses untouched.
+
+        Convergence is checked on the ENTERING masses — a lane whose
+        variance is already under threshold freezes before stepping.
+        That applies exactly the same step count as check-after-step
+        semantics (run_until_converged's: the round that crosses is the
+        last applied either way) while letting the check read the loop
+        CARRY, which keeps the variance fusion decoupled from this
+        round's gather chains (the check-after form re-inlined them,
+        measured ~100x). The one visible difference: a lane already
+        under threshold AT ADMISSION completes with 0 rounds, like a
+        flood admitted at coverage."""
+        s, w = qb.payload["s"], qb.payload["w"]
+        var = self._variance(graph, s, w)
+        done = qb.done | (qb.admitted & (var < qb.threshold))
+        live = qb.admitted & ~done
+        mask_f = graph.node_mask.astype(jnp.float32)
+        shares = 1.0 / (graph.out_degree.astype(jnp.float32) + 1.0)
+        # Kept share and sent shares both read ONE materialized s_sh
+        # (two consumers), the same structure — and so the same float
+        # ops — as PushSum.step's s_share.
+        s_sh = s * shares[:, None]
+        w_sh = w * shares[:, None]
+        s2 = (s_sh + L.propagate_sum_lanes(graph, s_sh,
+                                           self.method)) * mask_f[:, None]
+        w2 = (w_sh + L.propagate_sum_lanes(graph, w_sh,
+                                           self.method)) * mask_f[:, None]
+        rounds = qb.rounds + live.astype(jnp.int32)
+        # One share per outgoing edge of every live node, per live lane
+        # (models/pushsum.py's message model).
+        per_round = segment.frontier_messages(graph, graph.node_mask)
+        stats = {
+            "messages": (per_round.astype(jnp.uint32)
+                         * jnp.sum(live.astype(jnp.uint32))),
+            "variance_max": jnp.max(jnp.where(live, var, 0.0)),
+        }
+        return dataclasses.replace(
+            qb,
+            payload={"s": jnp.where(live[None, :], s2, s),
+                     "w": jnp.where(live[None, :], w2, w)},
+            done=done, rounds=rounds,
+        ), stats
+
+    def lane_values(self, graph: Graph, qb: QueryBatch) -> jax.Array:
+        """Per-lane answer: the network-mean estimate (f32[K]) — the
+        aggregation result each query asked for (0 on open lanes)."""
+        s, w = qb.payload["s"], qb.payload["w"]
+        est = jnp.where(w > 0, s / jnp.maximum(w, 1e-30), 0.0)
+        mask_f = graph.node_mask.astype(jnp.float32)
+        n_real = jnp.maximum(jnp.sum(graph.node_mask), 1)
+        mean = _lane_sum(mask_f, est) / n_real
+        return jnp.where(qb.admitted, mean, 0.0)

@@ -1,0 +1,296 @@
+"""Non-boolean query lanes: f32/i32 lane carriers + the byte budget.
+
+The batched message plane (ops/bitset.py, models/messagebatch.py) packs 32
+BOOLEAN predicates per uint32 word — 32 concurrent broadcasts in the
+footprint of one. The query families this module serves (min-plus routing,
+DHT successor chases, push-sum aggregation — models/querybatch.py) carry
+REAL values per lane: an f32 distance, an i32 cursor, two f32 masses. No
+bit packing exists for those — K lanes cost K full-width columns of HBM,
+which is the PR-10 expansion lesson ([N, 32] bit-plane blowups cost
+400 MB/round at B=1024) made permanent: **K is budgeted by bytes**, via
+:func:`lane_budget`, and every family's ``init``/``admit`` refuses an
+over-budget K with a loud typed error instead of silently OOMing mid-run.
+
+Layout: lane matrices are **node-major** — ``dtype[N_pad, K]``, the lane
+axis innermost — so one gathered node row moves K contiguous lane values
+(the f32 analog of 32 bit lanes riding one u32 word). The transposed
+``[K, N]`` layout turns every per-edge access into a K-strided walk; on
+the CPU backend that is the difference between a streaming kernel and a
+scatter of cache misses (measured ~50x at the 100k-node ratchet class's
+K=64).
+
+Kernels (each = the scalar ops/segment.py kernel applied per lane column,
+value-for-value):
+
+- :func:`propagate_min_plus_lanes` — K Bellman-Ford relaxations per
+  round. ``gather`` unrolls the neighbor table's degree axis into D
+  row-gather+minimum passes over the lane matrix (contiguous K-wide
+  rows; the fast path); ``segment`` lifts the sorted-COO segment-min to
+  ``[E_pad, K]`` operands (any graph, no table needed — segment ops
+  take ND data with segments along axis 0).
+- :func:`propagate_sum_lanes` — the same two lowerings for sums.
+- :func:`dht_hop_lanes` — one greedy DHT hop per lane: gather each
+  cursor's neighbor row, score it against the lane's key under the
+  overlay's distance metric (ring / xor), step to the closest strictly
+  improving neighbor.
+
+Both propagate lowerings are exact per lane: min is order-blind in f32,
+and the sum lowerings accumulate in the receiver-sorted edge order (the
+neighbor table enumerates exactly that order), matching
+``propagate_sum(method="segment")`` bitwise — the float-op-order contract
+the push-sum family pins (tests/test_querybatch.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.ops import segment
+from p2pnetwork_tpu.sim.graph import Graph
+
+__all__ = [
+    "DEFAULT_LANE_BUDGET_BYTES",
+    "LaneBudgetExceeded",
+    "lane_bytes",
+    "lane_budget",
+    "propagate_min_plus_lanes",
+    "propagate_sum_lanes",
+    "dht_hop_lanes",
+]
+
+#: Default per-state lane-carry budget. Sized for the CI/CPU world and the
+#: single-chip HBM story alike: 1 GiB of lane carry double-buffers to
+#: ~2 GiB inside a donationless loop, comfortably inside one v4 chip's
+#: HBM next to the graph. ``P2P_LANE_BUDGET_BYTES`` overrides (serving
+#: deployments size it to the chip minus the graph's resident footprint).
+DEFAULT_LANE_BUDGET_BYTES = 1 << 30
+
+
+class LaneBudgetExceeded(ValueError):
+    """Lane admission refused: the requested K does not fit the byte
+    budget.
+
+    The non-boolean lane families carry ``itemsize * n_pad`` bytes PER
+    LANE per carrier — there is no 32-per-word packing to hide behind
+    (boolean lanes get that for free; see :func:`lane_bytes`). Sizing K
+    "like the batched floods" silently multiplies HBM by the itemsize,
+    which is exactly how the PR-10 ``[N, 32]`` expansion reached
+    400 MB/round. This error names the numbers so the caller can budget:
+    ``requested_bytes`` for the asked-for capacity, ``budget_bytes`` for
+    the ceiling, plus the ``capacity``/``dtype``/``n_pad``/``carriers``
+    that produced them."""
+
+    def __init__(self, requested_bytes: int, budget_bytes: int, *,
+                 capacity: int, dtype, n_pad: int, carriers: int):
+        self.requested_bytes = int(requested_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.capacity = int(capacity)
+        self.dtype = jnp.dtype(dtype)
+        self.n_pad = int(n_pad)
+        self.carriers = int(carriers)
+        super().__init__(
+            f"{capacity} lanes of {self.dtype.name}[{n_pad}] x "
+            f"{carriers} carrier(s) need {self.requested_bytes:,} bytes "
+            f"of lane carry — over the {self.budget_bytes:,}-byte budget. "
+            f"Lower K, shrink the graph, or raise the budget "
+            f"(budget_bytes= / P2P_LANE_BUDGET_BYTES).")
+
+
+def lane_bytes(capacity: int, dtype, n_pad: int, *,
+               carriers: int = 1) -> int:
+    """Bytes of lane carry for ``capacity`` lanes of one ``dtype[n_pad]``
+    signal, times ``carriers`` state arrays (push-sum carries two).
+
+    ``bool`` lanes are the exception that motivates the whole helper:
+    they pack 32 per uint32 word (ops/bitset.py), so their cost is
+    ``ceil(capacity / 32)`` words — the batched flood plane's 32-free
+    lanes. Every other dtype pays full width per lane, which is the
+    asymmetry callers must budget for: 1024 boolean lanes on a 100k-node
+    graph cost ~12.8 MB per predicate; 1024 f32 lanes cost ~400 MB."""
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    if n_pad < 1:
+        raise ValueError(f"n_pad must be >= 1, got {n_pad}")
+    if carriers < 1:
+        raise ValueError(f"carriers must be >= 1, got {carriers}")
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(bool):
+        words = -(-int(capacity) // 32)
+        return words * 4 * int(n_pad) * int(carriers)
+    return int(capacity) * dt.itemsize * int(n_pad) * int(carriers)
+
+
+def lane_budget(capacity: int, dtype, n_pad: int, *, carriers: int = 1,
+                budget_bytes: int = None) -> int:
+    """Check ``capacity`` lanes against the byte budget; returns the
+    byte cost or raises :class:`LaneBudgetExceeded`.
+
+    The gate every query family's ``init``/``admit`` runs before touching
+    device memory. ``budget_bytes=None`` reads ``P2P_LANE_BUDGET_BYTES``
+    (default :data:`DEFAULT_LANE_BUDGET_BYTES`); pass an explicit budget
+    to size a deployment's lane pool against its real HBM headroom."""
+    cost = lane_bytes(capacity, dtype, n_pad, carriers=carriers)
+    if budget_bytes is None:
+        budget_bytes = int(os.environ.get("P2P_LANE_BUDGET_BYTES",
+                                          DEFAULT_LANE_BUDGET_BYTES))
+    if cost > int(budget_bytes):
+        raise LaneBudgetExceeded(cost, budget_bytes, capacity=capacity,
+                                 dtype=dtype, n_pad=n_pad,
+                                 carriers=carriers)
+    return cost
+
+
+def _auto_lane_method(graph: Graph) -> str:
+    """``auto`` for the lane kernels: the neighbor-table gather under the
+    scalar path's waste bound, else the ND segment form. The skew/MXU
+    lowerings have no lane form (same surface as propagate_or_lanes)."""
+    return "gather" if segment._gather_ok(graph) else "segment"
+
+
+def _require_no_dyn(graph: Graph, what: str) -> None:
+    if graph.dyn_senders is not None:
+        raise ValueError(
+            f"{what} does not fold the dynamic runtime-edge region — "
+            "consolidate the topology (sim/topology.py consolidate) "
+            "before batching queries over it")
+
+
+def propagate_min_plus_lanes(graph: Graph, dist: jax.Array,
+                             method: str = "auto") -> jax.Array:
+    """K min-plus relaxations in one program: ``dist`` is the node-major
+    lane matrix ``f32[N_pad, K]``; lane k's column relaxes exactly like
+    ``ops/segment.propagate_min_plus`` on that column —
+    ``out[v, k] = min(dist[u, k] + w(u, v))`` over live incoming edges,
+    ``+inf`` at dead/in-edge-less nodes. Weights come from the graph
+    (unit hop cost when unweighted), as in the scalar kernel.
+
+    ``method``: ``"gather"`` unrolls the complete neighbor table's
+    degree axis — D row-gather+minimum passes, each moving contiguous
+    K-wide lane rows (the fast path; same complete-table requirement as
+    the scalar gather); ``"segment"`` lifts the sorted-COO segment-min
+    to ``[E_pad, K]`` operands (any graph); ``"auto"`` picks gather
+    under the scalar waste bound. Exact per lane for every method (min
+    is order-blind in f32)."""
+    _require_no_dyn(graph, "propagate_min_plus_lanes")
+    if method == "auto":
+        method = _auto_lane_method(graph)
+    weighted = graph.edge_weight is not None
+    if method == "gather":
+        segment._require_complete_table(graph)
+        if weighted and graph.neighbor_weight is None:
+            raise ValueError(
+                "method='gather' on a weighted graph needs the aligned "
+                "neighbor_weight view — build with from_edges(weights=...)"
+                " or Graph.with_weights, or use method='segment'")
+        out = jnp.full_like(dist, jnp.inf)
+        for d in range(graph.neighbors.shape[1]):
+            w = graph.neighbor_weight[:, d, None] if weighted else 1.0
+            cand = jnp.where(graph.neighbor_mask[:, d, None],
+                             dist[graph.neighbors[:, d]] + w, jnp.inf)
+            out = jnp.minimum(out, cand)
+    elif method == "segment":
+        w = graph.edge_weight[:, None] if weighted else 1.0
+        contrib = jnp.where(graph.edge_mask[:, None],
+                            dist[graph.senders] + w, jnp.inf)
+        out = jax.ops.segment_min(
+            contrib, graph.receivers, num_segments=graph.n_nodes_padded,
+            indices_are_sorted=True)
+    else:
+        raise ValueError(
+            f"propagate_min_plus_lanes supports method 'segment', "
+            f"'gather' or 'auto', got {method!r} (the skew/MXU lowerings "
+            f"have no lane form)")
+    return jnp.where(graph.node_mask[:, None], out, jnp.inf)
+
+
+def propagate_sum_lanes(graph: Graph, vals: jax.Array,
+                        method: str = "auto") -> jax.Array:
+    """K neighbor-sums in one program: ``vals`` is ``f32[N_pad, K]``;
+    lane k's column sums like ``propagate_sum(method="segment")`` on that
+    column, bitwise — both lowerings here accumulate in the
+    receiver-sorted edge order (the neighbor table's rows enumerate
+    exactly that order), the float-op-order contract the push-sum family
+    pins."""
+    _require_no_dyn(graph, "propagate_sum_lanes")
+    if method == "auto":
+        method = _auto_lane_method(graph)
+    if method == "gather":
+        segment._require_complete_table(graph)
+        out = jnp.zeros_like(vals)
+        for d in range(graph.neighbors.shape[1]):
+            row = vals[graph.neighbors[:, d]]
+            out = out + jnp.where(graph.neighbor_mask[:, d, None], row,
+                                  0.0)
+    elif method == "segment":
+        contrib = jnp.where(graph.edge_mask[:, None], vals[graph.senders],
+                            0.0)
+        out = jax.ops.segment_sum(
+            contrib, graph.receivers, num_segments=graph.n_nodes_padded,
+            indices_are_sorted=True)
+    else:
+        raise ValueError(
+            f"propagate_sum_lanes supports method 'segment', 'gather' or "
+            f"'auto', got {method!r} (the skew/MXU lowerings have no "
+            f"lane form)")
+    return out * graph.node_mask.astype(vals.dtype)[:, None]
+
+
+#: Distance sentinel for masked DHT hop candidates — strictly above any
+#: real metric value (node ids are i32, so ring/xor distances < 2^31).
+_DHT_FAR = jnp.uint32(0xFFFFFFFF)
+
+#: The DHT overlay metrics: how far a node id is from a key.
+DHT_METRICS = ("ring", "xor")
+
+
+def dht_distance(node: jax.Array, key: jax.Array, n: int,
+                 metric: str) -> jax.Array:
+    """Overlay distance from ``node`` to ``key`` as ``u32`` (broadcasts).
+
+    ``ring``: clockwise identifier-ring distance ``(key - node) mod n`` —
+    what a Chord lookup greedily minimizes hopping its fingers.
+    ``xor``: Kademlia's XOR metric ``node ^ key``."""
+    if metric == "ring":
+        return jnp.mod(key - node, jnp.int32(n)).astype(jnp.uint32)
+    if metric == "xor":
+        return (node ^ key).astype(jnp.uint32)
+    raise ValueError(
+        f"unknown DHT metric {metric!r} — one of {DHT_METRICS}")
+
+
+def dht_hop_lanes(graph: Graph, cur: jax.Array, keys: jax.Array,
+                  metric: str = "ring"):
+    """One greedy DHT hop for K lookups at once: ``cur``/``keys`` are
+    ``i32[K]`` cursors and lookup keys; returns ``(next_cur, hopped)``
+    where each lane steps to its cursor's live neighbor closest to the
+    key under ``metric`` — but only when that neighbor is STRICTLY
+    closer than the cursor itself (``hopped`` bool[K]); a lane at a
+    local minimum keeps its cursor, which is the lookup's terminal
+    condition (arrived when the cursor IS the key's node, stuck
+    otherwise — dead responsible node, partitioned overlay).
+
+    The per-round cost is ``K x max_degree`` — one neighbor-row gather
+    per lane (thousands of lookups per compiled round ride one gather),
+    which is the whole point: a Chord/Kademlia overlay
+    (sim/graph.py ``chord`` / ``kademlia``) resolves lookups in
+    O(log n) such rounds. Ties break to the lowest neighbor-slot index,
+    deterministically. Requires the complete neighbor table (a
+    width-capped table would silently drop routing fingers)."""
+    segment._require_complete_table(graph)
+    _require_no_dyn(graph, "dht_hop_lanes")
+    if metric not in DHT_METRICS:
+        raise ValueError(
+            f"unknown DHT metric {metric!r} — one of {DHT_METRICS}")
+    n = graph.n_nodes
+    nbrs = graph.neighbors[cur]                      # i32[K, D]
+    valid = graph.neighbor_mask[cur] & graph.node_mask[nbrs]
+    d_nbr = jnp.where(valid, dht_distance(nbrs, keys[:, None], n, metric),
+                      _DHT_FAR)
+    d_cur = dht_distance(cur, keys, n, metric)
+    slot = jnp.argmin(d_nbr, axis=1)                 # first-min tie-break
+    lane = jnp.arange(cur.shape[0])
+    hopped = d_nbr[lane, slot] < d_cur
+    return jnp.where(hopped, nbrs[lane, slot], cur), hopped

@@ -26,9 +26,18 @@ through ``BatchFlood.admit`` — the serving front-end's seam. Per-batch
 occupancy and completion land in the ``sim_batch_active_lanes`` gauge
 and ``sim_batch_completion_rounds`` histogram.
 
-graftscope rides the resume/batch loops: ``recorder=`` on
-:func:`run_from`, :func:`run_until_coverage_from` and
-:func:`run_batch_until_coverage` (a
+The QUERY plane generalizes the batch loop past boolean floods:
+:func:`run_queries_until_done` advances a
+:class:`~p2pnetwork_tpu.models.querybatch.QueryBatch` of K non-boolean
+query lanes (min-plus route lookups, DHT successor chases, push-sum
+aggregations — f32/i32 carriers budgeted BY BYTES via
+``ops/lanes.lane_budget``) with the same donated-carry discipline,
+per-lane freeze, and a packed summary that additionally carries every
+lane's ANSWER back in the one transfer.
+
+graftscope rides the resume/batch/query loops: ``recorder=`` on
+:func:`run_from`, :func:`run_until_coverage_from`,
+:func:`run_batch_until_coverage` and :func:`run_queries_until_done` (a
 :class:`~p2pnetwork_tpu.sim.flightrec.FlightRecorder`) accumulates a
 bounded per-round record ring INSIDE the compiled carry — donated like
 the state, bit-identical results, one extra fetch per run — and, when a
@@ -749,6 +758,214 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
     return state, out
 
 
+# ------------------------------------------------------------- query plane
+
+
+def _query_body(graph, protocol, qb0, key, *, max_rounds, ring=None):
+    """The batched query loop: advance every running lane of a
+    :class:`~p2pnetwork_tpu.models.querybatch.QueryBatch` per iteration
+    until ALL admitted queries settle (or ``max_rounds`` more global
+    rounds pass) — ``_batch_body``'s sibling for the non-boolean lane
+    families (min-plus routing, DHT chases, push-sum). Per-lane
+    completion/round accounting lives in the family's step; this loop
+    only asks "is anything still running" and folds the per-round send
+    subtotal into the exact two-limb counter. The packed summary adds
+    the query plane's per-lane ANSWERS (``protocol.lane_values``) to the
+    batch plane's per-lane tail — one transfer for the whole K-query
+    result set. Callers hand in a REFRESHED batch (the entry point
+    does); ``ring`` is the flight-recorder carry (one row per global
+    round, same single-body discipline as the other loops)."""
+    capacity = int(qb0.admitted.shape[0])
+
+    def cond(carry):
+        qb, r = carry[0], carry[2]
+        return jnp.any(qb.admitted & ~qb.done) & (r < max_rounds)
+
+    def body(carry):
+        qb, k, r, hi, lo, occ = carry[:6]
+        k, sub = jax.random.split(k)
+        qb, stats = protocol.step(graph, qb, sub)
+        hi, lo = accum.add((hi, lo), stats["messages"])
+        active = jnp.sum((qb.admitted & ~qb.done).astype(jnp.int32))
+        # Lane occupancy — the query plane's "how full is the batch"
+        # analog of frontier occupancy: running lanes / capacity.
+        occ_r = active.astype(jnp.float32) / capacity
+        out = (qb, k, r + 1, hi, lo, occ + occ_r)
+        if ring is None:
+            return out
+        return out + (flightrec.write_row(
+            carry[6], r,
+            occupancy=occ_r,
+            new=stats["messages"],
+            total=flightrec.total_f32(hi, lo),
+            coverage=jnp.sum(qb.done.astype(jnp.int32)),
+            active_lanes=active,
+            ici_bytes=0.0),)
+
+    init = (qb0, key, jnp.int32(0), *accum.zero(), jnp.float32(0.0))
+    if ring is not None:
+        init = init + (ring,)
+    final = jax.lax.while_loop(cond, body, init)
+    qb, _, rounds, hi, lo, occ = final[:6]
+    packed = accum.pack_query_summary(
+        rounds,
+        jnp.sum((qb.admitted & ~qb.done).astype(jnp.int32)),
+        jnp.sum(qb.done.astype(jnp.int32)),
+        (hi, lo),
+        occ / jnp.maximum(rounds, 1),
+        bitset.pack_bits(qb.done),
+        qb.rounds,
+        protocol.lane_values(graph, qb),
+        values_float=protocol.VALUES_FLOAT,
+    )
+    if ring is None:
+        return qb, packed
+    return qb, packed, final[6]
+
+
+def _query_loop(graph, protocol, qb0, key, *, max_rounds):
+    return _query_body(graph, protocol, qb0, key, max_rounds=max_rounds)
+
+
+_query_loop_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds"),
+    donate_argnames=("qb0",))(_query_loop)
+_query_loop_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- the deliberate donate=False escape hatch, same as the batch twins
+    jax.jit, static_argnames=("protocol", "max_rounds"))(_query_loop)
+
+
+def _query_loop_rec(graph, protocol, qb0, key, ring, *, max_rounds):
+    """The recording form of :func:`_query_body` (wrapper so the jit
+    variants can name ``ring`` in ``donate_argnames``) — same RNG chain
+    and state math by construction."""
+    return _query_body(graph, protocol, qb0, key, max_rounds=max_rounds,
+                       ring=ring)
+
+
+_query_loop_rec_donating = functools.partial(
+    jax.jit, static_argnames=("protocol", "max_rounds"),
+    donate_argnames=("qb0", "ring"))(_query_loop_rec)
+_query_loop_rec_keeping = functools.partial(  # graftlint: ignore[carry-no-donate] -- same donate=False escape hatch as the non-recording twin
+    jax.jit, static_argnames=("protocol", "max_rounds"))(_query_loop_rec)
+
+
+def _record_query_summary(wall_s: float, transfer_s: float,
+                          transfer_bytes: int, out: dict,
+                          newly_done_rounds, protocol_name: str) -> None:
+    """Bridge one batched query-run summary into the registry: the
+    shared sim_* run counters under ``loop="query"`` plus the query
+    plane's own instruments — ``sim_query_active_lanes`` (queries still
+    running at return: >0 means max_rounds froze stragglers) and one
+    ``sim_query_completion_rounds`` observation per lane that settled
+    in this call."""
+    _record_run_summary("query", wall_s, transfer_s, transfer_bytes, out,
+                        protocol_name)
+    reg = telemetry.default_registry()
+    reg.gauge("sim_query_active_lanes",
+              "Query lanes still running (admitted, not settled) when "
+              "the last run_queries_until_done call returned — nonzero "
+              "means max_rounds froze stragglers.").set(
+                  float(out["active_lanes"]))
+    hist = reg.histogram(
+        "sim_query_completion_rounds",
+        "Rounds each batched query took to settle (one observation per "
+        "lane completed in a run_queries_until_done call).",
+        buckets=_COMPLETION_BUCKETS)
+    for r in newly_done_rounds.tolist():  # host ints (numpy, post-unpack)
+        hist.observe(r)
+    history.default_history().sample()
+
+
+def run_queries_until_done(graph: Graph, protocol, batch, key: jax.Array,
+                           *, max_rounds: int = 1024,
+                           donate: bool = True, recorder=None):
+    """Advance ALL in-flight queries of a lane-packed
+    :class:`~p2pnetwork_tpu.models.querybatch.QueryBatch` until every
+    admitted lane settles (or ``max_rounds`` global rounds pass) — the
+    query-family sibling of :func:`run_batch_until_coverage`, one
+    compiled program per call for K routing lookups / DHT chases /
+    aggregations at once.
+
+    ``protocol`` is a query family (models/querybatch.py
+    ``MinPlusQueries`` / ``DhtLookups`` / ``PushSumQueries``):
+    ``step(graph, batch, key) -> (batch, stats)`` with per-lane
+    completion folded into the state, ``stats["messages"]`` the
+    round's aggregate send subtotal (< 2^32 — budget ``K * E``), and
+    ``lane_values(graph, batch)`` the per-lane answers. Completed lanes
+    freeze; admission of new queries happens between calls via
+    ``protocol.admit`` — the same serving seam as the flood plane.
+
+    Returns ``(batch, out)``: aggregates (``rounds``, exact
+    ``messages``, ``active_lanes``, ``completed``, ``occupancy_mean`` —
+    mean running-lane fraction), per-lane vectors (``lane_done``,
+    ``lane_rounds`` — resume-cumulative, ``lane_values`` — the ANSWERS,
+    f32 or i32 per family, ``newly_completed_lanes``) and, when any lane
+    settled this call, ``completion_rounds_p50``/``p99`` over those
+    lanes. One packed device->host transfer however large K is.
+
+    ``donate=True`` (default) hands the batch's buffers to the loop and
+    invalidates the caller's copy (see :func:`run_from`). ``recorder``
+    rides the per-round flight ring in the donated carry and attaches
+    ``out["flight_record"]`` — per-lane results stay bit-identical to
+    recorder-off runs. With a trace plane installed (telemetry/spans.py)
+    the call runs under a ``query_run`` span with the same per-lane
+    ``lane_admit`` / ``lane_resume`` / ``lane_complete`` /
+    ``lane_freeze`` events as the batch plane."""
+    t0 = time.perf_counter()
+    _check_not_donated(batch)  # friendly error before refresh reads it
+    done0 = np.asarray(batch.done)
+    tracer = spans.current_tracer()
+    admitted0 = np.asarray(batch.admitted) if tracer is not None else None
+    rounds0 = np.asarray(batch.rounds) if tracer is not None else None
+    with spans.span("query_run", loop="engine", max_rounds=max_rounds):
+        if tracer is not None:
+            _emit_batch_entry_events(admitted0, done0, rounds0)
+        # Entry-time refresh — identity for today's families (their
+        # completions latch; nothing is mask-derived), kept eager for
+        # template parity with the batch plane: a future mask-derived
+        # refresh inside the donated jit would dead-code its stale
+        # input leaf and silently drop that donation (BatchFlood.refresh
+        # documents the incident).
+        batch = protocol.refresh(graph, batch)
+        capacity = int(batch.admitted.shape[0])
+        if recorder is None:
+            loop_fn = _pick_loop(_query_loop_donating, _query_loop_keeping,
+                                 donate, batch, graph, key)
+            state, packed = loop_fn(graph, protocol, batch, key,
+                                    max_rounds=max_rounds)
+            ring = None
+        else:
+            loop_fn = _pick_loop(_query_loop_rec_donating,
+                                 _query_loop_rec_keeping, donate, batch,
+                                 graph, key)
+            state, packed, ring = loop_fn(graph, protocol, batch, key,
+                                          recorder.init(),
+                                          max_rounds=max_rounds)
+        t1 = time.perf_counter()
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                     for leaf in jax.tree_util.tree_leaves((packed, ring)))
+        if ring is not None:
+            packed, ring = jax.device_get((packed, ring))
+        out = accum.unpack_query_summary(
+            packed, capacity, values_float=protocol.VALUES_FLOAT)
+        if ring is not None:
+            out["flight_record"] = flightrec.trim(ring, out["rounds"])
+        t2 = time.perf_counter()
+        newly = out["lane_done"] & ~done0
+        out["newly_completed_lanes"] = np.flatnonzero(newly).astype(np.int32)
+        newly_rounds = out["lane_rounds"][newly]
+        if newly_rounds.size:
+            out["completion_rounds_p50"] = float(
+                np.percentile(newly_rounds, 50))
+            out["completion_rounds_p99"] = float(
+                np.percentile(newly_rounds, 99))
+        if tracer is not None:
+            _emit_batch_exit_events(admitted0, done0, out)
+        _record_query_summary(t2 - t0, t2 - t1, nbytes, out, newly_rounds,
+                              type(protocol).__name__)
+    return state, out
+
+
 def donating_carry_loops() -> dict:
     """The donating state-carry loops, by name — the exact jitted objects
     the resume entry points dispatch, exposed as a stable seam for
@@ -761,6 +978,7 @@ def donating_carry_loops() -> dict:
         "coverage_from": _coverage_loop_donating,
         "converged_from": _converged_loop_donating,
         "batch_from": _batch_loop_donating,
+        "query_from": _query_loop_donating,
         # The flight-recorder twins: the ring is an extra donated carry
         # leaf, and the audit must prove it stays aliased (a recorder
         # that double-buffers its ring would silently tax every
@@ -768,6 +986,7 @@ def donating_carry_loops() -> dict:
         "run_from_rec": _run_from_rec_donating,
         "coverage_from_rec": _coverage_loop_rec_donating,
         "batch_from_rec": _batch_loop_rec_donating,
+        "query_from_rec": _query_loop_rec_donating,
     }
 
 

@@ -118,6 +118,51 @@ def pack_batch_summary(rounds: jax.Array, active_lanes: jax.Array,
     ])
 
 
+def pack_query_summary(rounds: jax.Array, active_lanes: jax.Array,
+                       completed: jax.Array, acc: Acc, occ_mean: jax.Array,
+                       done_words: jax.Array, lane_rounds: jax.Array,
+                       lane_values: jax.Array, *,
+                       values_float: bool) -> jax.Array:
+    """The query engine's one-transfer run summary:
+    ``i32[6 + W + K + K]`` — :func:`pack_batch_summary`'s head and
+    per-lane tail plus the query plane's addition: each lane's ANSWER
+    (``lane_values``) rides the same packed vector, so a whole
+    K-query result set costs one device->host transfer. Answers are
+    f32 (bitcast; routing distances, aggregation means) or raw i32
+    (DHT cursors — f32 would corrupt node ids past 2^24) per
+    ``values_float``, which is static protocol knowledge the unpacker
+    must be told again."""
+    if values_float:
+        vals = jax.lax.bitcast_convert_type(
+            lane_values.astype(jnp.float32), jnp.int32)
+    else:
+        vals = lane_values.astype(jnp.int32)
+    return jnp.concatenate([
+        pack_batch_summary(rounds, active_lanes, completed, acc, occ_mean,
+                           done_words, lane_rounds),
+        vals.reshape(-1),
+    ])
+
+
+def unpack_query_summary(packed, capacity: int, *,
+                         values_float: bool) -> dict:
+    """Host-side inverse of :func:`pack_query_summary` (forces the
+    transfer). ``lane_done``/``lane_rounds`` trim to ``capacity`` (the
+    done words pad to whole 32-lane blocks); ``lane_values`` comes back
+    f32 or i32 per ``values_float``. The head + per-lane core decodes
+    through :func:`unpack_batch_summary` — one copy of that layout."""
+    arr = np.asarray(packed)
+    capacity = int(capacity)
+    n_words = -(-capacity // 32)
+    core_len = _BATCH_HEAD + n_words + capacity
+    out = unpack_batch_summary(arr[:core_len], n_words)
+    out["lane_done"] = out["lane_done"][:capacity]
+    vals = arr[core_len:]
+    out["lane_values"] = (vals.view(np.float32) if values_float
+                          else vals.astype(np.int32))
+    return out
+
+
 def unpack_batch_summary(packed, n_words: int) -> dict:
     """Host-side inverse of :func:`pack_batch_summary` (forces the
     transfer). Returns ``rounds`` / ``active_lanes`` / ``completed`` /

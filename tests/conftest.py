@@ -78,6 +78,12 @@ def pytest_configure(config):
         "wiring (select with -m scope; part of the default tier-1 run)")
     config.addinivalue_line(
         "markers",
+        "query: batched query-lane tests — byte-budgeted non-boolean "
+        "carriers, min-plus/DHT/push-sum family identity sweeps, the "
+        "query engine loop, and the slow-marked 10x aggregate ratchets "
+        "(select with -m query; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
         "serve: graftserve serving front-end tests — submit/poll/stream "
         "lifecycle, admission pacing, quotas + structured load shedding, "
         "seeded-traffic determinism, preempt/resume bit-identity, the "

@@ -40,6 +40,17 @@ def _env(cache_dir, **extra):
         "BENCH_SERVE_CAP": "40",
         "BENCH_SERVE_TICKS": "4",
         "BENCH_SERVE_RATE": "15",
+        # The queries column runs the three batched query families:
+        # tiny K and a tiny chord overlay so orchestration (not the
+        # 100k-node ratchet shapes) is what the tests pay — and OFF by
+        # default in this suite: six extra XLA compiles per bench child
+        # would tax every orchestration test, so only the shared
+        # first_run fixture (which pins the published column) pays them.
+        "BENCH_QUERIES": "0",
+        "BENCH_QUERY_K_MINPLUS": "8",
+        "BENCH_QUERY_K_PUSHSUM": "4",
+        "BENCH_QUERY_K_DHT": "16",
+        "BENCH_QUERY_DHT_N": "512",
         # The multichip ring column spawns its own 8-virtual-device
         # child: tiny graph so the tests pay orchestration, not the
         # interpret/compile bill.
@@ -68,7 +79,17 @@ def _run(cache_dir, timeout=600, **extra):
 @pytest.fixture(scope="module")
 def first_run(tmp_path_factory):
     cache = tmp_path_factory.mktemp("bench_cache")
-    r, recs = _run(cache)
+    r, recs = _run(cache, BENCH_QUERIES="1")
+    # Snapshot THIS run's 1M artifact: later tests re-run bench over the
+    # same cache dir with the suite's default env (queries off), which
+    # overwrites BENCH_TELEMETRY.json — column tests that need the
+    # queries-enabled artifact read the snapshot. Guarded: a failed
+    # bench child writes no artifact, and the dependent tests' own
+    # returncode asserts must surface that stderr, not a copy error.
+    import shutil
+    if (cache / "BENCH_TELEMETRY.json").exists():
+        shutil.copy(cache / "BENCH_TELEMETRY.json",
+                    cache / "BENCH_TELEMETRY_first.json")
     return cache, r, recs
 
 
@@ -269,6 +290,50 @@ class TestStageTelemetry:
         assert r.returncode == 0, r.stderr[-2000:]
         tel = json.loads((tmp_path / "BENCH_TELEMETRY.json").read_text())
         assert tel["serving"] == {}
+
+    def test_queries_column_published_per_family(self, first_run):
+        # The queries column (ROADMAP 3): the three non-boolean batched
+        # query families each publish aggregate speedup vs warm
+        # sequential capacity-1 runs, lanes/s, and completion
+        # percentiles.
+        cache, _, _ = first_run
+        # the fixture's snapshot: the live artifact may since have been
+        # overwritten by a re-run with the suite's queries-off default
+        tel = json.loads(
+            (cache / "BENCH_TELEMETRY_first.json").read_text())
+        col = tel["queries"]
+        assert "error" not in col, col
+        for fam, k in (("minplus", 8), ("pushsum", 4), ("dht", 16)):
+            f = col[fam]
+            assert "error" not in f, (fam, f)
+            assert f["K"] == k
+            assert f["completed"] + f["active_lanes_end"] >= 1
+            assert f["best_s"] > 0
+            assert f["lanes_per_s"] > 0
+            assert f["completion_rounds_p99"] is not None
+            assert f["completion_rounds_p99"] >= \
+                f["completion_rounds_p50"] >= 0
+            assert f["aggregate_speedup_vs_sequential"] > 0
+            assert f["seq_sample_runs"] >= 1
+        # the DHT family rides its own chord overlay
+        assert col["dht"]["n_nodes"] == 512
+        assert col["minplus"]["n_nodes"] == col["pushsum"]["n_nodes"]
+
+    def test_queries_column_disabled_is_empty_not_missing(self, tmp_path):
+        # BENCH_QUERIES=0 (what the cpu-fallback parent pins) must
+        # publish an EMPTY column, keeping the artifact schema stable.
+        # The sibling columns are disabled and the method contest
+        # trimmed to one entry: this subprocess only proves the queries
+        # key's disabled shape.
+        r = subprocess.run(
+            [sys.executable, BENCH, "--stage", "1m"],
+            env=_env(tmp_path, BENCH_QUERIES="0", BENCH_BATCH="0",
+                     BENCH_SERVE="0", BENCH_MULTICHIP="0",
+                     BENCH_METHODS="segment"),
+            capture_output=True, text=True, timeout=600, cwd=REPO)
+        assert r.returncode == 0, r.stderr[-2000:]
+        tel = json.loads((tmp_path / "BENCH_TELEMETRY.json").read_text())
+        assert tel["queries"] == {}
 
     def test_multichip_column_published_with_ici_bytes(self, first_run):
         # The multichip ring column (the promoted dryrun_multichip): the

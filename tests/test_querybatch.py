@@ -1,0 +1,927 @@
+"""Batched query lanes: byte-budgeted non-boolean carriers, the three
+query families (min-plus routing, DHT lookups, push-sum aggregation),
+and the batched query engine loop.
+
+The contract under test (models/querybatch.py, ops/lanes.py): batching K
+queries into one compiled program changes the COST of answering them,
+never the answers. Min-plus and DHT lanes pin BIT-identity against
+independent single-query references (min is order-blind in f32; cursors
+are ints); push-sum pins the float-op-order contract — eager batched
+steps bitwise equal models/pushsum.py steps, and one-admitted-lane runs
+of the same compiled program bitwise equal the full batch (lane
+isolation). The byte budget is the other half: no family can admit past
+``ops/lanes.lane_budget`` silently — the typed
+:class:`LaneBudgetExceeded` is the contract. The slow-marked ratchets
+pin the point of it all: ≥10x aggregate throughput vs warm sequential
+capacity-1 runs at the bench-default K on 100k-node graphs, ratio-based
+on CPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.models.pushsum import PushSum, PushSumState
+from p2pnetwork_tpu.models.querybatch import (
+    DhtLookups, LaneBudgetExceeded, MinPlusQueries, PushSumQueries,
+    free_query_lanes, lane_dist)
+from p2pnetwork_tpu.models.messagebatch import LaneExhausted
+from p2pnetwork_tpu.ops import lanes as L
+from p2pnetwork_tpu.ops import segment as S
+from p2pnetwork_tpu.sim import engine, failures, flightrec
+from p2pnetwork_tpu.sim import graph as G
+from p2pnetwork_tpu.telemetry import spans
+from p2pnetwork_tpu.utils import accum
+
+pytestmark = pytest.mark.query
+
+KEY = jax.random.key(0)
+
+
+def ws(n=300, seed=3, **kw):
+    kw.setdefault("source_csr", True)
+    return G.watts_strogatz(n, 6, 0.2, seed=seed, **kw)
+
+
+# ------------------------------------------------------ reference runs
+
+
+def minplus_reference(g, src, tgt, max_rounds=256):
+    """Independent single-query Bellman-Ford: the per-lane kernel
+    (propagate_min_plus) iterated with the family's completion rule.
+    Returns (dist field, applied rounds)."""
+    seed = jnp.zeros(g.n_nodes_padded, bool).at[int(src)].set(True)
+    seed = seed & g.node_mask
+    d = jnp.where(seed, 0.0, jnp.inf).astype(jnp.float32)
+    if bool(seed[int(src)]) and int(src) == int(tgt):
+        return d, 0  # settled at admission
+    unweighted = g.edge_weight is None
+    r = 0
+    while r < max_rounds:
+        nd = jnp.minimum(d, S.propagate_min_plus(g, d, "auto"))
+        r += 1
+        changed = bool(jnp.any(nd != d))
+        d = nd
+        if (unweighted and bool(jnp.isfinite(d[int(tgt)]))) or not changed:
+            break
+    return d, r
+
+
+def dht_reference(g, origin, key_id, metric, max_rounds=128):
+    """Independent single-lookup greedy walk (numpy). Returns
+    (final cursor, applied rounds)."""
+    nbrs = np.asarray(g.neighbors)
+    nmask = np.asarray(g.neighbor_mask)
+    alive = np.asarray(g.node_mask)
+    n = g.n_nodes
+    cur, tgt = int(origin), int(key_id)
+    if cur == tgt or not alive[cur]:
+        return cur, 0
+    rounds = 0
+    while rounds < max_rounds:
+        cand = nbrs[cur]
+        valid = nmask[cur] & alive[cand]
+        if metric == "ring":
+            dn = np.where(valid, (tgt - cand) % n,
+                          np.uint64(2 ** 32 - 1)).astype(np.uint64)
+            dcur = (tgt - cur) % n
+        else:
+            dn = np.where(valid, (cand.astype(np.int64) ^ tgt),
+                          np.uint64(2 ** 32 - 1)).astype(np.uint64)
+            dcur = cur ^ tgt
+        j = int(np.argmin(dn))
+        rounds += 1  # a live lane applies the round, hop or stall
+        if dn[j] < dcur:
+            cur = int(cand[j])
+            if cur == tgt:
+                break  # arrived — frozen before the next round
+        else:
+            break  # stalled — that round applied but didn't move
+    return cur, rounds
+
+
+def pushsum_seed_state(g, seed, salt=0):
+    vals = jax.random.normal(
+        jax.random.fold_in(jax.random.key(salt), int(seed)),
+        (g.n_nodes_padded,), dtype=jnp.float32)
+    return PushSumState(s=vals * g.node_mask,
+                        w=g.node_mask.astype(jnp.float32))
+
+
+# -------------------------------------------------------- byte budget
+
+
+class TestLaneBudget:
+    def test_bit_lane_vs_f32_lane_asymmetry(self):
+        # 1024 boolean lanes pack 32 per u32 word; 1024 f32 lanes pay
+        # full width — the 32x the budget exists to make explicit.
+        n = 1000
+        bits = L.lane_bytes(1024, bool, n)
+        floats = L.lane_bytes(1024, jnp.float32, n)
+        assert bits == 32 * 4 * n  # ceil(1024/32) words x 4 bytes
+        assert floats == 1024 * 4 * n
+        assert floats == 32 * bits
+
+    def test_ragged_bool_capacity_rounds_up_to_words(self):
+        assert L.lane_bytes(33, bool, 10) == 2 * 4 * 10
+
+    def test_carriers_multiply(self):
+        one = L.lane_bytes(8, jnp.float32, 100, carriers=1)
+        assert L.lane_bytes(8, jnp.float32, 100, carriers=2) == 2 * one
+
+    def test_i32_lanes_price_like_f32(self):
+        assert (L.lane_bytes(64, jnp.int32, 500)
+                == L.lane_bytes(64, jnp.float32, 500))
+
+    @pytest.mark.parametrize("bad", [
+        dict(capacity=0, dtype=jnp.float32, n_pad=1),
+        dict(capacity=4, dtype=jnp.float32, n_pad=0),
+        dict(capacity=4, dtype=jnp.float32, n_pad=1, carriers=0),
+    ])
+    def test_invalid_args_raise(self, bad):
+        with pytest.raises(ValueError):
+            L.lane_bytes(**bad)
+
+    def test_under_budget_returns_cost(self):
+        assert L.lane_budget(4, jnp.float32, 100,
+                             budget_bytes=10_000) == 1600
+
+    def test_over_budget_raises_typed_error_naming_bytes(self):
+        with pytest.raises(LaneBudgetExceeded) as ei:
+            L.lane_budget(1000, jnp.float32, 1000, budget_bytes=1_000_000)
+        err = ei.value
+        assert isinstance(err, ValueError)  # back-compat except clause
+        assert err.requested_bytes == 4_000_000
+        assert err.budget_bytes == 1_000_000
+        assert err.capacity == 1000
+        assert "4,000,000" in str(err) and "1,000,000" in str(err)
+
+    def test_env_budget_override(self, monkeypatch):
+        monkeypatch.setenv("P2P_LANE_BUDGET_BYTES", "100")
+        with pytest.raises(LaneBudgetExceeded):
+            L.lane_budget(4, jnp.float32, 100)
+        monkeypatch.setenv("P2P_LANE_BUDGET_BYTES", "100000")
+        assert L.lane_budget(4, jnp.float32, 100) == 1600
+
+
+class TestBudgetGate:
+    """No family can allocate or admit past the budget silently —
+    acceptance criterion, pinned per family."""
+
+    def test_minplus_init_over_budget(self):
+        g = ws(64)
+        proto = MinPlusQueries(budget_bytes=100)
+        with pytest.raises(LaneBudgetExceeded):
+            proto.init(g, [0, 1], [2, 3])
+
+    def test_dht_init_over_budget(self):
+        g = G.chord(64)
+        proto = DhtLookups(budget_bytes=8)
+        with pytest.raises(LaneBudgetExceeded):
+            proto.init(g, [0, 1, 2], [3, 4, 5])
+
+    def test_pushsum_init_over_budget_counts_both_carriers(self):
+        g = ws(64)
+        n_pad = g.n_nodes_padded
+        # one f32 carrier of 4 lanes fits; push-sum carries TWO
+        fits_one = 4 * 4 * n_pad
+        assert MinPlusQueries(budget_bytes=fits_one).empty(g, 4)
+        with pytest.raises(LaneBudgetExceeded):
+            PushSumQueries(budget_bytes=fits_one).empty(g, 4)
+
+    @pytest.mark.parametrize("family", ["minplus", "dht", "pushsum"])
+    def test_over_budget_admit_raises_typed_error(self, family):
+        # Regression (acceptance): a batch built OUTSIDE the budget gate
+        # (hand-constructed, or a config whose budget shrank) must still
+        # refuse admission loudly — admit re-runs the gate.
+        g = ws(64)
+        roomy = dict(minplus=MinPlusQueries(),
+                     dht=DhtLookups(),
+                     pushsum=PushSumQueries())[family]
+        qb = roomy.empty(g, 4)
+        tight = dict(minplus=MinPlusQueries(budget_bytes=16),
+                     dht=DhtLookups(budget_bytes=4),
+                     pushsum=PushSumQueries(budget_bytes=16))[family]
+        with pytest.raises(LaneBudgetExceeded):
+            if family == "pushsum":
+                tight.admit(g, qb, [1])
+            else:
+                tight.admit(g, qb, [1], [2])
+
+
+# ------------------------------------------------------ kernel units
+
+
+class TestLaneKernels:
+    def test_minplus_lanes_gather_segment_and_vmap_agree(self):
+        g = ws(200)
+        rng = np.random.default_rng(0)
+        d = rng.uniform(0, 5, (g.n_nodes_padded, 6)).astype(np.float32)
+        d[rng.random(d.shape) < 0.5] = np.inf
+        dj = jnp.asarray(d)
+        out_g = L.propagate_min_plus_lanes(g, dj, "gather")
+        out_s = L.propagate_min_plus_lanes(g, dj, "segment")
+        ref = jax.vmap(lambda c: S.propagate_min_plus(g, c, "segment"),
+                       in_axes=1, out_axes=1)(dj)
+        assert bool(jnp.all(out_g == out_s))
+        assert bool(jnp.all(out_g == ref))
+
+    def test_sum_lanes_columns_match_segment_kernel_bitwise(self):
+        # The float-op-order contract: both lane lowerings accumulate in
+        # propagate_sum(method="segment")'s edge order.
+        g = ws(200)
+        rng = np.random.default_rng(1)
+        v = jnp.asarray(rng.normal(
+            size=(g.n_nodes_padded, 5)).astype(np.float32))
+        for method in ("gather", "segment"):
+            out = L.propagate_sum_lanes(g, v, method)
+            for k in range(5):
+                ref = S.propagate_sum(g, v[:, k], "segment")
+                assert bool(jnp.all(out[:, k] == ref)), (method, k)
+
+    def test_lane_kernels_reject_unknown_methods(self):
+        g = ws(64)
+        m = jnp.zeros((g.n_nodes_padded, 2), jnp.float32)
+        with pytest.raises(ValueError, match="skew"):
+            L.propagate_min_plus_lanes(g, m, "skew")
+        with pytest.raises(ValueError, match="lane form"):
+            L.propagate_sum_lanes(g, m, "blocked")
+
+    def test_dht_hop_ties_break_to_first_slot(self):
+        # Two equidistant closer neighbors: argmin takes the first table
+        # slot — the determinism the identity sweep relies on.
+        g = G.ring(8)
+        cur = jnp.array([0], jnp.int32)
+        keys = jnp.array([4], jnp.int32)  # ring: 1 and 7 both distance 3
+        nxt, hopped = L.dht_hop_lanes(g, cur, keys, "ring")
+        assert bool(hopped[0])
+        first_slot = int(np.asarray(g.neighbors)[0, 0])
+        d_first = (4 - first_slot) % 8
+        others = [int(v) for v, m in zip(np.asarray(g.neighbors)[0],
+                                         np.asarray(g.neighbor_mask)[0])
+                  if m]
+        best = min((4 - v) % 8 for v in others)
+        if d_first == best:
+            assert int(nxt[0]) == first_slot
+
+    def test_dht_hop_rejects_unknown_metric(self):
+        g = G.chord(16)
+        with pytest.raises(ValueError, match="metric"):
+            L.dht_hop_lanes(g, jnp.zeros(1, jnp.int32),
+                            jnp.zeros(1, jnp.int32), "euclid")
+
+    def test_gather_requires_complete_table(self):
+        g = ws(200, max_degree=2)  # width-capped table
+        m = jnp.zeros((g.n_nodes_padded, 2), jnp.float32)
+        with pytest.raises(ValueError, match="capped|neighbor table"):
+            L.propagate_min_plus_lanes(g, m, "gather")
+        with pytest.raises(ValueError):
+            L.dht_hop_lanes(g, jnp.zeros(1, jnp.int32),
+                            jnp.zeros(1, jnp.int32), "ring")
+
+
+# ---------------------------------------------------------- min-plus
+
+
+class TestMinPlusQueries:
+    def _sweep(self, g, srcs, tgts, proto=None, max_rounds=256):
+        proto = proto or MinPlusQueries()
+        qb = proto.init(g, srcs, tgts)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                max_rounds=max_rounds)
+        for k, (s, t) in enumerate(zip(srcs, tgts)):
+            d_ref, r_ref = minplus_reference(g, s, t, max_rounds)
+            assert int(out["lane_rounds"][k]) == r_ref, (k, s, t)
+            v = float(out["lane_values"][k])
+            ref_v = float(d_ref[int(t)])
+            assert (v == ref_v) or (np.isinf(v) and np.isinf(ref_v)), k
+            if r_ref > 0:
+                assert bool(jnp.all(lane_dist(qb, k) == d_ref)), k
+        assert bool(np.all(out["lane_done"][:len(srcs)]))
+        return qb, out
+
+    def test_identity_sweep_ws(self):
+        g = ws(300)
+        rng = np.random.default_rng(0)
+        srcs = rng.integers(0, 300, 9).astype(np.int32)
+        tgts = rng.integers(0, 300, 9).astype(np.int32)
+        srcs[3] = tgts[3] = 17          # settled at admission
+        srcs[4], tgts[4] = srcs[0], tgts[0]  # duplicate query
+        self._sweep(g, srcs, tgts)
+
+    def test_identity_sweep_er(self):
+        g = G.erdos_renyi(257, 0.03, seed=5, source_csr=True)
+        rng = np.random.default_rng(2)
+        self._sweep(g, rng.integers(0, 257, 7).astype(np.int32),
+                    rng.integers(0, 257, 7).astype(np.int32))
+
+    def test_unreachable_target_settles_at_fixpoint_with_inf(self):
+        # Two disjoint rings: a cross-component query has no path — the
+        # lane must freeze at its fixpoint with +inf, not spin.
+        src = np.arange(8, dtype=np.int32)
+        dst = (src + 1) % 8
+        s2 = src + 8
+        d2 = (src + 1) % 8 + 8
+        g = G.from_edges(np.concatenate([src, dst, s2, d2]),
+                         np.concatenate([dst, src, d2, s2]), 16,
+                         source_csr=True)
+        qb, out = self._sweep(g, [0, 0], [4, 12])
+        assert np.isfinite(out["lane_values"][0])
+        assert np.isinf(out["lane_values"][1])
+
+    def test_dead_source_settles_unreachable(self):
+        g = failures.fail_nodes(ws(120), [7])
+        qb, out = self._sweep(g, [7, 3], [30, 30])
+        assert np.isinf(out["lane_values"][0])
+        assert np.isfinite(out["lane_values"][1])
+
+    def test_weighted_graph_completes_at_fixpoint_with_exact_costs(self):
+        g = ws(200).with_weights(
+            lambda s, r: 1.0 + ((s * 31 + r) % 7).astype(jnp.float32))
+        rng = np.random.default_rng(3)
+        srcs = rng.integers(0, 200, 5).astype(np.int32)
+        tgts = rng.integers(0, 200, 5).astype(np.int32)
+        self._sweep(g, srcs, tgts)
+
+    def test_batched_equals_capacity_one_runs_bitwise(self):
+        g = ws(256, seed=9)
+        rng = np.random.default_rng(4)
+        srcs = rng.integers(0, 256, 6).astype(np.int32)
+        tgts = rng.integers(0, 256, 6).astype(np.int32)
+        proto = MinPlusQueries()
+        qb = proto.init(g, srcs, tgts)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY)
+        for k in range(6):
+            q1 = proto.init(g, srcs[k:k + 1], tgts[k:k + 1])
+            q1, o1 = engine.run_queries_until_done(g, proto, q1, KEY)
+            assert int(o1["lane_rounds"][0]) == int(out["lane_rounds"][k])
+            assert float(o1["lane_values"][0]) == float(
+                out["lane_values"][k])
+            assert bool(jnp.all(q1.payload["dist"][:, 0]
+                                == qb.payload["dist"][:, k]))
+
+    def test_admit_validation(self):
+        g = ws(100)
+        proto = MinPlusQueries()
+        with pytest.raises(ValueError, match="at least one"):
+            proto.init(g, [], [])
+        with pytest.raises(ValueError, match="pairs"):
+            proto.init(g, [0, 1], [2])
+        with pytest.raises(ValueError, match="out of range"):
+            proto.init(g, [-1], [2])
+        with pytest.raises(ValueError, match="out of range"):
+            proto.init(g, [0], [g.n_nodes_padded])
+        with pytest.raises(ValueError, match="capacity"):
+            proto.init(g, [0, 1], [2, 3], capacity=1)
+
+    def test_lane_exhaustion_is_the_backpressure_signal(self):
+        g = ws(100)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0, 1], [5, 6], capacity=3)
+        assert free_query_lanes(qb) == 1
+        with pytest.raises(LaneExhausted) as ei:
+            proto.admit(g, qb, [2, 3], [7, 8])
+        assert ei.value.free_lanes == 1 and ei.value.capacity == 3
+
+    def test_retire_recycles_and_second_wave_matches(self):
+        g = ws(256, seed=11)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [3, 99], [200, 10], capacity=2)
+        qb, out1 = engine.run_queries_until_done(g, proto, qb, KEY)
+        first_vals = out1["lane_values"].copy()
+        qb = proto.retire(qb)                    # all done -> all open
+        assert free_query_lanes(qb) == 2
+        assert bool(jnp.all(jnp.isinf(qb.payload["dist"])))
+        qb, lanes = proto.admit(g, qb, [50], [123])
+        qb, out2 = engine.run_queries_until_done(g, proto, qb, KEY)
+        d_ref, r_ref = minplus_reference(g, 50, 123)
+        lane = int(lanes[0])
+        assert int(out2["lane_rounds"][lane]) == r_ref
+        assert float(out2["lane_values"][lane]) == float(d_ref[123])
+        del first_vals
+
+    def test_retire_bounds_check(self):
+        g = ws(64)
+        qb = MinPlusQueries().init(g, [0], [5])
+        with pytest.raises(ValueError, match="capacity"):
+            MinPlusQueries().retire(qb, [-1])
+
+    def test_lane_dist_bounds_check(self):
+        g = ws(64)
+        qb = MinPlusQueries().init(g, [0], [5])
+        with pytest.raises(ValueError, match="capacity"):
+            lane_dist(qb, 99)
+
+    def test_frozen_lanes_stay_byte_identical_through_second_wave(self):
+        g = ws(256, seed=13)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [3], [200], capacity=2)
+        qb, _ = engine.run_queries_until_done(g, proto, qb, KEY,
+                                              donate=False)
+        frozen = np.asarray(qb.payload["dist"][:, 0]).copy()
+        qb, _ = proto.admit(g, qb, [50], [123])
+        qb, _ = engine.run_queries_until_done(g, proto, qb, KEY,
+                                              donate=False)
+        assert bool(np.all(np.asarray(qb.payload["dist"][:, 0])
+                           == frozen))
+
+
+# --------------------------------------------------------------- DHT
+
+
+class TestDhtLookups:
+    @pytest.mark.parametrize("builder,metric", [
+        (lambda: G.chord(128), "ring"),
+        (lambda: G.kademlia(128), "xor"),
+        (lambda: G.kademlia(100, k=2), "xor"),  # partially-populated ids
+    ])
+    def test_identity_sweep_vs_numpy_greedy_walk(self, builder, metric):
+        g = builder()
+        rng = np.random.default_rng(0)
+        K = 23
+        orgs = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        keys = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        orgs[5] = keys[5]  # arrived at admission
+        proto = DhtLookups(metric=metric)
+        qb = proto.init(g, orgs, keys)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                max_rounds=64)
+        assert bool(np.all(out["lane_done"][:K]))
+        assert out["lane_values"].dtype == np.int32
+        for k in range(K):
+            cur_ref, r_ref = dht_reference(g, orgs[k], keys[k], metric)
+            assert int(out["lane_values"][k]) == cur_ref, k
+            assert int(out["lane_rounds"][k]) == r_ref, k
+
+    def test_fully_populated_chord_resolves_every_lookup(self):
+        g = G.chord(256)
+        rng = np.random.default_rng(1)
+        orgs = rng.integers(0, 256, 64).astype(np.int32)
+        keys = rng.integers(0, 256, 64).astype(np.int32)
+        proto = DhtLookups(metric="ring")
+        qb = proto.init(g, orgs, keys)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY)
+        assert bool(np.all(out["lane_values"] == keys))
+        # O(log n) resolution: chord lookups finish in <= log2(n) hops
+        assert int(np.max(out["lane_rounds"][:64])) <= 8
+
+    def test_dead_responsible_node_stalls_not_found(self):
+        g = failures.fail_nodes(G.chord(128), [40])
+        proto = DhtLookups(metric="ring")
+        qb = proto.init(g, [3], [40])
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY)
+        assert bool(out["lane_done"][0])
+        assert int(out["lane_values"][0]) != 40  # stalled short of it
+
+    def test_dead_origin_completes_immediately(self):
+        g = failures.fail_nodes(G.chord(128), [3])
+        qb = DhtLookups().init(g, [3], [40])
+        assert bool(qb.done[0])
+        qb, out = engine.run_queries_until_done(g, DhtLookups(), qb, KEY)
+        assert int(out["lane_rounds"][0]) == 0
+
+    def test_key_range_validation(self):
+        g = G.chord(64)
+        with pytest.raises(ValueError, match="id space"):
+            DhtLookups().init(g, [0], [64])
+        with pytest.raises(ValueError, match="id space"):
+            DhtLookups().init(g, [0], [-1])
+
+    def test_metric_validated_at_construction(self):
+        with pytest.raises(ValueError, match="metric"):
+            DhtLookups(metric="cosine")
+
+
+# ----------------------------------------------------------- push-sum
+
+
+class TestPushSumQueries:
+    def test_eager_mass_trajectory_bitwise_vs_pushsum(self):
+        # The float-op-order contract: K batched lanes stepped eagerly
+        # produce bit-for-bit the masses of K independent
+        # models/pushsum.py runs, round for round.
+        g = ws(200, seed=7)
+        seeds = np.array([1, 9, 42], dtype=np.int32)
+        proto = PushSumQueries()
+        qb = proto.init(g, seeds, threshold=1e-30)  # nothing freezes
+        ref = PushSum(method="segment")
+        sts = [pushsum_seed_state(g, s) for s in seeds]
+        for r in range(10):
+            qb, _ = proto.step(g, qb, KEY)
+            for k in range(3):
+                sts[k], _ = ref.step(g, sts[k], KEY)
+                assert bool(jnp.all(qb.payload["s"][:, k]
+                                    == sts[k].s)), (r, k)
+                assert bool(jnp.all(qb.payload["w"][:, k]
+                                    == sts[k].w)), (r, k)
+
+    def test_engine_rounds_match_single_convergence_and_values(self):
+        g = ws(200, seed=7)
+        seeds = np.array([1, 9, 42, 77], dtype=np.int32)
+        th = 1e-3
+        proto = PushSumQueries()
+        qb = proto.init(g, seeds, threshold=th)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                max_rounds=512)
+        ref = PushSum(method="segment")
+        mask = np.asarray(g.node_mask)
+        for k, s in enumerate(seeds):
+            st = pushsum_seed_state(g, s)
+            true_mean = float(np.sum(np.asarray(st.s)) / mask.sum())
+            r = 0
+            while r < 512:
+                st, stats = ref.step(g, st, KEY)
+                r += 1
+                if float(stats["variance"]) < th:
+                    break
+            assert int(out["lane_rounds"][k]) == r, k
+            np.testing.assert_allclose(
+                np.asarray(qb.payload["s"][:, k]), np.asarray(st.s),
+                rtol=1e-5, atol=1e-7)
+            # the query's answer: the converged network-mean estimate
+            np.testing.assert_allclose(float(out["lane_values"][k]),
+                                       true_mean, rtol=0.2, atol=0.05)
+
+    def test_one_admitted_lane_in_full_width_batch_is_bit_identical(self):
+        # Lane isolation at the SAME compiled width: a K-wide batch with
+        # one admitted lane reproduces that lane of the full batch bit
+        # for bit — queries cannot interfere.
+        g = ws(200, seed=7)
+        seeds = np.array([1, 9, 42, 77], dtype=np.int32)
+        th = 1e-3
+        proto = PushSumQueries()
+        qb = proto.init(g, seeds, threshold=th)
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                max_rounds=512)
+        lone = proto.empty(g, 4)
+        lone, _ = proto.admit(g, lone, seeds[2:3], threshold=th)
+        lone, o1 = engine.run_queries_until_done(g, proto, lone, KEY,
+                                                 max_rounds=512)
+        assert int(o1["lane_rounds"][0]) == int(out["lane_rounds"][2])
+        assert float(o1["lane_values"][0]) == float(out["lane_values"][2])
+        assert bool(jnp.all(lone.payload["s"][:, 0]
+                            == qb.payload["s"][:, 2]))
+        assert bool(jnp.all(lone.payload["w"][:, 0]
+                            == qb.payload["w"][:, 2]))
+
+    def test_already_converged_at_admission_completes_with_zero_rounds(self):
+        g = ws(100)
+        proto = PushSumQueries()
+        qb = proto.init(g, [5], threshold=1e6)  # var(seed) ~1 << 1e6
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY)
+        assert bool(out["lane_done"][0])
+        assert int(out["lane_rounds"][0]) == 0
+
+    def test_threshold_validation(self):
+        g = ws(100)
+        with pytest.raises(ValueError, match="threshold"):
+            PushSumQueries().init(g, [1], threshold=0.0)
+
+    def test_seed_salt_changes_the_value_field(self):
+        g = ws(100)
+        a = PushSumQueries(seed_salt=0).init(g, [1], threshold=1e-3)
+        b = PushSumQueries(seed_salt=1).init(g, [1], threshold=1e-3)
+        assert not bool(jnp.all(a.payload["s"] == b.payload["s"]))
+
+
+# ------------------------------------------------- engine + summary
+
+
+class TestQueryEngine:
+    def test_packed_summary_roundtrip_float_and_int_values(self):
+        done = jnp.array([True, False, True, False, True], dtype=bool)
+        rounds = jnp.array([3, 0, 7, 1, 2], jnp.int32)
+        fvals = jnp.array([1.5, jnp.inf, -2.0, 0.0, 3.25], jnp.float32)
+        ivals = jnp.array([7, -1, 123456789, 0, 42], jnp.int32)
+        for vals, vf in ((fvals, True), (ivals, False)):
+            packed = accum.pack_query_summary(
+                jnp.int32(9), jnp.int32(2), jnp.int32(3),
+                (jnp.int32(1), jnp.uint32(5)), jnp.float32(0.25),
+                _pack_done(done), rounds, vals, values_float=vf)
+            out = accum.unpack_query_summary(packed, 5, values_float=vf)
+            assert out["rounds"] == 9
+            assert out["active_lanes"] == 2 and out["completed"] == 3
+            assert out["messages"] == (1 << 32) + 5
+            assert out["occupancy_mean"] == 0.25
+            assert bool(np.all(out["lane_done"] == np.asarray(done)))
+            assert bool(np.all(out["lane_rounds"] == np.asarray(rounds)))
+            assert bool(np.all(out["lane_values"] == np.asarray(vals)))
+
+    def test_newly_completed_excludes_pre_run_done_on_resume(self):
+        g = ws(256, seed=15)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0, 100], [200, 50])
+        qb, out1 = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                 max_rounds=1)
+        # round-1 cut: nothing settles on a 256-ring-ish graph in one
+        # round (sources != targets here)
+        qb, out2 = engine.run_queries_until_done(g, proto, qb, KEY)
+        done_after_1 = set(np.flatnonzero(out1["lane_done"]).tolist())
+        newly2 = set(out2["newly_completed_lanes"].tolist())
+        assert newly2.isdisjoint(done_after_1)
+        assert done_after_1 | newly2 == {0, 1}
+        # lane_rounds are resume-cumulative
+        assert int(out2["lane_rounds"][0]) >= int(out1["lane_rounds"][0])
+
+    def test_default_donation_invalidates_and_keeps_on_request(self):
+        g = ws(100)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0], [50])
+        kept, _ = engine.run_queries_until_done(g, proto, qb, KEY)
+        assert qb.payload["dist"].is_deleted()
+        with pytest.raises(ValueError, match="donated"):
+            engine.run_queries_until_done(g, proto, qb, KEY)
+        qb2 = proto.init(g, [0], [50])
+        _, _ = engine.run_queries_until_done(g, proto, qb2, KEY,
+                                             donate=False)
+        assert not qb2.payload["dist"].is_deleted()
+        del kept
+
+    def test_resume_equals_one_shot(self):
+        g = ws(256, seed=17)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0, 30], [200, 150])
+        one, out_one = engine.run_queries_until_done(g, proto, qb, KEY)
+        qb2 = proto.init(g, [0, 30], [200, 150])
+        qb2, _ = engine.run_queries_until_done(g, proto, qb2, KEY,
+                                               max_rounds=2)
+        qb2, out2 = engine.run_queries_until_done(g, proto, qb2, KEY)
+        assert bool(jnp.all(qb2.payload["dist"]
+                            == one.payload["dist"]))
+        assert bool(np.all(out2["lane_rounds"] == out_one["lane_rounds"]))
+
+    def test_max_rounds_freezes_stragglers_reported_active(self):
+        g = ws(300, seed=19)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0, 1], [250, 251])
+        qb, out = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                max_rounds=1)
+        assert out["rounds"] == 1
+        assert out["active_lanes"] == 2
+        assert out["completed"] == 0
+
+    def test_query_telemetry_registered(self):
+        from p2pnetwork_tpu import telemetry
+        g = ws(100)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0], [60])
+        engine.run_queries_until_done(g, proto, qb, KEY)
+        reg = telemetry.default_registry()
+        assert reg.value("sim_query_active_lanes") == 0.0
+        assert reg.value("sim_runs_total", loop="query") >= 1.0
+        hist = reg.histogram(
+            "sim_query_completion_rounds",
+            "Rounds each batched query took to settle (one observation "
+            "per lane completed in a run_queries_until_done call).",
+            buckets=engine._COMPLETION_BUCKETS)
+        assert hist.count >= 1
+
+    def test_dht_lane_values_survive_large_node_ids(self):
+        # i32 answers ride the packed summary raw — an f32 bitcast would
+        # corrupt node ids past 2^24; pin exactness of a 2^24+ id.
+        big = 17_000_000
+        packed = accum.pack_query_summary(
+            jnp.int32(1), jnp.int32(0), jnp.int32(1),
+            (jnp.int32(0), jnp.uint32(0)), jnp.float32(0.0),
+            _pack_done(jnp.array([True])), jnp.array([5], jnp.int32),
+            jnp.array([big], jnp.int32), values_float=False)
+        out = accum.unpack_query_summary(packed, 1, values_float=False)
+        assert int(out["lane_values"][0]) == big
+
+
+def _pack_done(done):
+    from p2pnetwork_tpu.ops import bitset
+    return bitset.pack_bits(jnp.asarray(done))
+
+
+# -------------------------------------------------- observability
+
+
+class TestQueryObservability:
+    def test_recorder_on_is_bit_identical_and_rows_describe_rounds(self):
+        g = ws(256, seed=21)
+        proto = MinPlusQueries()
+        qb1 = proto.init(g, [0, 9, 77], [200, 10, 140])
+        q_off, out_off = engine.run_queries_until_done(g, proto, qb1, KEY)
+        qb2 = proto.init(g, [0, 9, 77], [200, 10, 140])
+        rec = flightrec.FlightRecorder(capacity=64)
+        q_on, out_on = engine.run_queries_until_done(g, proto, qb2, KEY,
+                                                     recorder=rec)
+        assert bool(jnp.all(q_on.payload["dist"] == q_off.payload["dist"]))
+        for key in ("rounds", "messages", "completed"):
+            assert out_on[key] == out_off[key], key
+        assert bool(np.all(out_on["lane_rounds"] == out_off["lane_rounds"]))
+        assert bool(np.all(out_on["lane_values"] == out_off["lane_values"]))
+        fr = out_on["flight_record"]
+        assert fr.rounds == out_on["rounds"]
+        assert fr.rows.shape[0] == out_on["rounds"]
+        assert list(fr.column("round")) == list(
+            range(1, out_on["rounds"] + 1))
+        # active_lanes is non-increasing (queries only ever freeze)
+        active = fr.column("active_lanes")
+        assert bool(np.all(np.diff(active) <= 0))
+        # coverage column carries the settled-lane count; final row shows
+        # every lane done
+        assert fr.column("coverage")[-1] == 3
+
+    def test_trace_events_cover_the_lane_lifecycle(self):
+        g = ws(256, seed=23)
+        proto = MinPlusQueries()
+        t = spans.Tracer("query-test")
+        prev = spans.install_tracer(t)
+        try:
+            qb = proto.init(g, [0, 9], [200, 10])
+            qb, out = engine.run_queries_until_done(g, proto, qb, KEY)
+            qb = proto.retire(qb)
+        finally:
+            spans.install_tracer(prev)
+        assert len(t.find("query_run")) == 1
+        submits = sorted(sp.args["lane"] for sp in t.find("lane_submit"))
+        assert submits == [0, 1]
+        admits = sorted(sp.args["lane"] for sp in t.find("lane_admit"))
+        assert admits == [0, 1]
+        completes = {sp.args["lane"]: sp.args["rounds"]
+                     for sp in t.find("lane_complete")}
+        assert set(completes) == {0, 1}
+        for lane, r in completes.items():
+            assert r == int(out["lane_rounds"][lane])
+        assert sorted(sp.args["lane"] for sp in t.find("lane_retire")) \
+            == [0, 1]
+        assert t.find("lane_freeze") == []
+
+    def test_trace_freeze_and_resume_events(self):
+        g = ws(300, seed=25)
+        proto = MinPlusQueries()
+        t = spans.Tracer("query-freeze")
+        prev = spans.install_tracer(t)
+        try:
+            qb = proto.init(g, [0], [250])
+            qb, _ = engine.run_queries_until_done(g, proto, qb, KEY,
+                                                  max_rounds=1)
+            qb, _ = engine.run_queries_until_done(g, proto, qb, KEY)
+        finally:
+            spans.install_tracer(prev)
+        assert [sp.args["lane"] for sp in t.find("lane_freeze")] == [0]
+        assert [sp.args["lane"] for sp in t.find("lane_resume")] == [0]
+        assert [sp.args["lane"] for sp in t.find("lane_complete")] == [0]
+
+    def test_recorder_ring_is_donated(self):
+        # The rec twin donates the ring alongside the state (the audit
+        # covers the compiled artifact; this pins the runtime behavior).
+        g = ws(100)
+        proto = MinPlusQueries()
+        qb = proto.init(g, [0], [60])
+        rec = flightrec.FlightRecorder(capacity=16)
+        ring = rec.init()
+        engine._query_loop_rec_donating(g, proto, qb, KEY, ring,
+                                        max_rounds=8)
+        assert ring.is_deleted()
+
+
+# ------------------------------------------------- slow ratchets
+
+
+def _ws100k():
+    return G.watts_strogatz(100_000, 10, 0.1, seed=0, source_csr=True)
+
+
+@pytest.mark.slow
+class TestAggregateRatchets:
+    """The acceptance ratchets: >= 10x aggregate throughput vs warm
+    sequential capacity-1 runs of the same family, at the bench-default
+    K on 100k-node graphs — ratio-based (one machine measures both
+    sides), no wall-clock thresholds — plus the per-lane identity sweep
+    at the same scale."""
+
+    def test_minplus_ratchet_and_identity_at_bench_k(self):
+        import time
+        g = _ws100k()
+        K = 64  # bench default (BENCH_QUERY_K_MINPLUS)
+        rng = np.random.default_rng(0)
+        srcs = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        tgts = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        proto = MinPlusQueries()
+
+        def batched():
+            qb = proto.init(g, srcs, tgts)
+            return engine.run_queries_until_done(g, proto, qb, KEY,
+                                                 max_rounds=256)
+        batched()  # warm
+        times = []
+        for _ in range(2):  # best-of, like bench.py's best_s
+            t0 = time.perf_counter()
+            _, out = batched()
+            times.append(time.perf_counter() - t0)
+        batch_s = min(times)
+        assert int(out["completed"]) == K
+
+        def single(i):
+            q1 = proto.init(g, srcs[i:i + 1], tgts[i:i + 1])
+            return engine.run_queries_until_done(g, proto, q1, KEY,
+                                                 max_rounds=256)
+        single(0)  # warm the capacity-1 program
+        seq = 0.0
+        for i in range(K):
+            t0 = time.perf_counter()
+            _, o1 = single(i)
+            seq += time.perf_counter() - t0
+            # identity at scale: every lane bitwise equals its
+            # independent capacity-1 run
+            assert float(o1["lane_values"][0]) == float(
+                out["lane_values"][i]), i
+            assert int(o1["lane_rounds"][0]) == int(
+                out["lane_rounds"][i]), i
+        ratio = seq / batch_s
+        assert ratio >= 10.0, f"minplus aggregate ratio {ratio:.1f}x < 10x"
+
+    def test_dht_ratchet_and_identity_at_bench_k(self):
+        import time
+        g = G.chord(100_000)
+        K = 2048  # bench default (BENCH_QUERY_K_DHT)
+        rng = np.random.default_rng(0)
+        orgs = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        keys = rng.integers(0, g.n_nodes, K).astype(np.int32)
+        proto = DhtLookups(metric="ring")
+
+        def batched():
+            qb = proto.init(g, orgs, keys)
+            return engine.run_queries_until_done(g, proto, qb, KEY,
+                                                 max_rounds=128)
+        batched()
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            _, out = batched()
+            times.append(time.perf_counter() - t0)
+        batch_s = min(times)
+        assert int(out["completed"]) == K
+        # identity at scale: every lane vs the numpy greedy walk
+        for k in range(K):
+            cur_ref, r_ref = dht_reference(g, orgs[k], keys[k], "ring")
+            assert int(out["lane_values"][k]) == cur_ref, k
+            assert int(out["lane_rounds"][k]) == r_ref, k
+        # fully-populated chord: every lookup arrives
+        assert bool(np.all(out["lane_values"] == keys))
+
+        def single(i):
+            q1 = proto.init(g, orgs[i:i + 1], keys[i:i + 1])
+            return engine.run_queries_until_done(g, proto, q1, KEY,
+                                                 max_rounds=128)
+        single(0)
+        seq = 0.0
+        sample = 64  # extrapolated: 2048 sequential runs would dominate
+        for i in range(sample):
+            t0 = time.perf_counter()
+            single(i)
+            seq += time.perf_counter() - t0
+        ratio = (seq / sample) * K / batch_s
+        assert ratio >= 10.0, f"dht aggregate ratio {ratio:.1f}x < 10x"
+
+    def test_pushsum_ratchet_and_isolation_at_bench_k(self):
+        import time
+        g = _ws100k()
+        K = 32  # bench default (BENCH_QUERY_K_PUSHSUM)
+        seeds = (np.arange(K) * 7 + 1).astype(np.int32)
+        th = 1e-4
+        proto = PushSumQueries()
+
+        def batched():
+            qb = proto.init(g, seeds, threshold=th)
+            return engine.run_queries_until_done(g, proto, qb, KEY,
+                                                 max_rounds=512)
+        batched()
+        times = []
+        for _ in range(3):  # best-of: this box's noise swings ~25%
+            t0 = time.perf_counter()
+            qb, out = batched()
+            times.append(time.perf_counter() - t0)
+        batch_s = min(times)
+        assert int(out["completed"]) == K
+
+        def single(i):
+            q1 = proto.init(g, seeds[i:i + 1], threshold=th)
+            return engine.run_queries_until_done(g, proto, q1, KEY,
+                                                 max_rounds=512)
+        single(0)
+        seq = 0.0
+        sample = 8
+        for i in range(sample):
+            t0 = time.perf_counter()
+            _, o1 = single(i)
+            seq += time.perf_counter() - t0
+            assert int(o1["lane_rounds"][0]) == int(out["lane_rounds"][i])
+        ratio = (seq / sample) * K / batch_s
+        assert ratio >= 10.0, f"pushsum aggregate ratio {ratio:.1f}x < 10x"
+        # identity at scale: a one-admitted-lane run of the SAME width
+        # reproduces its lane of the full batch bit for bit
+        lone = proto.empty(g, K)
+        lone, _ = proto.admit(g, lone, seeds[3:4], threshold=th)
+        lone, o1 = engine.run_queries_until_done(g, proto, lone, KEY,
+                                                 max_rounds=512)
+        assert int(o1["lane_rounds"][0]) == int(out["lane_rounds"][3])
+        assert bool(jnp.all(lone.payload["s"][:, 0]
+                            == qb.payload["s"][:, 3]))
+        assert bool(jnp.all(lone.payload["w"][:, 0]
+                            == qb.payload["w"][:, 3]))
