@@ -9,7 +9,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
-	batch-check ring-check scope-check serve-check query-check
+	batch-check ring-check scope-check serve-check query-check quake-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -114,6 +114,16 @@ scope-check:
 # 1k-concurrent-lane 100k-node soak runs with -m 'serve and slow').
 serve-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_serve.py -q
+
+# graftquake device-plane chaos: seeded halo-hop fault injection
+# (byte-replayable, chunked == unchunked via fault_round0, bit-identical
+# across both comm backends), one-shot chip-loss/wedge dispatch faults,
+# integrity checks + RetryPolicy/Healer recovery bit-identity across
+# engine/sharded/graftserve, and the store/bench satellites (tox env
+# "quake"; the slow-marked 100k chaos soak + 1.10x integrity-check
+# overhead ratchet run with -m 'quake and slow').
+quake-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_graftquake.py -q
 
 # Batched query lanes: byte-budget gate, lane-kernel parity, the three
 # family identity sweeps (min-plus vs Bellman-Ford reference, DHT vs the

@@ -1340,7 +1340,19 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
     real record. Each attempt emits a heartbeat comment line so the
     driver log shows liveness; the window (BENCH_BACKEND_WINDOW_S) still
     bounds everything from above when the cap is raised. Returns None
-    when healthy, else the last error string."""
+    when healthy, else the last error string.
+
+    Retry gaps come from the supervise plane's shared
+    :class:`~p2pnetwork_tpu.supervise.heal.RetryPolicy` (graftquake):
+    exponential backoff with SEEDED jitter instead of the old fixed
+    60 s/1.5x ladder — when several benches restart against one
+    recovering tunnel, their seeds (BENCH_PROBE_BACKOFF_SEED, default
+    0) de-synchronize the retry storm, and the same seed replays the
+    same delays. Every attempt's chosen backoff lands in the probe log
+    (``backoff_s``), so an outage round's timing is reconstructible
+    from artifacts alone."""
+    from p2pnetwork_tpu.supervise.heal import RetryPolicy  # jax-free
+
     if window_s is None:
         # 40 min ceiling: with the probe cap at 2 the wedged path spends
         # ~4-5 min here worst case; the window only matters when an
@@ -1351,8 +1363,13 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
     if max_attempts is None:
         max_attempts = int(os.environ.get("BENCH_PROBE_MAX_ATTEMPTS", "2"))
     max_attempts = max(max_attempts, 1)
+    policy = RetryPolicy(
+        max_attempts=max_attempts,
+        backoff_base_s=float(os.environ.get("BENCH_PROBE_BACKOFF_S", "60")),
+        backoff_max_s=120.0, jitter=0.5,
+        seed=int(os.environ.get("BENCH_PROBE_BACKOFF_SEED", "0")))
     deadline = time.monotonic() + window_s
-    attempt, sleep_s = 0, 60.0
+    attempt = 0
     while True:
         attempt += 1
         err = _probe_backend_once(probe_timeout_s)
@@ -1364,11 +1381,14 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
                       file=sys.stderr, flush=True)
             return None
         remaining = deadline - time.monotonic()
+        backoff_s = policy.backoff_s(attempt)
         _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
                            "error": err,
+                           "backoff_s": round(backoff_s, 3),
                            "window_remaining_s": round(max(remaining, 0), 1)})
-        print(f"# probe {attempt}: {err}; {max(remaining, 0):.0f}s left in "
-              f"window", file=sys.stderr, flush=True)
+        print(f"# probe {attempt}: {err}; backoff {backoff_s:.1f}s; "
+              f"{max(remaining, 0):.0f}s left in window",
+              file=sys.stderr, flush=True)
         if attempt >= max_attempts:
             _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
                                "gave_up": f"probe cap {max_attempts}"})
@@ -1378,8 +1398,7 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
             _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
                                "gave_up": f"window {window_s}s"})
             return f"{err} [gave up after {attempt} probes over {window_s}s]"
-        time.sleep(min(sleep_s, max(remaining, 1.0)))
-        sleep_s = min(sleep_s * 1.5, 120.0)
+        time.sleep(min(backoff_s, max(remaining, 1.0)))
 
 
 def main():

@@ -1,14 +1,31 @@
-"""Chaos plane for the sockets backend: seeded, deterministic fault
-injection mirroring the sim failures API (``sim/failures.py``) name-for-name
-— ``kill_nodes`` / ``revive_nodes`` / ``cut_links`` / ``partition`` — plus
-sockets-only faults (latency, throttle, frame drop/duplicate/corrupt,
-slow-drain peer). See :mod:`p2pnetwork_tpu.chaos.plane` for the design and
-GETTING_STARTED.md "Fault injection & chaos" for the sim↔sockets mapping.
+"""Chaos plane: seeded, deterministic fault injection for BOTH backends.
 
-Stdlib-only, like the rest of the sockets backend — no jax import.
+- **Sockets** (:mod:`p2pnetwork_tpu.chaos.plane`): faults mirroring the
+  sim failures API (``sim/failures.py``) name-for-name — ``kill_nodes``
+  / ``revive_nodes`` / ``cut_links`` / ``partition`` — plus sockets-only
+  faults (latency, throttle, frame drop/duplicate/corrupt, slow-drain
+  peer). See GETTING_STARTED.md "Fault injection & chaos".
+- **Device** (:mod:`p2pnetwork_tpu.chaos.device`, graftquake): seeded
+  halo-hop faults for the sharded ring (:class:`FaultSchedule` /
+  :class:`FaultSpec` as a ``comm=`` value) and one-shot chunk-dispatch
+  faults (:class:`DispatchChaos` — chip preemption, wedged dispatch)
+  for the engine/serve drivers. Recovery lives in
+  :mod:`p2pnetwork_tpu.supervise.heal`; see GETTING_STARTED.md
+  "Device-plane chaos & self-healing".
+
+Top-level import stays stdlib-only (device.py defers jax into the fault
+math), preserving the sockets backend's no-jax rule.
 """
 
+from p2pnetwork_tpu.chaos.device import (ChipLost, DispatchChaos,
+                                          FaultSchedule, FaultSpec,
+                                          FaultyComm, WedgedDispatch,
+                                          install_dispatch_chaos)
 from p2pnetwork_tpu.chaos.plane import ChaosPlane
 from p2pnetwork_tpu.chaos.streams import ChaosReader, ChaosWriter
 
-__all__ = ["ChaosPlane", "ChaosReader", "ChaosWriter"]
+__all__ = [
+    "ChaosPlane", "ChaosReader", "ChaosWriter",
+    "FaultSchedule", "FaultSpec", "FaultyComm", "DispatchChaos",
+    "ChipLost", "WedgedDispatch", "install_dispatch_chaos",
+]

@@ -2,6 +2,9 @@
 propagation with ppermute ring exchange (sharded.py)."""
 
 from p2pnetwork_tpu.parallel.mesh import ring_mesh, shard_spec
-from p2pnetwork_tpu.parallel.sharded import ShardedGraph, flood, shard_graph
+from p2pnetwork_tpu.parallel.sharded import (CommPayloadMismatch,
+                                              ShardedGraph, flood,
+                                              shard_graph)
 
-__all__ = ["ring_mesh", "shard_spec", "ShardedGraph", "shard_graph", "flood"]
+__all__ = ["ring_mesh", "shard_spec", "ShardedGraph", "shard_graph", "flood",
+           "CommPayloadMismatch"]

@@ -82,10 +82,22 @@ COMM_BACKENDS = ("ppermute", "pallas")
 DEFAULT_COMM = "ppermute"
 
 
-def _resolve_comm(comm: str) -> str:
+def _resolve_comm(comm):
+    # Non-string comm values are spec OBJECTS (chaos/device.FaultSpec):
+    # hashable, already carrying a concrete backend, and built into a
+    # comm object by _make_ring_comm — they pass through untouched.
+    if not isinstance(comm, str):
+        return comm
     from p2pnetwork_tpu.parallel.auto import resolve_comm
 
     return resolve_comm(comm)
+
+
+class CommPayloadMismatch(TypeError):
+    """A halo payload's shape/dtype diverged from the template its ring
+    established on first shift — raised at trace time, where the caller
+    can read it, instead of failing deep inside the pallas kernel or the
+    XLA collective-permute lowering."""
 
 
 class _RingComm:
@@ -114,7 +126,13 @@ class _RingComm:
     the on-device follow-up (ROADMAP item 1).
     """
 
-    __slots__ = ("backend", "axis_name", "axis_size")
+    __slots__ = ("backend", "axis_name", "axis_size", "_tpl_fwd",
+                 "_tpl_back")
+
+    #: graftquake context seam: _ring_pass threads its scan's step index
+    #: through set_context only for comms that ask (chaos/device
+    #: FaultyComm); the bare backends stay byte-identical to before.
+    wants_step = False
 
     def __init__(self, backend: str, axis_name: str, axis_size: int):
         if backend not in COMM_BACKENDS:
@@ -124,8 +142,42 @@ class _RingComm:
         self.backend = backend
         self.axis_name = axis_name
         self.axis_size = axis_size
+        self._tpl_fwd = None
+        self._tpl_back = None
+
+    @property
+    def fuses(self) -> bool:
+        """Whether this backend carries the halo UNDER the blocked
+        segment sum (``fused_segment_sum`` returns non-None)."""
+        return self.backend == "pallas"
+
+    def set_context(self, round=None, step=None) -> None:
+        """Fault-injection context hook (round/step of the next hops) —
+        a no-op on the bare backends; chaos/device.FaultyComm records
+        the tracers for its site keying."""
+
+    def _check_payload(self, x, direction: str) -> None:
+        """Validate the payload against the template this ring
+        established on its first hop in ``direction`` (forward shifts
+        and the reverse Horner hops legitimately carry different
+        payloads — liveness masks vs degree counts — so each direction
+        owns a template). Shapes are static at trace time, so the check
+        is free at runtime and the error surfaces at the call site."""
+        sig = (tuple(x.shape), str(x.dtype))
+        slot = "_tpl_fwd" if direction == "shift" else "_tpl_back"
+        tpl = getattr(self, slot)
+        if tpl is None:
+            setattr(self, slot, sig)
+        elif tpl != sig:
+            raise CommPayloadMismatch(
+                f"halo payload {sig[0]}/{sig[1]} does not match the "
+                f"template {tpl[0]}/{tpl[1]} this ring established on "
+                f"its first {direction} — one ring moves one payload "
+                "shape per direction (build a separate pass for a "
+                "different payload)")
 
     def shift(self, x):
+        self._check_payload(x, "shift")
         if self.backend == "pallas":
             from p2pnetwork_tpu.ops import pallas_ring as PR
 
@@ -134,6 +186,7 @@ class _RingComm:
                                 perm=_ring_perm(self.axis_size))
 
     def shift_back(self, x):
+        self._check_payload(x, "shift_back")
         if self.backend == "pallas":
             from p2pnetwork_tpu.ops import pallas_ring as PR
 
@@ -149,14 +202,21 @@ class _RingComm:
         caller then shifts and applies separately)."""
         if self.backend != "pallas":
             return None
+        self._check_payload(rot, "shift")
         from p2pnetwork_tpu.ops import pallas_ring as PR
 
         return PR.ring_segment_sum(rot, contrib, local_dst, self.axis_name,
                                    self.axis_size, block, exact=exact)
 
 
-def _make_ring_comm(comm: str, axis_name: str, S: int) -> _RingComm:
-    return _RingComm(comm, axis_name, S)
+def _make_ring_comm(comm, axis_name: str, S: int):
+    """Build one ring's comm object: a backend name builds the bare
+    :class:`_RingComm`; a spec object (chaos/device.FaultSpec — anything
+    with ``make``) builds its wrapper. Specs are hashable, so they ride
+    the same lru-cached loop factories the backend strings do."""
+    if isinstance(comm, str):
+        return _RingComm(comm, axis_name, S)
+    return comm.make(axis_name, S)
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -1104,8 +1164,11 @@ def _ring_pass_unrolled(axis_name, S, rot, groups, diag, acc0, combine,
     shard-local compute both only read the resident block, so the hop is
     in flight across the whole step on overlap-capable backends."""
     pieces, masks, apply_diag = diag
+    wants_step = bool(getattr(comm, "wants_step", False))
     acc = acc0
     for t in range(S):
+        if wants_step and t < S - 1:
+            comm.set_context(step=t)
         rot_next = comm.shift(rot) if t < S - 1 else rot
         for fn, *arrs in groups:
             acc = combine(acc, fn(rot, *(a[t] for a in arrs)))
@@ -1181,11 +1244,23 @@ def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None,
     # The MXU static group's fused form (contrib gather, post-process,
     # exact flag, kernel block) — present only on the one-hot bucket
     # appliers (_bucket_*_mxu), consumed only by fusing backends.
+    # `comm.fuses` (not a backend-name check) is the gate: a wrapping
+    # comm (chaos/device.FaultyComm) carries its inner backend's name
+    # but declines the fused form so the hop payload stays exposed.
     fused = getattr(meta[0][0], "fused", None) if meta else None
-    use_fused = fused is not None and comm.backend == "pallas"
+    use_fused = fused is not None and comm.fuses
+    # graftquake seam: comms that key faults on the ring step ask for
+    # the scan's step index via set_context; the bare backends
+    # (wants_step=False) keep the exact pre-fault scan structure.
+    wants_step = bool(getattr(comm, "wants_step", False))
 
-    def ring_step(rc, bkt_arrays):
+    def ring_step(rc, xs):
         rot, acc = rc  # rot: frontier block resident this step
+        if wants_step:
+            bkt_arrays, t = xs
+            comm.set_context(step=t)
+        else:
+            bkt_arrays = xs
         if use_fused:
             contrib_fn, post, exact, kblock = fused
             arrs0 = bkt_arrays[: meta[0][1]]
@@ -1199,11 +1274,10 @@ def _ring_pass(axis_name, S, frontier, groups, acc0, combine, diag=None,
         return (rot_next, acc), None
 
     if S > 1:
-        (rot, acc), _ = jax.lax.scan(
-            ring_step,
-            (frontier, acc0),
-            tuple(a[: S - 1] for a in arrays),
-        )
+        xs = tuple(a[: S - 1] for a in arrays)
+        if wants_step:
+            xs = (xs, jnp.arange(S - 1, dtype=jnp.int32))
+        (rot, acc), _ = jax.lax.scan(ring_step, (frontier, acc0), xs)
     else:
         rot, acc = frontier, acc0
     return apply_all(acc, rot, tuple(a[S - 1] for a in arrays))
@@ -1425,7 +1499,7 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
                       bkt_src, bkt_dst, bkt_mask, dyn_src, dyn_dst, dyn_mask,
                       mxu_src, mxu_dst, mxu_mask, diag_masks,
                       node_mask, out_degree, seen0, frontier0,
-                      ring0=None, ici_round=None):
+                      ring0=None, ici_round=None, fault_round0=None):
     """Per-shard body: flood until the psum'd live coverage reaches the
     target — the device-side early-exit ``lax.while_loop`` of
     engine.run_until_coverage, multi-chip. The psum makes ``covered``
@@ -1437,11 +1511,18 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
     variant) append the per-round ring to the carry: every row is built
     from the psum'd replicated scalars, so the ring is replicated too
     and rides back as a fourth output. Results are bit-identical either
-    way — the ring never feeds the loop's math."""
+    way — the ring never feeds the loop's math.
+
+    ``fault_round0`` (fault-spec comms only) is the GLOBAL round of this
+    call's first round: the graftquake comm keys its fault sites on
+    ``fault_round0 + r``, so a resumed/healed chunk hits exactly the
+    sites an unchunked run would."""
     pass_ = _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           bkt_src, bkt_dst, bkt_mask,
                           dyn_src, dyn_dst, dyn_mask,
                           mxu_src, mxu_dst, mxu_mask, diag_masks)
+    wire_faults = (fault_round0 is not None
+                   and getattr(pass_.comm, "wants_step", False))
     node_mask_b, out_degree_b = node_mask[0], out_degree[0]
     n_live = jnp.maximum(
         jax.lax.psum(jnp.sum(node_mask_b.astype(jnp.int32)), axis_name), 1
@@ -1454,6 +1535,8 @@ def _ring_coverage_or(axis_name, S, block, pieces, mxu_block, comm,
 
     def body(carry):
         seen, frontier, rounds, prev_covered, hi, lo, occ = carry[:7]
+        if wire_faults:
+            pass_.comm.set_context(round=fault_round0 + rounds)
         delivered = pass_(frontier)
         new = delivered & ~seen & node_mask_b
         seen = seen | new
@@ -1512,11 +1595,20 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     # check_vma=False: see the note on the sibling ring-body factory.
     # The recorder variant (rec=True) appends the replicated flight ring
     # and the static per-round ICI byte estimate to the arguments and the
-    # ring to the outputs.
+    # ring to the outputs. A fault-spec comm (graftquake) appends one
+    # more replicated scalar — the global round of the chunk's first
+    # round — LAST, so string-comm programs keep their exact signature.
+    faulty = not isinstance(comm, str)
+    if faulty:
+        wrapped = lambda target, *args: body(  # noqa: E731
+            target, max_rounds, *args[:-1], fault_round0=args[-1])
+    else:
+        wrapped = lambda target, *args: body(target, max_rounds, *args)  # noqa: E731
     fn = shard_map(
-        lambda target, *args: body(target, max_rounds, *args),
+        wrapped,
         mesh=mesh, check_vma=False,
-        in_specs=(P(),) + (spec,) * 14 + ((P(), P()) if rec else ()),
+        in_specs=(P(),) + (spec,) * 14 + ((P(), P()) if rec else ())
+        + ((P(),) if faulty else ()),
         out_specs=(spec, spec, P()) + ((P(),) if rec else ()),
     )
     return jax.jit(fn)
@@ -1547,13 +1639,31 @@ def _rec_ici_round_bytes(key: tuple, build) -> int:
     return est
 
 
+def _record_comm_faults(comm, rounds, S, *, round0: int = 0) -> None:
+    """After a fault-spec run (graftquake): count the faults the executed
+    round window actually hit into ``chaos_device_faults_total{kind}`` —
+    a host replay of the schedule, exact by construction (the compiled
+    loop carries no counter). No-op for backend-string comms, empty
+    schedules, hop-free rings (S == 1) and zero-round runs."""
+    if isinstance(comm, str) or S <= 1 or not rounds:
+        return
+    schedule = getattr(comm, "schedule", None)
+    if schedule is None or not schedule.active:
+        return
+    from p2pnetwork_tpu.chaos import device as chaos_device
+
+    chaos_device.record_faults(schedule, rounds=int(rounds),
+                               n_steps=S - 1, n_shards=S,
+                               round0=int(round0))
+
+
 def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                          coverage_target: float = 0.99,
                          max_rounds: int = 1024,
                          axis_name: str = DEFAULT_AXIS,
                          state0=None, return_state: bool = False,
                          adaptive_k: int = 0, comm: str = DEFAULT_COMM,
-                         recorder=None):
+                         recorder=None, fault_round0: int = 0):
     """Flood until coverage of the LIVE population reaches the target —
     the north-star run-to-99% measurement (engine.run_until_coverage), on
     the multi-chip path. One XLA program, zero host round-trips per round.
@@ -1582,6 +1692,15 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
     config's static per-round comm-census estimate (the same pricing the
     bench multichip column publishes, per backend). Results stay
     bit-identical to recorder-off runs on BOTH comm backends.
+
+    ``comm`` also accepts a :class:`~p2pnetwork_tpu.chaos.device.FaultSpec`
+    (graftquake): the ring runs on the spec's backend with its seeded
+    fault schedule injected at the halo hops, keyed on the GLOBAL round
+    ``fault_round0 + r`` (chunked/resumed drivers pass ``fault_round0``
+    so every chunk hits the sites an unchunked run would); the faults the
+    executed window hit are counted into
+    ``chaos_device_faults_total{kind}`` after the run (dense loop only —
+    the adaptive path refuses fault specs like it refuses the recorder).
     """
     from p2pnetwork_tpu.models.flood import Flood
 
@@ -1603,6 +1722,11 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
                 "the flight recorder is not supported on the adaptive "
                 "frontier-sparse path — record the dense loop "
                 "(adaptive_k=0)")
+        if not isinstance(_resolve_comm(comm), str):
+            raise ValueError(
+                "fault-spec comms are not supported on the adaptive "
+                "frontier-sparse path — inject on the dense loop "
+                "(adaptive_k=0)")
         if sg.csr_pos is None:
             raise ValueError(
                 "adaptive_k requires a sender-CSR sharded graph — build "
@@ -1617,30 +1741,39 @@ def flood_until_coverage(sg: ShardedGraph, mesh: Mesh, source: int, *,
             jnp.float32(coverage_target), *common,
             sg.csr_pos, sg.csr_offsets, seen0, frontier0,
         )
-    elif recorder is None:
-        fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
-                           sg.diag_pieces, sg.mxu_block, _resolve_comm(comm))
-        seen, frontier, packed = fn(
-            jnp.float32(coverage_target), *common, seen0, frontier0,
-        )
     else:
         resolved = _resolve_comm(comm)
-        fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
-                           sg.diag_pieces, sg.mxu_block, resolved, rec=True)
-        base_fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
-                                sg.diag_pieces, sg.mxu_block, resolved)
-        ici = _rec_ici_round_bytes(
-            ("flood", mesh, axis_name, S, block, resolved,
-             sg.diag_pieces, sg.mxu_block),
-            lambda: (base_fn,
-                     (jnp.float32(coverage_target), *common, seen0,
-                      frontier0), S))
-        seen, frontier, packed, ring = fn(
-            jnp.float32(coverage_target), *common, seen0, frontier0,
-            recorder.init(), jnp.float32(ici),
-        )
-        packed, ring = jax.device_get((packed, ring))
+        # Fault-spec comms (graftquake) take the global first-round
+        # index as one extra trailing replicated scalar — traced, so
+        # chunked drivers advance it without recompiling.
+        ftail = () if isinstance(resolved, str) \
+            else (jnp.int32(fault_round0),)
+        if recorder is None:
+            fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                               sg.diag_pieces, sg.mxu_block, resolved)
+            seen, frontier, packed = fn(
+                jnp.float32(coverage_target), *common, seen0, frontier0,
+                *ftail,
+            )
+        else:
+            fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                               sg.diag_pieces, sg.mxu_block, resolved,
+                               rec=True)
+            base_fn = _flood_cov_fn(mesh, axis_name, S, block, max_rounds,
+                                    sg.diag_pieces, sg.mxu_block, resolved)
+            ici = _rec_ici_round_bytes(
+                ("flood", mesh, axis_name, S, block, resolved,
+                 sg.diag_pieces, sg.mxu_block),
+                lambda: (base_fn,
+                         (jnp.float32(coverage_target), *common, seen0,
+                          frontier0, *ftail), S))
+            seen, frontier, packed, ring = fn(
+                jnp.float32(coverage_target), *common, seen0, frontier0,
+                recorder.init(), jnp.float32(ici), *ftail,
+            )
+            packed, ring = jax.device_get((packed, ring))
     out = accum.unpack_summary(packed)
+    _record_comm_faults(comm, out["rounds"], S, round0=fault_round0)
     if ring is not None:
         out["flight_record"] = flightrec.trim(ring, out["rounds"])
     # The packed fifth slot is the mean per-round frontier occupancy —
@@ -2130,6 +2263,7 @@ def _make_or_pass(axis_name, S, block, pieces, mxu_block, comm,
                           jnp.zeros((block,), bool), jnp.logical_or,
                           diag=diag, comm=comm_obj)
 
+    pass_.comm = comm_obj  # round-context handle for fault-wired loops
     return pass_
 
 
@@ -3765,6 +3899,7 @@ def _make_or_lanes_pass(axis_name, S, block, comm,
                           jnp.zeros_like(lanes), jnp.bitwise_or,
                           comm=comm_obj)
 
+    pass_.comm = comm_obj  # round-context handle for fault-wired loops
     return pass_
 
 
@@ -3847,7 +3982,7 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
                     node_mask, out_degree,
                     seen0, frontier0, sent0, source, admitted, done0,
                     rounds0, seen_count0, target,
-                    ring0=None, ici_round=None):
+                    ring0=None, ici_round=None, fault_round0=None):
     """Per-shard body: advance EVERY running lane of a lane-packed batch
     until all admitted lanes complete (or ``max_rounds``) — the
     multi-chip mirror of ``engine._batch_loop`` + ``BatchFlood.step``,
@@ -3863,6 +3998,11 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
     pass_ = _make_or_lanes_pass(axis_name, S, block, comm,
                                 bkt_src, bkt_dst, bkt_mask,
                                 dyn_src, dyn_dst, dyn_mask)
+    # graftquake round context: a fault-spec comm keys its sites on the
+    # GLOBAL round (fault_round0 + r), so chunked serving drivers hit
+    # the same sites an unchunked run would.
+    wire_faults = (fault_round0 is not None
+                   and getattr(pass_.comm, "wants_step", False))
     nm = node_mask[0]
     node_lanes = jnp.where(nm, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     deg_u = out_degree[0].astype(jnp.uint32)
@@ -3883,6 +4023,8 @@ def _ring_batch_cov(axis_name, S, block, comm, max_rounds,
     def body(carry):
         seen, frontier, sent, done, rounds_l, seen_count, r, hi, lo, occ = \
             carry[:10]
+        if wire_faults:
+            pass_.comm.set_context(round=fault_round0 + r)
         live = admitted & ~done
         live_mask = bitset.pack_bits(live)  # u32[W] replicated
         front = frontier & live_mask[:, None]
@@ -3969,10 +4111,18 @@ def _batch_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
     body = functools.partial(_ring_batch_cov, axis_name, S, block, comm,
                              max_rounds)
     spec = P(axis_name)
+    # A fault-spec comm (graftquake) appends the global first-round
+    # scalar LAST — after the recorder pair when present — so the
+    # donated carry indices below never move and string-comm programs
+    # keep their exact pre-fault signature.
+    faulty = not isinstance(comm, str)
+    wrapped = body if not faulty else (
+        lambda *a: body(*a[:-1], fault_round0=a[-1]))
     # check_vma=False: see the note on the sibling ring-body factories.
     fn = shard_map(
-        body, mesh=mesh, check_vma=False,
-        in_specs=(spec,) * 11 + (P(),) * 6 + ((P(), P()) if rec else ()),
+        wrapped, mesh=mesh, check_vma=False,
+        in_specs=(spec,) * 11 + (P(),) * 6 + ((P(), P()) if rec else ())
+        + ((P(),) if faulty else ()),
         out_specs=(spec,) * 3 + (P(),) * 6 + (P(),)
         + ((P(),) if rec else ()),
     )
@@ -4004,7 +4154,8 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
                              max_rounds: int = 1024,
                              axis_name: str = DEFAULT_AXIS,
                              comm: str = DEFAULT_COMM,
-                             donate: bool = True, recorder=None):
+                             donate: bool = True, recorder=None,
+                             fault_round0: int = 0):
     """Advance ALL in-flight messages of a lane-packed batch on the
     SHARDED graph until every admitted lane reaches its coverage target —
     ``engine.run_batch_until_coverage`` on the multi-chip ring, one XLA
@@ -4043,9 +4194,18 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
     ``out["flight_record"]``; results stay bit-identical on both comm
     backends. The trace plane's ``batch_run`` span and per-lane
     lifecycle events mirror the engine loop's (``loop="sharded"``).
+
+    ``comm`` also accepts a graftquake
+    :class:`~p2pnetwork_tpu.chaos.device.FaultSpec` — seeded halo-hop
+    faults keyed on the global round ``fault_round0 + r`` (chunked
+    drivers pass ``fault_round0`` = the batch's cumulative round so
+    chunk boundaries never move a fault site), counted into
+    ``chaos_device_faults_total{kind}`` after the run.
     """
+    from p2pnetwork_tpu.chaos import device as chaos_device
     from p2pnetwork_tpu.sim import engine as _engine
 
+    chaos_device.dispatch_gate("sharded-batch")
     _require_lanes_layout(sg, "sharded run_batch_until_coverage")
     del key  # engine-signature symmetry; the batched flood draws nothing
     t0 = time.perf_counter()
@@ -4083,10 +4243,12 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
         args = (sg.bkt_src, sg.bkt_dst, sg.bkt_mask, dyn_src, dyn_dst,
                 dyn_mask, sg.node_mask, sg.out_degree,
                 *_shard_batch_args(sg, batch))
+        ftail = () if isinstance(resolved, str) \
+            else (jnp.int32(fault_round0),)
         ring = None
         if recorder is None:
             (seen, frontier, sent, source, admitted, done, rounds_l,
-             seen_count, target, packed) = fn(*args)
+             seen_count, target, packed) = fn(*args, *ftail)
         else:
             n_words = int(batch.seen.shape[0])
             base_fn = _batch_cov_fn(mesh, axis_name, sg.n_shards, sg.block,
@@ -4094,10 +4256,10 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
             ici = _rec_ici_round_bytes(
                 ("batch", mesh, axis_name, sg.n_shards, sg.block, resolved,
                  n_words),
-                lambda: (base_fn, args, sg.n_shards))
+                lambda: (base_fn, (*args, *ftail), sg.n_shards))
             (seen, frontier, sent, source, admitted, done, rounds_l,
              seen_count, target, packed, ring) = fn(
-                *args, recorder.init(), jnp.float32(ici))
+                *args, recorder.init(), jnp.float32(ici), *ftail)
         t1 = time.perf_counter()
         n_pad = batch.seen.shape[1]
         nbytes = sum(int(getattr(leaf, "nbytes", 0))
@@ -4105,6 +4267,8 @@ def run_batch_until_coverage(sg: ShardedGraph, mesh: Mesh, protocol,
         if ring is not None:
             packed, ring = jax.device_get((packed, ring))
         out = accum.unpack_batch_summary(packed, int(batch.seen.shape[0]))
+        _record_comm_faults(resolved, out["rounds"], sg.n_shards,
+                            round0=fault_round0)
         if ring is not None:
             out["flight_record"] = flightrec.trim(ring, out["rounds"])
         batch = dataclasses.replace(

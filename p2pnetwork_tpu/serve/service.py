@@ -179,6 +179,19 @@ class SimService:
         its lane's packed ``seen`` bits — the bit-identity witness the
         chaos-soak comparison uses (costs one host pull of the packed
         words per harvesting tick; off by default).
+    heal:
+        A :class:`~p2pnetwork_tpu.supervise.heal.RetryPolicy` (graftquake
+        self-healing): the tick's engine chunk runs under a
+        :class:`~p2pnetwork_tpu.supervise.heal.Healer` — undonated input
+        retained as the rollback state, end-of-chunk integrity checks
+        (template audit + batch-plane monotonicity), and policy-routed
+        retry on detected faults (injected chip preemptions, wedged
+        dispatches, integrity violations). A healed retry re-dispatches
+        the SAME chunk key against the retained input, so recovered
+        ticks are bit-identical to undisturbed ones and no admitted
+        lane is lost. Costs one extra live batch copy (the retained
+        input) plus one host pull of the carry per tick for the checks;
+        ``None`` (default) keeps the donating fast path.
     deadline_s / on_stall:
         Optional supervise-plane watchdog over driver ticks (heartbeat
         per tick; see supervise/watchdog.py for the stall modes).
@@ -198,6 +211,7 @@ class SimService:
                  slo_rounds: Optional[float] = None,
                  done_retention: int = 4096,
                  record_seen_hash: bool = False,
+                 heal=None,
                  deadline_s: Optional[float] = None,
                  on_stall: Union[str, Callable] = "raise",
                  idle_wait_s: float = 0.05,
@@ -253,6 +267,18 @@ class SimService:
         self.deadline_s = deadline_s
         self.on_stall = on_stall
         self._registry = registry
+        self._healer = None
+        if heal is not None:
+            from p2pnetwork_tpu.supervise.heal import Healer
+
+            # Template from the empty batch: every chunk's harvested
+            # carry must keep these exact shapes/dtypes (and finite
+            # floats — MessageBatch carries none, so the audit is pure
+            # structure here).
+            template = jax.tree_util.tree_map(
+                lambda x: np.zeros(x.shape, x.dtype), self._batch)
+            self._healer = Healer(heal, template=template, monotonic=True,
+                                  registry=registry)
 
         # ---- control plane (everything below _cond is guarded by it) --
         self._cond = concurrency.condition()
@@ -749,6 +775,7 @@ class SimService:
                 rec["admitted_round"] = self._round
                 admits.append((tid, rec["source"], rec["target"]))
             round0 = self._round
+            tick0 = self._tick
         if admits:
             self._admit_on_device(admits)
 
@@ -759,9 +786,23 @@ class SimService:
         out: dict = {}
         if running:
             chunk_key = jax.random.fold_in(self._base_key, round0 + 1)
-            self._batch, out = engine.run_batch_until_coverage(
-                self.graph, self._protocol, self._batch, chunk_key,
-                max_rounds=self.chunk_rounds, donate=True)
+            if self._healer is not None:
+                # Healing mode: undonated dispatch (the retained input
+                # IS the rollback state), integrity-checked, retried
+                # under the policy. The retry re-runs the same chunk
+                # key, so a healed tick's results are bit-identical to
+                # an undisturbed one and no admitted lane is lost.
+                def _dispatch(b):
+                    return engine.run_batch_until_coverage(
+                        self.graph, self._protocol, b, chunk_key,
+                        max_rounds=self.chunk_rounds, donate=False)
+
+                self._batch, out = self._healer.run_chunk(
+                    _dispatch, self._batch, chunk_index=tick0)
+            else:
+                self._batch, out = engine.run_batch_until_coverage(
+                    self.graph, self._protocol, self._batch, chunk_key,
+                    max_rounds=self.chunk_rounds, donate=True)
             executed = int(out["rounds"])
         completed = self._harvest(out, executed)
         if self._watchdog is not None:

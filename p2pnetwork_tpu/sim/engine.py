@@ -57,6 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from p2pnetwork_tpu import concurrency, telemetry
+from p2pnetwork_tpu.chaos import device as chaos_device
 from p2pnetwork_tpu.ops import bitset
 from p2pnetwork_tpu.sim import flightrec
 from p2pnetwork_tpu.sim.graph import Graph
@@ -313,6 +314,8 @@ def run_from(graph: Graph, protocol, state, key: jax.Array, rounds: int, *,
     ``(state, stats, FlightRecord)`` (the record fetch is the one extra
     sync the recorder adds, at the END of the run).
     """
+    # graftquake chunk-dispatch gate (see run_until_coverage_from).
+    chaos_device.dispatch_gate("engine-rounds")
     if recorder is None:
         fn = _pick_loop(_run_from_donating, _run_from_keeping, donate,
                         state, graph, key)
@@ -397,6 +400,11 @@ def run_until_coverage_from(
     ``out["flight_record"]`` — run results stay bit-identical to
     recorder-off runs, still with zero per-round host sync.
     """
+    # graftquake chunk-dispatch gate: an armed DispatchChaos fault
+    # (chip preemption / wedged dispatch) fires HERE, before any buffer
+    # is touched — one attribute read + None check when nothing is
+    # installed (chaos/device.py).
+    chaos_device.dispatch_gate("engine-coverage")
     keys = _require_stats(graph, protocol, state0, key,
                           ("coverage", "messages"))
     t0 = time.perf_counter()
@@ -695,6 +703,10 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
     (telemetry/spans.py), the whole call runs under a ``batch_run`` span
     carrying per-lane ``lane_admit`` / ``lane_resume`` /
     ``lane_complete`` / ``lane_freeze`` events."""
+    # graftquake chunk-dispatch gate (see run_until_coverage_from): an
+    # armed fault raises before the batch is read, so a healing retry
+    # re-dispatches an intact carry.
+    chaos_device.dispatch_gate("engine-batch")
     t0 = time.perf_counter()
     _check_not_donated(batch)  # friendly error before refresh reads it
     # Pre-run done flags, snapshotted BEFORE the refresh: a lane the

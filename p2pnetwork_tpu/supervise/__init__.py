@@ -9,7 +9,13 @@ crash-tolerant long runs.
 - :class:`~p2pnetwork_tpu.supervise.runner.SupervisedRun` /
   :class:`~p2pnetwork_tpu.supervise.runner.Preempted` — chunked,
   auto-checkpointing, resumable driver for the sim engine's run-to-*
-  loops.
+  loops;
+- :class:`~p2pnetwork_tpu.supervise.heal.RetryPolicy` /
+  :class:`~p2pnetwork_tpu.supervise.heal.Healer` /
+  :class:`~p2pnetwork_tpu.supervise.heal.IntegrityViolation` —
+  graftquake self-healing: end-of-chunk integrity checks plus
+  policy-routed rollback-and-retry of detected bad state (stdlib-only
+  at import; jax defers into the check functions).
 
 The store and runner need jax (they sit on ``sim/checkpoint.py`` and the
 engine); they load lazily so the sockets-only surface of this package —
@@ -20,12 +26,16 @@ backend is stdlib-only" rule.
 from p2pnetwork_tpu.supervise.watchdog import StallTimeout, Watchdog
 
 __all__ = ["Watchdog", "StallTimeout", "CheckpointStore", "SupervisedRun",
-           "Preempted"]
+           "Preempted", "RetryPolicy", "Healer", "IntegrityViolation"]
 
 _LAZY = {
     "CheckpointStore": ("p2pnetwork_tpu.supervise.store", "CheckpointStore"),
     "SupervisedRun": ("p2pnetwork_tpu.supervise.runner", "SupervisedRun"),
     "Preempted": ("p2pnetwork_tpu.supervise.runner", "Preempted"),
+    "RetryPolicy": ("p2pnetwork_tpu.supervise.heal", "RetryPolicy"),
+    "Healer": ("p2pnetwork_tpu.supervise.heal", "Healer"),
+    "IntegrityViolation": ("p2pnetwork_tpu.supervise.heal",
+                           "IntegrityViolation"),
 }
 
 

@@ -1,0 +1,427 @@
+"""Self-healing recovery: integrity checks + policy-routed chunk retry.
+
+supervise/runner.py recovers from process DEATH (checkpoint + resume);
+nothing recovered from DETECTED bad state — a corrupted halo word, a
+chip lost mid-traffic, a wedged dispatch. This module is that half:
+
+- **Detection** — cheap end-of-chunk integrity checks on the harvested
+  carry, surfaced as typed :class:`IntegrityViolation` naming the
+  failing leaf, chunk and shard: a shape/dtype/finiteness audit against
+  the state template (:func:`audit_state`), monotonicity invariants on
+  the batch plane's latched progress (:func:`check_monotonic` — seen
+  bits only gain, counters/rounds never regress, done never unlatches),
+  and an optional checksum cross-validation against a *replicated
+  reference fold* (re-executing the chunk on the trusted path — the
+  single-chip engine or the clean comm backend, bit-identical peers by
+  the PR-11 parity pin — and comparing :func:`state_checksum`). The
+  cheap checks catch state damage; the reference fold catches
+  semantically-consistent comm corruption, which no local invariant
+  can.
+
+- **Recovery** — :class:`RetryPolicy` (exponential backoff with seeded
+  deterministic jitter, a max-attempt budget, per-failure-class action
+  routing) driving :class:`Healer.run_chunk`: roll the chunk back to
+  its input (the retained undonated state, or the last
+  :class:`~p2pnetwork_tpu.supervise.store.CheckpointStore` entry when a
+  store is configured), optionally reroute to a fallback dispatch
+  (clean comm backend / single-chip engine), re-execute. Chunk keys are
+  the supervise schedule (``fold_in(base_key, round + 1)``), so a
+  healed re-run is bit-identical to a chunk that never faulted.
+
+Retries count into ``heal_retries_total{outcome}`` (``retry`` /
+``fallback`` routing decisions, ``healed`` chunks that recovered,
+``exhausted`` budget overruns); the trace plane gets ``heal_retry`` /
+``heal_rollback`` / ``heal_recovered`` ride-along events.
+
+Top-level import is stdlib-only (jax/numpy defer into the check
+functions) so bench.py's parent process can share :class:`RetryPolicy`
+for its probe backoff without touching jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Callable, Dict, Mapping, Optional
+
+from p2pnetwork_tpu import concurrency, telemetry
+from p2pnetwork_tpu.chaos.device import ChipLost, WedgedDispatch
+from p2pnetwork_tpu.supervise.watchdog import StallTimeout
+from p2pnetwork_tpu.telemetry import spans
+
+__all__ = [
+    "IntegrityViolation", "RetryPolicy", "Healer", "classify_failure",
+    "audit_state", "check_monotonic", "state_checksum",
+]
+
+#: Healer retry-policy actions a failure class can route to.
+ACTIONS = ("retry", "fallback", "raise")
+
+#: Default per-failure-class routing: deterministic comm corruption
+#: (integrity) re-runs the SAME faults if retried in place, so it routes
+#: to the fallback path; one-shot dispatch faults (preempt/wedge) retry
+#: where they ran.
+DEFAULT_ROUTES: Mapping[str, str] = {
+    "integrity": "fallback",
+    "preempt": "retry",
+    "wedged": "retry",
+}
+
+
+class IntegrityViolation(RuntimeError):
+    """A detected-bad-state failure: the end-of-chunk integrity checks
+    rejected a harvested carry. ``kind`` names the check (``template`` /
+    ``nonfinite`` / ``monotonicity`` / ``checksum``), ``leaf`` the
+    failing state leaf, ``chunk`` the chunk index, ``shard`` the shard
+    when the check localizes one."""
+
+    def __init__(self, kind: str, *, leaf: str = "", chunk: int = -1,
+                 shard: Optional[int] = None, detail: str = ""):
+        self.kind = kind
+        self.leaf = leaf
+        self.chunk = int(chunk)
+        self.shard = shard
+        self.detail = detail
+        where = f"chunk {chunk}" + (f", shard {shard}"
+                                    if shard is not None else "")
+        what = f" leaf {leaf!r}" if leaf else ""
+        tail = f": {detail}" if detail else ""
+        super().__init__(f"integrity violation [{kind}] at {where}{what}"
+                         f"{tail}")
+
+
+# --------------------------------------------------------------- checks
+
+
+def _named_leaves(state):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def audit_state(state, template, *, chunk: int = -1) -> None:
+    """Template audit of a harvested carry: every leaf must match the
+    template's shape and dtype, and float leaves must be finite (the
+    corrupt fault's bitcast bit-flips mint NaN/Inf patterns). Raises
+    :class:`IntegrityViolation` on the first failing leaf."""
+    import numpy as np
+
+    got = _named_leaves(state)
+    want = _named_leaves(template)
+    if len(got) != len(want):
+        raise IntegrityViolation(
+            "template", chunk=chunk,
+            detail=f"state has {len(got)} leaves, template {len(want)}")
+    for (name, leaf), (_, tpl) in zip(got, want):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        if shape != tuple(tpl.shape) or str(dtype) != str(tpl.dtype):
+            raise IntegrityViolation(
+                "template", leaf=name, chunk=chunk,
+                detail=f"got {shape}/{dtype}, template "
+                       f"{tuple(tpl.shape)}/{tpl.dtype}")
+        arr = np.asarray(leaf)  # graftlint: ignore[host-sync-in-loop] -- ONE audited host pull of the harvested carry per CHUNK is this check's documented cost; never per round
+        if np.issubdtype(arr.dtype, np.floating) \
+                and not np.isfinite(arr).all():
+            raise IntegrityViolation(
+                "nonfinite", leaf=name, chunk=chunk,
+                detail="non-finite values in a float leaf")
+
+
+def check_monotonic(prev, curr, *, chunk: int = -1) -> None:
+    """Monotonicity invariants between one chunk's input and output for
+    batch-plane states (duck-typed on the MessageBatch fields; other
+    state shapes pass through — their progress algebra is not latched):
+    seen bits only GAIN, per-lane seen counts and round counts never
+    regress, done never unlatches. Catches zeroing/rollback damage that
+    a per-leaf audit cannot (each side is individually well-formed).
+
+    Assumes a FIXED live population between the chunk's input and
+    output: node failures applied to the graph between healed chunks
+    make the entry-time refresh legitimately LOWER ``seen_count`` under
+    the new mask (the seen BITS still only gain). Apply churn at healer
+    boundaries with ``monotonic=False`` for that chunk, or re-baseline
+    — the in-tree adopters (graftserve, SupervisedRun) hold their graph
+    fixed, so they never hit this."""
+    import numpy as np
+
+    if not (hasattr(curr, "seen") and hasattr(curr, "seen_count")
+            and hasattr(curr, "done") and hasattr(curr, "rounds")):
+        return
+    prev_seen = np.asarray(prev.seen)
+    curr_seen = np.asarray(curr.seen)
+    lost = prev_seen & ~curr_seen
+    if lost.any():
+        raise IntegrityViolation(
+            "monotonicity", leaf="seen", chunk=chunk,
+            detail=f"{int(np.count_nonzero(lost))} seen words lost bits")
+    if (np.asarray(curr.seen_count) < np.asarray(prev.seen_count)).any():
+        raise IntegrityViolation(
+            "monotonicity", leaf="seen_count", chunk=chunk,
+            detail="per-lane coverage numerator regressed")
+    if (np.asarray(curr.rounds) < np.asarray(prev.rounds)).any():
+        raise IntegrityViolation(
+            "monotonicity", leaf="rounds", chunk=chunk,
+            detail="per-lane round counter regressed")
+    if (np.asarray(prev.done) & ~np.asarray(curr.done)).any():
+        raise IntegrityViolation(
+            "monotonicity", leaf="done", chunk=chunk,
+            detail="a completed lane's done flag unlatched")
+
+
+def state_checksum(state) -> str:
+    """sha256 over every leaf's bytes (shape/dtype framed) — the
+    bit-identity witness the checksum cross-validation compares between
+    a chunk result and its replicated reference fold."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for name, leaf in _named_leaves(state):
+        arr = np.asarray(leaf)  # graftlint: ignore[host-sync-in-loop] -- the checksum IS a per-chunk host fold of every leaf; bounded by the state size, once per chunk
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------- policy
+
+
+def _seeded_unit(seed: int, salt: int, attempt: int) -> float:
+    """Deterministic uniform in [0, 1) from (seed, salt, attempt) — a
+    sha256 fold, identical on every platform (no RNG state, no wall
+    clock)."""
+    digest = hashlib.sha256(
+        f"{seed}:{salt}:{attempt}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+
+def classify_failure(exc: BaseException) -> Optional[str]:
+    """The failure class a retry policy routes on, or None for
+    exceptions healing must not swallow (caller errors, supervise
+    Preempted kills, anything unknown)."""
+    if isinstance(exc, IntegrityViolation):
+        return "integrity"
+    if isinstance(exc, ChipLost):
+        return "preempt"
+    if isinstance(exc, (WedgedDispatch, StallTimeout)):
+        return "wedged"
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with seeded jitter plus per-class routing.
+
+    ``backoff_s(attempt)`` is ``backoff_base_s * 2**(attempt-1)`` capped
+    at ``backoff_max_s``, jittered by ``±jitter/2`` of itself with a
+    deterministic sha256-seeded uniform — same (seed, salt, attempt) ⇒
+    same delay, on any platform (bench.py's probe loop shares this, so
+    probe logs are replayable). ``routes`` maps a failure class
+    (:func:`classify_failure`) to an action in :data:`ACTIONS`;
+    unlisted classes raise."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+    routes: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_ROUTES))
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff seconds must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        for cls, action in self.routes.items():
+            if action not in ACTIONS:
+                raise ValueError(
+                    f"route for {cls!r} must be one of {ACTIONS}, "
+                    f"got {action!r}")
+
+    def backoff_s(self, attempt: int, salt: int = 0) -> float:
+        """Delay before retrying after the ``attempt``-th failure
+        (1-based)."""
+        if attempt < 1:
+            raise ValueError("attempt is 1-based")
+        base = min(self.backoff_base_s * (2.0 ** (attempt - 1)),
+                   self.backoff_max_s)
+        u = _seeded_unit(self.seed, salt, attempt)
+        return max(0.0, base * (1.0 + self.jitter * (u - 0.5)))
+
+    def delays(self, n: int, salt: int = 0):
+        """The first ``n`` backoff delays — what a probe log records."""
+        return [self.backoff_s(a, salt) for a in range(1, n + 1)]
+
+    def action_for(self, failure_class: Optional[str]) -> str:
+        return self.routes.get(failure_class, "raise") \
+            if failure_class is not None else "raise"
+
+
+# --------------------------------------------------------------- healer
+
+
+class Healer:
+    """The recovery engine: wrap a chunk dispatch with integrity checks,
+    rollback and policy-routed retry.
+
+    ``dispatch`` callables are ``state -> (state, out)`` and MUST NOT
+    donate their input (the retained input is the rollback fallback and
+    the monotonicity baseline — run the engine loops with
+    ``donate=False`` under healing; one extra live state copy is the
+    cost of rollback eligibility). Rollback prefers the configured
+    :class:`CheckpointStore`'s newest loadable entry (``store`` +
+    ``template``) — the durable authority — and falls back to the
+    retained input.
+
+    Checks per attempt: template audit (when ``template`` is set),
+    monotonicity (``monotonic=True``, batch-plane states), and the
+    checksum cross-validation when a ``verify`` dispatch is given —
+    the replicated reference fold re-executes the chunk on the trusted
+    path and the results must be bit-identical (the comm backends and
+    the engine/sharded pair are pinned exact peers, so there are no
+    false positives — and no tolerance for silent wrong answers).
+    """
+
+    def __init__(self, policy: Optional[RetryPolicy] = None, *,
+                 template: Any = None, monotonic: bool = True,
+                 fallback_dispatch: Optional[Callable] = None,
+                 verify_dispatch: Optional[Callable] = None,
+                 store=None,
+                 registry: Optional[telemetry.Registry] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.template = template
+        self.monotonic = bool(monotonic)
+        self.fallback_dispatch = fallback_dispatch
+        self.verify_dispatch = verify_dispatch
+        self.store = store
+        self._sleep = sleep if sleep is not None else concurrency.sleep
+        reg = registry if registry is not None \
+            else telemetry.default_registry()
+        self._m_retries = reg.counter(
+            "heal_retries_total",
+            "Healing decisions by outcome: retry/fallback route taken, "
+            "healed chunk recovered, exhausted attempt budget.",
+            ("outcome",))
+
+    # ------------------------------------------------------------ checks
+
+    def check(self, prev, state, *, chunk: int = -1) -> None:
+        """The cheap always-on integrity checks (template + finiteness +
+        monotonicity): ONE host pull of the harvested carry per CHUNK
+        (shared by both checks — never per round) plus the input's four
+        latched-progress leaves when monotonicity applies. States the
+        monotonicity duck-typing rejects (engine protocol tuples) cost
+        nothing here unless a template audit is configured."""
+        monotonic_applies = (
+            self.monotonic and prev is not None
+            and all(hasattr(state, f)
+                    for f in ("seen", "seen_count", "done", "rounds")))
+        if self.template is None and not monotonic_applies:
+            return
+        import jax
+
+        state_h = jax.device_get(state)
+        if self.template is not None:
+            audit_state(state_h, self.template, chunk=chunk)
+        if monotonic_applies:
+            check_monotonic(prev, state_h, chunk=chunk)
+
+    # ------------------------------------------------------------- drive
+
+    def _rollback_input(self, retained, chunk: int):
+        if self.store is not None and self.template is not None:
+            restored = self.store.load_latest(self.template)
+            if restored is not None:
+                if spans.current_tracer() is not None:
+                    spans.emit("heal_rollback", chunk=chunk,
+                               round=int(restored[2]),
+                               path=restored[4])
+                import jax
+
+                return jax.device_put(restored[0])
+        return retained
+
+    def run_chunk(self, dispatch: Callable, state, *, chunk_index: int = -1,
+                  salt: Optional[int] = None,
+                  fallback: Optional[Callable] = None,
+                  verify: Optional[Callable] = None):
+        """Execute one chunk with healing; returns ``(state, out)``.
+
+        ``fallback`` / ``verify`` override the healer-level dispatches
+        for this chunk (chunked drivers rebuild them per chunk key).
+        Unroutable failures propagate untouched; a routable failure
+        rolls back, backs off (seeded, deterministic) and re-executes —
+        on the fallback path when the policy says so — until the
+        attempt budget exhausts."""
+        fallback = fallback if fallback is not None \
+            else self.fallback_dispatch
+        verify = verify if verify is not None else self.verify_dispatch
+        salt = chunk_index if salt is None else salt
+        current = dispatch
+        on_fallback = False
+        failed = False
+        attempt = 0
+        while True:
+            attempt += 1
+            inp = state if attempt == 1 \
+                else self._rollback_input(state, chunk_index)
+            try:
+                new_state, out = current(inp)
+                self.check(inp, new_state, chunk=chunk_index)
+                if verify is not None and not on_fallback:
+                    ref_state, _ = verify(inp)
+                    if state_checksum(new_state) != state_checksum(ref_state):
+                        raise IntegrityViolation(
+                            "checksum", chunk=chunk_index,
+                            detail="chunk result diverges from the "
+                                   "replicated reference fold")
+                if failed:
+                    self._m_retries.labels("healed").inc()
+                    if spans.current_tracer() is not None:
+                        spans.emit("heal_recovered", chunk=chunk_index,
+                                   attempts=attempt,
+                                   fallback=on_fallback)
+                return new_state, out
+            except (IntegrityViolation, ChipLost, WedgedDispatch,
+                    StallTimeout) as e:
+                failed = True
+                cls = classify_failure(e)
+                action = self.policy.action_for(cls)
+                if action == "raise" or attempt >= self.policy.max_attempts:
+                    # "exhausted" counts BUDGET overruns only — a
+                    # raise-routed class propagating on attempt 1 is a
+                    # routing decision, not an exhausted budget.
+                    if attempt >= self.policy.max_attempts:
+                        self._m_retries.labels("exhausted").inc()
+                    raise
+                # The outcome label records the decision taken on THIS
+                # failure — a retry-routed failure after the fallback
+                # path engaged still counts as "retry". A fallback route
+                # with no fallback dispatch configured degrades to an
+                # in-place retry; that degrade is made visible (trace
+                # event field) because re-running DETERMINISTIC comm
+                # corruption in place reproduces it — though for the
+                # single-chip drivers, where integrity damage means a
+                # transient, the in-place retry is the right response.
+                degraded = action == "fallback" and fallback is None
+                if action == "fallback" and not degraded:
+                    current = fallback
+                    on_fallback = True
+                    outcome = "fallback"
+                else:
+                    outcome = "retry"
+                self._m_retries.labels(outcome).inc()
+                if spans.current_tracer() is not None:
+                    spans.emit("heal_retry", chunk=chunk_index,
+                               attempt=attempt, failure=cls,
+                               action=outcome, degraded=degraded)
+                delay = self.policy.backoff_s(attempt, salt=salt)
+                if delay > 0:
+                    self._sleep(delay)

@@ -91,6 +91,20 @@ class SupervisedRun:
         Watchdog deadline per chunk dispatch and its stall mode
         (``"raise"`` / ``"warn"`` / callable, like ``retrace_guard``).
         ``None`` disables the watchdog.
+    heal:
+        A :class:`~p2pnetwork_tpu.supervise.heal.RetryPolicy`
+        (graftquake self-healing): every chunk dispatch runs undonated
+        under a :class:`~p2pnetwork_tpu.supervise.heal.Healer`, so a
+        detected DISPATCH fault (injected chip preemption, wedged
+        dispatch, watchdog stall surfaced inside the dispatch) rolls
+        the chunk back to its retained input and re-executes with the
+        SAME chunk key — the healed run is bit-identical to an
+        unfaulted one. Integrity DETECTION (template audit, checksum
+        cross-validation) needs a template/verify dispatch the generic
+        runner cannot derive — drive
+        :meth:`~p2pnetwork_tpu.supervise.heal.Healer.run_chunk`
+        directly to add those. Costs one extra live state copy;
+        ``None`` (default) keeps mid-cadence chunk donation.
     on_chunk:
         Optional ``callable(run, info)`` fired after every chunk with
         ``{"round", "executed", "coverage", "checkpointed"}`` — the
@@ -105,6 +119,7 @@ class SupervisedRun:
                  retain: int = 3,
                  deadline_s: Optional[float] = None,
                  on_stall: Union[str, Callable] = "raise",
+                 heal=None,
                  on_chunk: Optional[Callable] = None,
                  registry: Optional[telemetry.Registry] = None):
         if chunk_rounds < 1:
@@ -122,6 +137,7 @@ class SupervisedRun:
         self.checkpoint_every_s = checkpoint_every_s
         self.deadline_s = deadline_s
         self.on_stall = on_stall
+        self.heal = heal
         self.on_chunk = on_chunk
         self._registry = registry
         reg = registry if registry is not None else telemetry.default_registry()
@@ -264,6 +280,16 @@ class SupervisedRun:
             watchdog = Watchdog(self.deadline_s, name=f"supervised-{mode}",
                                 on_stall=self.on_stall,
                                 registry=self._registry).start()
+        healer = None
+        if self.heal is not None:
+            from p2pnetwork_tpu.supervise.heal import Healer
+
+            # Rollback authority is the RETAINED chunk input (healing
+            # forces donate=False below), never the store: the store's
+            # newest entry can be an older boundary, and re-executing
+            # one chunk from an older round would corrupt the round
+            # accounting this loop owns.
+            healer = Healer(self.heal, registry=self._registry)
         try:
             while total < total_target:
                 chunk = min(self.chunk_rounds, total_target - total)
@@ -279,20 +305,35 @@ class SupervisedRun:
                     # the duration of the dispatch (module docstring).
                     self._set_fallback((state, base_key, total, messages))
                 try:
+                    donate_chunk = healer is None and not ckpt_feeding
                     if mode == "coverage":
-                        state, out = engine.run_until_coverage_from(
-                            self.graph, self.protocol, state, chunk_key,
-                            coverage_target=coverage_target,
-                            max_rounds=chunk,
-                            steps_per_round=steps_per_round,
-                            donate=not ckpt_feeding)
+                        def _chunk_cov(s, _key=chunk_key, _n=chunk):
+                            return engine.run_until_coverage_from(
+                                self.graph, self.protocol, s, _key,
+                                coverage_target=coverage_target,
+                                max_rounds=_n,
+                                steps_per_round=steps_per_round,
+                                donate=donate_chunk)
+
+                        if healer is not None:
+                            state, out = healer.run_chunk(
+                                _chunk_cov, state, chunk_index=chunks)
+                        else:
+                            state, out = _chunk_cov(state)
                         executed = int(out["rounds"])  # graftlint: ignore[host-sync-in-loop] -- packed summary already transferred by the engine; these are host scalars
                         messages += int(out["messages"])  # graftlint: ignore[host-sync-in-loop] -- host scalar (see above)
                         coverage = float(out["coverage"])  # graftlint: ignore[host-sync-in-loop] -- host scalar (see above)
                     else:
-                        state, stats = engine.run_from(
-                            self.graph, self.protocol, state, chunk_key,
-                            chunk, donate=not ckpt_feeding)
+                        def _chunk_rounds(s, _key=chunk_key, _n=chunk):
+                            return engine.run_from(
+                                self.graph, self.protocol, s, _key,
+                                _n, donate=donate_chunk)
+
+                        if healer is not None:
+                            state, stats = healer.run_chunk(
+                                _chunk_rounds, state, chunk_index=chunks)
+                        else:
+                            state, stats = _chunk_rounds(state)
                         executed = chunk
                         if "messages" in stats:
                             messages += int(  # graftlint: ignore[host-sync-in-loop] -- one transfer per CHUNK is the supervised design (checkpoint totals need it), not a per-round sync

@@ -29,10 +29,12 @@ import hashlib
 import json
 import os
 import tempfile
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.sim import checkpoint as ckpt
+from p2pnetwork_tpu.telemetry import spans
 
 __all__ = ["CheckpointStore", "atomic_write_json"]
 
@@ -96,7 +98,9 @@ class CheckpointStore:
             "supervise_checkpoints_skipped_total",
             "Checkpoint entries skipped during resume, by cause (corrupt "
             "in-file digest, manifest/file hash mismatch, missing file, "
-            "template mismatch).", ("reason",))
+            "template mismatch; manifest-missing counts a resume that "
+            "fell back to a directory scan because the manifest itself "
+            "was gone or unreadable).", ("reason",))
 
     # -------------------------------------------------------------- writing
 
@@ -223,10 +227,25 @@ class CheckpointStore:
         match the manifest's file hash when one is recorded, and (c) pass
         ``checkpoint.load``'s in-file digest and structure checks. Any
         failure skips to the next-older entry (counted into
-        ``supervise_checkpoints_skipped_total{reason}``). Returns
+        ``supervise_checkpoints_skipped_total{reason}``). A resume whose
+        manifest is gone/unreadable but whose directory still holds
+        entries falls back to the scan, counted once as
+        ``{reason="manifest-missing"}``, and the entry it recovers is
+        logged (warning + ``store_scan_recovery`` trace event) — damage
+        survived should be visible, not silent. Returns
         ``(state, key, round_index, message_count, path)``, or ``None``
         when no entry is loadable (fresh start)."""
-        for entry in reversed(self.entries()):
+        ents = self._read_manifest()
+        scan_fallback = False
+        if not ents:
+            ents = self._scan_entries()
+            if ents:
+                # A trail with no manifest is damage (the manifest is
+                # rename-published after every entry), not a fresh dir —
+                # count the fallback; an empty directory stays silent.
+                scan_fallback = True
+                self._m_skipped.labels("manifest-missing").inc()
+        for entry in reversed(ents):
             path = os.path.join(self.directory, entry["file"])
             if not os.path.exists(path):
                 self._m_skipped.labels("missing").inc()
@@ -246,5 +265,14 @@ class CheckpointStore:
                 # damage semantics say keep walking, counted distinctly.
                 self._m_skipped.labels("template_mismatch").inc()
                 continue
+            if scan_fallback:
+                warnings.warn(
+                    f"checkpoint manifest missing/unreadable in "
+                    f"{self.directory}; recovered entry "
+                    f"{entry['file']!r} (round {rnd}) via directory "
+                    f"scan", RuntimeWarning, stacklevel=2)
+                if spans.current_tracer() is not None:
+                    spans.emit("store_scan_recovery", round=int(rnd),
+                               path=path)
             return state, key, rnd, msgs, path
         return None

@@ -89,6 +89,14 @@ def pytest_configure(config):
         "seeded-traffic determinism, preempt/resume bit-identity, the "
         "HTTP endpoints, and the slow-marked 1k-concurrent-lane soak "
         "(select with -m serve; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "quake: graftquake device-plane chaos tests — seeded halo-hop "
+        "fault injection (byte-replayable, cross-backend bit-identical), "
+        "dispatch chip-loss/wedge faults, integrity checks, RetryPolicy/"
+        "Healer recovery bit-identity across engine/sharded/graftserve, "
+        "and the slow-marked 100k chaos soak (select with -m quake; "
+        "part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")
