@@ -9,7 +9,8 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
-	batch-check ring-check scope-check serve-check query-check quake-check
+	batch-check ring-check scope-check serve-check query-check quake-check \
+	sight-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -124,6 +125,15 @@ serve-check:
 # overhead ratchet run with -m 'quake and slow').
 quake-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_graftquake.py -q
+
+# graftsight observability plane: ticket-scoped correlated tracing
+# (one Perfetto tree per ticket lifecycle, chaos included), the
+# tick-phase profiler + /dashboard endpoint, the SLO burn-rate engine
+# and its AIMD admission consumption, and the tracer-on bit-identity
+# pins (tox env "sight"; the slow-marked 1.10x serve-tick overhead
+# ratchet runs with -m 'sight and slow').
+sight-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_graftsight.py -q
 
 # Batched query lanes: byte-budget gate, lane-kernel parity, the three
 # family identity sweeps (min-plus vs Bellman-Ford reference, DHT vs the

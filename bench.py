@@ -520,6 +520,10 @@ def bench_serving():
             stats.get("completion_rounds_p99"),
         "graph_build_s": round(build_s, 2),
         "graph_cached": cached,
+        # graftsight tick-phase profile: where the driven ticks spent
+        # their wall (retire/admit/dispatch/harvest/checkpoint) — the
+        # same document /dashboard publishes live.
+        "tick_phases": svc.tick_phases(),
     }
     print(f"# serving cap={svc.capacity}: {col['sustained_lanes_per_s']} "
           f"lanes/s sustained, peak {col['peak_concurrent_lanes']} "
@@ -1349,8 +1353,10 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
     recovering tunnel, their seeds (BENCH_PROBE_BACKOFF_SEED, default
     0) de-synchronize the retry storm, and the same seed replays the
     same delays. Every attempt's chosen backoff lands in the probe log
-    (``backoff_s``), so an outage round's timing is reconstructible
-    from artifacts alone."""
+    (``backoff_s``), and the session closes with one ``policy_summary``
+    entry — policy parameters, the full deterministic backoff schedule,
+    and the outcome (clean / healed / gave_up) — so an outage round's
+    timing is reconstructible from artifacts alone."""
     from p2pnetwork_tpu.supervise.heal import RetryPolicy  # jax-free
 
     if window_s is None:
@@ -1368,6 +1374,24 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
         backoff_base_s=float(os.environ.get("BENCH_PROBE_BACKOFF_S", "60")),
         backoff_max_s=120.0, jitter=0.5,
         seed=int(os.environ.get("BENCH_PROBE_BACKOFF_SEED", "0")))
+    def _summarize(outcome: str, attempts: int) -> None:
+        # graftsight satellite: one policy-summary entry per probe
+        # session — the policy's parameters, its full (deterministic)
+        # backoff schedule, and how the session ended
+        # (clean / healed / gave_up), so an outage round's retry timing
+        # is reconstructible from the artifact without re-deriving the
+        # seeded jitter.
+        _PROBE_LOG.append({
+            "policy_summary": True, "ts": time.time(),
+            "outcome": outcome, "attempts": attempts,
+            "max_attempts": policy.max_attempts,
+            "backoff_base_s": policy.backoff_base_s,
+            "backoff_max_s": policy.backoff_max_s,
+            "jitter": policy.jitter, "seed": policy.seed,
+            "backoff_schedule_s": [
+                round(d, 3) for d in policy.delays(policy.max_attempts)],
+        })
+
     deadline = time.monotonic() + window_s
     attempt = 0
     while True:
@@ -1379,6 +1403,9 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
                                    "recovered": True})
                 print(f"# backend recovered on probe attempt {attempt}",
                       file=sys.stderr, flush=True)
+                _summarize("healed", attempt)
+            else:
+                _summarize("clean", attempt)
             return None
         remaining = deadline - time.monotonic()
         backoff_s = policy.backoff_s(attempt)
@@ -1392,11 +1419,13 @@ def _backend_alive(window_s=None, probe_timeout_s=None, max_attempts=None):
         if attempt >= max_attempts:
             _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
                                "gave_up": f"probe cap {max_attempts}"})
+            _summarize("gave_up", attempt)
             return (f"{err} [gave up after {attempt} probes "
                     f"(cap {max_attempts}); handing off to fallback]")
         if remaining <= 0:
             _PROBE_LOG.append({"attempt": attempt, "ts": time.time(),
                                "gave_up": f"window {window_s}s"})
+            _summarize("gave_up", attempt)
             return f"{err} [gave up after {attempt} probes over {window_s}s]"
         time.sleep(min(backoff_s, max(remaining, 1.0)))
 

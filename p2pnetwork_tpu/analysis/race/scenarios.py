@@ -459,6 +459,105 @@ def _serve_admit_storm():
 
 
 @scenario(
+    "sight_scrape_under_serve",
+    "The graftsight observability plane under exploration: scraper "
+    "threads read /dashboard's document (dashboard_doc, sockets-free), "
+    "the Prometheus text, trace exports and the tick-phase profile "
+    "while the driver-role thread runs admission ticks through an "
+    "armed dispatch fault and its heal retry — every cross-thread "
+    "read of the tracer store, SLO rings, phase ring and heal "
+    "counters racing the writer that is mid-tick.")
+def _sight_scrape_under_serve():
+    try:
+        import jax  # noqa: F401
+        from p2pnetwork_tpu.serve.service import (  # noqa: F401
+            Rejected, SimService)
+        from p2pnetwork_tpu.sim import graph as G
+        from p2pnetwork_tpu.supervise.heal import RetryPolicy
+    except Exception as e:  # pragma: no cover - jax-less image
+        raise ScenarioUnavailable(f"needs jax/serve: {e}") from e
+    g = G.watts_strogatz(24, 4, 0.1, seed=1, source_csr=True)
+    # Warm OUTSIDE the managed world, heal path included: a healing
+    # service dispatches through the retained-input path, so its engine
+    # program (and the registry's process-global sim_* locks) must be
+    # compile-hot before any schedule runs (see serve_admit_storm).
+    warm = SimService(g, capacity=8, queue_depth=4, chunk_rounds=4, seed=0,
+                      heal=RetryPolicy(backoff_base_s=0.0))
+    warm.submit(1)
+    warm.tick()
+    warm.close()
+
+    def body():
+        from p2pnetwork_tpu import telemetry
+        from p2pnetwork_tpu.chaos import device as chaos_device
+        from p2pnetwork_tpu.serve.service import Rejected, SimService
+        from p2pnetwork_tpu.supervise.heal import RetryPolicy
+        from p2pnetwork_tpu.telemetry import spans
+        from p2pnetwork_tpu.telemetry.export import to_prometheus
+        from p2pnetwork_tpu.telemetry.httpd import dashboard_doc
+        from p2pnetwork_tpu.telemetry.slo import (
+            SLOEngine, serve_objectives)
+        from p2pnetwork_tpu.utils.logging import EventLog
+
+        reg = _fresh_registry()
+        hist = telemetry.History(capacity=32)
+        slo = SLOEngine(serve_objectives(slo_rounds=64),
+                        registry=reg, log=EventLog())
+        tracer = telemetry.Tracer(max_spans=2048)
+        prev_tracer = spans.install_tracer(tracer)
+        # One preempt at the first dispatch of every schedule: the
+        # driver's heal retry runs WHILE the scrapers read, so the
+        # fault/heal counters and per-ticket replay race real readers.
+        prev_chaos = chaos_device.install_dispatch_chaos(
+            chaos_device.DispatchChaos(preempt_at=(0,), registry=reg))
+        try:
+            svc = watch(SimService(
+                g, capacity=8, queue_depth=4, chunk_rounds=4, seed=0,
+                heal=RetryPolicy(backoff_base_s=0.0), slo=slo,
+                registry=reg))
+
+            def driver_role():
+                for _ in range(3):
+                    svc.tick()
+
+            def submitter():
+                for s in (1, 2, 3):
+                    try:
+                        svc.submit(s)
+                    except Rejected:
+                        pass
+
+            def scraper_a():
+                # The /dashboard + /metrics scrape path, sockets-free.
+                dashboard_doc(reg, hist, tracer, slo, svc)
+                to_prometheus(reg)
+                slo.snapshot()
+
+            def scraper_b():
+                # The /trace + /history scrape path plus the profile.
+                tracer.to_chrome()
+                tracer.traces()
+                hist.snapshot(last=8)
+                svc.tick_phases()
+                svc.dashboard_slice()
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("driver", driver_role),
+                                ("submit", submitter),
+                                ("scrape-a", scraper_a),
+                                ("scrape-b", scraper_b))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+            svc.close()
+        finally:
+            chaos_device.install_dispatch_chaos(prev_chaos)
+            spans.install_tracer(prev_tracer)
+    return body
+
+
+@scenario(
     "partition_heal",
     "The PR 2 partition-heal soak's control plane under exploration: "
     "partition, concurrent traffic probing link_ok on both sides, heal, "

@@ -377,6 +377,14 @@ def record_faults(schedule: FaultSchedule, *, rounds: int, n_steps: int,
             total += counts[kind]
     if total and spans.current_tracer() is not None:
         spans.emit("device_faults", round0=round0, rounds=rounds, **counts)
+        # graftsight correlation: each fault SITE as its own point event
+        # (round/step/shard/kind), bounded so a dense schedule cannot
+        # flood the span store — the aggregate event above always
+        # carries the exact totals.
+        for rnd, step, shard, kind in schedule.sites_between(
+                round0, round0 + rounds, n_steps, n_shards)[:64]:
+            spans.emit("device_fault", round=rnd, step=step,
+                       shard=shard, kind=kind)
     return counts
 
 

@@ -78,7 +78,7 @@ from p2pnetwork_tpu.telemetry import spans
 
 __all__ = [
     "SimService", "Rejected", "QueueFull", "QuotaExceeded",
-    "ServiceClosed", "TERMINAL_STATES",
+    "ServiceClosed", "TERMINAL_STATES", "TICK_PHASES", "ticket_trace",
 ]
 
 _SIDECAR = "service_state.json"
@@ -90,6 +90,69 @@ TERMINAL_STATES = frozenset({"done", "cancelled", "timeout"})
 #: floods complete in O(diameter) rounds, queue wait adds chunk-sized
 #: steps, so geometric 1..4096 covers both.
 _LATENCY_ROUND_BUCKETS = telemetry.exponential_buckets(1.0, 2.0, 13)
+
+#: graftsight tick-phase profiler: the five driver phases every tick
+#: walks, in execution order (ISSUE/ROADMAP naming: retire,
+#: admit-marshal, device-dispatch, harvest, checkpoint).
+TICK_PHASES = ("retire", "admit", "dispatch", "harvest", "checkpoint")
+
+#: Tick-phase histogram buckets: CPU-tick phases run ~10µs..10s.
+_PHASE_SECOND_BUCKETS = telemetry.exponential_buckets(1e-5, 2.0, 20)
+
+
+def ticket_trace(ticket: str) -> str:
+    """The ticket's logical trace id (graftsight correlation): derived
+    from the ticket id alone — deterministic, stable across replays —
+    so ``/trace?trace_id=tkt-<ticket>`` exports one ticket's
+    submit→admit→chunk→fault→heal→complete lifecycle."""
+    return f"tkt-{ticket}"
+
+
+class _PhaseClock:
+    """Per-tick wall breakdown of the serve driver into the
+    :data:`TICK_PHASES`. Always measures (``time.perf_counter`` deltas
+    — a handful of clock reads per tick); additionally emits a
+    ``serve_tick`` span with nested per-phase child spans when a tracer
+    is installed. Wall times feed metrics/spans ONLY — never ticket
+    records — so the serving plane's determinism contract holds with
+    the profiler permanently on."""
+
+    __slots__ = ("phases", "_t0", "_name", "_tracer", "_tick_sid", "_sid")
+
+    def __init__(self, tracer):
+        self._tracer = tracer
+        self._tick_sid = tracer.begin("serve_tick") \
+            if tracer is not None else None
+        self._sid = None
+        self._name: Optional[str] = None
+        self.phases: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+
+    def _close_phase(self, now: float) -> None:
+        if self._name is not None:
+            self.phases[self._name] = (
+                self.phases.get(self._name, 0.0) + (now - self._t0))
+        if self._sid is not None:
+            self._tracer.end(self._sid)
+            self._sid = None
+
+    def enter(self, name: str) -> None:
+        now = time.perf_counter()
+        self._close_phase(now)
+        if self._tracer is not None:
+            self._sid = self._tracer.begin(f"tick_{name}",
+                                           parent=self._tick_sid)
+        self._name, self._t0 = name, now
+
+    def done(self, tick: int) -> Dict[str, float]:
+        self._close_phase(time.perf_counter())
+        self._name = None
+        if self._tick_sid is not None:
+            self._tracer.end(self._tick_sid)
+            self._tracer.point(
+                "tick_phases", parent=self._tick_sid, tick=tick,
+                **{ph: self.phases.get(ph, 0.0) for ph in TICK_PHASES})
+        return self.phases
 
 
 class Rejected(RuntimeError):
@@ -192,6 +255,16 @@ class SimService:
         lane is lost. Costs one extra live batch copy (the retained
         input) plus one host pull of the carry per tick for the checks;
         ``None`` (default) keeps the donating fast path.
+    slo:
+        A graftsight :class:`~p2pnetwork_tpu.telemetry.slo.SLOEngine`
+        (or ``None``). The driver feeds it per-ticket completion rounds
+        and wall latency, per-submission shed flags and per-dispatch
+        heal flags, and evaluates it once per tick; a firing objective
+        with ``admission_signal=True`` halves the admit budget that
+        tick (multiplicative decrease on sustained burn — the explicit
+        SLO signal next to the ``slo_rounds`` percentile rule). Only
+        deterministic observation streams may carry the signal, so
+        seeded replays stay byte-identical.
     deadline_s / on_stall:
         Optional supervise-plane watchdog over driver ticks (heartbeat
         per tick; see supervise/watchdog.py for the stall modes).
@@ -212,6 +285,7 @@ class SimService:
                  done_retention: int = 4096,
                  record_seen_hash: bool = False,
                  heal=None,
+                 slo=None,
                  deadline_s: Optional[float] = None,
                  on_stall: Union[str, Callable] = "raise",
                  idle_wait_s: float = 0.05,
@@ -267,6 +341,14 @@ class SimService:
         self.deadline_s = deadline_s
         self.on_stall = on_stall
         self._registry = registry
+        #: graftsight SLO engine (telemetry/slo.py) or None. The driver
+        #: feeds it per-ticket completion rounds/wall, per-submission
+        #: shed flags and per-dispatch heal flags, evaluates it once per
+        #: tick, and treats a firing admission-signal objective as an
+        #: explicit multiplicative-decrease signal alongside the AIMD
+        #: percentile rule. Evaluation is a pure function of
+        #: deterministic feeds, so seeded replays stay byte-identical.
+        self._slo = slo
         self._healer = None
         if heal is not None:
             from p2pnetwork_tpu.supervise.heal import Healer
@@ -352,6 +434,28 @@ class SimService:
         self._m_latency_s = reg.histogram(
             "serve_latency_seconds",
             "Submit-to-completion wall latency per completed ticket.")
+        self._m_phase = reg.histogram(
+            "serve_tick_phase_seconds",
+            "Per-tick wall time of each driver phase (graftsight "
+            "tick-phase profiler): retire/admit/dispatch/harvest/"
+            "checkpoint.", ("phase",), buckets=_PHASE_SECOND_BUCKETS)
+        self._m_phase_wall = reg.gauge(
+            "serve_tick_phase_wall_s",
+            "Last tick's wall time per driver phase — a gauge so the "
+            "history ring samples it next to the engine's per-run "
+            "occupancy/ici columns.", ("phase",))
+        self._m_healed_ticks = reg.counter(
+            "serve_healed_ticks_total",
+            "Driver ticks whose engine chunk needed the Healer "
+            "(faulted, then recovered within the retry budget).")
+        # Tick-phase profile state: written by the driver, snapshotted
+        # by /dashboard scrape threads — its own small lock, never
+        # nested with _cond.
+        self._phase_lock = concurrency.lock()
+        self._phase_ring: List[dict] = []  # bounded below
+        self._phase_totals: Dict[str, float] = {}
+        self._phase_max: Dict[str, float] = {}
+        self._phase_ticks = 0
 
         self._store: Optional[CheckpointStore] = None
         if store is not None:
@@ -536,6 +640,8 @@ class SimService:
                 self._counts["rejected"] += 1
                 self._dirty = True  # shed counts survive resume too
             self._m_rejected.labels(reject.reason).inc()
+            if self._slo is not None:
+                self._slo.record("shed", 1.0)
             raise reject
         # Bound metric cardinality: only configured tenants (and the
         # default) get their own label child — arbitrary client-supplied
@@ -545,9 +651,11 @@ class SimService:
             else "other"
         self._m_submitted.labels(label).inc()
         self._m_queue.set(float(depth))
+        if self._slo is not None:
+            self._slo.record("shed", 0.0)
         if spans.current_tracer() is not None:
-            spans.emit("ticket_submit", ticket=tid, source=source,
-                       tenant=tenant)
+            spans.emit("ticket_submit", trace=ticket_trace(tid),
+                       ticket=tid, source=source, tenant=tenant)
         return tid
 
     def poll(self, ticket: str) -> Optional[dict]:
@@ -733,7 +841,19 @@ class SimService:
         this in a loop. Returns ``{"admitted", "completed",
         "executed_rounds", "running", "active"}`` for harness
         bookkeeping (``running`` = lanes in flight during this tick's
-        engine chunk, ``active`` = still running after harvest)."""
+        engine chunk, ``active`` = still running after harvest).
+
+        Every tick is profiled into the :data:`TICK_PHASES` wall
+        breakdown (``serve_tick_phase_seconds{phase}``, the last-tick
+        gauges the history ring samples, and the ``/dashboard`` tick
+        slice); with a tracer installed the tick additionally emits a
+        ``serve_tick`` span with per-phase children plus per-ticket
+        correlated lifecycle events under ``tkt-<ticket>`` trace ids
+        (:func:`ticket_trace`). Wall times never enter ticket records
+        — the profiler does not move the determinism contract."""
+        tracer = spans.current_tracer()
+        pc = _PhaseClock(tracer)
+        pc.enter("retire")
         if self._watchdog is None and self.deadline_s is not None:
             self._watchdog = Watchdog(
                 self.deadline_s, name="serve-driver",
@@ -760,6 +880,7 @@ class SimService:
         # on the device until the NEXT tick's retire, so counting it
         # free would over-admit and trip admit()'s LaneExhausted. No
         # device sync needed either way.
+        pc.enter("admit")
         admits: List[Tuple[str, int, float]] = []
         with self._cond:
             free = max(0, self.capacity - len(self._lane_ticket)
@@ -780,8 +901,14 @@ class SimService:
             self._admit_on_device(admits)
 
         # One compiled chunk for every running lane (skipped when idle).
+        pc.enter("dispatch")
+        lane_tids: List[Tuple[int, str]] = []
         with self._cond:
             running = len(self._lane_ticket)
+            if tracer is not None and running:
+                # Snapshot BEFORE the chunk: these are the tickets the
+                # dispatch (and any fault it heals through) served.
+                lane_tids = sorted(self._lane_ticket.items())
         executed = 0
         out: dict = {}
         if running:
@@ -804,9 +931,33 @@ class SimService:
                     self.graph, self._protocol, self._batch, chunk_key,
                     max_rounds=self.chunk_rounds, donate=True)
             executed = int(out["rounds"])
+        heal_report = self._healer.last_report \
+            if (self._healer is not None and running) else None
+        faulted = bool(heal_report and heal_report["events"])
+        if faulted and heal_report["healed"]:
+            self._m_healed_ticks.inc()
+        if tracer is not None:
+            self._emit_ticket_chunk_events(lane_tids, tick0, executed,
+                                           heal_report)
+        pc.enter("harvest")
         completed = self._harvest(out, executed)
+        if self._slo is not None:
+            # One heal observation per DISPATCHING tick (idle ticks are
+            # no evidence either way), then the per-tick evaluation.
+            # Only deterministic, admission_signal objectives may steer
+            # the budget; a firing one is a multiplicative decrease,
+            # recovery rides the existing AIMD additive increase.
+            if running:
+                self._slo.record("heal", 1.0 if faulted else 0.0)
+            self._slo.evaluate(tick0)
+            if self._slo.firing(admission_only=True):
+                with self._cond:
+                    self._admit_budget = max(1, self._admit_budget // 2)
+                    budget_now = self._admit_budget
+                self._m_budget.set(float(budget_now))
         if self._watchdog is not None:
             self._watchdog.heartbeat()
+        pc.enter("checkpoint")
 
         # Checkpoint AFTER the preemption gate: an armed kill fires
         # before the checkpoint due at this boundary, like a real
@@ -837,9 +988,91 @@ class SimService:
         if (self._store is not None and dirty
                 and tick_now % self.checkpoint_every_ticks == 0):
             self._checkpoint()
+        self._record_phases(pc.done(tick0), tick0)
         return {"admitted": len(admits), "completed": completed,
                 "executed_rounds": executed, "running": running,
                 "active": active}
+
+    def _emit_ticket_chunk_events(self, lane_tids: List[Tuple[int, str]],
+                                  tick0: int, executed: int,
+                                  heal_report: Optional[dict]) -> None:
+        """Per-ticket correlated trace events for one dispatched chunk
+        (tracer-on only). Every riding ticket gets a ``ticket_chunk``
+        point under its ``tkt-<id>`` trace; when the Healer's attempt
+        report says the chunk faulted, each ticket also gets the
+        fault→integrity-fail→heal-retry(→heal-recovered) chain — the
+        chunk is shared, so a fault on it IS an event in every riding
+        ticket's lifecycle."""
+        events = heal_report["events"] if heal_report else []
+        for lane, tid in lane_tids:
+            tr = ticket_trace(tid)
+            spans.emit("ticket_chunk", trace=tr, ticket=tid, lane=lane,
+                       tick=tick0, rounds=executed, faulted=bool(events))
+            for ev in events:
+                spans.emit("ticket_fault", trace=tr, ticket=tid,
+                           kind=ev["failure"], chunk=heal_report["chunk"],
+                           attempt=ev["attempt"])
+                if "integrity_kind" in ev:
+                    spans.emit("ticket_integrity_fail", trace=tr,
+                               ticket=tid, kind=ev["integrity_kind"],
+                               leaf=ev.get("leaf", ""),
+                               chunk=heal_report["chunk"])
+                spans.emit("ticket_heal_retry", trace=tr, ticket=tid,
+                           attempt=ev["attempt"], action=ev["action"],
+                           degraded=ev["degraded"])
+            if events and heal_report["healed"]:
+                spans.emit("ticket_heal_recovered", trace=tr, ticket=tid,
+                           attempts=heal_report["attempts"],
+                           fallback=heal_report["fallback"])
+
+    def _record_phases(self, phases: Dict[str, float], tick: int) -> None:
+        """Fold one tick's phase walls into the profiler state: the
+        per-phase histogram + last-tick gauges (what the history ring
+        joins with the flight recorder's per-round columns) and the
+        bounded recent-ticks ring behind :meth:`tick_phases`."""
+        row = {"tick": tick}
+        for ph in TICK_PHASES:
+            s = phases.get(ph, 0.0)
+            row[ph] = s
+            self._m_phase.labels(ph).observe(s)
+            self._m_phase_wall.labels(ph).set(s)
+        with self._phase_lock:
+            self._phase_ticks += 1
+            self._phase_ring.append(row)
+            if len(self._phase_ring) > 128:
+                del self._phase_ring[:-128]
+            for ph in TICK_PHASES:
+                s = row[ph]
+                self._phase_totals[ph] = self._phase_totals.get(ph, 0.0) + s
+                if s > self._phase_max.get(ph, 0.0):
+                    self._phase_max[ph] = s
+
+    def tick_phases(self) -> dict:
+        """The tick-phase profile (graftsight): ``{"ticks", "per_phase":
+        {phase: {"total_s", "mean_s", "last_s", "max_s"}}, "recent":
+        [last 32 per-tick rows]}``. Thread-safe — what ``/dashboard``
+        and the bench ``serving.tick_phases`` slice read."""
+        with self._phase_lock:
+            ticks = self._phase_ticks
+            totals = dict(self._phase_totals)
+            maxes = dict(self._phase_max)
+            recent = list(self._phase_ring[-32:])
+        per_phase = {}
+        for ph in TICK_PHASES:
+            tot = totals.get(ph, 0.0)
+            per_phase[ph] = {
+                "total_s": tot,
+                "mean_s": tot / ticks if ticks else 0.0,
+                "last_s": recent[-1][ph] if recent else 0.0,
+                "max_s": maxes.get(ph, 0.0),
+            }
+        return {"ticks": ticks, "per_phase": per_phase, "recent": recent}
+
+    def dashboard_slice(self) -> dict:
+        """What ``/dashboard`` embeds for this service (duck-typed by
+        telemetry/httpd.py): the ``/stats`` document plus the
+        tick-phase profile."""
+        return {"stats": self.stats(), "tick_phases": self.tick_phases()}
 
     def _admit_on_device(self, admits: List[Tuple[str, int, float]]) -> None:
         """Seed the popped submissions into open lanes, grouped by
@@ -908,6 +1141,10 @@ class SimService:
                      for tid, _ in completions]
             if completions:
                 self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        if spans.current_tracer() is not None:
+            for lane, tid in assigned:
+                spans.emit("ticket_admit", trace=ticket_trace(tid),
+                           ticket=tid, lane=lane)
         self._report_completions(completions, walls)
 
     def _report_completions(self, completions: List[Tuple[str, dict]],
@@ -920,11 +1157,18 @@ class SimService:
         for (tid, rec), (_, t_sub) in zip(completions, walls):
             self._m_completed.inc()
             self._m_latency_rounds.observe(rec["latency_rounds"])
+            if self._slo is not None:
+                # latency_rounds is a plain int by the time a record is
+                # built; record() coerces to float itself.
+                self._slo.record("completion_rounds",
+                                 rec["latency_rounds"])
             if t_sub is not None:
                 self._m_latency_s.observe(now - t_sub)
+                if self._slo is not None:
+                    self._slo.record("completion_wall_s", now - t_sub)
             if tracer is not None:
-                spans.emit("ticket_done", ticket=tid,
-                           rounds=rec["rounds"],
+                spans.emit("ticket_done", trace=ticket_trace(tid),
+                           ticket=tid, rounds=rec["rounds"],
                            latency_rounds=rec["latency_rounds"])
 
     def _harvest(self, out: dict, executed: int) -> int:
@@ -1012,8 +1256,12 @@ class SimService:
             self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
         self._retire_ready.extend(recycled)
         self._report_completions(completions, walls)
-        for _ in timed_out:
+        tracer = spans.current_tracer()
+        for lane, tid in timed_out:
             self._m_timeout.inc()
+            if tracer is not None:
+                spans.emit("ticket_timeout", trace=ticket_trace(tid),
+                           ticket=tid, lane=lane)
         self._m_budget.set(float(budget_now))
         return len(completions)
 

@@ -765,6 +765,16 @@ def run_batch_until_coverage(graph: Graph, protocol, batch, key: jax.Array,
                 np.percentile(newly_rounds, 99))
         if tracer is not None:
             _emit_batch_exit_events(admitted0, done0, out)
+            # graftsight: one summary point per chunk inside the
+            # batch_run span — the engine-side join key for the serve
+            # driver's per-ticket ticket_chunk replay (serve/service.py
+            # correlates by tick; this carries the chunk's aggregates).
+            spans.emit("batch_summary",
+                       rounds=int(out["rounds"]),
+                       completed=int(out["completed"]),
+                       active_lanes=int(out["active_lanes"]),
+                       newly_completed=int(
+                           out["newly_completed_lanes"].size))
         _record_batch_summary(t2 - t0, t2 - t1, nbytes, out, newly_rounds,
                               type(protocol).__name__)
     return state, out

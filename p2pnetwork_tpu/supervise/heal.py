@@ -30,8 +30,14 @@ chip lost mid-traffic, a wedged dispatch. This module is that half:
 
 Retries count into ``heal_retries_total{outcome}`` (``retry`` /
 ``fallback`` routing decisions, ``healed`` chunks that recovered,
-``exhausted`` budget overruns); the trace plane gets ``heal_retry`` /
-``heal_rollback`` / ``heal_recovered`` ride-along events.
+``exhausted`` budget overruns); detected integrity failures count into
+``quake_integrity_failures_total{kind}`` and every rollback into
+``heal_rollbacks_total{source}``; the trace plane gets ``heal_retry``
+/ ``heal_rollback`` / ``heal_recovered`` ride-along events (integrity
+failures carry their check kind). :attr:`Healer.last_report` keeps the
+most recent chunk's attempt history as a plain dict so an adopter —
+graftserve's driver — can replay what happened to the lanes riding
+that chunk as per-ticket correlated trace events (graftsight).
 
 Top-level import is stdlib-only (jax/numpy defer into the check
 functions) so bench.py's parent process can share :class:`RetryPolicy`
@@ -309,6 +315,23 @@ class Healer:
             "Healing decisions by outcome: retry/fallback route taken, "
             "healed chunk recovered, exhausted attempt budget.",
             ("outcome",))
+        self._m_integrity = reg.counter(
+            "quake_integrity_failures_total",
+            "Integrity-check rejections by check kind "
+            "(template/nonfinite/monotonicity/checksum).",
+            ("kind",))
+        self._m_rollbacks = reg.counter(
+            "heal_rollbacks_total",
+            "Chunk rollbacks before a retry, by rollback source: the "
+            "checkpoint store's newest entry or the retained undonated "
+            "input.", ("source",))
+        #: Attempt history of the most recent :meth:`run_chunk` call —
+        #: ``{"chunk", "attempts", "healed", "fallback", "exhausted",
+        #: "events": [{"attempt", "failure", "action", "degraded",
+        #: "integrity_kind"?, "leaf"?}, ...]}``. Plain data, written by
+        #: the chunk-driving thread only (driver-confined like the
+        #: serve plane's batch state); ``None`` until the first chunk.
+        self.last_report: Optional[dict] = None
 
     # ------------------------------------------------------------ checks
 
@@ -339,6 +362,7 @@ class Healer:
         if self.store is not None and self.template is not None:
             restored = self.store.load_latest(self.template)
             if restored is not None:
+                self._m_rollbacks.labels("store").inc()
                 if spans.current_tracer() is not None:
                     spans.emit("heal_rollback", chunk=chunk,
                                round=int(restored[2]),
@@ -346,6 +370,10 @@ class Healer:
                 import jax
 
                 return jax.device_put(restored[0])
+        self._m_rollbacks.labels("retained").inc()
+        if spans.current_tracer() is not None:
+            spans.emit("heal_rollback", chunk=chunk, round=-1,
+                       path="")
         return retained
 
     def run_chunk(self, dispatch: Callable, state, *, chunk_index: int = -1,
@@ -368,8 +396,13 @@ class Healer:
         on_fallback = False
         failed = False
         attempt = 0
+        report = {"chunk": int(chunk_index), "attempts": 0,
+                  "healed": False, "fallback": False, "exhausted": False,
+                  "events": []}
+        self.last_report = report
         while True:
             attempt += 1
+            report["attempts"] = attempt
             inp = state if attempt == 1 \
                 else self._rollback_input(state, chunk_index)
             try:
@@ -383,6 +416,8 @@ class Healer:
                             detail="chunk result diverges from the "
                                    "replicated reference fold")
                 if failed:
+                    report["healed"] = True
+                    report["fallback"] = on_fallback
                     self._m_retries.labels("healed").inc()
                     if spans.current_tracer() is not None:
                         spans.emit("heal_recovered", chunk=chunk_index,
@@ -393,13 +428,22 @@ class Healer:
                     StallTimeout) as e:
                 failed = True
                 cls = classify_failure(e)
+                entry = {"attempt": attempt, "failure": cls,
+                         "action": "", "degraded": False}
+                if isinstance(e, IntegrityViolation):
+                    entry["integrity_kind"] = e.kind
+                    entry["leaf"] = e.leaf
+                    self._m_integrity.labels(e.kind).inc()
+                report["events"].append(entry)
                 action = self.policy.action_for(cls)
                 if action == "raise" or attempt >= self.policy.max_attempts:
                     # "exhausted" counts BUDGET overruns only — a
                     # raise-routed class propagating on attempt 1 is a
                     # routing decision, not an exhausted budget.
                     if attempt >= self.policy.max_attempts:
+                        report["exhausted"] = True
                         self._m_retries.labels("exhausted").inc()
+                    entry["action"] = "raise"
                     raise
                 # The outcome label records the decision taken on THIS
                 # failure — a retry-routed failure after the fallback
@@ -417,11 +461,15 @@ class Healer:
                     outcome = "fallback"
                 else:
                     outcome = "retry"
+                entry["action"] = outcome
+                entry["degraded"] = degraded
                 self._m_retries.labels(outcome).inc()
                 if spans.current_tracer() is not None:
                     spans.emit("heal_retry", chunk=chunk_index,
                                attempt=attempt, failure=cls,
-                               action=outcome, degraded=degraded)
+                               action=outcome, degraded=degraded,
+                               integrity_kind=entry.get("integrity_kind",
+                                                        ""))
                 delay = self.policy.backoff_s(attempt, salt=salt)
                 if delay > 0:
                     self._sleep(delay)

@@ -378,10 +378,23 @@ class SupervisedRun:
                     spans.emit("checkpoint", round=total, path=last_path)
                 spans.emit("chunk", round=total, executed=executed,
                            checkpointed=checkpointed)
+                # graftsight: a chunk that needed healing leaves its
+                # attempt history on the healer — surface it next to the
+                # chunk event (correlated by round) and hand it to the
+                # on_chunk observer, so a supervised soak's trace answers
+                # "which chunks healed, from what" without log archaeology.
+                heal_report = None if healer is None else healer.last_report
+                if heal_report is not None and heal_report["events"]:
+                    spans.emit("heal_report", round=total,
+                               chunk=heal_report["chunk"],
+                               attempts=heal_report["attempts"],
+                               healed=heal_report["healed"],
+                               fallback=heal_report["fallback"])
                 if self.on_chunk is not None:
                     self.on_chunk(self, {
                         "round": total, "executed": executed,
                         "coverage": coverage, "checkpointed": checkpointed,
+                        "heal": heal_report,
                     })
                 if done:
                     break

@@ -14,6 +14,9 @@
   Chrome/Perfetto and JSONL exporters.
 - :mod:`~p2pnetwork_tpu.telemetry.history` — the graftscope history ring:
   a bounded gauge time-series sampled once per engine run summary.
+- :mod:`~p2pnetwork_tpu.telemetry.slo` — the graftsight SLO engine:
+  declarative objectives over rolling windows, multi-window burn-rate
+  alerts as EventLog records + ``slo_burn_rate`` gauges.
 """
 
 from p2pnetwork_tpu.telemetry.registry import (
@@ -28,6 +31,9 @@ from p2pnetwork_tpu.telemetry.history import (
     History, default_history, set_default_history,
 )
 from p2pnetwork_tpu.telemetry.httpd import MetricsServer
+from p2pnetwork_tpu.telemetry.slo import (
+    Objective, SLOEngine, serve_objectives,
+)
 from p2pnetwork_tpu.telemetry.spans import (
     Tracer, current_tracer, install_tracer, uninstall_tracer,
 )
@@ -39,5 +45,6 @@ __all__ = [
     "event_record", "metric_records", "to_prometheus", "write_jsonl",
     "History", "default_history", "set_default_history",
     "MetricsServer",
+    "Objective", "SLOEngine", "serve_objectives",
     "Tracer", "current_tracer", "install_tracer", "uninstall_tracer",
 ]

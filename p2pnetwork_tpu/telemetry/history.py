@@ -93,12 +93,18 @@ class History:
                 out.append((row["ts"], v))
         return out
 
-    def snapshot(self) -> dict:
+    def snapshot(self, last: Optional[int] = None) -> dict:
         """JSON-able transposed view — what ``/history`` serves:
         ``{"capacity", "samples", "series": {name: [{"labels": [...],
         "points": [[ts, value], ...]}]}}`` with points in sample
-        order."""
+        order. ``last`` keeps only the most recent N samples (the
+        ``/history?n=`` query — a long serving run's scrape need not
+        ship the whole ring)."""
         rows = self.rows()
+        if last is not None:
+            if last < 1:
+                raise ValueError(f"last must be >= 1, got {last}")
+            rows = rows[-last:]
         series: dict = {}
         for row in rows:
             for (name, labelvals), value in row["values"].items():

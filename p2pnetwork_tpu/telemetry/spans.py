@@ -124,10 +124,14 @@ class Tracer:
         return st[-1] if st else getattr(self, "root", None)
 
     def begin(self, name: str, parent: Optional[int] = None,
-              **args) -> int:
+              trace: Optional[str] = None, **args) -> int:
         """Open a span; returns its id. ``parent=None`` nests under the
         calling thread's current :meth:`span` context (the root span
-        when there is none); ``parent=-1`` makes a root (no parent)."""
+        when there is none); ``parent=-1`` makes a root (no parent).
+        ``trace`` overrides the span's trace id — graftsight's ticket-
+        scoped correlation: lifecycle events for one serve ticket carry
+        ``tkt-<id>`` so :meth:`to_chrome` can export that ticket's tree
+        alone, while the span still nests in this tracer's store."""
         if parent is None:
             parent = self._current()
         elif parent == -1:
@@ -137,7 +141,8 @@ class Tracer:
         with self._lock:
             sid = self._next_id
             self._next_id += 1
-            sp = Span(sid, self.trace_id, parent, name, t0, tid, args)
+            sp = Span(sid, trace if trace is not None else self.trace_id,
+                      parent, name, t0, tid, args)
             if self._root_span is None:
                 self._root_span = sp  # pinned outside the bounded deque
             else:
@@ -156,17 +161,18 @@ class Tracer:
             if sp is not None and sp.t1 is None:
                 sp.t1 = t1
 
-    def point(self, name: str, parent: Optional[int] = None, **args) -> int:
+    def point(self, name: str, parent: Optional[int] = None,
+              trace: Optional[str] = None, **args) -> int:
         """A zero-duration span (an instantaneous lifecycle event)."""
-        sid = self.begin(name, parent=parent, **args)
+        sid = self.begin(name, parent=parent, trace=trace, **args)
         self.end(sid)
         return sid
 
     @contextlib.contextmanager
-    def span(self, name: str, **args):
+    def span(self, name: str, trace: Optional[str] = None, **args):
         """Open a span for the dynamic extent of the block; spans and
         events recorded inside (on this thread) nest under it."""
-        sid = self.begin(name, **args)
+        sid = self.begin(name, trace=trace, **args)
         st = self._stack()
         st.append(sid)
         try:
@@ -198,15 +204,37 @@ class Tracer:
     def find(self, name: str) -> List[Span]:
         return [sp for sp in self.spans() if sp.name == name]
 
+    def traces(self) -> dict:
+        """Retained span counts per trace id, insertion-ordered — the
+        tracer's own trace id first, then every ticket-scoped override
+        (:meth:`begin`'s ``trace=``) in first-seen order. What the
+        ``/dashboard`` recent-traces table lists."""
+        counts: dict = {}
+        for sp in self.spans():
+            counts[sp.trace_id] = counts.get(sp.trace_id, 0) + 1
+        return counts
+
     # ------------------------------------------------------------ exporters
 
-    def to_chrome(self) -> dict:
+    def to_chrome(self, trace_id: Optional[str] = None) -> dict:
         """The Chrome/Perfetto trace-event document: one ``ph: "X"``
         complete event per span (µs timestamps), span/parent/trace ids
-        in ``args`` so the tree survives the format."""
+        in ``args`` so the tree survives the format. ``trace_id``
+        filters to one logical trace (a single ticket's lifecycle when
+        the serve plane stamped ``tkt-<id>`` trace overrides).
+
+        The top-level ``metadata`` reports what the document does NOT
+        contain: ``dropped_spans`` counts spans evicted by the
+        ``max_spans`` bound — a serving soak that overflowed the store
+        exports a document that says so instead of silently reading as
+        complete."""
         now = self._clock()
         events = []
+        traces = set()
         for sp in self.spans():
+            traces.add(sp.trace_id)
+            if trace_id is not None and sp.trace_id != trace_id:
+                continue
             t1 = now if sp.t1 is None else sp.t1
             events.append({
                 "name": sp.name,
@@ -219,7 +247,17 @@ class Tracer:
                 "args": {"span_id": sp.span_id, "parent_id": sp.parent_id,
                          "trace_id": sp.trace_id, **sp.args},
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "dropped_spans": self.dropped_spans,
+                "spans": len(events),
+                "traces": 1 if trace_id is not None else len(traces),
+                "trace_id": trace_id if trace_id is not None
+                            else self.trace_id,
+            },
+        }
 
     def to_records(self) -> List[dict]:
         """Every span as one record in the shared telemetry JSONL schema
@@ -276,22 +314,23 @@ def current_tracer() -> Optional[Tracer]:
         return _installed
 
 
-def emit(name: str, **args) -> None:
+def emit(name: str, trace: Optional[str] = None, **args) -> None:
     """Record a point event on the installed tracer; no-op (one
     None-check) when tracing is off — the instrumentation seams call
-    this unconditionally."""
+    this unconditionally. ``trace`` stamps a logical trace id on the
+    event (graftsight's per-ticket correlation)."""
     t = current_tracer()
     if t is not None:
-        t.point(name, **args)
+        t.point(name, trace=trace, **args)
 
 
 @contextlib.contextmanager
-def span(name: str, **args):
+def span(name: str, trace: Optional[str] = None, **args):
     """A span on the installed tracer for the dynamic extent of the
     block; a plain no-op context when tracing is off."""
     t = current_tracer()
     if t is None:
         yield None
         return
-    with t.span(name, **args) as sid:
+    with t.span(name, trace=trace, **args) as sid:
         yield sid

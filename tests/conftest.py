@@ -97,6 +97,14 @@ def pytest_configure(config):
         "Healer recovery bit-identity across engine/sharded/graftserve, "
         "and the slow-marked 100k chaos soak (select with -m quake; "
         "part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "sight: graftsight observability tests — ticket-scoped trace "
+        "correlation (Perfetto-per-ticket under chaos), tick-phase "
+        "profiler, SLO engine burn-rate alerts + AIMD consumption, "
+        "/dashboard + query-param endpoints, tracer-on bit-identity, "
+        "and the slow-marked serve-tick overhead ratchet (select with "
+        "-m sight; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

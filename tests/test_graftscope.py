@@ -423,7 +423,9 @@ class TestTracer:
             t.point("evt")
         t.close()
         doc = t.to_chrome()
-        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        assert set(doc) == {"traceEvents", "displayTimeUnit", "metadata"}
+        assert doc["metadata"]["dropped_spans"] == 0  # graftsight's honesty
+        assert doc["metadata"]["spans"] == len(doc["traceEvents"])
         for ev in doc["traceEvents"]:
             assert ev["ph"] == "X" and ev["cat"] == "graftscope"
             assert ev["dur"] >= 0 and ev["ts"] > 0
@@ -949,7 +951,8 @@ class TestBenchProbeLog:
         assert bench._backend_alive(window_s=300, probe_timeout_s=1,
                                     max_attempts=3) is None
         kinds = [("recovered" if e.get("recovered") else "error")
-                 for e in bench._PROBE_LOG]
+                 for e in bench._PROBE_LOG
+                 if not e.get("policy_summary")]  # graftsight's trailer
         assert kinds == ["error", "recovered"]
 
     def test_probe_log_lands_in_telemetry_artifact(self, tmp_path,
