@@ -10,7 +10,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
 	batch-check ring-check scope-check serve-check query-check quake-check \
-	sight-check
+	sight-check churn-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -134,6 +134,15 @@ quake-check:
 # ratchet runs with -m 'sight and slow').
 sight-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_graftsight.py -q
+
+# graftchurn live-growth plane: bit-identical overlay growth with the
+# O(log K) geometric repad schedule, checkpoint/supervised resume
+# across a repad, mid-service grow/delta mutations (zero admitted
+# lanes dropped, untouched tickets bit-identical), sidecar growth
+# replay, and seeded churn storms (tox env "churn"; the slow-marked
+# 100k churn-under-chaos soak runs with -m 'churn and slow').
+churn-check:
+	$(TEST_ENV) $(PY) -m pytest tests/test_graftchurn.py -q
 
 # Batched query lanes: byte-budget gate, lane-kernel parity, the three
 # family identity sweeps (min-plus vs Bellman-Ford reference, DHT vs the

@@ -131,6 +131,29 @@ def all_donation_audits() -> List[DonationAudit]:
                 {"max_rounds": 64},
                 len(jax.tree_util.tree_leaves(batch)))
 
+    def batch_from_repad():
+        import numpy as np
+
+        from p2pnetwork_tpu.models.messagebatch import BatchFlood
+        from p2pnetwork_tpu.sim import engine
+        from p2pnetwork_tpu.sim import graph as graph_mod
+
+        g = shape_class("ws1k")
+        proto = BatchFlood(method="auto")
+        batch = proto.init(g, np.arange(32, dtype=np.int32) * 11 % 900)
+        # Cross the pad boundary (graftchurn's live-growth path): the
+        # zero-extended batch leaves are fresh concatenations, so the
+        # grown-shape recompile must donate them exactly like the
+        # originals — a repad that silently double-buffers would tax
+        # every post-growth dispatch.
+        g2 = graph_mod.grow(g, 200)
+        assert g2.n_nodes_padded != g.n_nodes_padded
+        batch = proto.repad(batch, g2.n_nodes_padded)
+        args = (g2, proto, batch, jax.random.key(0))
+        return (engine.donating_carry_loops()["batch_from"], args,
+                {"max_rounds": 64},
+                len(jax.tree_util.tree_leaves(batch)))
+
     def _query_batch(g):
         import numpy as np
 
@@ -239,6 +262,10 @@ def all_donation_audits() -> List[DonationAudit]:
             name="engine/batch_from", build=batch_from,
             doc="batched message-plane loop "
                 "(engine.run_batch_until_coverage)"),
+        DonationAudit(
+            name="engine/batch_from_repad", build=batch_from_repad,
+            doc="batched message-plane loop after a live repad "
+                "(graftchurn growth: graph.grow + protocol.repad)"),
         # The query plane's donating carry: f32 lane matrices are the
         # HBM-heavy leaves byte-budgeting exists for — a silently
         # double-buffered query carry would double exactly the cost

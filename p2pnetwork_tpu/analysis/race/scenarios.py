@@ -459,6 +459,83 @@ def _serve_admit_storm():
 
 
 @scenario(
+    "churn_storm_vs_serve",
+    "The graftchurn mutation plane under exploration: a foreign thread "
+    "queues live overlay mutations (grow + a wiring delta, whose "
+    "endpoint validation reads the queued-grow total under _cond) and "
+    "another submits tickets while the driver-role thread runs "
+    "admission ticks whose mutate phase drains the queue — the "
+    "mutate/submit/stats interleavings the atomic between-tick "
+    "mutation contract promises to serialize.")
+def _churn_storm_vs_serve():
+    try:
+        import jax  # noqa: F401
+        from p2pnetwork_tpu.serve.service import (  # noqa: F401
+            Rejected, SimService)
+        from p2pnetwork_tpu.sim import graph as G
+    except Exception as e:  # pragma: no cover - jax-less image
+        raise ScenarioUnavailable(f"needs jax/serve: {e}") from e
+    g = G.watts_strogatz(24, 4, 0.1, seed=1, source_csr=True)
+
+    def mutations():
+        return [("grow", 2),
+                ("delta", G.GraphDelta.undirected(add_senders=[24, 25],
+                                                  add_receivers=[0, 1]))]
+
+    # Warm OUTSIDE the managed world (the serve_admit_storm rule): the
+    # first mutation lazily registers the sim_graph_grow/serve_mutation
+    # metric families and compiles the post-churn engine shapes; warmed
+    # here, every explored schedule starts compile-hot on raw locks.
+    warm = SimService(g, capacity=8, queue_depth=3, chunk_rounds=4, seed=0)
+    warm.submit(1)
+    for kind, payload in mutations():
+        warm.grow(payload) if kind == "grow" else warm.apply_delta(payload)
+    warm.tick()
+    warm.tick()
+    warm.close()
+
+    def body():
+        from p2pnetwork_tpu.serve.service import Rejected, SimService
+        reg = _fresh_registry()
+        svc = watch(SimService(
+            g, capacity=8, queue_depth=3, chunk_rounds=4, seed=0,
+            registry=reg))
+
+        def driver_role():
+            for _ in range(3):
+                svc.tick()
+
+        def mutator():
+            for kind, payload in mutations():
+                if kind == "grow":
+                    svc.grow(payload)
+                else:
+                    svc.apply_delta(payload)
+
+        def submitter():
+            for s in (1, 2):
+                try:
+                    svc.submit(s)
+                except Rejected:
+                    pass  # load shed is a designed outcome, not a bug
+
+        def prober():
+            svc.stats()
+            svc.busy()
+            svc.tickets()
+
+        ts = [concurrency.thread(target=f, name=nm)
+              for nm, f in (("driver", driver_role), ("mutate", mutator),
+                            ("submit", submitter), ("probe", prober))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+        svc.close()
+    return body
+
+
+@scenario(
     "sight_scrape_under_serve",
     "The graftsight observability plane under exploration: scraper "
     "threads read /dashboard's document (dashboard_doc, sockets-free), "

@@ -13,13 +13,22 @@
   :mod:`p2pnetwork_tpu.supervise.heal`; see GETTING_STARTED.md
   "Device-plane chaos & self-healing".
 
+- **Churn** (:mod:`p2pnetwork_tpu.chaos.storm`, graftchurn): seeded
+  join/leave/grow overlay storms (:class:`ChurnPattern` /
+  :class:`ChurnSchedule`) driven through graftserve's live mutation
+  plane — byte-replayable, interleavable with a traffic schedule. See
+  GETTING_STARTED.md "Live overlay growth & churn storms".
+
 Top-level import stays stdlib-only (device.py defers jax into the fault
-math), preserving the sockets backend's no-jax rule.
+math; storm.py — which speaks the jax-backed serving plane — loads
+lazily on first attribute access), preserving the sockets backend's
+no-jax rule.
 """
 
 from p2pnetwork_tpu.chaos.device import (ChipLost, DispatchChaos,
                                           FaultSchedule, FaultSpec,
-                                          FaultyComm, WedgedDispatch,
+                                          FaultyComm, UnreachableFaultSite,
+                                          WedgedDispatch,
                                           install_dispatch_chaos)
 from p2pnetwork_tpu.chaos.plane import ChaosPlane
 from p2pnetwork_tpu.chaos.streams import ChaosReader, ChaosWriter
@@ -27,5 +36,17 @@ from p2pnetwork_tpu.chaos.streams import ChaosReader, ChaosWriter
 __all__ = [
     "ChaosPlane", "ChaosReader", "ChaosWriter",
     "FaultSchedule", "FaultSpec", "FaultyComm", "DispatchChaos",
-    "ChipLost", "WedgedDispatch", "install_dispatch_chaos",
+    "ChipLost", "WedgedDispatch", "UnreachableFaultSite",
+    "install_dispatch_chaos",
+    "ChurnPattern", "ChurnSchedule",
 ]
+
+_STORM_NAMES = ("ChurnPattern", "ChurnSchedule")
+
+
+def __getattr__(name):
+    if name in _STORM_NAMES:
+        from p2pnetwork_tpu.chaos import storm
+        return getattr(storm, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -50,7 +50,7 @@ from p2pnetwork_tpu.telemetry import spans
 
 __all__ = [
     "FAULT_KINDS", "FaultSchedule", "FaultSpec", "FaultyComm",
-    "ChipLost", "WedgedDispatch", "DispatchChaos",
+    "ChipLost", "WedgedDispatch", "DispatchChaos", "UnreachableFaultSite",
     "install_dispatch_chaos", "dispatch_gate", "record_faults",
 ]
 
@@ -71,6 +71,18 @@ def _faults_counter(registry: Optional[telemetry.Registry] = None):
         "Device-plane faults injected by graftquake, by kind (corrupt / "
         "zero / delay halo hops from a FaultSchedule; preempt / wedge "
         "dispatch faults from DispatchChaos).", ("kind",))
+
+
+class UnreachableFaultSite(UserWarning):
+    """An explicit ``FaultSchedule.sites`` entry can never fire on the
+    ring it was handed to: its step or shard index is outside
+    ``[0, axis_size)``. The classic way to hit this is live overlay
+    growth — a schedule authored against the pre-grow shard count is
+    replayed against the regrown ring and some sites fall off the end.
+    A site that silently never fires would make a chaos run look
+    healthier than it is, so the mismatch is loud (this warning plus a
+    ``fault_sites_unreachable`` trace event), but not fatal: the
+    in-range sites still inject exactly as scheduled."""
 
 
 class ChipLost(RuntimeError):
@@ -288,7 +300,29 @@ class FaultSpec:
 
     def make(self, axis_name: str, axis_size: int) -> "FaultyComm":
         """The sharded._make_ring_comm seam: build this spec's comm
-        object for one ring."""
+        object for one ring. Rebuilt on every recompile — in particular
+        after a live ``Graph.grow`` changes the ring size — so this is
+        where explicit schedule sites are checked against the ring they
+        will actually run on: a site whose step or shard is outside
+        ``[0, axis_size)`` can never fire (ring steps and shard indices
+        both range over the axis size) and draws a structured
+        :class:`UnreachableFaultSite` warning instead of vanishing."""
+        import warnings
+
+        stale = [s for s in self.schedule.sites
+                 if not (0 <= s[1] < axis_size and 0 <= s[2] < axis_size)]
+        if stale:
+            warnings.warn(
+                f"{len(stale)} explicit fault site(s) unreachable on "
+                f"ring axis {axis_name!r} (size {axis_size}): "
+                f"{stale[:8]!r}{' ...' if len(stale) > 8 else ''} — "
+                "step/shard must lie in [0, axis_size); a schedule "
+                "authored before overlay growth must be re-targeted",
+                UnreachableFaultSite, stacklevel=2)
+            if spans.current_tracer() is not None:
+                spans.emit("fault_sites_unreachable", axis=axis_name,
+                           axis_size=int(axis_size), n_stale=len(stale),
+                           sites=[list(s) for s in stale[:16]])
         return FaultyComm(self, axis_name, axis_size)
 
 

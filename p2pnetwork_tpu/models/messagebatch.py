@@ -104,6 +104,40 @@ class MessageBatch:
     def capacity(self) -> int:
         return self.n_words * bitset.WORD
 
+    @property
+    def n_nodes_padded(self) -> int:
+        return self.seen.shape[1]
+
+    def repad(self, new_n_pad: int) -> "MessageBatch":
+        """Carry every in-flight lane across a node-capacity repad
+        (``Graph.grow``): zero-extend the three packed bit-planes from
+        the old ``N_pad`` to ``new_n_pad`` columns. Fresh capacity
+        padding is unseen by every lane — exactly the state a batch
+        admitted against the grown graph would hold — and the per-lane
+        metadata (source, admitted, done, rounds, seen_count, target) is
+        capacity-independent and rides along untouched, so admission
+        order, the latched-completion contract, and each lane's
+        admission-time coverage target all survive. Zero admitted lanes
+        are dropped by construction. The engine seam needs nothing
+        special: its jit caches key on shapes, so the first run of a
+        repadded batch compiles a fresh program at the new capacity and
+        later repads of the same size reuse it."""
+        new_n_pad = int(new_n_pad)
+        n_pad = self.n_nodes_padded
+        if new_n_pad == n_pad:
+            return self
+        if new_n_pad < n_pad:
+            raise ValueError(
+                f"repad to {new_n_pad} below the current node capacity "
+                f"{n_pad} — lanes cannot shrink without dropping state")
+        pad = [(0, 0), (0, new_n_pad - n_pad)]
+        return dataclasses.replace(
+            self,
+            seen=jnp.pad(self.seen, pad),
+            frontier=jnp.pad(self.frontier, pad),
+            sent=jnp.pad(self.sent, pad),
+        )
+
 
 def _lane_word(batch: MessageBatch, lane: int):
     """(word, bit) of a lane id, bounds-checked: an out-of-range lane
@@ -264,6 +298,13 @@ class BatchFlood:
             seen_count=batch.seen_count.at[lanes_j].set(count0),
             target=batch.target.at[lanes_j].set(tgt),
         ), lanes
+
+    def repad(self, batch: MessageBatch, new_n_pad: int) -> MessageBatch:
+        """Protocol-level spelling of :meth:`MessageBatch.repad` — the
+        seam a serving driver calls right after ``Graph.grow`` repads
+        node capacity, so the batch it carries matches the grown graph's
+        shapes before the next engine dispatch."""
+        return batch.repad(new_n_pad)
 
     def retire(self, batch: MessageBatch, lanes=None) -> MessageBatch:
         """Release lanes back to OPEN (default: every ``done`` lane),

@@ -15,6 +15,7 @@ makes "heavy traffic" a reproducible workload. See GETTING_STARTED.md
 """
 
 from p2pnetwork_tpu.serve.service import (
+    GraphMismatch,
     QueueFull,
     QuotaExceeded,
     Rejected,
@@ -30,6 +31,7 @@ from p2pnetwork_tpu.serve.traffic import (
 )
 
 __all__ = [
+    "GraphMismatch",
     "QueueFull",
     "QuotaExceeded",
     "Rejected",

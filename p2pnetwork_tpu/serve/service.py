@@ -70,6 +70,7 @@ from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.models.messagebatch import BatchFlood
 from p2pnetwork_tpu.sim import checkpoint as ckpt
 from p2pnetwork_tpu.sim import engine
+from p2pnetwork_tpu.sim import graph as graph_mod
 from p2pnetwork_tpu.supervise.runner import Preempted
 from p2pnetwork_tpu.supervise.store import (CheckpointStore,
                                              atomic_write_json)
@@ -78,7 +79,8 @@ from p2pnetwork_tpu.telemetry import spans
 
 __all__ = [
     "SimService", "Rejected", "QueueFull", "QuotaExceeded",
-    "ServiceClosed", "TERMINAL_STATES", "TICK_PHASES", "ticket_trace",
+    "ServiceClosed", "GraphMismatch", "TERMINAL_STATES", "TICK_PHASES",
+    "ticket_trace",
 ]
 
 _SIDECAR = "service_state.json"
@@ -91,10 +93,12 @@ TERMINAL_STATES = frozenset({"done", "cancelled", "timeout"})
 #: steps, so geometric 1..4096 covers both.
 _LATENCY_ROUND_BUCKETS = telemetry.exponential_buckets(1.0, 2.0, 13)
 
-#: graftsight tick-phase profiler: the five driver phases every tick
-#: walks, in execution order (ISSUE/ROADMAP naming: retire,
+#: graftsight tick-phase profiler: the driver phases every tick walks,
+#: in execution order (ISSUE/ROADMAP naming: mutate — queued graph
+#: deltas/growth applied atomically between chunks — then retire,
 #: admit-marshal, device-dispatch, harvest, checkpoint).
-TICK_PHASES = ("retire", "admit", "dispatch", "harvest", "checkpoint")
+TICK_PHASES = ("mutate", "retire", "admit", "dispatch", "harvest",
+               "checkpoint")
 
 #: Tick-phase histogram buckets: CPU-tick phases run ~10µs..10s.
 _PHASE_SECOND_BUCKETS = telemetry.exponential_buckets(1e-5, 2.0, 20)
@@ -186,6 +190,29 @@ class QuotaExceeded(Rejected):
 
 class ServiceClosed(RuntimeError):
     """The service was closed (or its driver died); no more admissions."""
+
+
+class GraphMismatch(ValueError):
+    """The checkpoint trail records a different overlay than the graph
+    this service was constructed with.
+
+    The sidecar embeds a layout fingerprint (sim/layoutcache.py source
+    digest folded with the graph's node/edge counts and edge-content
+    hash), so a trail from overlay A can no longer resume "successfully"
+    against overlay B just because the array shapes happen to agree.
+    Raised WITHOUT touching the trail — the tickets in it are real;
+    reconstruct with the right graph, or pass ``resume=False`` to
+    deliberately discard them. Growth steps recorded in the sidecar are
+    the sanctioned exception: a trail whose graph grew mid-service
+    resumes from the pre-growth construction by replaying those steps.
+    """
+
+    def __init__(self, message: str, *, expected: Optional[str] = None,
+                 got: Optional[str] = None, directory: str = ""):
+        self.expected = expected
+        self.got = got
+        self.directory = directory
+        super().__init__(message)
 
 
 class SimService:
@@ -378,7 +405,11 @@ class SimService:
         self._messages = 0     # cumulative exact message total
         self._latencies: List[float] = []   # rolling completion rounds
         self._counts = {"submitted": 0, "completed": 0, "cancelled": 0,
-                        "rejected": 0, "timeout": 0}
+                        "rejected": 0, "timeout": 0, "mutations": 0}
+        #: Queued live-mutation plane (graftchurn): (kind, payload)
+        #: pairs — ("delta", GraphDelta) / ("grow", n_new_nodes) —
+        #: drained atomically by the driver's mutate tick phase.
+        self._mutations: List[Tuple[str, Any]] = []
         self._submit_walls: Dict[str, float] = {}
         #: Anything the sidecar records changed since the last published
         #: pair — gates checkpointing so an IDLE background driver
@@ -393,6 +424,25 @@ class SimService:
         self._retire_ready: List[int] = []   # harvested lanes to recycle
         self._thread: Optional[Any] = None
         self._watchdog: Optional[Watchdog] = None
+        #: Growth steps applied this service lifetime (sidecar-recorded:
+        #: the sanctioned resume path replays them onto the pre-growth
+        #: construction). Driver-confined, like the graph they describe.
+        self._growth_history: List[dict] = []
+        #: Whether the served graph's delta-donate targets (degrees,
+        #: neighbor-table rows) are buffers this service owns outright.
+        #: The constructor graph is caller-owned — and a no-repad
+        #: ``grow`` shares every table buffer with its input — so the
+        #: first ``apply_delta`` must copy (``donate=False``), which
+        #: rebuilds all donate targets fresh and transfers ownership;
+        #: every later delta keeps the in-place churn fast path.
+        self._graph_donate_safe = False
+        # Graph-identity fingerprint caches (computed lazily, only when
+        # a store needs them): the edge-content sha survives growth
+        # (edges untouched) but not deltas; the full fingerprint caches
+        # until any mutation lands.
+        self._edges_sha: Optional[str] = None
+        self._graph_fp: Optional[str] = None
+        self._graph_fp_base: Optional[str] = None
 
         reg = registry if registry is not None \
             else telemetry.default_registry()
@@ -448,6 +498,17 @@ class SimService:
             "serve_healed_ticks_total",
             "Driver ticks whose engine chunk needed the Healer "
             "(faulted, then recovered within the retry budget).")
+        self._m_mutations = reg.counter(
+            "serve_mutations_total",
+            "Live graph mutations applied by the driver's mutate tick "
+            "phase, by kind (delta = GraphDelta edge churn; grow = node "
+            "growth, with or without a capacity repad).", ("kind",))
+        self._m_capacity = reg.gauge(
+            "graph_capacity",
+            "Padded node capacity of the served graph (grows in "
+            "geometric repad steps under Graph.grow; the static shape "
+            "every compiled consumer is keyed on).")
+        self._m_capacity.set(float(graph.n_nodes_padded))
         # Tick-phase profile state: written by the driver, snapshotted
         # by /dashboard scrape threads — its own small lock, never
         # nested with _cond.
@@ -472,6 +533,9 @@ class SimService:
                     "graftserve needs a checkpoint store with retain >= 2 "
                     "(retain=1 can prune the entry the current sidecar "
                     "references before the next sidecar lands)")
+            # The as-constructed fingerprint, BEFORE any resume-replayed
+            # growth: what a later resume of this trail must present.
+            self._graph_fp_base = self._graph_fingerprint()
             if resume:
                 self._try_resume()
             else:
@@ -558,6 +622,55 @@ class SimService:
         service on the same store resumes from the last durable pair."""
         with self._cond:
             self._preempt_at = int(at_tick)
+
+    # ------------------------------------------------------ live mutations
+
+    def apply_delta(self, delta: "graph_mod.GraphDelta") -> None:
+        """Queue an edge-churn :class:`~p2pnetwork_tpu.sim.graph.GraphDelta`
+        for the next tick's mutate phase.
+
+        Mutations apply atomically BETWEEN serve ticks (never inside a
+        dispatched chunk): the driver drains the queue first thing each
+        tick, in submission order, before retire/admit/dispatch — so a
+        chunk either entirely precedes or entirely follows a mutation,
+        admitted lanes are never dropped, and tickets completed before
+        the mutation tick keep byte-identical results (latched lanes are
+        never recomputed). Endpoints are validated HERE, against the
+        node count the delta will see after any growth already queued
+        ahead of it — a bad id raises a typed
+        :class:`~p2pnetwork_tpu.sim.graph.EdgeEndpointError` at the
+        caller, not an opaque failure inside the driver."""
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(self._driver_error or "service is closed")
+            n_eff = self.graph.n_nodes + sum(
+                p for k, p in self._mutations if k == "grow")
+            graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
+                delta.add_senders, delta.add_receivers, n_eff)
+            graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
+                delta.remove_senders, delta.remove_receivers, n_eff)
+            self._mutations.append(("delta", delta))
+            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+
+    def grow(self, n_new_nodes: int) -> None:
+        """Queue live overlay growth: ``n_new_nodes`` fresh live nodes
+        (ids continuing from the current count) join at the next tick's
+        mutate phase via :func:`~p2pnetwork_tpu.sim.graph.grow`.
+
+        When the grown count exceeds the padded capacity the graph
+        repads geometrically and the in-flight batch zero-extends with
+        it (``MessageBatch.repad``) — zero admitted lanes dropped, the
+        latched-completion contract preserved; compiled consumers
+        recompile at the new static shape on their next dispatch. Wire
+        the new nodes' edges with :meth:`apply_delta` afterwards."""
+        n_new_nodes = int(n_new_nodes)
+        if n_new_nodes < 0:
+            raise ValueError("n_new_nodes must be >= 0")
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(self._driver_error or "service is closed")
+            self._mutations.append(("grow", n_new_nodes))
+            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
 
     # ---------------------------------------------------------- request API
 
@@ -809,6 +922,9 @@ class SimService:
             lat = list(self._latencies)
             doc = {
                 "capacity": self.capacity,
+                "graph_nodes": self.graph.n_nodes,
+                "graph_capacity": self.graph.n_nodes_padded,
+                "mutations_queued": len(self._mutations),
                 "queue_depth": len(self._queue),
                 "queue_limit": self.queue_depth,
                 "active_lanes": len(self._lane_ticket),
@@ -853,6 +969,20 @@ class SimService:
         — the profiler does not move the determinism contract."""
         tracer = spans.current_tracer()
         pc = _PhaseClock(tracer)
+        # Mutate first: queued graph deltas / growth land atomically
+        # BEFORE this tick's chunk, so the dispatch below runs entirely
+        # against the post-mutation graph (and a repadded batch) — never
+        # mid-chunk, never half-applied.
+        pc.enter("mutate")
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(self._driver_error or "service is closed")
+            # Snapshot-then-clear under the lock: the drained list is a
+            # fresh private copy, so iterating it during the (slow,
+            # lock-free) apply below never touches shared state.
+            muts, self._mutations = list(self._mutations), []
+        if muts:
+            self._apply_mutations(muts)
         pc.enter("retire")
         if self._watchdog is None and self.deadline_s is not None:
             self._watchdog = Watchdog(
@@ -1288,6 +1418,88 @@ class SimService:
             self._tickets.pop(old, None)
             self._submit_walls.pop(old, None)
 
+    # ------------------------------------------------------ mutation plane
+
+    def _apply_mutations(self, muts: List[Tuple[str, Any]]) -> None:
+        """Drain one tick's queued mutations onto the served graph
+        (driver-confined — the graph and batch are the driver's).
+
+        Deltas ride ``apply_delta(donate=...)`` — the first delta
+        copies (the constructor graph is caller-owned; see
+        ``_graph_donate_safe``), after which every delta takes the
+        churn-storm fast path (touched neighbor rows scatter in
+        place); growth rides
+        ``graph.grow`` with its geometric repad schedule. When the
+        padded capacity changes, the in-flight batch zero-extends via
+        ``repad`` — zero admitted lanes dropped — and the healer's
+        integrity template rebuilds at the new shapes. A failing
+        mutation propagates and kills the driver loudly: mutations are
+        operator actions, and a half-applied queue must not be
+        silently skipped."""
+        g = self.graph
+        old_pad = g.n_nodes_padded
+        for kind, payload in muts:
+            if kind == "grow":
+                g = graph_mod.grow(g, payload)
+                self._growth_history.append({
+                    "tick": self._tick, "n_new": int(payload),  # graftlint: ignore[host-sync-in-loop,lock-guard] -- grow amounts are Python ints; _tick is driver-written and this runs on the driver
+                    "n_nodes": int(g.n_nodes),  # graftlint: ignore[host-sync-in-loop] -- static graph field (host int by construction)
+                    "n_pad": int(g.n_nodes_padded)})  # graftlint: ignore[host-sync-in-loop] -- static padded capacity (host int by construction)
+            else:
+                g = graph_mod.apply_delta(
+                    g, payload, donate=self._graph_donate_safe)
+                self._graph_donate_safe = True
+                self._edges_sha = None   # edge content changed
+            self._m_mutations.labels(kind).inc()
+            if spans.current_tracer() is not None:
+                spans.emit("serve_mutation", kind=kind, tick=self._tick,  # graftlint: ignore[lock-guard] -- _tick is driver-written and _apply_mutations runs on the driver
+                           n_nodes=int(g.n_nodes),  # graftlint: ignore[host-sync-in-loop] -- static graph field (host int by construction)
+                           n_pad=int(g.n_nodes_padded))  # graftlint: ignore[host-sync-in-loop] -- static padded capacity (host int by construction)
+        new_pad = g.n_nodes_padded
+        self.graph = g
+        self._graph_fp = None            # identity changed either way
+        if new_pad != old_pad:
+            # Capacity repad: the batch's per-node axes zero-extend (no
+            # admitted lane touched; latched completions stay latched)
+            # and the next dispatch recompiles at the grown shape.
+            self._batch = self._protocol.repad(self._batch, new_pad)
+            if self._healer is not None:
+                self._healer.template = jax.tree_util.tree_map(
+                    lambda x: np.zeros(x.shape, x.dtype), self._batch)
+        n_live = int(np.sum(np.asarray(g.node_mask)))
+        with self._cond:
+            self._n_live = n_live
+            self._counts["mutations"] += len(muts)
+            self._dirty = True
+        self._m_capacity.set(float(new_pad))
+
+    def _graph_fingerprint(self) -> str:
+        """The served graph's identity for the sidecar: the
+        sim/layoutcache.py source fingerprint folded with this graph's
+        node/edge counts, padded capacity, and edge-content sha. Cached
+        until a mutation invalidates it (growth keeps the edge sha —
+        edges are untouched — deltas recompute it)."""
+        if self._graph_fp is not None:
+            return self._graph_fp
+        import hashlib
+
+        from p2pnetwork_tpu.sim import layoutcache
+
+        g = self.graph
+        if self._edges_sha is None:
+            arrs = jax.device_get({"senders": g.senders,
+                                   "receivers": g.receivers,
+                                   "edge_mask": g.edge_mask})
+            h = hashlib.sha256()
+            for name in ("senders", "receivers", "edge_mask"):
+                h.update(np.ascontiguousarray(arrs[name]).tobytes())
+            self._edges_sha = h.hexdigest()[:16]
+        self._graph_fp = layoutcache.fingerprint(params={"serve_graph": {
+            "n_nodes": int(g.n_nodes), "n_edges": int(g.n_edges),
+            "n_pad": int(g.n_nodes_padded), "edges_sha": self._edges_sha,
+        }})
+        return self._graph_fp
+
     # ------------------------------------------------------------- driver
 
     def _driver_loop(self) -> None:
@@ -1300,7 +1512,7 @@ class SimService:
                 if self._closed:
                     return
                 if not (self._queue or self._lane_ticket
-                        or self._cancel_lanes):
+                        or self._cancel_lanes or self._mutations):
                     self._cond.wait(timeout=self.idle_wait_s)  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
                 if self._closed:
                     return
@@ -1353,8 +1565,15 @@ class SimService:
         the two leaves the previous consistent pair (the sidecar is the
         resume authority, pointing at a never-rewritten entry within the
         retention window)."""
+        # Graph identity (computed outside the lock — it may pull edge
+        # arrays to host): the fingerprint gate resume checks, plus the
+        # growth steps that sanction a base-fingerprint resume.
+        fp = self._graph_fingerprint()
         with self._cond:
             snap = self._snapshot_locked()
+        snap["graph_fingerprint"] = fp
+        snap["graph_fingerprint_base"] = self._graph_fp_base
+        snap["growth"] = [dict(s) for s in self._growth_history]
         try:
             path = self._store.save(self._batch, self._base_key,
                                     snap["round"], snap["messages"])
@@ -1402,6 +1621,52 @@ class SimService:
             return False
         entry = snap.get("checkpoint_file")
         path = os.path.join(self._store.directory, str(entry))
+        # Graph-identity gate (trail-preserving): the sidecar's
+        # fingerprint must explain the constructed graph — either it IS
+        # the trail's graph, or the trail's recorded growth steps grow
+        # the construction into it (the sanctioned exception, replayed
+        # here so the batch template below already has the grown
+        # shapes). Anything else is a wrong-overlay resume: refuse with
+        # the trail intact. Legacy sidecars without a fingerprint skip
+        # the gate.
+        side_fp = snap.get("graph_fingerprint")
+        if side_fp is not None:
+            growth = [dict(s) for s in snap.get("growth", [])]
+            fp0 = self._graph_fingerprint()
+            if fp0 == side_fp:
+                self._growth_history = growth
+            elif fp0 == snap.get("graph_fingerprint_base"):
+                for step in growth:
+                    self.graph = graph_mod.grow(
+                        self.graph, int(step["n_new"]),  # graftlint: ignore[host-sync-in-loop] -- sidecar JSON scalar, already host
+                        node_capacity=int(step["n_pad"]))  # graftlint: ignore[host-sync-in-loop] -- sidecar JSON scalar, already host
+                self._graph_fp = None
+                self._growth_history = growth
+                if self._graph_fingerprint() != side_fp:
+                    raise GraphMismatch(
+                        f"checkpoint trail at {self._store.directory!r} "
+                        "records graph mutations beyond growth (edge "
+                        "deltas); replaying the recorded growth onto "
+                        "this construction does not reproduce the "
+                        "trail's graph — reconstruct the mutated graph "
+                        "(persist it with sim/checkpoint.save_graph) or "
+                        "pass resume=False to discard the trail",
+                        expected=side_fp, got=self._graph_fingerprint(),
+                        directory=self._store.directory)
+                self._m_capacity.set(float(self.graph.n_nodes_padded))
+                if spans.current_tracer() is not None:
+                    spans.emit("serve_resume_regrow",
+                               steps=len(growth),
+                               n_pad=int(self.graph.n_nodes_padded))
+            else:
+                raise GraphMismatch(
+                    f"checkpoint trail at {self._store.directory!r} was "
+                    f"written against a different overlay (recorded "
+                    f"fingerprint {side_fp}, constructed graph "
+                    f"{fp0}) — construct with the graph the trail "
+                    "belongs to, or pass resume=False to discard it",
+                    expected=side_fp, got=fp0,
+                    directory=self._store.directory)
         template = self._template()
         try:
             state, key, rnd, msgs = ckpt.load(path, template)

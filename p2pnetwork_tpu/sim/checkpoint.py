@@ -196,6 +196,52 @@ def apply_topology_state(graph, ts: Dict[str, Any]):
     return dataclasses.replace(graph, **kw)
 
 
+def grow_state(state: Any, template: Any) -> Any:
+    """Zero-extend every leaf of ``state`` into ``template``'s shapes — the
+    repad-compatibility half of ``Graph.grow``.
+
+    A checkpoint written at node capacity ``N_pad`` holds per-node leaves of
+    width ``N_pad``; after a geometric repad the resumed run's template is
+    wider. Growth padding is all-dead (``node_mask`` False), and zero IS the
+    canonical state value for dead padding in every shipped protocol (init
+    masks by liveness), so zero-extension makes resume-across-repad
+    bit-identical to an uninterrupted grown run — tests/test_graftchurn.py
+    pins that.
+
+    Each leaf must match its template leaf's dtype and rank, and be no
+    larger along any axis (growth only — shrinking would drop state);
+    otherwise ``ValueError``, which :meth:`CheckpointStore.load_latest`
+    counts as a template mismatch and skips past. Leaves whose shapes
+    already match pass through untouched (the no-repad case is identity).
+    """
+    s_leaves, s_def = jax.tree_util.tree_flatten(state)
+    t_leaves, t_def = jax.tree_util.tree_flatten(template)
+    if str(s_def) != str(t_def):
+        raise ValueError(
+            f"state structure mismatch:\n  state: {s_def}\n  template: {t_def}")
+    out = []
+    for i, (s, t) in enumerate(zip(s_leaves, t_leaves)):
+        t_shape = tuple(np.shape(t))
+        t_dtype = np.dtype(getattr(t, "dtype", None) or np.asarray(t).dtype)  # graftlint: ignore[host-sync-in-loop] -- dtype probe of a dtype-less leaf (a Python scalar); no device transfer
+        s_shape = tuple(np.shape(s))
+        s_dtype = np.dtype(getattr(s, "dtype", None) or np.asarray(s).dtype)  # graftlint: ignore[host-sync-in-loop] -- dtype probe of a dtype-less leaf (a Python scalar); no device transfer
+        if s_shape == t_shape and s_dtype == t_dtype:
+            out.append(s)
+            continue
+        if (s_dtype != t_dtype or len(s_shape) != len(t_shape)
+                or any(a > b for a, b in zip(s_shape, t_shape))):
+            raise ValueError(
+                f"state leaf {i} is not repad-growable: saved "
+                f"{s_dtype}{s_shape}, template {t_dtype}{t_shape} — a "
+                f"repad-compatible leaf matches dtype and rank and only "
+                f"grows along axes")
+        grown = np.zeros(t_shape, s_dtype)
+        grown[tuple(slice(0, d) for d in s_shape)] = np.asarray(  # graftlint: ignore[host-sync-in-loop] -- zero-extension IS a host splice of every grown leaf; once per resume, not per round
+            jax.device_get(s))  # graftlint: ignore[host-sync-in-loop] -- one audited pull per grown leaf, once per resume
+        out.append(grown)
+    return jax.tree_util.tree_unflatten(s_def, out)
+
+
 def load_node_payload(path: str, graph, protocol_state_template) -> Tuple[
         Dict[str, Any], jax.Array, int, int]:
     """Load a JaxSimNode checkpoint (payload dict with ``protocol``,
@@ -271,12 +317,19 @@ def save(path: str, state: Any, key: jax.Array, round_index: int,
         raise
 
 
-def load(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
+def load(path: str, template: Any, *,
+         grow: bool = False) -> Tuple[Any, jax.Array, int, int]:
     """Load a checkpoint written by :func:`save`.
 
     ``template`` is a state pytree with the same structure (e.g. a freshly
     built ``protocol.init(...)``); its treedef validates the file.
     Returns ``(state, key, round_index, message_count)``.
+
+    ``grow=True`` makes the template repad-compatible: a file whose leaves
+    are *smaller* than the template's (written before a ``Graph.grow``
+    capacity repad) is zero-extended into the grown shapes via
+    :func:`grow_state`; leaves that cannot grow into the template (dtype or
+    rank change, shrink) stay a ``ValueError``.
 
     Integrity: a file carrying the embedded content hash (every checkpoint
     written since the hash landed in the format) is verified against it; a
@@ -315,6 +368,8 @@ def load(path: str, template: Any) -> Tuple[Any, jax.Array, int, int]:
     n = len([k for k in payload if k.startswith("leaf_")])
     leaves = [payload[f"leaf_{i}"] for i in range(n)]
     state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if grow:
+        state = grow_state(state, template)
     key = jax.random.wrap_key_data(payload["__key__"])
     messages = int(payload["__messages__"]) if "__messages__" in payload else 0
     return state, key, int(payload["__round__"]), messages

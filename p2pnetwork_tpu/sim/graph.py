@@ -362,6 +362,13 @@ class Graph:
         return apply_delta(self, delta, edge_pad_multiple=edge_pad_multiple,
                            donate=donate)
 
+    def grow(self, n_new_nodes: int, *,
+             node_capacity: Optional[int] = None) -> "Graph":
+        """Grow the overlay by ``n_new_nodes`` fresh node ids — see
+        :func:`grow` (amortized geometric capacity repad; bit-identical
+        to a from-scratch :func:`from_edges` at the grown capacity)."""
+        return grow(self, n_new_nodes, node_capacity=node_capacity)
+
     def with_hybrid(self, block: int = 512, max_diags: int = 64) -> "Graph":
         """Return a copy carrying the diagonal+remainder representation used
         by the ``"hybrid"`` aggregation method — circular-shift passes for
@@ -405,6 +412,44 @@ def _build_source_csr(senders: np.ndarray, edge_mask: np.ndarray,
 def _as_edge_array(x, dtype=np.int32) -> np.ndarray:
     return (np.zeros(0, dtype=dtype) if x is None
             else np.asarray(x, dtype=dtype).reshape(-1))
+
+
+class EdgeEndpointError(ValueError):
+    """A delta edge names a node id outside ``[0, n_nodes)``.
+
+    Raised at :func:`apply_delta` / :func:`grow` entry, BEFORE any array
+    is touched — an out-of-range id would otherwise surface as an index
+    error or a silent scatter into capacity padding depending on which
+    derived view met it first. ``pairs`` carries up to 16 offending
+    ``(sender, receiver)`` tuples and ``n_nodes`` the valid id bound.
+    Subclasses :class:`ValueError` (and keeps the historical
+    "edge endpoint out of range" message prefix) so existing handlers
+    keep working.
+    """
+
+    def __init__(self, pairs, n_nodes: int):
+        self.pairs = [(int(s), int(r)) for s, r in pairs]
+        self.n_nodes = int(n_nodes)
+        shown = ", ".join(f"({s}, {r})" for s, r in self.pairs[:5])
+        more = ("" if len(self.pairs) <= 5
+                else f", +{len(self.pairs) - 5} more")
+        super().__init__(
+            f"edge endpoint out of range: edge(s) name a node id outside "
+            f"[0, {self.n_nodes}) as (sender, receiver): {shown}{more}")
+
+
+def _check_endpoints(senders: np.ndarray, receivers: np.ndarray,
+                     n_nodes: int) -> None:
+    """Raise :class:`EdgeEndpointError` for any edge naming an id outside
+    ``[0, n_nodes)``."""
+    if not senders.size:
+        return
+    bad = ((senders < 0) | (senders >= n_nodes)
+           | (receivers < 0) | (receivers >= n_nodes))
+    if bad.any():
+        idx = np.flatnonzero(bad)[:16]
+        raise EdgeEndpointError(
+            list(zip(senders[idx], receivers[idx])), n_nodes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -684,11 +729,8 @@ def apply_delta(graph: Graph, delta: GraphDelta, *,
     pad_mult = edge_pad_multiple or graph.edge_pad_multiple
     add_s, add_r = delta.add_senders, delta.add_receivers
     rem_s, rem_r = delta.remove_senders, delta.remove_receivers
-    if add_s.size and (add_s.max() >= graph.n_nodes
-                       or add_r.max() >= graph.n_nodes):
-        raise ValueError("edge endpoint out of range")
-    if add_s.size and (add_s.min() < 0 or add_r.min() < 0):
-        raise ValueError("edge endpoint out of range")
+    _check_endpoints(add_s, add_r, graph.n_nodes)
+    _check_endpoints(rem_s, rem_r, graph.n_nodes)
     weighted = graph.edge_weight is not None
     if weighted and add_s.size and delta.add_weights is None:
         raise ValueError(
@@ -907,6 +949,191 @@ def apply_delta(graph: Graph, delta: GraphDelta, *,
         max_out_span=max_out_span,
         **arrays,
     )
+
+
+# ----------------------------------------------------------- live growth
+
+
+def growth_capacity(demand: int, current: int) -> int:
+    """Geometric node-capacity schedule: the smallest doubling of
+    ``current`` that covers ``demand``.
+
+    Doubling (not rounding up to the next pad multiple) is what makes
+    :func:`grow` amortized: a sequence of K single-node growth steps
+    crosses only O(log K) capacity boundaries, so the capacity-dependent
+    rebuilds — and the recompiles every jitted consumer pays at a new
+    ``N_pad`` — are paid O(log K) times, not K times. Doubling a pad
+    multiple stays a pad multiple, so XLA tiling assumptions hold at
+    every step.
+    """
+    cap = max(int(current), 1)
+    demand = int(demand)
+    while cap < demand:
+        cap *= 2
+    return cap
+
+
+def grow(graph: Graph, n_new_nodes: int, *,
+         node_capacity: Optional[int] = None) -> Graph:
+    """Grow the overlay by ``n_new_nodes`` fresh live node ids
+    (``n_nodes .. n_nodes + n_new_nodes - 1``), repadding node capacity
+    on the geometric schedule of :func:`growth_capacity` when demand
+    exceeds the current ``N_pad``.
+
+    The node-capacity counterpart of :func:`apply_delta`'s O(delta) edge
+    churn: existing node ids, edges, liveness masks, and the dynamic
+    edge region are preserved bit-for-bit; only the capacity-dependent
+    leaves are rebuilt (node mask/degrees zero-extended, neighbor-table
+    rows zero-extended, the COO padding tail re-aimed at the new
+    ``N_pad - 1`` sentinel so the receiver sort order survives, CSR
+    offsets extended, layout permutations identity-extended, and the
+    blocked/hybrid/skew layouts rebuilt at the new capacity with their
+    recorded tuning). The result is bit-identical to a from-scratch
+    :func:`from_edges` of the same edge list at
+    ``node_pad_multiple=new capacity`` — wire the new nodes' edges with
+    the existing :func:`apply_delta` machinery afterwards (its
+    ``donate=True`` fast path stays valid: every grown leaf is a fresh
+    device buffer).
+
+    ``node_capacity`` pins an explicit target capacity (>= both the
+    current capacity and the grown node count) instead of the doubling
+    schedule — the repad-resume path uses it to match a checkpoint's
+    recorded capacity exactly. When neither the node count nor the
+    capacity changes this is a no-op returning ``graph`` itself.
+    """
+    if n_new_nodes < 0:
+        raise ValueError("n_new_nodes must be >= 0")
+    n_pad = graph.n_nodes_padded
+    new_n = graph.n_nodes + int(n_new_nodes)
+    new_pad = growth_capacity(new_n, n_pad)
+    if node_capacity is not None:
+        if int(node_capacity) < max(new_n, n_pad):
+            raise ValueError(
+                f"node_capacity {node_capacity} below the grown node "
+                f"count {new_n} / current capacity {n_pad}")
+        new_pad = int(node_capacity)
+    if n_new_nodes == 0 and new_pad == n_pad:
+        return graph
+    _reset_phases()
+    with _phase("grow"):
+        g = _grow(graph, new_n, new_pad)
+    telemetry.default_registry().counter(
+        "sim_graph_grow_total",
+        "Live overlay growth steps, split by whether node capacity "
+        "repadded.", ("repad",)).labels(
+            "true" if new_pad != n_pad else "false").inc()
+    return g
+
+
+def _grow(graph: Graph, new_n: int, new_pad: int) -> Graph:
+    n_nodes, n_pad = graph.n_nodes, graph.n_nodes_padded
+    e, e_pad = graph.n_edges, graph.n_edges_padded
+    hybrid_rep = graph.hybrid
+    s_live = r_live = None
+    if graph.blocked is not None or hybrid_rep is not None \
+            or graph.skew is not None:
+        s_live = np.asarray(graph.senders)[:e]
+        r_live = np.asarray(graph.receivers)[:e]
+
+    if new_pad == n_pad:
+        # Capacity holds: flip the new ids live and bump the static node
+        # count. The hybrid layout is the one capacity-independent view
+        # that bakes n_nodes (its diagonal census runs over the live
+        # block), so it alone rebuilds.
+        nm = np.asarray(graph.node_mask).copy()
+        nm[n_nodes:new_n] = True
+        if hybrid_rep is not None:
+            from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
+
+            kw = {}
+            if hybrid_rep.remainder is not None:
+                kw["block"] = hybrid_rep.remainder.block
+            hybrid_rep = build_hybrid_from_arrays(
+                s_live, r_live, new_n, n_pad, **kw)
+        return dataclasses.replace(
+            graph, n_nodes=new_n, hybrid=hybrid_rep,
+            node_mask=jax.device_put(nm))
+
+    # Repad: rebuild exactly the capacity-dependent leaves. Everything
+    # edge-shaped except the receiver padding tail is N-independent and
+    # carries over untouched (senders pad with 0, src_eid with e_pad-1).
+    nm = np.zeros(new_pad, dtype=bool)
+    nm[:n_pad] = np.asarray(graph.node_mask)
+    nm[n_nodes:new_n] = True
+    in_deg = np.zeros(new_pad, dtype=np.int32)
+    in_deg[:n_pad] = np.asarray(graph.in_degree)
+    out_deg = np.zeros(new_pad, dtype=np.int32)
+    out_deg[:n_pad] = np.asarray(graph.out_degree)
+    # Padding receivers re-aim at the NEW last padded id — still >= every
+    # live id, so the non-decreasing promise behind
+    # indices_are_sorted=True survives the repad.
+    r_arr = np.asarray(graph.receivers).copy()
+    r_arr[e:] = new_pad - 1
+    arrays = {"node_mask": nm, "in_degree": in_deg, "out_degree": out_deg,
+              "receivers": r_arr}
+
+    if graph.neighbors is not None:
+        # Row-extend with empty rows — exactly what from_edges builds for
+        # ids with no incoming edges, so capped-row subsampling (whose
+        # shared RNG stream depends only on the capped degrees, which
+        # growth never changes) stays bit-identical.
+        width = graph.neighbors.shape[1]
+        nb = np.zeros((new_pad, width), dtype=np.int32)
+        nb[:n_pad] = np.asarray(graph.neighbors)
+        nbm = np.zeros((new_pad, width), dtype=bool)
+        nbm[:n_pad] = np.asarray(graph.neighbor_mask)
+        arrays["neighbors"] = nb
+        arrays["neighbor_mask"] = nbm
+        if graph.neighbor_weight is not None:
+            nw = np.zeros((new_pad, width), dtype=np.float32)
+            nw[:n_pad] = np.asarray(graph.neighbor_weight)
+            arrays["neighbor_weight"] = nw
+
+    if graph.src_offsets is not None:
+        # New rows own zero out-edges: the exclusive-prefix-sum tail just
+        # repeats the total. src_eid's e_pad-1 padding fill is
+        # N-independent and rides along.
+        so = np.asarray(graph.src_offsets)
+        arrays["src_offsets"] = np.concatenate(
+            [so, np.full(new_pad - n_pad, so[-1], dtype=np.int32)])
+
+    if graph.layout_perm is not None:
+        # The relabeling extends with the identity over the new capacity
+        # range, like from_edges pads it over the padding ids.
+        ext = np.arange(n_pad, new_pad, dtype=np.int32)
+        arrays["layout_perm"] = np.concatenate(
+            [np.asarray(graph.layout_perm), ext])
+        arrays["layout_inv"] = np.concatenate(
+            [np.asarray(graph.layout_inv), ext])
+
+    blocked_rep, skew_rep = graph.blocked, graph.skew
+    if blocked_rep is not None:
+        from p2pnetwork_tpu.ops.blocked import build_blocked_from_arrays
+
+        blocked_rep = build_blocked_from_arrays(
+            s_live, r_live, new_pad, blocked_rep.block)
+    if hybrid_rep is not None:
+        from p2pnetwork_tpu.ops.diag import build_hybrid_from_arrays
+
+        kw = {}
+        if hybrid_rep.remainder is not None:
+            kw["block"] = hybrid_rep.remainder.block
+        hybrid_rep = build_hybrid_from_arrays(
+            s_live, r_live, new_n, new_pad, **kw)
+    if skew_rep is not None:
+        from p2pnetwork_tpu.ops.skew import build_skew_from_arrays
+
+        w_unpadded = None
+        if graph.edge_weight is not None:
+            w_unpadded = np.asarray(graph.edge_weight)[:e]
+        skew_rep = build_skew_from_arrays(
+            s_live, r_live, new_pad, e_pad, width=skew_rep.width,
+            weights=w_unpadded)
+
+    arrays = jax.device_put(arrays)
+    return dataclasses.replace(
+        graph, n_nodes=new_n, blocked=blocked_rep, hybrid=hybrid_rep,
+        skew=skew_rep, **arrays)
 
 
 def from_edges(

@@ -224,7 +224,12 @@ class SupervisedRun:
             lambda k: self.protocol.init(self.graph, k), key)
         template = jax.tree_util.tree_map(
             lambda s: np.zeros(s.shape, s.dtype), template)
-        restored = self.store.load_latest(template) if resume else None
+        # grow=True: a trail written before a Graph.grow capacity repad is
+        # still this run's trail — zero-extend it into the grown template
+        # (checkpoint.grow_state) so resume-across-repad is bit-identical
+        # to an uninterrupted grown run. Identity when shapes match.
+        restored = self.store.load_latest(template, grow=True) \
+            if resume else None
         if restored is not None:
             state, base_key, rnd, msgs, path = restored
             # device_put once: checkpoint leaves come back as host numpy,

@@ -219,7 +219,7 @@ class CheckpointStore:
         ents = self.entries()
         return int(ents[-1]["round"]) if ents else None
 
-    def load_latest(self, template: Any) -> Optional[
+    def load_latest(self, template: Any, *, grow: bool = False) -> Optional[
             Tuple[Any, Any, int, int, str]]:
         """Restore the newest loadable checkpoint, skipping damage.
 
@@ -227,7 +227,11 @@ class CheckpointStore:
         match the manifest's file hash when one is recorded, and (c) pass
         ``checkpoint.load``'s in-file digest and structure checks. Any
         failure skips to the next-older entry (counted into
-        ``supervise_checkpoints_skipped_total{reason}``). A resume whose
+        ``supervise_checkpoints_skipped_total{reason}``). ``grow=True``
+        accepts repad-compatible entries written before a ``Graph.grow``
+        capacity change (leaves zero-extended into the template's grown
+        shapes via ``checkpoint.grow_state``); entries that cannot grow
+        into the template still skip as ``template_mismatch``. A resume whose
         manifest is gone/unreadable but whose directory still holds
         entries falls back to the scan, counted once as
         ``{reason="manifest-missing"}``, and the entry it recovers is
@@ -255,7 +259,7 @@ class CheckpointStore:
                 self._m_skipped.labels("hash_mismatch").inc()
                 continue
             try:
-                state, key, rnd, msgs = ckpt.load(path, template)
+                state, key, rnd, msgs = ckpt.load(path, template, grow=grow)
             except ckpt.CheckpointCorrupt:
                 self._m_skipped.labels("corrupt").inc()
                 continue

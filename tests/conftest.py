@@ -105,6 +105,15 @@ def pytest_configure(config):
         "/dashboard + query-param endpoints, tracer-on bit-identity, "
         "and the slow-marked serve-tick overhead ratchet (select with "
         "-m sight; part of the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "churn: graftchurn live-growth tests — bit-identical overlay "
+        "growth with O(log K) repads, checkpoint/supervised resume "
+        "across a repad, mid-service grow/delta mutations (zero lanes "
+        "dropped, untouched tickets bit-identical), sidecar growth "
+        "replay, seeded churn storms, and the slow-marked 100k "
+        "churn-under-chaos soak (select with -m churn; part of the "
+        "default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")
