@@ -10,7 +10,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
 	batch-check ring-check scope-check serve-check query-check quake-check \
-	sight-check churn-check
+	sight-check churn-check mem-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -51,8 +51,10 @@ supervise-check:
 
 # graftlint + graftaudit gates: zero non-baselined findings at BOTH
 # layers — source AST (retrace/host-sync/lock discipline) and compiled IR
-# (jaxpr rules, signature parity, donation aliasing, cost ratchet) —
-# then both test subsets (tox env "analysis").
+# (jaxpr rules, signature parity, donation aliasing, cost ratchet, AND
+# the graftmem memory ratchet/model-drift gate, which rides the full
+# graftaudit run by default) — then both test subsets (tox env
+# "analysis").
 analysis-check:
 	$(PY) -m p2pnetwork_tpu.analysis p2pnetwork_tpu/
 	$(PY) -m p2pnetwork_tpu.analysis.ir
@@ -65,6 +67,18 @@ analysis-check:
 audit-check:
 	$(PY) -m p2pnetwork_tpu.analysis.ir
 	$(TEST_ENV) $(PY) -m pytest tests/test_iraudit.py -q
+
+# graftmem static memory plane: the full graftaudit gate (the
+# membudgets ratchet + analytic/compiled model-drift check ride it by
+# default), the north-star capacity plan evaluated from the checked-in
+# coefficients (fails loudly when membudgets.json lacks a capacity
+# model), then the graftmem test subset — liveness-walk parity, ratchet
+# arithmetic, planner extrapolation, the SimService hbm_budget_bytes
+# 429 gate (tox env "mem").
+mem-check:
+	$(PY) -m p2pnetwork_tpu.analysis.ir
+	$(PY) -m p2pnetwork_tpu.analysis.ir --plan
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m mem
 
 # graftrace gate: the deterministic-concurrency scenario battery (every
 # builtin scenario × K seeded schedules, zero non-baselined races or

@@ -1071,6 +1071,12 @@ def _write_stage_telemetry(stage: str, tel: dict, stage_wall_s: float) -> None:
         # blessed flops/bytes per lowering for this stage's shape-class,
         # so drift between model and wall-clock is visible per artifact.
         "ir_cost_model": _ir_cost_slice(stage),
+        # The graftmem slice: the static capacity plan for this stage's
+        # node count (checked-in membudgets.json closed-form
+        # coefficients — nothing is built or compiled) beside the live
+        # allocator numbers (`device_memory_stats`), so planned-vs-
+        # resident drift is visible per artifact.
+        "memory": _memory_slice(stage),
         "metrics": reg.snapshot(),
     }
     path = _telemetry_path(stage)
@@ -1104,6 +1110,59 @@ def _ir_cost_slice(stage: str) -> dict:
                 "tolerance": doc.get("tolerance"), "entries": entries}
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _device_memory_stats() -> dict:
+    """Per-device allocator occupancy at snapshot time
+    (``device.memory_stats()``). Backends without allocator stats — the
+    CPU backend returns None — record ``available: False`` with a
+    structured warning, never a crash: the static plan beside it is the
+    number the artifact is really for on such hosts."""
+    out = {"available": False, "devices": []}
+    try:
+        import jax
+
+        for d in jax.devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                out["devices"].append(
+                    {"id": d.id, "platform": d.platform, "stats": None})
+                continue
+            out["available"] = True
+            out["devices"].append(
+                {"id": d.id, "platform": d.platform,
+                 "stats": {k: int(v) for k, v in stats.items()
+                           if isinstance(v, (int, float))}})
+        if not out["available"]:
+            _warn_event("bench_device_memory_stats_unavailable",
+                        platform=jax.devices()[0].platform
+                        if jax.devices() else "none")
+    except Exception as e:
+        _warn_event("bench_device_memory_stats_failed",
+                    error=f"{type(e).__name__}: {e}")
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _memory_slice(stage: str) -> dict:
+    """The graftmem slice: capacity.plan at this stage's node count
+    (the 1M headline plans the north-star 10k-lane shape) from the
+    checked-in coefficients, beside the measured per-device allocator
+    stats. Failure to plan must not sink a measured bench — a host
+    without a blessed capacity model records the error and moves on."""
+    nodes = {"1m": 1_000_000, "10m": 10_000_000}.get(stage, 1_000_000)
+    out = {"device_memory_stats": _device_memory_stats()}
+    try:
+        from p2pnetwork_tpu.analysis.ir import capacity as irc
+
+        p = irc.plan(nodes, lanes=10_016)
+        out["plan"] = {k: p[k] for k in
+                       ("entry", "n_nodes", "n_pad", "e_pad", "lanes",
+                        "lane_words", "global_bytes",
+                        "recommended_shards")}
+    except Exception as e:
+        out["plan"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def _stage_compile_budget(stage: str) -> int:

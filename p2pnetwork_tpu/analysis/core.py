@@ -222,7 +222,7 @@ class Rule:
     run: Callable[[Module], Iterable[Finding]]
 
 
-_RULES: Dict[str, Rule] = {}
+_RULES: Dict[str, Rule] = {}  # graftlint: ignore[unbounded-cache] -- rule registry: one entry per @register_rule decorator at import time, fixed vocabulary
 
 
 def register_rule(id: str, severity: str, doc: str):

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 
 from p2pnetwork_tpu.analysis.core import Finding
-from p2pnetwork_tpu.analysis.ir.registry import Trace
+from p2pnetwork_tpu.analysis.ir.registry import Trace, parse_shape_class
 
 __all__ = ["collect_costs", "load_budgets", "write_budgets",
            "check_budgets", "default_budgets_path", "DEFAULT_TOLERANCE"]
@@ -130,6 +130,18 @@ def _ratchet(name: str, message: str, severity: str = "P1") -> Finding:
                    rule="ir-cost-ratchet", message=message)
 
 
+def _class_of(name: str) -> str:
+    """The shape-class suffix of a lowering name. Stale-row findings must
+    say WHICH class's record went stale — `or/segment` exists at both
+    ws1k and ba1k, and the bare name is ambiguous between them."""
+    cls = name.rsplit("@", 1)[-1] if "@" in name else "?"
+    try:
+        parse_shape_class(cls)
+        return cls
+    except ValueError:
+        return "?"
+
+
 def check_budgets(costs: Dict[str, dict], budgets: dict,
                   tolerance: Optional[float] = None,
                   skipped: Optional[Sequence[str]] = None) -> List[Finding]:
@@ -196,7 +208,8 @@ def check_budgets(costs: Dict[str, dict], budgets: dict,
     stale = sorted(set(entries) - set(costs) - set(skipped or ()))
     for name in stale:
         out.append(_ratchet(
-            name, "budget entry for a lowering the registry no longer "
-                  "produces — regenerate budgets.json (--write-budgets) "
-                  "so the file matches HEAD", severity="P2"))
+            name, f"budget entry for a lowering the registry no longer "
+                  f"produces (shape-class {_class_of(name)}) — regenerate "
+                  "budgets.json (--write-budgets) so the file matches "
+                  "HEAD", severity="P2"))
     return sorted(out)

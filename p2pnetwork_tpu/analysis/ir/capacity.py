@@ -1,0 +1,356 @@
+"""graftmem capacity planner: closed-form HBM extrapolation for the zoo.
+
+The memory plane (memory.py) prices every lowering at the SMALL audit
+shapes — deliberately, to keep the gate sub-minute. The serving campaign
+asks a different question: does ``u32[W, N]`` at W=313 over a 1M-node
+overlay fit a chip, and if not, how many shards? Answering it by
+building the graph defeats the point of planning.
+
+So: trace each registry entry at 2–3 scaled shape points (``ws256`` /
+``ws512`` / ``ws1k`` — same generators, same seed, only the node count
+moves; registry.zoo_at makes that a one-liner), price each point through
+the same ``memory_analysis()`` + analytic-liveness machinery the ratchet
+trusts, and fit per-entry closed-form coefficients::
+
+    global_bytes(N_pad, E_pad, W) = c0 + cN·N_pad + cE·E_pad
+                                       + cW·max(0, W - W0)·N_pad
+    per_chip(shards)              = c0 + (global_bytes - c0) / shards
+
+``cW`` (the lane-word slope) comes from a dedicated two-point probe of
+the lane kernel at W=1 vs W=8 — the only coefficient the canonical
+registry cannot expose, because every checked-in entry traces at one
+word. ``W0`` is the word count the entry itself was traced at (1 for
+the lane/batched entries, 0 otherwise), so the lane term prices only
+the EXTRA words a wider deployment adds.
+
+Identifiability caveat, stated rather than hidden: both graph families
+grow edges linearly in nodes (WS: k·n, BA: m·n), so the fit points
+cannot separate ``cN`` from ``cE`` — the least-squares solution splits
+the joint slope at the family's edges-per-node ratio. Extrapolations
+stay exact for targets built by the same generators (the planner derives
+``E_pad`` from the family model for exactly this reason); feeding a
+hand-rolled ``E_pad`` at a wildly different density is outside the
+model's warranty, and ``plan()`` says so in its output.
+
+The fitted coefficients ride in ``membudgets.json`` under
+``capacity_model`` (written by ``graftaudit --write-membudgets``), so
+``plan()`` extrapolates from checked-in, reviewed numbers WITHOUT
+building or compiling anything — cheap enough for SimService to consult
+on every submit/grow (the ``hbm_budget_bytes`` knob in serve/service.py
+prices admission against :func:`serving_footprint_bytes` instead of
+OOMing mid-tick).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["fit_capacity_model", "plan", "serving_footprint_bytes",
+           "northstar_plan", "CAPACITY_SCHEMA", "DEFAULT_SERVING_ENTRY",
+           "NODE_PAD_MULTIPLE", "LANES_PER_WORD"]
+
+CAPACITY_SCHEMA = "graftmem-capacity-v1"
+#: graph.from_edges' default node padding — the planner must pad target
+#: node counts the way the builder will, or the extrapolation prices a
+#: graph nobody constructs.
+NODE_PAD_MULTIPLE = 128
+EDGE_PAD_MULTIPLE = 128
+#: One u32 lane word carries 32 concurrent messages (ops/bitset.py).
+LANES_PER_WORD = 32
+#: The serving plane's measured program: the batched run-to-coverage
+#: engine loop — what one graftserve tick compiles down to.
+DEFAULT_SERVING_ENTRY = "cov/batchflood-engine@ws"
+#: Scaled shape points per family (suffixes onto ws/ba). Three points
+#: over-determine the 2-dof family slope, so the fit residual is a real
+#: linearity check, not zero by construction.
+FIT_SIZES = ("256", "512", "1k")
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-int(x) // m) * m
+
+
+def _base_name(name: str) -> str:
+    """``cov/batchflood-engine@ws512`` -> ``cov/batchflood-engine@ws`` —
+    one fitted model per (lowering, family), fed by every fit point."""
+    head, _, cls = name.rpartition("@")
+    fam = "ba" if cls.startswith("ba") else "ws"
+    return f"{head}@{fam}"
+
+
+def _lane_words_traced(name: str) -> int:
+    """Words of lane state the registry entry itself carries (W0): the
+    lane kernels and batched-flood loops trace at exactly one u32 word
+    (32 lanes); everything else has no lane axis to widen."""
+    return 1 if ("lanes" in name or "batchflood" in name) else 0
+
+
+def _lstsq(rows: List[List[float]], ys: List[float]) -> List[float]:
+    """Minimum-norm least squares (numpy lapack under the hood)."""
+    import numpy as np
+
+    a = np.asarray(rows, dtype=np.float64)  # graftlint: ignore[f64-literal] -- host-side fit numerics on Python floats, never a device array
+    b = np.asarray(ys, dtype=np.float64)  # graftlint: ignore[f64-literal] -- same: lstsq conditioning wants f64, independent of the x64 flag
+    sol, *_ = np.linalg.lstsq(a, b, rcond=None)
+    return [float(v) for v in sol]
+
+
+def _global_bytes(record: dict, shards: int = 1) -> Optional[float]:
+    """The fit target of one memory record: whole-program resident bytes
+    = shards × per-device compiled peak, plus the folded-constant payload
+    (XLA embeds closure-captured graph tables in the executable — absent
+    from every memory_analysis bucket, resident on chip all the same)."""
+    comp = record.get("compiled")
+    if comp is None:
+        return None
+    const = float(record.get("analytic", {}).get("const", 0))
+    return int(shards) * float(comp.get("peak", 0)) + const
+
+
+# ----------------------------------------------------------------- fitting
+
+
+def _graph_dims(cls: str) -> Tuple[int, int]:
+    """(N_pad, E_pad) of one shape-class — host-side numpy build, cheap
+    at the ≤1k fit sizes, never touches a device."""
+    from p2pnetwork_tpu.analysis.ir import registry
+
+    g = registry.shape_class(cls)
+    return int(g.n_nodes_padded), int(g.n_edges_padded)
+
+
+def _lane_word_slope() -> dict:
+    """cW: bytes per (extra lane word × padded node), probed by pricing
+    the lane kernel at W=1 vs W=8 on ws256 — the one axis the canonical
+    registry never widens."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from p2pnetwork_tpu.analysis.ir import memory, registry
+    from p2pnetwork_tpu.ops import segment as S
+
+    cls = "ws256"
+    g = registry.shape_class(cls)
+    n_pad = int(g.n_nodes_padded)
+    got: Dict[int, float] = {}
+    for w in (1, 8):
+        def build(w=w):
+            lanes = jnp.zeros((w, g.n_nodes_padded), dtype=jnp.uint32)
+            return functools.partial(S.propagate_or_lanes, g,
+                                     method="gather"), (lanes,)
+        entry = registry.Lowering(
+            name=f"_capfit/or_lanes-w{w}@{cls}", op="or_lanes",
+            variant="gather", shape_class=cls, build=build, parity=False)
+        rec = memory.collect_memory(
+            [registry.trace_lowering(entry)]).get(entry.name, {})
+        total = _global_bytes(rec)
+        if total is not None:
+            got[w] = total
+    if len(got) < 2:
+        return {"cW": 4.0, "basis": "fallback: u32 plane = 4·N bytes/word"}
+    ws = sorted(got)
+    cw = (got[ws[1]] - got[ws[0]]) / ((ws[1] - ws[0]) * n_pad)
+    return {"cW": round(cw, 6),
+            "basis": f"or_lanes/gather@{cls} W={ws[0]}->W={ws[1]}"}
+
+
+def fit_capacity_model(canonical_records: Optional[dict] = None) -> dict:
+    """Trace + price the zoo at every fit point and fit the per-entry
+    closed forms. EXPENSIVE (two extra full-registry AOT passes plus the
+    lane probe) — runs only under ``graftaudit --write-membudgets``.
+
+    ``canonical_records`` (the ws1k/ba1k records the bless run already
+    collected) supply the third fit point for free when given.
+    """
+    from p2pnetwork_tpu.analysis.ir import memory, registry
+
+    import jax
+
+    n_dev = len(jax.devices())
+    # point label -> {"ws": cls, "ba": cls, records, dims per family}
+    points: List[dict] = []
+    for size in FIT_SIZES:
+        ws_cls, ba_cls = f"ws{size}", f"ba{size}"
+        zoo = registry.zoo_at(ws_cls, ba_cls)
+        if size == "1k" and canonical_records is not None:
+            records = canonical_records
+        else:
+            entries = [e for e in zoo if e.needs_devices <= n_dev]
+            traces = [registry.trace_lowering(e) for e in entries]
+            records = memory.collect_memory(traces)
+        points.append({"ws": ws_cls, "ba": ba_cls, "records": records,
+                       "shards": {e.name: e.needs_devices for e in zoo}})
+
+    graph_info: Dict[str, dict] = {}
+    for fam in ("ws", "ba"):
+        dims = [_graph_dims(p[fam]) for p in points]
+        slope = _lstsq([[1.0, float(n)] for n, _ in dims],  # graftlint: ignore[host-sync-in-loop] -- padded dims are plain Python ints
+                       [float(e) for _, e in dims])  # graftlint: ignore[host-sync-in-loop] -- same
+        graph_info[fam] = {
+            "fit_classes": [p[fam] for p in points],
+            "n_pad": [n for n, _ in dims],
+            "e_pad": [e for _, e in dims],
+            "e0": round(slope[0], 3),
+            "edges_per_node": round(slope[1], 6),
+        }
+
+    # Group each entry's fit points by (lowering, family) base name.
+    samples: Dict[str, List[Tuple[int, int, float]]] = {}
+    shards_of: Dict[str, int] = {}
+    for p in points:
+        for name, rec in p["records"].items():
+            shards = int(p["shards"].get(name, 1))  # graftlint: ignore[host-sync-in-loop] -- registry metadata, plain Python int
+            total = _global_bytes(rec, shards)
+            if total is None:
+                continue
+            base = _base_name(name)
+            fam = base.rsplit("@", 1)[-1]
+            n_pad, e_pad = _graph_dims(p[fam])
+            samples.setdefault(base, []).append((n_pad, e_pad, total))
+            shards_of[base] = shards
+
+    fitted: Dict[str, dict] = {}
+    for base, pts in sorted(samples.items()):
+        if len(pts) < 2:
+            continue  # one point fits nothing — entry stays unplannable
+        rows = [[1.0, float(n), float(e)] for n, e, _ in pts]  # graftlint: ignore[host-sync-in-loop] -- fit points are host ints from the trace census
+        ys = [y for _, _, y in pts]
+        c0, cn, ce = _lstsq(rows, ys)
+        resid = max(abs((c0 + cn * n + ce * e) - y) / max(y, 1.0)
+                    for (n, e, y) in pts)
+        fitted[base] = {
+            "c0": round(c0, 3), "cN": round(cn, 6), "cE": round(ce, 6),
+            "shards": shards_of.get(base, 1),
+            "w0": _lane_words_traced(base),
+            "points": len(pts),
+            "max_resid": round(resid, 4),
+        }
+
+    return {
+        "schema": CAPACITY_SCHEMA,
+        "comment": ("Per-(lowering, family) closed-form HBM coefficients: "
+                    "global_bytes = c0 + cN*N_pad + cE*E_pad + "
+                    "cW*max(0, W-w0)*N_pad; per_chip(s) = c0 + "
+                    "(global-c0)/s. Fit over the scaled shape points "
+                    "(ws256/ws512/ws1k and ba siblings); cN/cE are "
+                    "identified jointly through the family's "
+                    "edges-per-node ratio (both generators grow edges "
+                    "linearly in nodes). max_resid is the worst relative "
+                    "fit error across the points — a linearity check."),
+        "graph": graph_info,
+        "lane": _lane_word_slope(),
+        "entries": fitted,
+    }
+
+
+# ---------------------------------------------------------------- planning
+
+
+def _load_model(model: Optional[dict]) -> Optional[dict]:
+    if model is not None:
+        return model
+    from p2pnetwork_tpu.analysis.ir import memory
+
+    return memory.load_membudgets().get("capacity_model")
+
+
+def _eval_model(coeffs: dict, lane_cw: float, n_pad: int, e_pad: int,
+                lane_words: int) -> Tuple[float, float]:
+    """(global_bytes, shardable_bytes) of one fitted entry at a shape."""
+    extra_w = max(0, int(lane_words) - int(coeffs.get("w0", 0)))
+    shardable = (coeffs["cN"] * n_pad + coeffs["cE"] * e_pad
+                 + lane_cw * extra_w * n_pad)
+    return coeffs["c0"] + shardable, shardable
+
+
+def plan(n_nodes: int, lanes: int = 0,
+         entry: str = DEFAULT_SERVING_ENTRY,
+         per_chip_hbm_bytes: float = 16 * 1024**3,
+         headroom: float = 0.9,
+         shard_candidates: Sequence[int] = (1, 2, 4, 8, 16, 32, 64, 128,
+                                            256, 512, 1024),
+         model: Optional[dict] = None) -> dict:
+    """Extrapolate one lowering's HBM footprint to an arbitrary overlay
+    WITHOUT building it, from the checked-in coefficients.
+
+    Returns a plan document: padded dims, the modeled global footprint,
+    a per-chip table over ``shard_candidates``, and the smallest shard
+    count whose per-chip bytes fit under ``headroom × per_chip_hbm``
+    (``recommended_shards``; None when nothing in the candidate list
+    fits). Raises ``ValueError`` when membudgets.json carries no
+    capacity model (run ``graftaudit --write-membudgets``) or the entry
+    was never fitted."""
+    m = _load_model(model)
+    if not m or "entries" not in m:
+        raise ValueError(
+            "no capacity model: membudgets.json lacks `capacity_model` — "
+            "bless one with `graftaudit --write-membudgets`")
+    coeffs = m["entries"].get(entry)
+    if coeffs is None:
+        known = ", ".join(sorted(m["entries"]))
+        raise ValueError(f"no fitted capacity entry {entry!r} "
+                         f"(fitted: {known})")
+    fam = entry.rsplit("@", 1)[-1]
+    ginfo = m.get("graph", {}).get(fam, {})
+    n_pad = _round_up(max(int(n_nodes), 1), NODE_PAD_MULTIPLE)
+    e_est = (ginfo.get("edges_per_node", 0.0) * n_pad
+             + ginfo.get("e0", 0.0))
+    e_pad = _round_up(max(int(math.ceil(e_est)), 1), EDGE_PAD_MULTIPLE)
+    lane_words = -(-int(lanes) // LANES_PER_WORD) if lanes else 0
+    lane_cw = float(m.get("lane", {}).get("cW", 4.0))
+    global_bytes, shardable = _eval_model(coeffs, lane_cw, n_pad, e_pad,
+                                          lane_words)
+    budget = headroom * float(per_chip_hbm_bytes)
+    table = []
+    recommended = None
+    for s in shard_candidates:
+        per_chip = coeffs["c0"] + shardable / max(int(s), 1)  # graftlint: ignore[host-sync-in-loop] -- shard counts are host ints, no device values in the planner
+        fits = per_chip <= budget
+        table.append({"shards": int(s), "per_chip_bytes": int(per_chip),  # graftlint: ignore[host-sync-in-loop] -- same
+                      "fits": fits})
+        if fits and recommended is None:
+            recommended = int(s)  # graftlint: ignore[host-sync-in-loop] -- same
+    return {
+        "entry": entry,
+        "n_nodes": int(n_nodes), "n_pad": n_pad, "e_pad": e_pad,
+        "lanes": int(lanes), "lane_words": lane_words,
+        "global_bytes": int(global_bytes),
+        "per_chip_hbm_bytes": int(per_chip_hbm_bytes),
+        "headroom": headroom,
+        "recommended_shards": recommended,
+        "per_chip": table,
+        "model_note": ("E_pad derived from the family edges-per-node "
+                       "model; densities far from the fitted generators "
+                       "are outside the model's warranty"),
+    }
+
+
+def serving_footprint_bytes(n_padded: int, e_padded: int,
+                            lane_words: int, shards: int = 1,
+                            entry: str = DEFAULT_SERVING_ENTRY,
+                            model: Optional[dict] = None) -> Optional[int]:
+    """Per-chip planned bytes of the serving program over a CONCRETE
+    graph (the caller already holds padded dims — SimService does) at
+    ``lane_words`` of in-flight lane state. Returns None when no
+    capacity model is checked in or the entry was never fitted — the
+    caller degrades to not enforcing, loudly, rather than guessing."""
+    m = _load_model(model)
+    if not m:
+        return None
+    coeffs = (m.get("entries") or {}).get(entry)
+    if coeffs is None:
+        return None
+    lane_cw = float(m.get("lane", {}).get("cW", 4.0))
+    _, shardable = _eval_model(coeffs, lane_cw, int(n_padded),
+                               int(e_padded), int(lane_words))
+    return int(coeffs["c0"] + shardable / max(int(shards), 1))
+
+
+def northstar_plan(per_chip_hbm_bytes: float = 16 * 1024**3,
+                   model: Optional[dict] = None) -> dict:
+    """ROADMAP item 2's SCALE question, answered from the checked-in
+    coefficients: the 10k-lane (W=313 words) / 1M-node serving shape."""
+    return plan(n_nodes=1_000_000, lanes=10_016,
+                per_chip_hbm_bytes=per_chip_hbm_bytes, model=model)

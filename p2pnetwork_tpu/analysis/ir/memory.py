@@ -1,0 +1,445 @@
+"""graftmem: static HBM liveness audit + memory ratchet for the zoo.
+
+``Compiled.memory_analysis()`` prices a program's device footprint
+(argument / output / temp / alias-credited bytes) without executing it —
+deterministic for a fixed (program, backend, jaxlib), exactly like the
+cost ratchet's ``cost_analysis()``. This module pins those numbers per
+(lowering, shape-class) into a checked-in ``membudgets.json`` with the
+budgets.json tolerance-ratchet semantics (``graftaudit
+--write-membudgets`` to bless), and CROSS-CHECKS each compiled record
+against an analytic jaxpr buffer-liveness walk: last-use liveness over
+every eqn, recursive into cond branches, while/scan bodies and
+pjit/shard_map callees like the primitive census, with donation aliases
+credited — the donation audit's ``input_output_alias`` pairs are the
+ground truth for which argument buffers XLA reuses.
+
+Three rules ride the record:
+
+========================  =====  ==========================================
+rule                      sev    fires on
+========================  =====  ==========================================
+``ir-mem-regression``     P1     compiled peak bytes drifted past the
+                                 blessed tolerance (shrink past it is P2 —
+                                 bless the win so the ratchet holds)
+``ir-mem-unbudgeted``     P1     a lowering with no blessed memory budget
+``ir-mem-model-drift``    P2     the analytic walk and the compiled
+                                 record disagree by more than
+                                 ``MODEL_TOLERANCE`` — the planner's
+                                 closed-form extrapolations (capacity.py)
+                                 can no longer be trusted for this entry
+========================  =====  ==========================================
+
+Degrade path: a backend whose ``Compiled`` objects lack
+``memory_analysis()`` (or return nothing) cannot crash the audit — the
+affected entries land on a skip-list (reported loudly, exactly like the
+<8-device device skip-list) and ``--write-membudgets`` refuses to bless
+a degraded run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2pnetwork_tpu.analysis.core import Finding
+from p2pnetwork_tpu.analysis.ir.donation import _alias_section
+from p2pnetwork_tpu.analysis.ir.registry import Trace, parse_shape_class
+
+__all__ = ["collect_memory", "analytic_memory", "load_membudgets",
+           "write_membudgets", "check_membudgets",
+           "default_membudgets_path", "DEFAULT_TOLERANCE",
+           "MODEL_TOLERANCE", "MEM_UNAVAILABLE"]
+
+SCHEMA = "graftaudit-membudgets-v1"
+#: Ratchet tolerance on compiled peak bytes (same semantics as the cost
+#: ratchet's: growth AND shrink past it fail until blessed).
+DEFAULT_TOLERANCE = 0.20
+#: Allowed analytic-vs-compiled disagreement on peak bytes. The analytic
+#: walk does not model XLA fusion (it counts every jaxpr intermediate at
+#: its last-use liveness), so it systematically overestimates temp; peak
+#: is argument-dominated at the audit shapes, which keeps the honest
+#: bound this tight.
+MODEL_TOLERANCE = 0.20
+#: Record marker for entries the backend could not price (no
+#: ``memory_analysis`` support) — the degrade skip-list, not a failure.
+MEM_UNAVAILABLE = "memory_analysis unavailable"
+
+#: ``{output_path}: (param_index, ...)`` pairs of the compiled ENTRY
+#: line's ``input_output_alias`` section — the capture group is the
+#: donated PARAMETER index, which maps onto the jaxpr invar the analytic
+#: walk credits.
+_ALIAS_PARAM = re.compile(r"\{[\d,\s]*\}:\s*\((\d+)")
+
+
+# ------------------------------------------------------- analytic walk
+
+
+def _aval_bytes(aval) -> int:
+    """Nominal buffer bytes of one abstract value (0 for non-arrays,
+    e.g. abstract tokens or key arrays without a dtype)."""
+    dtype = getattr(aval, "dtype", None)
+    size = getattr(aval, "size", None)
+    if dtype is None or size is None:
+        return 0
+    try:
+        return int(size) * jnp.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr in one eqn's params (cond branches, while/scan
+    bodies, pjit/shard_map callees) — the same descent the primitive
+    census walks."""
+    for v in eqn.params.values():
+        for x in (v if isinstance(v, (tuple, list)) else (v,)):
+            if hasattr(x, "eqns"):
+                yield x
+            else:
+                inner = getattr(x, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield inner
+
+
+def _liveness_peak(jaxpr, outvars_credit: frozenset) -> int:
+    """Peak live intermediate bytes of one (open) jaxpr under last-use
+    liveness. Vars in ``outvars_credit`` (the program's own outputs)
+    are excluded — they are output buffers, not temps. Control-flow
+    eqns contribute their bodies' peaks as a transient at their program
+    point (branches never run concurrently, so cond takes the max)."""
+    eqns = list(getattr(jaxpr, "eqns", ()))
+    last_use: Dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if hasattr(v, "aval"):
+                last_use[id(v)] = i
+    live: Dict[int, int] = {}
+    cur = 0
+    peak = 0
+    for i, eqn in enumerate(eqns):
+        inner = 0
+        subs = list(_sub_jaxprs(eqn))
+        if subs:
+            if eqn.primitive.name == "cond":
+                inner = max(_liveness_peak(s, frozenset()) for s in subs)
+            else:
+                inner = sum(_liveness_peak(s, frozenset()) for s in subs)
+        for v in eqn.outvars:
+            if id(v) in outvars_credit or not hasattr(v, "aval"):
+                continue
+            b = _aval_bytes(v.aval)
+            if id(v) not in live:
+                live[id(v)] = b
+                cur += b
+        peak = max(peak, cur + inner)
+        # Free every buffer whose last use was this eqn — including
+        # outputs nothing ever reads (their one program point was the
+        # production itself).
+        for v in list(eqn.outvars) + list(eqn.invars):
+            if last_use.get(id(v), -1) <= i and id(v) in live:
+                cur -= live.pop(id(v))
+    return peak
+
+
+def _used_invar_positions(jaxpr) -> set:
+    """Positions of the invars actually READ somewhere in the program or
+    returned from it. jit compiles with ``keep_unused=False`` semantics —
+    unused parameters are pruned before XLA prices them — so the
+    analytic walk must prune them too. Usage propagates through
+    call-like eqns whose single sub-jaxpr's invars align 1:1 with the
+    eqn's (pjit/closed_call): an argument forwarded into a callee that
+    never reads it is still unused. Non-aligned control flow
+    (while/scan/cond offset their operand lists) conservatively counts
+    every operand as used."""
+    used: set = set()
+    for eqn in jaxpr.eqns:
+        # _sub_jaxprs may yield a ClosedJaxpr (pjit) — unwrap to the open
+        # jaxpr, whose invars are positional.
+        subs = [getattr(s, "jaxpr", s) for s in _sub_jaxprs(eqn)]
+        if len(subs) == 1 and len(subs[0].invars) == len(eqn.invars):
+            for k in _used_invar_positions(subs[0]):
+                if hasattr(eqn.invars[k], "aval"):
+                    used.add(id(eqn.invars[k]))
+        else:
+            for v in eqn.invars:
+                if hasattr(v, "aval"):
+                    used.add(id(v))
+    used.update(id(v) for v in jaxpr.outvars if hasattr(v, "aval"))
+    return {k for k, v in enumerate(jaxpr.invars) if id(v) in used}
+
+
+def analytic_memory(closed, alias_bytes: int = 0,
+                    shards: int = 1) -> Dict[str, int]:
+    """The device-free twin of ``memory_analysis()``.
+
+    ``argument``/``output`` come straight off the avals of the USED
+    invars/outvars (jit prunes unused parameters before XLA prices
+    them), divided by ``shards`` for multi-device programs —
+    ``memory_analysis`` reports per-device bytes. ``const`` is the
+    hoisted trace-constant payload (graph tables closed over by
+    ``functools.partial`` builders): XLA folds these into the
+    executable, so they appear in NO ``memory_analysis`` bucket — but
+    they are resident on chip all the same, which is why the capacity
+    planner prices ``const`` on top of the compiled peak. ``temp`` is
+    the recursive last-use liveness peak — an upper bound (it does not
+    model fusion), recorded for the planner's headroom estimate, kept
+    OUT of the parity metric. ``interface = argument + output - alias``
+    is the drift-gate metric: exact-by-construction unless the
+    sharding/pruning assumptions the planner also relies on break."""
+    jaxpr = closed.jaxpr
+    shards = max(int(shards), 1)
+    used = _used_invar_positions(jaxpr)
+    argument = sum(_aval_bytes(v.aval)
+                   for k, v in enumerate(jaxpr.invars)
+                   if k in used) // shards
+    const = sum(_aval_bytes(c) for c in closed.consts)
+    output = sum(_aval_bytes(v.aval) for v in jaxpr.outvars
+                 if hasattr(v, "aval")) // shards
+    outset = frozenset(id(v) for v in jaxpr.outvars if hasattr(v, "aval"))
+    temp = _liveness_peak(jaxpr, outset) // shards
+    alias = min(int(alias_bytes) // shards, argument)
+    return {"argument": argument, "output": output, "const": const,
+            "temp": temp, "alias": alias,
+            "interface": argument + output - alias}
+
+
+def _alias_credit_bytes(hlo: str, invars) -> int:
+    """Donated-buffer credit: bytes of every invar the compiled
+    ``input_output_alias`` section names as a reused parameter — the
+    donation audit's alias pairs, reused as the analytic model's ground
+    truth. Parameter indices past the invar list (constant hoisting)
+    are skipped rather than guessed."""
+    credit = 0
+    for m in _ALIAS_PARAM.finditer(_alias_section(hlo)):
+        idx = int(m.group(1))  # graftlint: ignore[host-sync-in-loop] -- regex group over HLO text, no device values
+        if 0 <= idx < len(invars) and hasattr(invars[idx], "aval"):
+            credit += _aval_bytes(invars[idx].aval)
+    return credit
+
+
+# ------------------------------------------------------ compiled record
+
+
+def collect_memory(traces: Sequence[Trace]) -> Dict[str, dict]:
+    """AOT-compile every traced lowering and extract its memory record::
+
+        {name: {"compiled": {argument, output, temp, alias, peak},
+                "analytic": {argument, output, temp, alias, peak},
+                "model_ratio": analytic_peak / compiled_peak}}
+
+    Entries that failed to trace are skipped (ir-trace-error already
+    fired). A compile failure records ``{"error": ...}`` (the ratchet
+    reports it); a backend without ``memory_analysis()`` records
+    ``{"skipped": MEM_UNAVAILABLE}`` — the degrade path, surfaced by
+    the CLI, never a crash."""
+    out: Dict[str, dict] = {}
+    for trace in traces:
+        if trace.error is not None:
+            continue
+        name = trace.entry.name
+        try:
+            fn, args = trace.entry.build()
+            lowered = (
+                fn.lower(*args) if hasattr(fn, "lower")
+                # graftlint: ignore[jit-in-loop] -- AOT audit driver: each
+                # iteration lowers a DIFFERENT entry exactly once; nothing
+                # executes, so there is no compile cache to preserve.
+                else jax.jit(fn).lower(*args))
+            compiled = lowered.compile()
+            ma = getattr(compiled, "memory_analysis", None)
+            stats = ma() if callable(ma) else None
+            if isinstance(stats, (list, tuple)):  # older jax: per device
+                stats = stats[0] if stats else None
+            if stats is None or not hasattr(stats, "temp_size_in_bytes"):
+                out[name] = {"skipped": MEM_UNAVAILABLE}
+                continue
+            compiled_rec = {
+                "argument": int(stats.argument_size_in_bytes),  # graftlint: ignore[host-sync-in-loop] -- memory_analysis() stats are host ints
+                "output": int(stats.output_size_in_bytes),  # graftlint: ignore[host-sync-in-loop] -- same
+                "temp": int(stats.temp_size_in_bytes),  # graftlint: ignore[host-sync-in-loop] -- same
+                "alias": int(stats.alias_size_in_bytes),  # graftlint: ignore[host-sync-in-loop] -- same
+            }
+            compiled_rec["peak"] = (
+                compiled_rec["argument"] + compiled_rec["output"]
+                + compiled_rec["temp"] - compiled_rec["alias"])
+            record = {"compiled": compiled_rec}
+            if trace.jaxpr is not None:
+                alias_credit = _alias_credit_bytes(
+                    compiled.as_text(), trace.jaxpr.jaxpr.invars)
+                analytic = analytic_memory(
+                    trace.jaxpr, alias_credit,
+                    shards=trace.entry.needs_devices)
+                record["analytic"] = analytic
+                have = (compiled_rec["argument"] + compiled_rec["output"]
+                        - compiled_rec["alias"])
+                if have > 0:
+                    record["model_ratio"] = round(
+                        analytic["interface"] / have, 4)
+            out[name] = record
+        except Exception as e:  # noqa: BLE001 — surfaced by the ratchet
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def mem_skipped(records: Dict[str, dict]) -> List[str]:
+    """Names whose backend could not price memory (the degrade list)."""
+    return sorted(n for n, r in records.items()
+                  if r.get("skipped") == MEM_UNAVAILABLE)
+
+
+# ----------------------------------------------------------- the ratchet
+
+
+def default_membudgets_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "membudgets.json")
+
+
+def load_membudgets(path: Optional[str] = None) -> dict:
+    """The checked-in memory-budget document (``{}`` when absent — a
+    repo without membudgets gates nothing until ``--write-membudgets``
+    blesses)."""
+    path = path or default_membudgets_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_membudgets(records: Dict[str, dict], path: Optional[str] = None,
+                     tolerance: float = DEFAULT_TOLERANCE,
+                     capacity_model: Optional[dict] = None) -> str:
+    """Bless the current memory records as the new baseline. The fitted
+    capacity model (capacity.py coefficients) rides in the same file so
+    ``capacity.plan`` extrapolates from checked-in, reviewed numbers."""
+    import jaxlib
+
+    path = path or default_membudgets_path()
+    payload = {
+        "schema": SCHEMA,
+        "comment": ("graftmem static HBM budgets. compiled.* comes from "
+                    "Compiled.memory_analysis() on the CPU backend; "
+                    "analytic.* from the jaxpr buffer-liveness walk "
+                    "(donation aliases credited from the compiled "
+                    "input_output_alias pairs). CI fails on peak drift "
+                    "past `tolerance` or analytic/compiled disagreement "
+                    "past `model_tolerance`; bless deliberate changes "
+                    "with `graftaudit --write-membudgets` and commit the "
+                    "diff. `capacity_model` holds the fitted closed-form "
+                    "coefficients capacity.plan extrapolates from."),
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "tolerance": tolerance,
+        "model_tolerance": MODEL_TOLERANCE,
+        "entries": {k: records[k] for k in sorted(records)
+                    if "skipped" not in records[k]},
+    }
+    if capacity_model is not None:
+        payload["capacity_model"] = capacity_model
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def _mem_finding(rule: str, name: str, message: str,
+                 severity: str) -> Finding:
+    return Finding(severity=severity, file=name, line=0, col=0,
+                   rule=rule, message=message)
+
+
+def _class_of(name: str) -> str:
+    """The shape-class suffix of a lowering name (for finding text —
+    a stale row must say WHICH class's record went stale)."""
+    cls = name.rsplit("@", 1)[-1] if "@" in name else "?"
+    try:
+        parse_shape_class(cls)
+        return cls
+    except ValueError:
+        return "?"
+
+
+def check_membudgets(records: Dict[str, dict], budgets: dict,
+                     tolerance: Optional[float] = None,
+                     skipped: Optional[Sequence[str]] = None
+                     ) -> List[Finding]:
+    """Current memory records vs the blessed membudgets. Fails on:
+    compiled peak drift past tolerance (``ir-mem-regression``; shrink is
+    P2), lowerings with no blessed record (``ir-mem-unbudgeted``),
+    analytic-vs-compiled disagreement past ``MODEL_TOLERANCE``
+    (``ir-mem-model-drift``), compile failures, and stale rows.
+
+    ``skipped`` names lowerings this run could not audit — the device
+    skip-list AND the memory_analysis-unavailable degrade list; their
+    blessed rows are NOT stale."""
+    entries = budgets.get("entries", {})
+    if tolerance is None:
+        tolerance = float(budgets.get("tolerance", DEFAULT_TOLERANCE))
+    model_tol = float(budgets.get("model_tolerance", MODEL_TOLERANCE))
+    skip = set(skipped or ()) | set(mem_skipped(records))
+    out: List[Finding] = []
+    for name, rec in sorted(records.items()):
+        if rec.get("skipped") == MEM_UNAVAILABLE:
+            continue
+        if "error" in rec:
+            out.append(_mem_finding(
+                "ir-mem-regression", name,
+                f"lowering failed to AOT-compile: {rec['error']} — the "
+                "memory gate is off for it", "P1"))
+            continue
+        ratio = rec.get("model_ratio")
+        if ratio is not None and abs(ratio - 1.0) > model_tol:
+            out.append(_mem_finding(
+                "ir-mem-model-drift", name,
+                f"analytic liveness walk disagrees with "
+                f"memory_analysis() by {ratio:.2f}x on interface bytes "
+                f"(tolerance {model_tol:.0%}) — the capacity planner's "
+                "closed-form extrapolation is untrustworthy for this "
+                "entry; fix the model (analysis/ir/memory.py) or explain "
+                "the compiled-side change", "P2"))
+        budget = entries.get(name)
+        if budget is None:
+            out.append(_mem_finding(
+                "ir-mem-unbudgeted", name,
+                "new lowering with no blessed memory budget — run "
+                "`graftaudit --write-membudgets` and commit "
+                "membudgets.json", "P1"))
+            continue
+        if "error" in budget or "compiled" not in budget:
+            out.append(_mem_finding(
+                "ir-mem-regression", name,
+                "blessed memory budget is a compile-error record — no "
+                "bytes to ratchet against; re-bless with "
+                "--write-membudgets once the lowering compiles", "P1"))
+            continue
+        have = rec["compiled"].get("peak", 0)
+        want = budget["compiled"].get("peak", 0)
+        if want > 0:
+            r = float(have) / float(want)  # graftlint: ignore[host-sync-in-loop] -- budget JSON values, plain Python ints on the host
+            if r > 1.0 + tolerance:
+                out.append(_mem_finding(
+                    "ir-mem-regression", name,
+                    f"compiled peak memory grew {r:.2f}x over budget "
+                    f"({have} vs {want} bytes, tolerance "
+                    f"{tolerance:.0%}) — explain the regression or bless "
+                    "it with --write-membudgets", "P1"))
+            elif r < 1.0 - tolerance:
+                out.append(_mem_finding(
+                    "ir-mem-regression", name,
+                    f"compiled peak memory shrank to {r:.2f}x of budget "
+                    f"({have} vs {want} bytes) — nice, but bless it "
+                    "(--write-membudgets) so the ratchet holds the new "
+                    "level", "P2"))
+    stale = sorted(set(entries) - set(records) - skip)
+    for name in stale:
+        out.append(_mem_finding(
+            "ir-mem-regression", name,
+            f"memory budget entry for a lowering the registry no longer "
+            f"produces (shape-class {_class_of(name)}) — regenerate "
+            "membudgets.json (--write-membudgets) so the file matches "
+            "HEAD", "P2"))
+    return sorted(out)

@@ -34,8 +34,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Lowering", "Trace", "all_lowerings", "shape_class",
-           "trace_lowering", "signature_text", "COLLECTIVE_PRIMS"]
+__all__ = ["Lowering", "Trace", "all_lowerings", "zoo_at", "shape_class",
+           "parse_shape_class", "trace_lowering", "signature_text",
+           "COLLECTIVE_PRIMS"]
 
 #: Cross-device primitives the census tracks, with the per-occurrence ICI
 #: byte model: bytes moved ≈ operand_bytes × factor(S) on an S-way ring —
@@ -93,7 +94,25 @@ class Trace:
 
 # ------------------------------------------------------------ shape-classes
 
-_GRAPH_CACHE: Dict[str, object] = {}
+# Bounded by construction: keys come from parse_shape_class (the two
+# canonical audit classes plus the handful of scaled fit points the
+# capacity planner traces), each a one-time host graph build.
+_GRAPH_CACHE: Dict[str, object] = {}  # graftlint: ignore[unbounded-cache] -- keyed on the finite shape-class vocabulary (2 audit classes + capacity fit points), not on user input
+
+
+def parse_shape_class(name: str) -> Tuple[str, int]:
+    """``(family, n_nodes)`` of a shape-class name: ``ws1k`` -> ("ws",
+    1024), ``ba256`` -> ("ba", 256). The canonical audit classes are
+    ``ws1k``/``ba1k``; scaled siblings (``ws256``, ``ws512``, ...) exist
+    so the capacity planner can trace one lowering at several shape
+    points and fit its memory model — same generators, same seed, only
+    the node count moves."""
+    import re
+
+    m = re.fullmatch(r"(ws|ba)(\d+)(k?)", name)
+    if not m:
+        raise ValueError(f"unknown shape-class {name!r}")
+    return m.group(1), int(m.group(2)) * (1024 if m.group(3) else 1)
 
 
 def shape_class(name: str):
@@ -102,18 +121,17 @@ def shape_class(name: str):
     if g is None:
         from p2pnetwork_tpu.sim import graph as G
 
-        if name == "ws1k":
+        family, n = parse_shape_class(name)
+        if family == "ws":
             # Quasi-regular small-world: `auto` routes to gather; carries
             # every single-chip representation the zoo lowers through.
-            g = G.watts_strogatz(1024, 6, 0.2, seed=0, blocked=True,
+            g = G.watts_strogatz(n, 6, 0.2, seed=0, blocked=True,
                                  skew_table=True, source_csr=True)
-        elif name == "ba1k":
+        else:
             # Degree-skewed scale-free: the skew table's home class
             # (`auto` routes to skew once the gather waste bound trips).
-            g = G.barabasi_albert(1024, 3, seed=0, skew_table=True,
+            g = G.barabasi_albert(n, 3, seed=0, skew_table=True,
                                   source_csr=True)
-        else:
-            raise ValueError(f"unknown shape-class {name!r}")
         _GRAPH_CACHE[name] = g
     return g
 
@@ -505,6 +523,64 @@ def _sharded_cov_entry(cls: str) -> Lowering:
                     parity=False, needs_devices=8)
 
 
+def zoo_at(ws: str = "ws1k", ba: str = "ba1k") -> List[Lowering]:
+    """The registry's entry set built against arbitrary shape-classes —
+    ``all_lowerings()`` is ``zoo_at()`` at the canonical audit classes;
+    the capacity planner calls it at scaled siblings (``ws256``, ...) to
+    trace the same programs at several shape points."""
+    entries: List[Lowering] = []
+    for v in ("segment", "gather", "blocked", "skew", "frontier"):
+        entries.append(_kernel_entry("or", v, ws, dtype=bool))
+    for v in ("segment", "gather", "blocked", "skew"):
+        entries.append(_kernel_entry("sum", v, ws, dtype=float))
+    for v in ("segment", "gather", "skew", "frontier"):
+        entries.append(_kernel_entry("max", v, ws, dtype=float))
+    for v in ("segment", "gather", "skew", "frontier"):
+        entries.append(_kernel_entry("minplus", v, ws, dtype=float))
+    entries.append(_flood_step_entry("dense", ws))
+    entries.append(_flood_step_entry("bitset", ws))
+    # The lane-packed batched kernels (32 messages per word) and the
+    # batched engine loop — the message plane's compiled surface.
+    for v in ("segment", "gather", "frontier"):
+        entries.append(_lanes_kernel_entry(v, ws))
+    # The non-boolean query-lane kernels (f32/i32 lane carriers,
+    # ops/lanes.py) and the batched query engine loop — PR 14's
+    # compiled surface. The gather/segment pairs are parity groups on
+    # ws1k; ba1k registers the auto-dispatch answer there (the gather
+    # waste bound trips, no skew lane form exists -> segment).
+    for v in ("gather", "segment"):
+        entries.append(_query_lanes_entry("minplus_lanes", v, ws))
+        entries.append(_query_lanes_entry("sum_lanes", v, ws))
+    entries.append(_dht_hop_entry(ws))
+    entries.append(_engine_query_entry(ws))
+    entries.append(_engine_cov_entry(ws))
+    entries.append(_engine_batch_cov_entry(ws))
+    # The graftscope flight-recorder twins of the engine loops: same
+    # programs plus one ring-row write per round, censused so recorder
+    # overhead stays visible in the cost ratchet.
+    entries.append(_engine_cov_rec_entry(ws))
+    entries.append(_engine_batch_cov_rec_entry(ws))
+    entries.append(_sharded_cov_entry(ws))
+    # The halo-exchange seam: ppermute vs pallas ring DMAs as
+    # signature-parity peers, plus the lane-word halo programs the
+    # batched plane rides multi-chip.
+    entries.append(_ring_step_entry("ppermute", ws))
+    entries.append(_ring_step_entry("pallas", ws))
+    entries.append(_sharded_or_lanes_entry(ws))
+    entries.append(_sharded_batch_cov_entry(ws))
+    # The degree-skewed class: the three lowerings whose crossover the
+    # routing actually arbitrates there (segment vs skew vs frontier) —
+    # and the batched kernels' own arbitrated pair (lanes-auto routes to
+    # segment on skewed tables; frontier shares the compaction budget).
+    for v in ("segment", "skew", "frontier"):
+        entries.append(_kernel_entry("or", v, ba, dtype=bool))
+    for v in ("segment", "frontier"):
+        entries.append(_lanes_kernel_entry(v, ba))
+    for op in ("minplus_lanes", "sum_lanes"):
+        entries.append(_query_lanes_entry(op, "segment", ba))
+    return entries
+
+
 def all_lowerings() -> List[Lowering]:
     """The full registry, parity-grouped by ``(op, shape_class)``.
 
@@ -514,57 +590,7 @@ def all_lowerings() -> List[Lowering]:
     do not lower on the CPU backend — and are audited at the source level
     by graftlint instead.
     """
-    entries: List[Lowering] = []
-    for v in ("segment", "gather", "blocked", "skew", "frontier"):
-        entries.append(_kernel_entry("or", v, "ws1k", dtype=bool))
-    for v in ("segment", "gather", "blocked", "skew"):
-        entries.append(_kernel_entry("sum", v, "ws1k", dtype=float))
-    for v in ("segment", "gather", "skew", "frontier"):
-        entries.append(_kernel_entry("max", v, "ws1k", dtype=float))
-    for v in ("segment", "gather", "skew", "frontier"):
-        entries.append(_kernel_entry("minplus", v, "ws1k", dtype=float))
-    entries.append(_flood_step_entry("dense", "ws1k"))
-    entries.append(_flood_step_entry("bitset", "ws1k"))
-    # The lane-packed batched kernels (32 messages per word) and the
-    # batched engine loop — the message plane's compiled surface.
-    for v in ("segment", "gather", "frontier"):
-        entries.append(_lanes_kernel_entry(v, "ws1k"))
-    # The non-boolean query-lane kernels (f32/i32 lane carriers,
-    # ops/lanes.py) and the batched query engine loop — PR 14's
-    # compiled surface. The gather/segment pairs are parity groups on
-    # ws1k; ba1k registers the auto-dispatch answer there (the gather
-    # waste bound trips, no skew lane form exists -> segment).
-    for v in ("gather", "segment"):
-        entries.append(_query_lanes_entry("minplus_lanes", v, "ws1k"))
-        entries.append(_query_lanes_entry("sum_lanes", v, "ws1k"))
-    entries.append(_dht_hop_entry("ws1k"))
-    entries.append(_engine_query_entry("ws1k"))
-    entries.append(_engine_cov_entry("ws1k"))
-    entries.append(_engine_batch_cov_entry("ws1k"))
-    # The graftscope flight-recorder twins of the engine loops: same
-    # programs plus one ring-row write per round, censused so recorder
-    # overhead stays visible in the cost ratchet.
-    entries.append(_engine_cov_rec_entry("ws1k"))
-    entries.append(_engine_batch_cov_rec_entry("ws1k"))
-    entries.append(_sharded_cov_entry("ws1k"))
-    # The halo-exchange seam: ppermute vs pallas ring DMAs as
-    # signature-parity peers, plus the lane-word halo programs the
-    # batched plane rides multi-chip.
-    entries.append(_ring_step_entry("ppermute", "ws1k"))
-    entries.append(_ring_step_entry("pallas", "ws1k"))
-    entries.append(_sharded_or_lanes_entry("ws1k"))
-    entries.append(_sharded_batch_cov_entry("ws1k"))
-    # The degree-skewed class: the three lowerings whose crossover the
-    # routing actually arbitrates there (segment vs skew vs frontier) —
-    # and the batched kernels' own arbitrated pair (lanes-auto routes to
-    # segment on skewed tables; frontier shares the compaction budget).
-    for v in ("segment", "skew", "frontier"):
-        entries.append(_kernel_entry("or", v, "ba1k", dtype=bool))
-    for v in ("segment", "frontier"):
-        entries.append(_lanes_kernel_entry(v, "ba1k"))
-    for op in ("minplus_lanes", "sum_lanes"):
-        entries.append(_query_lanes_entry(op, "segment", "ba1k"))
-    return entries
+    return zoo_at("ws1k", "ba1k")
 
 
 # ----------------------------------------------------------------- tracing

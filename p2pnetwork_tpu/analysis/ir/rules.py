@@ -62,7 +62,7 @@ class IRRule:
     run: Callable[[Trace], Iterable[str]]
 
 
-_IR_RULES: Dict[str, IRRule] = {}
+_IR_RULES: Dict[str, IRRule] = {}  # graftlint: ignore[unbounded-cache] -- rule registry populated once at import by @_register, fixed vocabulary
 
 
 def _register(id: str, severity: str, doc: str):

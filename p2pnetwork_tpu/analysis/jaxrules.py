@@ -39,6 +39,11 @@ rule                      sev    fires on
 ``carry-no-donate``       P2     a jitted function carrying a ``lax`` loop
                                  whose jit wrapper donates nothing — the carry
                                  is double-buffered for the whole run
+``unbounded-cache``       P2     a module/class-level dict cache written
+                                 inside a function with no eviction anywhere
+                                 in the module — every distinct key resident
+                                 forever (host memory, and for compiled-
+                                 artifact caches, a compile per key)
 ========================  =====  ==============================================
 
 Detection is deliberately syntactic (stdlib ``ast``; no jax import, no type
@@ -554,3 +559,102 @@ def rule_carry_no_donate(module: Module) -> Iterable[Tuple[ast.AST, str]]:
                          "arguments — pass donate_argnums/donate_argnames "
                          "for the carry (or suppress where double-buffering "
                          "is the documented contract)")
+
+
+@register_rule(
+    "unbounded-cache", "P2",
+    "A module/class-level dict cache written inside a function with no "
+    "eviction anywhere in the module: every distinct key stays resident "
+    "for the process lifetime — memoization that looks free until the "
+    "key space turns out to be user-shaped.")
+def rule_unbounded_cache(module: Module) -> Iterable[Tuple[ast.AST, str]]:
+    # The _rec_ici_round_bytes pattern: `_CACHE: dict = {}` at module (or
+    # class) scope, `_CACHE[key] = build(...)` inside a function, nothing
+    # anywhere that ever removes an entry. Deliberately bounded caches
+    # (finite key vocabulary) suppress with the rationale on the
+    # DECLARATION line — that is where the finding anchors.
+
+    def _empty_dict(value: Optional[ast.AST]) -> bool:
+        if isinstance(value, ast.Dict) and not value.keys:
+            return True
+        return (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "dict"
+                and not value.args and not value.keywords)
+
+    def _decl_of(body: Sequence[ast.stmt]) -> Iterable[Tuple[str, ast.AST]]:
+        for stmt in body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and _empty_dict(stmt.value)):
+                yield stmt.targets[0].id, stmt
+            elif (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and _empty_dict(stmt.value)):
+                yield stmt.target.id, stmt
+
+    caches: Dict[str, ast.AST] = dict(_decl_of(module.tree.body))
+    for cls in ast.walk(module.tree):
+        if isinstance(cls, ast.ClassDef):
+            # A class-body dict is ONE shared mapping per class —
+            # self._cache[k] = v from any instance grows it globally.
+            caches.update(_decl_of(cls.body))
+    if not caches:
+        return
+
+    def _base(expr: ast.AST) -> Optional[str]:
+        """The cache a subscript/method target names: bare ``NAME`` or
+        the shared class dict through ``self``/``cls``."""
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls")):
+            return expr.attr
+        return None
+
+    evicted: Set[str] = set()
+    writes: Dict[str, Tuple[str, int]] = {}  # cache -> (fn, write count)
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = _base(tgt.value)
+                        if name in caches:
+                            evicted.add(name)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                name = _base(node.func.value)
+                if name in caches:
+                    if node.func.attr in ("pop", "popitem", "clear"):
+                        evicted.add(name)
+                    elif node.func.attr == "setdefault" \
+                            and len(node.args) >= 2:
+                        had = writes.get(name, (fn.name, 0))
+                        writes[name] = (had[0], had[1] + 1)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        name = _base(tgt.value)
+                        if name in caches:
+                            had = writes.get(name, (fn.name, 0))
+                            writes[name] = (had[0], had[1] + 1)
+                    elif isinstance(tgt, ast.Name) and tgt.id in caches:
+                        # A function-scope rebind (`CACHE = {}`) resets
+                        # the mapping — eviction by replacement.
+                        evicted.add(tgt.id)
+
+    for name, (fn_name, count) in sorted(writes.items()):
+        if name in evicted:
+            continue
+        more = f" (and {count - 1} more site(s))" if count > 1 else ""
+        yield caches[name], (
+            f"dict cache `{name}` grows inside `{fn_name}`{more} with no "
+            "eviction anywhere in the module — bound it (maxsize + "
+            "pop/clear, or functools.lru_cache) or suppress here with "
+            "the rationale for why its key space is finite")

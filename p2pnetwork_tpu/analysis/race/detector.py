@@ -256,7 +256,7 @@ def _report_access(var: str, is_write: bool) -> None:
 # ----------------------------------------------------------------- watch
 
 #: Parsed-module cache for guarded-attribute inference (keyed by file).
-_module_cache: Dict[str, Optional[Module]] = {}
+_module_cache: Dict[str, Optional[Module]] = {}  # graftlint: ignore[unbounded-cache] -- keyed by source file path; bounded by the finite set of modules the process imports
 
 
 def _module_for(cls: type) -> Optional[Module]:

@@ -54,7 +54,7 @@ class _Scenario(NamedTuple):
 #: name -> scenario. Builtins are the CI battery; externally registered
 #: scenarios (``--scenarios-from``, test fixtures) join the registry but
 #: not the default gate.
-SCENARIOS: Dict[str, _Scenario] = {}
+SCENARIOS: Dict[str, _Scenario] = {}  # graftlint: ignore[unbounded-cache] -- scenario registry: builtins at import plus explicit --scenarios-from registrations, not per-request growth
 
 
 def scenario(name: str, doc: str, *, builtin: bool = True):

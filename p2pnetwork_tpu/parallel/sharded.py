@@ -1618,7 +1618,7 @@ def _flood_cov_fn(mesh: Mesh, axis_name: str, S: int, block: int,
 #: ``ici_bytes`` column, keyed on the compiled-shape config — the commviz
 #: census is an abstract trace (tens of ms), not something to pay per
 #: recorded run.
-_REC_ICI_CACHE: dict = {}
+_REC_ICI_CACHE: dict = {}  # graftlint: ignore[unbounded-cache] -- keyed on compiled-shape config; one entry per distinct (ws, ba, shards) lowering, a finite vocabulary per process
 
 
 def _rec_ici_round_bytes(key: tuple, build) -> int:

@@ -16,6 +16,7 @@ makes "heavy traffic" a reproducible workload. See GETTING_STARTED.md
 
 from p2pnetwork_tpu.serve.service import (
     GraphMismatch,
+    MemoryBudgetExceeded,
     QueueFull,
     QuotaExceeded,
     Rejected,
@@ -32,6 +33,7 @@ from p2pnetwork_tpu.serve.traffic import (
 
 __all__ = [
     "GraphMismatch",
+    "MemoryBudgetExceeded",
     "QueueFull",
     "QuotaExceeded",
     "Rejected",

@@ -79,6 +79,7 @@ from p2pnetwork_tpu.telemetry import spans
 
 __all__ = [
     "SimService", "Rejected", "QueueFull", "QuotaExceeded",
+    "MemoryBudgetExceeded",
     "ServiceClosed", "GraphMismatch", "TERMINAL_STATES", "TICK_PHASES",
     "ticket_trace",
 ]
@@ -186,6 +187,16 @@ class QuotaExceeded(Rejected):
     """The tenant's token bucket is empty this tick."""
 
     reason = "quota"
+
+
+class MemoryBudgetExceeded(Rejected):
+    """The graftmem capacity plan prices this admission (or growth) past
+    the service's stated ``hbm_budget_bytes`` — refused up front with
+    the planned numbers, never an OOM mid-tick. The plan comes from the
+    checked-in ``membudgets.json`` capacity coefficients
+    (analysis/ir/capacity.py), so the check is pure host arithmetic."""
+
+    reason = "memory_budget"
 
 
 class ServiceClosed(RuntimeError):
@@ -316,6 +327,7 @@ class SimService:
                  deadline_s: Optional[float] = None,
                  on_stall: Union[str, Callable] = "raise",
                  idle_wait_s: float = 0.05,
+                 hbm_budget_bytes: Optional[float] = None,
                  registry: Optional[telemetry.Registry] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
@@ -363,6 +375,39 @@ class SimService:
                 raise ValueError("slo_rounds must be > 0 (None disables "
                                  "the AIMD controller)")
         self.slo_rounds = slo_rounds
+        # Capacity-plan admission gate (graftmem): price the serving
+        # program's per-chip footprint from the checked-in closed-form
+        # coefficients, and refuse submits/grows that would plan past
+        # the stated budget — the typed-429 alternative to an OOM
+        # mid-tick. `is not None` again: 0 must be a loud error.
+        if hbm_budget_bytes is not None:
+            hbm_budget_bytes = float(hbm_budget_bytes)
+            if hbm_budget_bytes <= 0:
+                raise ValueError("hbm_budget_bytes must be > 0 (None "
+                                 "disables the memory-budget gate)")
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._cap_model: Optional[dict] = None
+        if hbm_budget_bytes is not None:
+            from p2pnetwork_tpu.analysis.ir import memory as _graftmem
+
+            # Loaded once — the admission path must stay pure host
+            # arithmetic, not a JSON read per submit.
+            self._cap_model = _graftmem.load_membudgets().get(
+                "capacity_model")
+            planned = self._planned_footprint_bytes(graph.n_nodes_padded)
+            if planned is None:
+                raise ValueError(
+                    "hbm_budget_bytes is set but no capacity model is "
+                    "available (membudgets.json lacks `capacity_model`) "
+                    "— bless one with `graftaudit --write-membudgets` or "
+                    "drop the knob")
+            if planned > hbm_budget_bytes:
+                # Construction over budget is operator error, not load —
+                # a shed here would reject every submit forever.
+                raise ValueError(
+                    f"graph plans {planned} bytes/chip at construction, "
+                    f"over hbm_budget_bytes={int(hbm_budget_bytes)} — "
+                    "shard the overlay or raise the budget")
         self._record_seen_hash = bool(record_seen_hash)
         self.idle_wait_s = float(idle_wait_s)
         self.deadline_s = deadline_s
@@ -454,7 +499,9 @@ class SimService:
             "serve_rejected_total",
             "Submissions load-shed by the serving front-end, by reason "
             "(queue_full = lanes busy and the bounded FIFO at depth; "
-            "quota = tenant token bucket empty this tick).", ("reason",))
+            "quota = tenant token bucket empty this tick; memory_budget "
+            "= the graftmem capacity plan prices the footprint past "
+            "hbm_budget_bytes).", ("reason",))
         self._m_completed = reg.counter(
             "serve_completed_total",
             "Tickets whose broadcast reached its coverage target.")
@@ -652,6 +699,31 @@ class SimService:
             self._mutations.append(("delta", delta))
             self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
 
+    def _planned_footprint_bytes(self, n_padded: int) -> Optional[int]:
+        """Per-chip planned HBM bytes of the serving program at a node
+        capacity (graftmem closed form: checked-in coefficients, pure
+        host arithmetic). None when no capacity model is available —
+        only reachable with the gate disabled, since construction
+        refuses the knob without a model."""
+        from p2pnetwork_tpu.analysis.ir import capacity as _capacity
+
+        lane_words = -(-self.capacity // 32)
+        return _capacity.serving_footprint_bytes(
+            int(n_padded), int(self.graph.n_edges_padded), lane_words,
+            model=self._cap_model)
+
+    def _planned_capacity_nodes(self, extra_nodes: int = 0) -> int:
+        """Padded node capacity once every QUEUED grow (plus
+        ``extra_nodes``) lands — the geometric repad schedule
+        (graph.growth_capacity) applied to the pending demand. Caller
+        holds ``self._cond`` (reads ``_mutations``)."""
+        demand = self.graph.n_nodes + int(extra_nodes) + sum(
+            p for k, p in self._mutations if k == "grow")  # graftlint: ignore[lock-guard] -- caller holds self._cond (documented contract above)
+        current = self.graph.n_nodes_padded
+        if demand <= current:
+            return current
+        return graph_mod.growth_capacity(demand, current)
+
     def grow(self, n_new_nodes: int) -> None:
         """Queue live overlay growth: ``n_new_nodes`` fresh live nodes
         (ids continuing from the current count) join at the next tick's
@@ -666,11 +738,37 @@ class SimService:
         n_new_nodes = int(n_new_nodes)
         if n_new_nodes < 0:
             raise ValueError("n_new_nodes must be >= 0")
+        reject: Optional[Rejected] = None
         with self._cond:
             if self._closed:
                 raise ServiceClosed(self._driver_error or "service is closed")
-            self._mutations.append(("grow", n_new_nodes))
-            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+            if self.hbm_budget_bytes is not None:
+                planned_cap = self._planned_capacity_nodes(n_new_nodes)
+                planned = self._planned_footprint_bytes(planned_cap)
+                if planned is not None and planned > self.hbm_budget_bytes:
+                    # Refused BEFORE the mutation queues: a growth the
+                    # plan prices over budget must never reach the
+                    # driver's mutate phase, where the repad would OOM
+                    # mid-tick instead of 429-ing here.
+                    reject = MemoryBudgetExceeded(
+                        f"growth to {planned_cap} padded nodes plans "
+                        f"{planned} bytes/chip, over hbm_budget_bytes="
+                        f"{int(self.hbm_budget_bytes)} — shard or raise "
+                        "the budget",
+                        planned_bytes=int(planned),
+                        hbm_budget_bytes=int(self.hbm_budget_bytes),
+                        planned_capacity=int(planned_cap))
+            if reject is None:
+                self._mutations.append(("grow", n_new_nodes))
+                self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        if reject is not None:
+            with self._cond:
+                self._counts["rejected"] += 1
+                self._dirty = True  # shed counts survive resume too
+            self._m_rejected.labels(reject.reason).inc()
+            if self._slo is not None:
+                self._slo.record("shed", 1.0)
+            raise reject
 
     # ---------------------------------------------------------- request API
 
@@ -681,9 +779,12 @@ class SimService:
         Sheds instead of erroring when the service is saturated: every
         lane busy and the FIFO at ``queue_depth`` raises
         :class:`QueueFull`; an empty tenant token bucket raises
-        :class:`QuotaExceeded` — both carry the backpressure numbers and
-        count into ``serve_rejected_total{reason}``. A bad ``source`` is
-        a caller error (plain ``ValueError``), not a shed."""
+        :class:`QuotaExceeded`; a planned footprint past
+        ``hbm_budget_bytes`` (pending growth included) raises
+        :class:`MemoryBudgetExceeded` — all carry the backpressure
+        numbers and count into ``serve_rejected_total{reason}``. A bad
+        ``source`` is a caller error (plain ``ValueError``), not a
+        shed."""
         source = int(source)
         if not 0 <= source < self.graph.n_nodes_padded:
             raise ValueError(
@@ -706,7 +807,21 @@ class SimService:
             if self._closed:
                 raise ServiceClosed(
                     self._driver_error or "service is closed")
-            if tenant in self._quotas and self._buckets.get(tenant, 0.0) < 1.0:
+            planned = None
+            if self.hbm_budget_bytes is not None:
+                planned = self._planned_footprint_bytes(
+                    self._planned_capacity_nodes())
+            if planned is not None and planned > self.hbm_budget_bytes:
+                # The service is over-plan (queued growth will repad past
+                # the budget): stop taking load before the repad lands.
+                reject = MemoryBudgetExceeded(
+                    f"planned footprint {planned} bytes/chip over "
+                    f"hbm_budget_bytes={int(self.hbm_budget_bytes)} "
+                    "(pending growth repads past the plan) — back off",
+                    planned_bytes=int(planned),
+                    hbm_budget_bytes=int(self.hbm_budget_bytes),
+                    planned_capacity=int(self._planned_capacity_nodes()))
+            elif tenant in self._quotas and self._buckets.get(tenant, 0.0) < 1.0:
                 reject = QuotaExceeded(
                     f"tenant {tenant!r} out of quota this tick "
                     f"(refills at the next driver tick)",

@@ -114,6 +114,13 @@ def pytest_configure(config):
         "replay, seeded churn storms, and the slow-marked 100k "
         "churn-under-chaos soak (select with -m churn; part of the "
         "default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "mem: graftmem static memory plane tests — analytic liveness "
+        "walk vs memory_analysis() parity, membudgets ratchet "
+        "arithmetic, capacity-planner extrapolation, SimService "
+        "hbm_budget_bytes admission gate (select with -m mem; part of "
+        "the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

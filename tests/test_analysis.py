@@ -363,6 +363,53 @@ class TestJaxRules:
         """
         assert not only(lint(src), "carry-no-donate")
 
+    def test_unbounded_cache_fires_at_declaration(self):
+        # The finding anchors at the declaration line so the
+        # suppress-with-rationale lives where the cache is defined,
+        # not at every write site.
+        src = """
+            _CACHE = {}  # HOT
+
+            def lookup(key, build):
+                if key not in _CACHE:
+                    _CACHE[key] = build(key)
+                return _CACHE[key]
+
+            def warm(keys, build):
+                for k in keys:
+                    _CACHE.setdefault(k, build(k))
+        """
+        findings = assert_fires(src, "unbounded-cache", "HOT")
+        assert "_CACHE" in findings[0].message
+        assert "lookup" in findings[0].message
+
+    def test_unbounded_cache_class_attr_fires(self):
+        src = """
+            class Planner:
+                _memo = {}  # HOT
+
+                def plan(self, key):
+                    self._memo[key] = key * 2
+                    return self._memo[key]
+        """
+        assert_fires(src, "unbounded-cache", "HOT")
+
+    def test_bounded_cache_is_clean(self):
+        # Any eviction anywhere in the module (pop/clear/del/rebind)
+        # marks the dict as bounded.
+        src = """
+            _CACHE = {}
+
+            def lookup(key, build):
+                if len(_CACHE) > 128:
+                    _CACHE.clear()
+                _CACHE[key] = build(key)
+                return _CACHE[key]
+
+            _PLAIN = {}  # written nowhere: data, not a cache
+        """
+        assert not only(lint(src), "unbounded-cache")
+
 
 # ============================================= concurrency rule fixtures
 
@@ -859,7 +906,7 @@ class TestEngine:
         expected = {
             "jit-in-loop", "jit-immediate-call", "host-sync-in-loop",
             "tracer-branch", "jit-static-array", "jit-closure-ndarray",
-            "f64-literal", "carry-no-donate",
+            "f64-literal", "carry-no-donate", "unbounded-cache",
             "lock-order-cycle", "lock-across-await", "blocking-under-lock",
             "async-blocking-call", "lock-guard", "lock-open-call",
             "wait-untimed", "raw-concurrency-primitive",

@@ -245,6 +245,31 @@ class TestStageTelemetry:
             assert model["entries"]["or/frontier@ws1k"]["flops"] > 0
             assert "cov/flood-ppermute@ws1k" in model["entries"]
 
+    def test_memory_slice_published_with_device_stats(self, first_run):
+        # The graftmem slice (schema-pinned): the static capacity plan
+        # from the checked-in membudgets coefficients beside the live
+        # `device_memory_stats` snapshot. On the CPU backend the
+        # allocator stats are honestly unavailable (per-device stats:
+        # None, available: False) — never missing, never a crash.
+        cache, _, _ = first_run
+        for fname, nodes in (("BENCH_TELEMETRY.json", 1_000_000),
+                             ("BENCH_TELEMETRY_10M.json", 10_000_000)):
+            tel = json.loads((cache / fname).read_text())
+            mem = tel["memory"]
+            dms = mem["device_memory_stats"]
+            assert isinstance(dms["available"], bool)
+            assert dms["devices"], "no per-device rows"
+            for row in dms["devices"]:
+                assert set(row) == {"id", "platform", "stats"}
+                if not dms["available"]:
+                    assert row["stats"] is None
+            plan = mem["plan"]
+            assert "error" not in plan, plan
+            assert plan["n_nodes"] == nodes
+            assert plan["n_pad"] % 128 == 0
+            assert plan["lane_words"] == 313
+            assert plan["global_bytes"] > 0
+
     def test_batched_column_published_with_p99(self, first_run):
         # The batched message-plane column (ROADMAP 2a) lands in the 1M
         # stage artifact: B in-flight floods per compiled program, the

@@ -1,0 +1,428 @@
+"""graftmem (analysis/ir/memory.py + capacity.py + the serve gate) tests.
+
+Three layers, mirroring test_iraudit.py's contract for the cost ratchet:
+
+- **model fixtures** — the analytic liveness walk priced against
+  ``Compiled.memory_analysis()`` on deliberately simple programs
+  (pruned arguments, donation credit, folded constants), plus the
+  degrade path: a backend without ``memory_analysis()`` lands on the
+  skip-list loudly and can never bless;
+- **machinery** — membudgets round-trip, ratchet arithmetic (peak
+  growth fails P1, shrink asks for a re-bless, model drift is P2,
+  stale rows name their shape-class), capacity-plan evaluation and its
+  failure modes;
+- **the live tree** — the checked-in membudgets.json must cover every
+  registry entry with analytic-vs-compiled parity inside the model
+  tolerance, the checked-in capacity model must price the north-star
+  serving shape, and SimService's ``hbm_budget_bytes`` knob must shed
+  over-plan admissions as a typed 429, never queue them.
+"""
+
+import copy
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from p2pnetwork_tpu import telemetry  # noqa: E402
+from p2pnetwork_tpu.analysis.ir import capacity as C  # noqa: E402
+from p2pnetwork_tpu.analysis.ir import memory as M  # noqa: E402
+from p2pnetwork_tpu.analysis.ir import registry  # noqa: E402
+from p2pnetwork_tpu.analysis.ir.registry import Lowering  # noqa: E402
+from p2pnetwork_tpu.serve import MemoryBudgetExceeded, SimService  # noqa: E402
+from p2pnetwork_tpu.sim import graph as G  # noqa: E402
+from p2pnetwork_tpu.telemetry.httpd import MetricsServer  # noqa: E402
+
+pytestmark = pytest.mark.mem
+
+
+def _entry(name, build, **kw):
+    op, rest = name.split("/", 1)
+    variant, cls = rest.split("@", 1)
+    kw.setdefault("parity", False)
+    return Lowering(name=name, op=op, variant=variant, shape_class=cls,
+                    build=build, **kw)
+
+
+def _collect_one(entry):
+    return M.collect_memory([registry.trace_lowering(entry)])[entry.name]
+
+
+def _rec(argument=600, output=300, temp=150, alias=50, ratio=1.0):
+    """A synthetic memory record for ratchet-arithmetic tests — no
+    compile needed to exercise check_membudgets."""
+    comp = {"argument": argument, "output": output, "temp": temp,
+            "alias": alias, "peak": argument + output + temp - alias}
+    ana = {"argument": argument, "output": output, "const": 0,
+           "temp": temp, "alias": alias,
+           "interface": argument + output - alias}
+    return {"compiled": comp, "analytic": ana, "model_ratio": ratio}
+
+
+# ------------------------------------------------------- analytic walk
+
+
+class TestAnalyticWalk:
+    def test_interface_matches_compiled_on_simple_program(self):
+        x = jnp.zeros(1024, jnp.float32)
+        e = _entry("or/simple@ws1k", lambda: (lambda a: a * 2.0 + 1.0, (x,)))
+        rec = _collect_one(e)
+        assert rec["analytic"]["argument"] == 4096
+        assert rec["analytic"]["argument"] == rec["compiled"]["argument"]
+        assert rec["analytic"]["output"] == rec["compiled"]["output"]
+        assert rec["model_ratio"] == 1.0
+        assert rec["compiled"]["peak"] > 0
+
+    def test_unused_arguments_are_pruned(self):
+        # jit drops parameters nothing reads before XLA prices them —
+        # the analytic walk must agree, or every partial-application
+        # lowering would drift.
+        x = jnp.zeros(1024, jnp.float32)
+        e = _entry("or/pruned@ws1k",
+                   lambda: ((lambda a, unused: a * 2.0), (x, x)))
+        rec = _collect_one(e)
+        assert rec["analytic"]["argument"] == 4096
+        assert rec["analytic"]["argument"] == rec["compiled"]["argument"]
+
+    def test_folded_constants_are_priced_separately(self):
+        # A closure-captured table becomes a jaxpr const: XLA folds it
+        # into the executable (absent from every memory_analysis
+        # bucket), so it must land in `const`, not `argument`.
+        table = jnp.arange(256, dtype=jnp.int32)
+        closed = jax.make_jaxpr(lambda a: a + table)(
+            jnp.zeros(256, jnp.int32))
+        ana = M.analytic_memory(closed)
+        assert ana["const"] == 1024
+        assert ana["argument"] == 1024
+
+    def test_alias_credit_and_shards_arithmetic(self):
+        closed = jax.make_jaxpr(lambda a: a + 1.0)(
+            jnp.zeros(1024, jnp.float32))
+        ana = M.analytic_memory(closed, alias_bytes=4096)
+        assert ana["alias"] == 4096
+        assert ana["interface"] == ana["argument"] + ana["output"] - 4096
+        # alias credit can never exceed the argument bytes it aliases
+        capped = M.analytic_memory(closed, alias_bytes=10**9)
+        assert capped["alias"] == capped["argument"]
+        # memory_analysis reports per-device bytes: shards divide
+        sharded = M.analytic_memory(closed, shards=4)
+        assert sharded["argument"] == ana["argument"] // 4
+
+
+# ------------------------------------------------------- degrade path
+
+
+class TestDegrade:
+    def _simple(self):
+        x = jnp.zeros(128, jnp.float32)
+        return _entry("or/degrade@ws1k", lambda: ((lambda a: a * 2.0), (x,)))
+
+    def test_memory_analysis_unavailable_is_a_loud_skip(self, monkeypatch):
+        monkeypatch.setattr(jax.stages.Compiled, "memory_analysis",
+                            lambda self: None)
+        e = self._simple()
+        recs = M.collect_memory([registry.trace_lowering(e)])
+        assert recs[e.name] == {"skipped": M.MEM_UNAVAILABLE}
+        assert M.mem_skipped(recs) == [e.name]
+        # skipped records gate nothing and do not read as stale
+        doc = {"entries": {e.name: _rec()}}
+        assert M.check_membudgets(recs, doc) == []
+
+    def test_write_membudgets_drops_skipped_entries(self, tmp_path):
+        # The reason the CLI refuses a degraded bless: the written file
+        # would silently lose the skipped rows and fail the next full
+        # run as "no blessed memory budget".
+        path = str(tmp_path / "m.json")
+        M.write_membudgets({"or/a@ws1k": _rec(),
+                            "or/b@ws1k": {"skipped": M.MEM_UNAVAILABLE}},
+                           path)
+        assert set(M.load_membudgets(path)["entries"]) == {"or/a@ws1k"}
+
+    def test_compile_failure_is_a_gated_error_record(self):
+        # Traces fine, then the memory pass's rebuild blows up — the
+        # failure must become a P1 finding, never a silent ungate.
+        calls = {"n": 0}
+        x = jnp.zeros(128, jnp.float32)
+
+        def build():
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise RuntimeError("backend cannot lower this entry")
+            return (lambda a: a * 2.0), (x,)
+
+        e = _entry("or/nocompile@ws1k", build)
+        recs = M.collect_memory([registry.trace_lowering(e)])
+        assert "error" in recs[e.name]
+        found = M.check_membudgets(recs, {"entries": {}})
+        assert any("failed to AOT-compile" in f.message
+                   and f.severity == "P1" for f in found)
+
+    def test_cli_degrades_loudly_and_refuses_bless(self, monkeypatch,
+                                                   tmp_path, capsys):
+        # Full cycle on a one-entry registry: bless clean, break
+        # memory_analysis(), and the gate must still pass (loud skip
+        # list on stderr) while --write-membudgets refuses.
+        from p2pnetwork_tpu.analysis.ir import __main__ as cli
+        from p2pnetwork_tpu.analysis.ir import budgets as B
+
+        e = self._simple()
+        monkeypatch.setattr(registry, "all_lowerings", lambda: [e])
+        monkeypatch.setattr(
+            C, "fit_capacity_model",
+            lambda recs=None: {"schema": C.CAPACITY_SCHEMA, "entries": {}})
+        bpath = str(tmp_path / "b.json")
+        mpath = str(tmp_path / "m.json")
+        assert cli.main(["--write-budgets", "--budgets", bpath]) == 0
+        assert cli.main(["--write-membudgets", "--membudgets", mpath,
+                         "--budgets", bpath]) == 0
+        assert e.name in M.load_membudgets(mpath)["entries"]
+        capsys.readouterr()
+        monkeypatch.setattr(jax.stages.Compiled, "memory_analysis",
+                            lambda self: None)
+        assert cli.main(["--budgets", bpath, "--membudgets", mpath]) == 0
+        err = capsys.readouterr().err
+        assert "memory plane degraded" in err and e.name in err
+        assert cli.main(["--write-membudgets", "--membudgets",
+                         str(tmp_path / "m2.json"),
+                         "--budgets", bpath]) == 2
+        assert "refusing --write-membudgets on a degraded run" in \
+            capsys.readouterr().err
+        assert not (tmp_path / "m2.json").exists()
+        del B  # imported for parity with the CLI's budget path
+
+
+# ----------------------------------------------------------- the ratchet
+
+
+class TestMemRatchet:
+    def test_round_trip(self, tmp_path):
+        recs = {"or/a@ws1k": _rec()}
+        path = M.write_membudgets(recs, str(tmp_path / "m.json"))
+        doc = M.load_membudgets(path)
+        assert doc["schema"] == M.SCHEMA
+        assert doc["tolerance"] == M.DEFAULT_TOLERANCE
+        assert M.check_membudgets(recs, doc) == []
+
+    def test_peak_growth_fails_and_shrink_asks_for_a_bless(self):
+        recs = {"or/a@ws1k": _rec()}
+        doc = {"entries": {"or/a@ws1k": _rec()}}
+        grown = copy.deepcopy(doc)
+        grown["entries"]["or/a@ws1k"]["compiled"]["peak"] = 100
+        found = M.check_membudgets(recs, grown)
+        assert found and found[0].rule == "ir-mem-regression"
+        assert found[0].severity == "P1" and "grew" in found[0].message
+        shrunk = copy.deepcopy(doc)
+        shrunk["entries"]["or/a@ws1k"]["compiled"]["peak"] = 10**6
+        found = M.check_membudgets(recs, shrunk)
+        assert found and found[0].severity == "P2"
+        assert "shrank" in found[0].message
+
+    def test_stored_tolerance_is_honored(self):
+        recs = {"or/a@ws1k": _rec()}
+        doc = {"tolerance": 0.5, "entries": {"or/a@ws1k": _rec()}}
+        doc["entries"]["or/a@ws1k"]["compiled"]["peak"] = \
+            int(recs["or/a@ws1k"]["compiled"]["peak"] / 1.4)
+        assert M.check_membudgets(recs, doc) == []
+        assert M.check_membudgets(recs, doc, tolerance=0.2) != []
+
+    def test_unbudgeted_lowering_is_P1(self):
+        found = M.check_membudgets({"or/new@ws1k": _rec()}, {"entries": {}})
+        assert found and found[0].rule == "ir-mem-unbudgeted"
+        assert found[0].severity == "P1"
+
+    def test_model_drift_is_P2(self):
+        recs = {"or/a@ws1k": _rec(ratio=1.5)}
+        doc = {"entries": {"or/a@ws1k": _rec(ratio=1.5)}}
+        found = [f for f in M.check_membudgets(recs, doc)
+                 if f.rule == "ir-mem-model-drift"]
+        assert found and found[0].severity == "P2"
+        assert "1.50x" in found[0].message
+
+    def test_stale_entry_names_the_shape_class(self):
+        doc = {"entries": {"or/ghost@ws1k": _rec()}}
+        found = M.check_membudgets({}, doc)
+        assert found and "no longer produces" in found[0].message
+        assert "shape-class ws1k" in found[0].message
+        # the device/mem skip-lists exempt their rows from staleness
+        assert M.check_membudgets({}, doc, skipped=["or/ghost@ws1k"]) == []
+
+    def test_blessed_error_record_is_a_finding_not_an_ungate(self):
+        recs = {"or/a@ws1k": _rec()}
+        doc = {"entries": {"or/a@ws1k": {"error": "RuntimeError: OOM"}}}
+        found = M.check_membudgets(recs, doc)
+        assert found and "compile-error record" in found[0].message
+
+
+# ------------------------------------------------------- the live tree
+
+
+class TestCheckedInMembudgets:
+    @pytest.fixture(scope="class")
+    def doc(self):
+        doc = M.load_membudgets()
+        assert doc, "analysis/ir/membudgets.json is missing"
+        return doc
+
+    def test_covers_every_registry_entry(self, doc):
+        names = {e.name for e in registry.all_lowerings()}
+        assert set(doc["entries"]) == names
+
+    def test_parity_within_model_tolerance_on_every_entry(self, doc):
+        # THE planner-trust gate: on every entry the analytic walk must
+        # agree with memory_analysis() to within the model tolerance,
+        # or capacity.plan's extrapolations are fiction.
+        tol = doc["model_tolerance"]
+        off = {n: rec.get("model_ratio")
+               for n, rec in doc["entries"].items()
+               if rec.get("model_ratio") is None
+               or abs(rec["model_ratio"] - 1.0) > tol}
+        assert off == {}
+
+    def test_live_recompute_matches_the_blessed_records(self, doc):
+        # Reprice a sample at HEAD against the checked-in file — the
+        # same comparison `graftaudit` makes in CI, kept cheap by
+        # sampling (the full sweep is the CLI gate's job).
+        sample = ["or/segment@ws1k", "or/gather@ws1k", "sum/segment@ws1k"]
+        entries = [e for e in registry.all_lowerings() if e.name in sample]
+        assert len(entries) == len(sample)
+        recs = M.collect_memory(
+            [registry.trace_lowering(e) for e in entries])
+        others = sorted(set(doc["entries"]) - set(recs))
+        assert M.check_membudgets(recs, doc, skipped=others) == []
+
+    def test_capacity_model_is_checked_in(self, doc):
+        cap = doc.get("capacity_model")
+        assert cap and cap["schema"] == C.CAPACITY_SCHEMA
+        assert C.DEFAULT_SERVING_ENTRY in cap["entries"]
+        assert cap["lane"]["cW"] > 0
+        for base, fit in cap["entries"].items():
+            assert fit["points"] >= 2, base
+            assert "max_resid" in fit, base
+
+
+# --------------------------------------------------------- the planner
+
+
+class TestCapacityPlanner:
+    @pytest.fixture(scope="class")
+    def model(self):
+        cap = M.load_membudgets().get("capacity_model")
+        assert cap, "membudgets.json lacks capacity_model"
+        return cap
+
+    def test_northstar_plan_fits_one_chip(self, model):
+        # ROADMAP item 2's scale question, answered without building
+        # anything: 1M nodes / 10k lanes (W=313 u32 words).
+        p = C.northstar_plan(model=model)
+        assert p["n_pad"] == 1_000_064 and p["n_pad"] % 128 == 0
+        assert p["lane_words"] == 313
+        assert p["e_pad"] >= 5_000_000  # WS k=6: ~6 edge slots per node
+        assert p["global_bytes"] > 0
+        assert p["recommended_shards"] == 1
+
+    def test_plan_requires_a_model_and_a_fitted_entry(self, model):
+        with pytest.raises(ValueError, match="no capacity model"):
+            C.plan(1000, model={})
+        with pytest.raises(ValueError, match="no fitted capacity entry"):
+            C.plan(1000, entry="or/ghost@ws", model=model)
+
+    def test_footprint_consistent_with_plan(self, model):
+        p = C.plan(50_000, lanes=64, model=model)
+        fp = C.serving_footprint_bytes(p["n_pad"], p["e_pad"],
+                                       p["lane_words"], shards=1,
+                                       model=model)
+        assert fp == p["per_chip"][0]["per_chip_bytes"]
+        assert abs(fp - p["global_bytes"]) <= 1
+
+    def test_footprint_degrades_to_none_without_a_model(self):
+        assert C.serving_footprint_bytes(128, 256, 1, model={}) is None
+        assert C.serving_footprint_bytes(
+            128, 256, 1, entry="or/ghost@ws",
+            model={"entries": {}}) is None
+
+    def test_per_chip_shrinks_with_shards_and_grows_with_lanes(self, model):
+        p = C.plan(200_000, lanes=1024, model=model)
+        per_chip = [row["per_chip_bytes"] for row in p["per_chip"]]
+        assert per_chip == sorted(per_chip, reverse=True)
+        narrow = C.plan(200_000, lanes=0, model=model)
+        assert p["global_bytes"] > narrow["global_bytes"]
+
+
+# ------------------------------------------------------ the serve gate
+
+
+def _post(url, doc=None, timeout=10):
+    data = json.dumps(doc or {}).encode()
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+class TestServeMemoryGate:
+    @pytest.fixture(scope="class")
+    def ws300(self):
+        return G.watts_strogatz(300, 6, 0.2, seed=3, source_csr=True)
+
+    def _svc(self, g, **kw):
+        kw.setdefault("capacity", 32)
+        kw.setdefault("queue_depth", 8)
+        kw.setdefault("seed", 0)
+        kw.setdefault("registry", telemetry.Registry())
+        return SimService(g, **kw)
+
+    def test_construction_refuses_an_over_budget_graph(self, ws300):
+        with pytest.raises(ValueError, match="over hbm_budget_bytes"):
+            self._svc(ws300, hbm_budget_bytes=1024.0)
+
+    def test_construction_refuses_the_knob_without_a_model(self, ws300,
+                                                           monkeypatch):
+        monkeypatch.setattr(M, "load_membudgets", lambda *a: {})
+        with pytest.raises(ValueError, match="no capacity model"):
+            self._svc(ws300, hbm_budget_bytes=float(1 << 30))
+
+    def test_grow_over_budget_sheds_typed_and_queues_nothing(self, ws300):
+        # 16 MiB: roomy for the 384-padded construction footprint,
+        # far under the ~65 MB the 16.7M-node repad plans.
+        svc = self._svc(ws300, hbm_budget_bytes=float(1 << 24))
+        before = svc.stats()["rejected"]
+        with pytest.raises(MemoryBudgetExceeded) as ei:
+            svc.grow(10_000_000)
+        d = ei.value.to_dict()
+        assert d["reason"] == "memory_budget"
+        assert d["planned_bytes"] > d["hbm_budget_bytes"]
+        assert d["planned_capacity"] >= 10_000_000
+        assert svc.stats()["rejected"] == before + 1
+        # the over-plan growth must never reach the mutate phase
+        assert not svc._mutations
+        # an affordable grow still queues
+        svc.grow(10)
+        assert len(svc._mutations) == 1
+
+    def test_submit_over_plan_sheds_as_http_429(self, ws300):
+        reg = telemetry.Registry()
+        svc = self._svc(ws300, registry=reg,
+                        hbm_budget_bytes=float(1 << 30))
+        t = svc.submit(0)  # under budget: admitted
+        assert t.startswith("t")
+        # Shrink the budget under the already-planned footprint — the
+        # operator tightening the knob on a live service — and every
+        # admission must shed with the structured payload.
+        svc.hbm_budget_bytes = 1.0
+        with pytest.raises(MemoryBudgetExceeded):
+            svc.submit(1)
+        with MetricsServer(registry=reg, port=0, service=svc) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/submit", {"source": 1})
+            assert ei.value.code == 429
+            doc = json.loads(ei.value.read().decode())
+            assert doc["reason"] == "memory_budget"
+            assert doc["planned_bytes"] > doc["hbm_budget_bytes"]
+            met = urllib.request.urlopen(base + "/metrics").read()
+            assert b'serve_rejected_total{reason="memory_budget"}' in met
