@@ -10,7 +10,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test examples bench dryrun telemetry-check chaos-check perf-check \
 	analysis-check supervise-check audit-check build-check race-check \
 	batch-check ring-check scope-check serve-check query-check quake-check \
-	sight-check churn-check mem-check
+	sight-check churn-check mem-check dur-check
 
 test:
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m "not slow"
@@ -157,6 +157,16 @@ sight-check:
 # 100k churn-under-chaos soak runs with -m 'churn and slow').
 churn-check:
 	$(TEST_ENV) $(PY) -m pytest tests/test_graftchurn.py -q
+
+# graftdur durability plane: write-ahead intent journal (CRC records,
+# torn-tail fuzz at every byte offset, segment rotation/compaction),
+# crash-seam resume bit-identity (mid-tick, mid-sidecar-publish,
+# mid-journal-append), DurabilityLost shedding + HTTP 503s, hot-standby
+# promote + FencedEpoch fencing (tox env "dur"; the slow-marked
+# crash-storm campaign and the 1.10x fsync=tick overhead ratchet run
+# with -m 'dur and slow').
+dur-check:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m dur
 
 # Batched query lanes: byte-budget gate, lane-kernel parity, the three
 # family identity sweeps (min-plus vs Bellman-Ford reference, DHT vs the

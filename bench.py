@@ -456,6 +456,90 @@ def bench_batched():
     return col
 
 
+def time_durability(graph, *, cap: int, chunk: int, ticks: int,
+                    rate: float, seed: int = 0,
+                    policies=("off", "tick", "record"),
+                    replay_records: int = 1000) -> dict:
+    """The ``durability`` slice of the serving column (graftdur): what
+    the write-ahead journal costs per fsync policy, and how fast a
+    recovery scan replays.
+
+    Drives the SAME seeded traffic schedule four times over a scratch
+    checkpoint store — once unjournaled (the baseline: checkpoint
+    cadence included, so the ratio isolates the JOURNAL, not the
+    store), once per fsync policy — and reports
+    ``overhead_ratio = journaled_wall / unjournaled_wall``. The
+    slow-marked ratchet (tests/test_graftdur.py) pins fsync=tick at
+    <= 1.10x. ``replay_scan_ms_per_1k`` times the torn-tail-tolerant
+    segment scan (:func:`serve.journal.read_records`) over a
+    synthetic ``replay_records``-record journal — the recovery-path
+    latency a resume pays per 1k acknowledged intents."""
+    import shutil
+    import tempfile
+
+    from p2pnetwork_tpu.serve import SimService, TrafficPattern
+    from p2pnetwork_tpu.serve import drive as serve_drive
+    from p2pnetwork_tpu.serve import generate as serve_generate
+    from p2pnetwork_tpu.serve.journal import Journal, read_records
+
+    pattern = TrafficPattern(ticks=ticks, rate=rate,
+                             coverage_target=0.99)
+    sched = serve_generate(pattern, graph.n_nodes, seed=seed)
+
+    def one_drive(journal, fsync):
+        d = tempfile.mkdtemp(prefix="bench_dur_")
+        try:
+            svc = SimService(graph, capacity=cap, queue_depth=cap,
+                             chunk_rounds=chunk, seed=seed, store=d,
+                             journal=journal, journal_fsync=fsync)
+            t0 = time.perf_counter()
+            out = serve_drive(svc, sched)
+            wall = time.perf_counter() - t0
+            stats = svc.stats()
+            svc.close()
+            return wall, out, stats
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    # Warm the engine program (and the store/sidecar write path) before
+    # any timed drive: called standalone — e.g. by the ratchet test —
+    # the first drive would otherwise charge one-time XLA compile to
+    # whichever arm runs first and invert the ratio.
+    one_drive(False, "tick")
+    base_wall, base_out, _ = one_drive(False, "tick")
+    col = {
+        "ticks": ticks, "rate": rate,
+        "offered": base_out["submitted"] + len(base_out["shed"]),
+        "unjournaled_wall_s": round(base_wall, 4),
+        "fsync": {},
+    }
+    for pol in policies:
+        wall, _, stats = one_drive(True, pol)
+        jstats = stats.get("journal") or {}
+        col["fsync"][pol] = {
+            "wall_s": round(wall, 4),
+            "overhead_ratio": round(wall / max(base_wall, 1e-9), 4),
+            "appends": jstats.get("appended"),
+            "fsyncs": jstats.get("fsyncs"),
+        }
+    jd = tempfile.mkdtemp(prefix="bench_dur_replay_")
+    try:
+        j = Journal(jd, fsync="off")
+        for i in range(int(replay_records)):
+            j.append("submit", ticket=f"t{i:08d}", source=i % 1024,
+                     tenant="default", round=i, tick=i // 8)
+        j.close()
+        t0 = time.perf_counter()
+        records, corrupt = read_records(jd)
+        scan_s = time.perf_counter() - t0
+        assert len(records) == int(replay_records) and corrupt == 0
+        col["replay_scan_ms_per_1k"] = round(
+            scan_s * 1000.0 * 1000.0 / max(int(replay_records), 1), 3)
+    finally:
+        shutil.rmtree(jd, ignore_errors=True)
+    return col
+
+
 def bench_serving():
     """The ``serving`` bench column: seeded open-loop traffic
     (serve/traffic.py — Poisson arrivals, hot-key skew, diurnal bursts)
@@ -530,6 +614,25 @@ def bench_serving():
           f"concurrent, p99={col['submit_to_completion_rounds_p99']} "
           f"rounds, shed_rate={col['shed_rate']}",
           file=sys.stderr, flush=True)
+    # graftdur durability slice: journal overhead per fsync policy +
+    # recovery-scan latency, on a reduced drive (BENCH_DUR=0 disables).
+    if os.environ.get("BENCH_DUR", "1") != "0":
+        dur_ticks = int(os.environ.get("BENCH_DUR_TICKS", 8))
+        dur_rate = float(os.environ.get("BENCH_DUR_RATE", cap / 8.0))
+        try:
+            col["durability"] = time_durability(
+                g, cap=cap, chunk=chunk, ticks=dur_ticks,
+                rate=dur_rate, seed=0)
+            tick_ratio = \
+                col["durability"]["fsync"]["tick"]["overhead_ratio"]
+            print(f"# durability: fsync=tick x{tick_ratio} vs "
+                  f"unjournaled, replay "
+                  f"{col['durability']['replay_scan_ms_per_1k']} "
+                  f"ms/1k records", file=sys.stderr, flush=True)
+        except Exception as e:
+            col["durability"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# durability slice failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
     return col
 
 

@@ -678,3 +678,107 @@ def _partition_heal():
         for t in ts:
             t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
     return body
+
+
+@scenario(
+    "journal_vs_close",
+    "The graftdur durability plane under exploration: a foreign thread "
+    "submits (each acknowledgement is a journal append inside _cond) "
+    "while the driver-role thread runs boundary ticks (tick_barrier "
+    "fsync + rotate/compact inside _checkpoint), a closer runs the "
+    "final-checkpoint close() path, and a promoter fences the trail "
+    "via Standby.promote() — the append/close/promote interleavings "
+    "where a zombie's publish must die as FencedEpoch, never as a "
+    "torn pair or a silently un-journaled acknowledgement.")
+def _journal_vs_close():
+    try:
+        import jax  # noqa: F401
+        from p2pnetwork_tpu.serve.service import (  # noqa: F401
+            DurabilityLost, FencedEpoch, Rejected, ServiceClosed,
+            SimService)
+        from p2pnetwork_tpu.serve.standby import Standby  # noqa: F401
+        from p2pnetwork_tpu.sim import graph as G
+    except Exception as e:  # pragma: no cover - jax-less image
+        raise ScenarioUnavailable(f"needs jax/serve: {e}") from e
+    g = G.watts_strogatz(24, 4, 0.1, seed=1, source_csr=True)
+
+    # Warm OUTSIDE the managed world (the serve_admit_storm rule): the
+    # first journaled service registers the serve_journal_* metric
+    # families and compiles the engine shapes; the warm promote
+    # additionally compiles the resumed-construction path. Warmed here,
+    # every explored schedule starts compile-hot on raw locks.
+    warm_dir = tempfile.mkdtemp(prefix="graftrace_dur_warm_")
+    try:
+        warm = SimService(g, capacity=8, queue_depth=3, chunk_rounds=4,
+                          seed=0, store=warm_dir)
+        warm.submit(1)
+        warm.tick()
+        warm_p = Standby(g, warm_dir, capacity=8, queue_depth=3,
+                         chunk_rounds=4, seed=0).promote()
+        warm_p.close()
+        warm.close()
+    finally:
+        shutil.rmtree(warm_dir, ignore_errors=True)
+
+    def body():
+        from p2pnetwork_tpu.serve.service import (
+            DurabilityLost, FencedEpoch, Rejected, ServiceClosed,
+            SimService)
+        from p2pnetwork_tpu.serve.standby import Standby
+        reg = _fresh_registry()
+        d = tempfile.mkdtemp(prefix="graftrace_dur_")
+        try:
+            svc = watch(SimService(
+                g, capacity=8, queue_depth=3, chunk_rounds=4, seed=0,
+                store=d, registry=reg))
+            # One published pair before the races: promote() then
+            # resumes real state instead of clearing an empty trail.
+            svc.submit(1)
+            svc.tick()
+
+            def driver_role():
+                for _ in range(3):
+                    try:
+                        svc.tick()
+                    except (FencedEpoch, ServiceClosed):
+                        # Designed outcomes: the promoter fenced our
+                        # boundary publish (we are the zombie now), or
+                        # the closer beat us to the driver.
+                        return
+
+            def submitter():
+                for s in (2, 3):
+                    try:
+                        svc.submit(s)
+                    except (Rejected, ServiceClosed):
+                        pass  # shed / post-close submit: designed
+
+            def closer():
+                try:
+                    svc.close()
+                except FencedEpoch:
+                    pass  # final checkpoint fenced: the zombie's close
+
+            def promoter():
+                reg2 = _fresh_registry()
+                promoted = watch(Standby(
+                    g, d, capacity=8, queue_depth=3, chunk_rounds=4,
+                    seed=0, registry=reg2).promote())
+                promoted.close()
+
+            ts = [concurrency.thread(target=f, name=nm)
+                  for nm, f in (("driver", driver_role),
+                                ("submit", submitter),
+                                ("close", closer),
+                                ("promote", promoter))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()  # graftlint: ignore[wait-untimed] -- managed-world join: deliberately unbounded so a wedged schedule reports as a graftrace deadlock, not a silent timeout
+            try:
+                svc.close()
+            except FencedEpoch:
+                pass  # the promoter owns the trail now
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return body

@@ -19,10 +19,17 @@
   plane — byte-replayable, interleavable with a traffic schedule. See
   GETTING_STARTED.md "Live overlay growth & churn storms".
 
+- **Crash storms** (:mod:`p2pnetwork_tpu.chaos.crashstorm`, graftdur):
+  seeded SIGKILL schedules (:class:`CrashSchedule` / :class:`KillPoint`)
+  against graftserve's durability seams — mid-tick, mid-journal-append,
+  mid-sidecar-publish, disk-full — driven as a subprocess soak
+  (:func:`run_campaign`) asserting zero acknowledged-ticket loss. See
+  GETTING_STARTED.md "Durability & failover".
+
 Top-level import stays stdlib-only (device.py defers jax into the fault
-math; storm.py — which speaks the jax-backed serving plane — loads
-lazily on first attribute access), preserving the sockets backend's
-no-jax rule.
+math; storm.py and crashstorm.py — which speak the jax-backed serving
+plane — load lazily on first attribute access), preserving the sockets
+backend's no-jax rule.
 """
 
 from p2pnetwork_tpu.chaos.device import (ChipLost, DispatchChaos,
@@ -39,14 +46,21 @@ __all__ = [
     "ChipLost", "WedgedDispatch", "UnreachableFaultSite",
     "install_dispatch_chaos",
     "ChurnPattern", "ChurnSchedule",
+    "CrashSchedule", "KillPoint", "CampaignError", "KILL_KINDS",
 ]
 
 _STORM_NAMES = ("ChurnPattern", "ChurnSchedule")
+
+_CRASHSTORM_NAMES = ("CrashSchedule", "KillPoint", "CampaignError",
+                     "KILL_KINDS")
 
 
 def __getattr__(name):
     if name in _STORM_NAMES:
         from p2pnetwork_tpu.chaos import storm
         return getattr(storm, name)
+    if name in _CRASHSTORM_NAMES:
+        from p2pnetwork_tpu.chaos import crashstorm
+        return getattr(crashstorm, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

@@ -38,6 +38,7 @@ import numpy as np
 from p2pnetwork_tpu.serve.service import (TERMINAL_STATES,
                                            Rejected, SimService)
 from p2pnetwork_tpu.serve.traffic import TrafficSchedule
+from p2pnetwork_tpu.serve.traffic import _consume_replay
 from p2pnetwork_tpu.sim.graph import GraphDelta
 
 __all__ = ["ChurnPattern", "ChurnSchedule", "generate", "drive"]
@@ -45,6 +46,21 @@ __all__ = ["ChurnPattern", "ChurnSchedule", "generate", "drive"]
 #: Event kinds in schedule-array code order.
 EVENT_KINDS = ("grow", "join", "leave")
 _KIND_CODE = {k: i for i, k in enumerate(EVENT_KINDS)}
+
+
+def _replay_mutation(service: SimService, t: int, want_kind: str) -> bool:
+    """Positional churn replay (graftdur resume): when the service's
+    journal-replay suffix heads with exactly the mutation this storm
+    event would queue (same kind, due at or before tick ``t``), replay
+    that record instead of re-queueing a duplicate. The storm schedule
+    is seed-deterministic, so records line up event-for-event with the
+    re-driven schedule."""
+    head = service.replay_peek()
+    if (head is not None and int(head.get("tick", 0)) <= t
+            and head.get("kind") == want_kind):
+        service.replay_next()
+        return True
+    return False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,15 +300,30 @@ def drive(service: SimService, storm: ChurnSchedule, *,
                 tickets[tid] = rec
                 pending.discard(tid)
 
+    replayed = 0
     for t in range(start, storm.ticks):
         for kind, amount, delta in storm.events_at(t):
             events[kind] += 1
             if kind in ("grow", "join"):
-                service.grow(amount)
+                if not _replay_mutation(service, t, "grow"):
+                    service.grow(amount)
             if delta is not None:
-                service.apply_delta(delta)
+                if not _replay_mutation(service, t, "delta"):
+                    service.apply_delta(delta)
         if traffic is not None:
             for source, tenant in traffic.arrivals_at(t):
+                rec = _consume_replay(service, t)
+                if rec is not None:
+                    replayed += 1
+                    if rec["kind"] == "submit":
+                        submitted += 1
+                        pending.add(str(rec["ticket"]))
+                    else:
+                        shed.append({"tick": t, "source": int(source),
+                                     "tenant": tenant,
+                                     "reason": str(rec.get("reason",
+                                                           "replayed"))})
+                    continue
                 try:
                     tid = service.submit(
                         source,
@@ -313,7 +344,8 @@ def drive(service: SimService, storm: ChurnSchedule, *,
     completed = sum(1 for rec in tickets.values()
                     if rec is not None and rec["status"] == "done")
     return {"tickets": tickets, "shed": shed, "submitted": submitted,
-            "completed": completed, "drain_ticks": drained,
+            "completed": completed, "replayed": replayed,
+            "drain_ticks": drained,
             "peak_concurrent_lanes": peak, "executed_rounds": rounds,
             "events": events,
             "graph_nodes": int(service.graph.n_nodes),

@@ -12,9 +12,23 @@ a seeded open-loop traffic generator (:mod:`~p2pnetwork_tpu.serve.traffic`:
 Poisson arrivals, hot-key skew, diurnal bursts — byte-replayable) that
 makes "heavy traffic" a reproducible workload. See GETTING_STARTED.md
 "Simulation as a service".
+
+graftdur adds the durability plane: a write-ahead intent journal
+(:class:`Journal`) closing the sub-boundary SIGKILL window, typed
+degradation (:class:`DurabilityLost` 503s when the journal fails), and
+hot-standby failover (:class:`Standby`, epoch-fenced ``promote()``
+refusing a zombie primary's publish with :class:`FencedEpoch`). See
+GETTING_STARTED.md "Durability & failover".
 """
 
+from p2pnetwork_tpu.serve.journal import (
+    FSYNC_POLICIES,
+    Journal,
+    RECORD_KINDS,
+)
 from p2pnetwork_tpu.serve.service import (
+    DurabilityLost,
+    FencedEpoch,
     GraphMismatch,
     MemoryBudgetExceeded,
     QueueFull,
@@ -24,6 +38,7 @@ from p2pnetwork_tpu.serve.service import (
     SimService,
     TERMINAL_STATES,
 )
+from p2pnetwork_tpu.serve.standby import Standby
 from p2pnetwork_tpu.serve.traffic import (
     TrafficPattern,
     TrafficSchedule,
@@ -32,13 +47,19 @@ from p2pnetwork_tpu.serve.traffic import (
 )
 
 __all__ = [
+    "DurabilityLost",
+    "FSYNC_POLICIES",
+    "FencedEpoch",
     "GraphMismatch",
+    "Journal",
     "MemoryBudgetExceeded",
     "QueueFull",
     "QuotaExceeded",
+    "RECORD_KINDS",
     "Rejected",
     "ServiceClosed",
     "SimService",
+    "Standby",
     "TERMINAL_STATES",
     "TrafficPattern",
     "TrafficSchedule",

@@ -68,6 +68,8 @@ import numpy as np
 
 from p2pnetwork_tpu import concurrency, telemetry
 from p2pnetwork_tpu.models.messagebatch import BatchFlood
+from p2pnetwork_tpu.serve.journal import Journal
+from p2pnetwork_tpu.serve.journal import clear_segments as _clear_journal
 from p2pnetwork_tpu.sim import checkpoint as ckpt
 from p2pnetwork_tpu.sim import engine
 from p2pnetwork_tpu.sim import graph as graph_mod
@@ -79,7 +81,7 @@ from p2pnetwork_tpu.telemetry import spans
 
 __all__ = [
     "SimService", "Rejected", "QueueFull", "QuotaExceeded",
-    "MemoryBudgetExceeded",
+    "MemoryBudgetExceeded", "DurabilityLost", "FencedEpoch",
     "ServiceClosed", "GraphMismatch", "TERMINAL_STATES", "TICK_PHASES",
     "ticket_trace",
 ]
@@ -111,6 +113,28 @@ def ticket_trace(ticket: str) -> str:
     so ``/trace?trace_id=tkt-<ticket>`` exports one ticket's
     submit→admit→chunk→fault→heal→complete lifecycle."""
     return f"tkt-{ticket}"
+
+
+def _delta_fields(delta: "graph_mod.GraphDelta") -> dict:
+    """A GraphDelta as JSON-able journal fields (directed form — the
+    stored arrays already carry both directions of an undirected
+    build), inverted by :func:`_delta_from_fields` at replay."""
+    return {
+        "add_s": np.asarray(delta.add_senders).tolist(),
+        "add_r": np.asarray(delta.add_receivers).tolist(),
+        "add_w": (None if delta.add_weights is None
+                  else np.asarray(delta.add_weights).tolist()),
+        "rem_s": np.asarray(delta.remove_senders).tolist(),
+        "rem_r": np.asarray(delta.remove_receivers).tolist(),
+    }
+
+
+def _delta_from_fields(rec: dict) -> "graph_mod.GraphDelta":
+    return graph_mod.GraphDelta(
+        add_senders=rec.get("add_s"), add_receivers=rec.get("add_r"),
+        add_weights=rec.get("add_w"),
+        remove_senders=rec.get("rem_s"),
+        remove_receivers=rec.get("rem_r"))
 
 
 class _PhaseClock:
@@ -199,6 +223,34 @@ class MemoryBudgetExceeded(Rejected):
     reason = "memory_budget"
 
 
+class DurabilityLost(Rejected):
+    """The write-ahead journal can no longer append (disk full, I/O
+    error): the service flips to a LOUD shedding mode instead of
+    silently accepting work it cannot make durable. Every subsequent
+    submit/grow/apply_delta sheds with this reason (``503`` over HTTP,
+    ``serve_rejected_total{reason="durability"}``) until a new service
+    is constructed on a healthy volume — the trail up to the failure is
+    intact and resumes normally. Sticky by design: a journal whose tail
+    may be torn must not interleave fresh records after the tear."""
+
+    reason = "durability"
+
+
+class FencedEpoch(RuntimeError):
+    """A demoted (zombie) primary tried to publish against a trail a
+    newer epoch owns: :meth:`SimService.checkpoint` found a sidecar
+    fencing token above its own. The publish was refused BEFORE
+    touching the trail — split-brain is impossible by construction
+    (promotion bumps the epoch and publishes the token first; any
+    late writer then fails this check). Carries ``ours`` (the zombie's
+    epoch) and ``current`` (the token in the sidecar)."""
+
+    def __init__(self, message: str, *, ours: int, current: int):
+        self.ours = int(ours)
+        self.current = int(current)
+        super().__init__(message)
+
+
 class ServiceClosed(RuntimeError):
     """The service was closed (or its driver died); no more admissions."""
 
@@ -261,6 +313,29 @@ class SimService:
         atomic sidecar. ``resume=True`` (default) restores the newest
         consistent (checkpoint, sidecar) pair at construction;
         ``resume=False`` clears any previous trail.
+    journal / journal_fsync:
+        The graftdur sub-boundary durability plane (serve/journal.py):
+        a write-ahead journal of every admission-plane intent in the
+        store directory, appended BEFORE the intent is acknowledged, so
+        a SIGKILL between checkpoint boundaries loses no acknowledged
+        submit — resume restores the pair, then replays the journal
+        suffix (:meth:`replay_next` / the drives' positional
+        consumption) with the SAME ticket ids and bit-identical
+        results. ``journal=None`` (default) enables it whenever a store
+        is configured; ``False`` keeps the boundary-granular legacy
+        semantics; ``True`` without a store is an error.
+        ``journal_fsync`` is the power-loss policy knob
+        (:data:`~p2pnetwork_tpu.serve.journal.FSYNC_POLICIES`:
+        ``"record"`` / ``"tick"`` default / ``"off"``). An append
+        failure flips the service into :class:`DurabilityLost`
+        shedding — loud degradation, never silent un-journaled work.
+    epoch:
+        Fencing token for hot-standby failover. ``None`` (default)
+        adopts the trail's epoch on resume (0 fresh); an explicit int
+        pins it — :meth:`~p2pnetwork_tpu.serve.standby.Standby.promote`
+        passes ``observed + 1`` so the promoted service's first
+        checkpoint publishes a token every zombie-primary publish then
+        fails against (:class:`FencedEpoch`).
     quotas:
         Per-tenant token buckets: ``{tenant: (refill_per_tick, burst)}``.
         Unlisted tenants are unlimited. Buckets refill at tick
@@ -317,6 +392,9 @@ class SimService:
                  store: Union[CheckpointStore, str, None] = None,
                  resume: bool = True, checkpoint_every_ticks: int = 1,
                  retain: int = 3,
+                 journal: Optional[bool] = None,
+                 journal_fsync: str = "tick",
+                 epoch: Optional[int] = None,
                  quotas: Optional[Dict[str, Tuple[float, float]]] = None,
                  max_active_lanes: Optional[int] = None,
                  slo_rounds: Optional[float] = None,
@@ -451,11 +529,27 @@ class SimService:
         self._latencies: List[float] = []   # rolling completion rounds
         self._counts = {"submitted": 0, "completed": 0, "cancelled": 0,
                         "rejected": 0, "timeout": 0, "mutations": 0}
-        #: Queued live-mutation plane (graftchurn): (kind, payload)
-        #: pairs — ("delta", GraphDelta) / ("grow", n_new_nodes) —
-        #: drained atomically by the driver's mutate tick phase.
-        self._mutations: List[Tuple[str, Any]] = []
+        #: Queued live-mutation plane (graftchurn): (kind, payload, seq)
+        #: triples — ("delta", GraphDelta, seq) / ("grow", n_new_nodes,
+        #: seq), the seq being the journal record that acknowledged the
+        #: intent (None unjournaled) — drained atomically by the
+        #: driver's mutate tick phase.
+        self._mutations: List[Tuple[str, Any, Optional[int]]] = []
         self._submit_walls: Dict[str, float] = {}
+        # ---- graftdur durability plane (lock-guarded like the rest) --
+        #: Why the journal refuses appends, or None while durable. Sticky:
+        #: every admission sheds DurabilityLost until reconstruction.
+        self._durability_lost: Optional[str] = None
+        #: Journal records past the last published pair, awaiting replay
+        #: (seq-ordered; drives consume positionally, tick()'s mutate
+        #: phase is the fallback).
+        self._replay_queue: List[dict] = []
+        #: Last journal seqno appended AND acknowledged by this service.
+        self._j_acked = 0
+        #: Seqnos of journaled grow/delta intents still queued in
+        #: _mutations (unapplied): the published cover must stay BELOW
+        #: them or compaction would eat intents nothing has applied yet.
+        self._j_pending_mut: List[int] = []
         #: Anything the sidecar records changed since the last published
         #: pair — gates checkpointing so an IDLE background driver
         #: (ticking every idle_wait_s for quota refill) does not
@@ -464,11 +558,26 @@ class SimService:
         self._closed = False
         self._driver_error: Optional[str] = None
         self._preempt_at: Optional[int] = None
+        #: Failover fencing epoch (graftdur): published in the sidecar,
+        #: checked before every publish (_check_fence). Pinned when the
+        #: caller passed one; adopted from the trail otherwise.
+        if epoch is not None:
+            epoch = int(epoch)
+            if epoch < 0:
+                raise ValueError("epoch must be >= 0")
+        self._epoch = 0 if epoch is None else epoch
+        self._epoch_pinned = epoch is not None
 
         # ---- driver-confined (only the tick() caller touches these) ---
         self._retire_ready: List[int] = []   # harvested lanes to recycle
         self._thread: Optional[Any] = None
         self._watchdog: Optional[Watchdog] = None
+        #: Crash-seam hooks (chaos/crashstorm.py): called as fn(tick) at
+        #: the mid-tick point (between dispatch and harvest) and during
+        #: the sidecar publish (between store entry and sidecar rename).
+        #: Plain attributes — installing one is a test/chaos action.
+        self._tick_fault: Optional[Callable[[int], None]] = None
+        self._publish_fault: Optional[Callable[[int], None]] = None
         #: Growth steps applied this service lifetime (sidecar-recorded:
         #: the sanctioned resume path replays them onto the pre-growth
         #: construction). Driver-confined, like the graph they describe.
@@ -556,6 +665,12 @@ class SimService:
             "geometric repad steps under Graph.grow; the static shape "
             "every compiled consumer is keyed on).")
         self._m_capacity.set(float(graph.n_nodes_padded))
+        self._m_journal_lag = reg.gauge(
+            "serve_journal_lag",
+            "Journal records past the last published checkpoint pair "
+            "(last appended seqno minus the pair's covered seqno) — the "
+            "replay debt a crash right now would pay, sampled at each "
+            "publish.")
         # Tick-phase profile state: written by the driver, snapshotted
         # by /dashboard scrape threads — its own small lock, never
         # nested with _cond.
@@ -566,6 +681,15 @@ class SimService:
         self._phase_ticks = 0
 
         self._store: Optional[CheckpointStore] = None
+        self._journal: Optional[Journal] = None
+        if journal_fsync not in ("record", "tick", "off"):
+            raise ValueError(
+                f"journal_fsync must be 'record', 'tick' or 'off', "
+                f"got {journal_fsync!r}")
+        if journal and store is None:
+            raise ValueError(
+                "journal=True needs a checkpoint store (the journal "
+                "lives in the store directory; pass store=...)")
         if store is not None:
             self._store = store if isinstance(store, CheckpointStore) \
                 else CheckpointStore(store, retain=retain, registry=registry)
@@ -583,10 +707,28 @@ class SimService:
             # The as-constructed fingerprint, BEFORE any resume-replayed
             # growth: what a later resume of this trail must present.
             self._graph_fp_base = self._graph_fingerprint()
+            if not resume:
+                # Clear BEFORE the journal constructs: the fresh journal
+                # then scans a clean directory instead of recovering a
+                # trail the caller just discarded.
+                self._clear_trail()
+            if journal is None or journal:
+                self._journal = Journal(self._store.directory,
+                                        fsync=journal_fsync,
+                                        registry=registry)
             if resume:
                 self._try_resume()
-            else:
-                self._clear_trail()
+                if self._journal is not None:
+                    # The replay suffix: every record the restored pair
+                    # does not cover. With no pair at all (a kill before
+                    # the first checkpoint) _j_acked is 0 and EVERY
+                    # recovered record replays onto the fresh state.
+                    covered = self._j_acked
+                    self._replay_queue = [
+                        r for r in self._journal.records()
+                        if int(r["seq"]) > covered]
+            if self._journal is not None:
+                self._journal.epoch = self._epoch
 
     # ------------------------------------------------------------ lifecycle
 
@@ -654,6 +796,12 @@ class SimService:
                     f"graftserve: final close checkpoint failed "
                     f"({type(e).__name__}: {e}); the trail ends at the "
                     "last tick boundary", RuntimeWarning, stacklevel=2)
+        if first_close and self._journal is not None:
+            # After the final pair (so its rotate/compact ran). Any
+            # intent the final pair does NOT cover — journaled-but-
+            # unapplied mutations, a skipped final checkpoint — stays
+            # in surviving segments for the next resume's replay.
+            self._journal.close()
 
     def __enter__(self) -> "SimService":
         return self.start()
@@ -687,17 +835,43 @@ class SimService:
         ahead of it — a bad id raises a typed
         :class:`~p2pnetwork_tpu.sim.graph.EdgeEndpointError` at the
         caller, not an opaque failure inside the driver."""
+        reject: Optional[Rejected] = None
         with self._cond:
             if self._closed:
                 raise ServiceClosed(self._driver_error or "service is closed")
-            n_eff = self.graph.n_nodes + sum(
-                p for k, p in self._mutations if k == "grow")
-            graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
-                delta.add_senders, delta.add_receivers, n_eff)
-            graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
-                delta.remove_senders, delta.remove_receivers, n_eff)
-            self._mutations.append(("delta", delta))
-            self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+            if self._durability_lost is not None:
+                reject = DurabilityLost(
+                    f"durability lost ({self._durability_lost}) — the "
+                    "journal cannot acknowledge this delta",
+                    detail=self._durability_lost)
+            else:
+                n_eff = self.graph.n_nodes + sum(
+                    p for k, p, _s in self._mutations if k == "grow")
+                graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
+                    delta.add_senders, delta.add_receivers, n_eff)
+                graph_mod._check_endpoints(  # graftlint: ignore[lock-open-call] -- pure host numpy bounds check; must be atomic with the queue append vs concurrent growers
+                    delta.remove_senders, delta.remove_receivers, n_eff)
+                try:
+                    seq = self._journal_append_locked(
+                        "delta", **_delta_fields(delta))
+                except OSError:
+                    reject = DurabilityLost(
+                        f"journal append failed "
+                        f"({self._durability_lost}) — delta refused",
+                        detail=self._durability_lost)
+                else:
+                    self._mutations.append(("delta", delta, seq))
+                    if seq is not None:
+                        self._j_pending_mut.append(seq)
+                    self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+        if reject is not None:
+            with self._cond:
+                self._counts["rejected"] += 1
+                self._dirty = True  # shed counts survive resume too
+            self._m_rejected.labels(reject.reason).inc()
+            if self._slo is not None:
+                self._slo.record("shed", 1.0)
+            raise reject
 
     def _planned_footprint_bytes(self, n_padded: int) -> Optional[int]:
         """Per-chip planned HBM bytes of the serving program at a node
@@ -718,7 +892,7 @@ class SimService:
         (graph.growth_capacity) applied to the pending demand. Caller
         holds ``self._cond`` (reads ``_mutations``)."""
         demand = self.graph.n_nodes + int(extra_nodes) + sum(
-            p for k, p in self._mutations if k == "grow")  # graftlint: ignore[lock-guard] -- caller holds self._cond (documented contract above)
+            p for k, p, _s in self._mutations if k == "grow")  # graftlint: ignore[lock-guard] -- caller holds self._cond (documented contract above)
         current = self.graph.n_nodes_padded
         if demand <= current:
             return current
@@ -742,7 +916,12 @@ class SimService:
         with self._cond:
             if self._closed:
                 raise ServiceClosed(self._driver_error or "service is closed")
-            if self.hbm_budget_bytes is not None:
+            if self._durability_lost is not None:
+                reject = DurabilityLost(
+                    f"durability lost ({self._durability_lost}) — the "
+                    "journal cannot acknowledge this growth",
+                    detail=self._durability_lost)
+            elif self.hbm_budget_bytes is not None:
                 planned_cap = self._planned_capacity_nodes(n_new_nodes)
                 planned = self._planned_footprint_bytes(planned_cap)
                 if planned is not None and planned > self.hbm_budget_bytes:
@@ -759,7 +938,18 @@ class SimService:
                         hbm_budget_bytes=int(self.hbm_budget_bytes),
                         planned_capacity=int(planned_cap))
             if reject is None:
-                self._mutations.append(("grow", n_new_nodes))
+                try:
+                    seq = self._journal_append_locked("grow",
+                                                      n=n_new_nodes)
+                except OSError:
+                    reject = DurabilityLost(
+                        f"journal append failed "
+                        f"({self._durability_lost}) — growth refused",
+                        detail=self._durability_lost)
+            if reject is None:
+                self._mutations.append(("grow", n_new_nodes, seq))
+                if seq is not None:
+                    self._j_pending_mut.append(seq)
                 self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
         if reject is not None:
             with self._cond:
@@ -811,7 +1001,15 @@ class SimService:
             if self.hbm_budget_bytes is not None:
                 planned = self._planned_footprint_bytes(
                     self._planned_capacity_nodes())
-            if planned is not None and planned > self.hbm_budget_bytes:
+            if self._durability_lost is not None:
+                # Loud degradation (graftdur): an un-journalable submit
+                # must never be acknowledged — it would vanish on the
+                # next crash while the caller holds a ticket id.
+                reject = DurabilityLost(
+                    f"durability lost ({self._durability_lost}) — "
+                    "shedding until the service is reconstructed on a "
+                    "healthy volume", detail=self._durability_lost)
+            elif planned is not None and planned > self.hbm_budget_bytes:
                 # The service is over-plan (queued growth will repad past
                 # the budget): stop taking load before the repad lands.
                 reject = MemoryBudgetExceeded(
@@ -844,9 +1042,26 @@ class SimService:
                     active_lanes=len(self._lane_ticket),
                     capacity=self.capacity)
             else:
+                # Append-before-ack (graftdur): the ticket id is
+                # journaled BEFORE the counter advances or the record
+                # exists, so acknowledged ⟺ journaled. A failing append
+                # leaves NO partial ticket and sheds DurabilityLost; a
+                # kill mid-append aborts the submit entirely (the caller
+                # never saw an id — nothing was lost).
+                tid = f"t{self._next_ticket:08d}"
+                try:
+                    self._journal_append_locked(
+                        "submit", ticket=tid, source=source,
+                        target=target, tenant=tenant,
+                        round=self._round)
+                except OSError:
+                    reject = DurabilityLost(
+                        f"journal append failed "
+                        f"({self._durability_lost}) — submit refused",
+                        detail=self._durability_lost)
+            if reject is None:
                 if tenant in self._quotas:
                     self._buckets[tenant] -= 1.0
-                tid = f"t{self._next_ticket:08d}"
                 self._next_ticket += 1
                 self._tickets[tid] = {
                     "ticket": tid, "tenant": tenant, "source": source,
@@ -867,6 +1082,19 @@ class SimService:
             with self._cond:
                 self._counts["rejected"] += 1
                 self._dirty = True  # shed counts survive resume too
+                if (self._durability_lost is None
+                        and reject.reason != "durability"):
+                    # Sheds are admission-plane intents too: journaling
+                    # them keeps replay positional (the drive maps each
+                    # arrival to exactly one record). Best-effort — a
+                    # failure here flips DurabilityLost for the NEXT
+                    # admission; this one already sheds.
+                    try:
+                        self._journal_append_locked(
+                            "shed", reason=reject.reason, source=source,
+                            tenant=tenant)
+                    except OSError:
+                        pass
             self._m_rejected.labels(reject.reason).inc()
             if self._slo is not None:
                 self._slo.record("shed", 1.0)
@@ -906,6 +1134,24 @@ class SimService:
                 # "accepted" and then silently lost on resume.
                 return False
             rec = self._tickets.get(str(ticket))
+            if (rec is not None
+                    and rec["status"] in ("queued", "running")):
+                if self._durability_lost is not None:
+                    raise DurabilityLost(
+                        f"durability lost ({self._durability_lost}) — "
+                        "the journal cannot acknowledge this "
+                        "cancellation", detail=self._durability_lost)
+                try:
+                    # Append-before-ack, like submit: a cancellation the
+                    # journal never saw would resurrect the ticket on
+                    # replay.
+                    self._journal_append_locked("cancel",
+                                                ticket=str(ticket))
+                except OSError as e:
+                    raise DurabilityLost(
+                        f"journal append failed "
+                        f"({self._durability_lost}) — cancellation "
+                        "refused", detail=self._durability_lost) from e
             if rec is not None and rec["status"] == "queued":
                 rec["status"] = "cancelled"
                 self._queue = [t for t in self._queue if t != rec["ticket"]]
@@ -1055,8 +1301,19 @@ class SimService:
                 "tickets_retained": len(self._tickets),
                 "closed": self._closed,
                 "quota_tokens": dict(self._buckets),
+                # graftdur durability fields: the fencing epoch, why
+                # the service is shedding (None while durable), the
+                # unreplayed journal suffix, and the seqno a pair
+                # published now would cover.
+                "epoch": self._epoch,
+                "durability_lost": self._durability_lost,
+                "replay_pending": len(self._replay_queue),
+                "journal_covered": self._j_covered_locked()
+                if self._journal is not None else None,
                 **self._counts,
             }
+        if self._journal is not None:
+            doc["journal"] = self._journal.stats()
         if lat:
             doc["completion_rounds_p50"] = float(np.percentile(lat, 50))
             doc["completion_rounds_p99"] = float(np.percentile(lat, 99))
@@ -1092,6 +1349,15 @@ class SimService:
         with self._cond:
             if self._closed:
                 raise ServiceClosed(self._driver_error or "service is closed")
+            # Replay fallback (graftdur): recovered journal records due
+            # at or before this tick apply now — drives consume the
+            # suffix positionally BEFORE calling tick(), so anything
+            # still here belongs to an earlier slot (a non-drive
+            # resume). Records for later ticks stay queued.
+            while (self._replay_queue
+                   and int(self._replay_queue[0].get("tick", 0))  # graftlint: ignore[host-sync-in-loop] -- journal records are parsed JSON (host ints), never device values
+                   <= self._tick):
+                self._replay_apply_locked(self._replay_queue.pop(0))
             # Snapshot-then-clear under the lock: the drained list is a
             # fresh private copy, so iterating it during the (slow,
             # lock-free) apply below never touches shared state.
@@ -1184,8 +1450,27 @@ class SimService:
         if tracer is not None:
             self._emit_ticket_chunk_events(lane_tids, tick0, executed,
                                            heal_report)
+        if self._tick_fault is not None:
+            # Crash seam (chaos/crashstorm.py): mid-tick, after the
+            # dispatch, before any of its results reach the ticket
+            # table — the window where a kill costs the most state.
+            self._tick_fault(tick0)
         pc.enter("harvest")
         completed = self._harvest(out, executed)
+        if self._journal is not None:
+            # The per-tick durability barrier (fsync="tick" policy):
+            # everything acknowledged this tick reaches the platter
+            # before the tick ends. A failing barrier is a durability
+            # loss like a failing append — flip and shed, loudly, but
+            # keep the driver alive (completed work is still real).
+            try:
+                self._journal.tick_barrier()
+            except OSError as e:
+                with self._cond:
+                    if self._durability_lost is None:
+                        self._durability_lost = (
+                            f"journal fsync failed: "
+                            f"{type(e).__name__}: {e}")
         if self._slo is not None:
             # One heal observation per DISPATCHING tick (idle ticks are
             # no evidence either way), then the per-tick evaluation.
@@ -1194,6 +1479,12 @@ class SimService:
             # recovery rides the existing AIMD additive increase.
             if running:
                 self._slo.record("heal", 1.0 if faulted else 0.0)
+            with self._cond:
+                dur_lost = self._durability_lost is not None
+            # One durability observation per tick (the graftdur SLO
+            # stream — dropped unless the engine declares the
+            # objective; see telemetry.slo.serve_objectives).
+            self._slo.record("durability", 1.0 if dur_lost else 0.0)
             self._slo.evaluate(tick0)
             if self._slo.firing(admission_only=True):
                 with self._cond:
@@ -1533,9 +1824,142 @@ class SimService:
             self._tickets.pop(old, None)
             self._submit_walls.pop(old, None)
 
+    # ------------------------------------------- graftdur durability plane
+
+    def _journal_append_locked(self, kind: str, **fields) -> Optional[int]:
+        """Append one admission-plane intent record (caller holds
+        ``_cond``); returns its seqno, or ``None`` with no journal
+        configured. Any failure flips the service into the sticky
+        :class:`DurabilityLost` shedding mode BEFORE propagating — the
+        intent was never acknowledged, and nothing after a possibly-torn
+        tail may be."""
+        if self._journal is None:
+            return None
+        try:
+            seq = self._journal.append(kind, tick=self._tick, **fields)  # graftlint: ignore[lock-open-call] -- the append IS the acknowledgement: it must be atomic with the state change it acknowledges (one unbuffered write; fsync only under the per-record policy)
+        except BaseException as e:
+            if self._durability_lost is None:
+                self._durability_lost = (
+                    f"journal append failed: {type(e).__name__}: {e}")
+            raise
+        self._j_acked = seq
+        return seq
+
+    def _j_covered_locked(self) -> int:
+        """The seqno a pair published NOW covers (caller holds
+        ``_cond``): everything acknowledged, MINUS journaled intents the
+        pair does not yet reflect — queued-but-unapplied mutations and
+        the unconsumed replay suffix. Compaction keys on this, so those
+        intents survive in the journal until something applies them."""
+        covered = self._j_acked
+        if self._j_pending_mut:
+            covered = min(covered, self._j_pending_mut[0] - 1)
+        if self._replay_queue:
+            covered = min(covered,
+                          int(self._replay_queue[0]["seq"]) - 1)
+        return covered
+
+    def replay_pending(self) -> int:
+        """Journal records recovered at resume and not yet replayed."""
+        with self._cond:
+            return len(self._replay_queue)
+
+    def replay_peek(self) -> Optional[dict]:
+        """The next recovered record awaiting replay (a copy), or
+        ``None``. Drives use the ``kind``/``tick`` fields to consume
+        positionally — each record at its original arrival slot."""
+        with self._cond:
+            return dict(self._replay_queue[0]) \
+                if self._replay_queue else None
+
+    def replay_next(self) -> Optional[dict]:
+        """Replay ONE recovered record onto the service state and
+        return it (``None`` when the suffix is exhausted). A replayed
+        submit re-issues the SAME ticket id the crashed life
+        acknowledged (verified against the persisted counter — a
+        divergence is a corrupted-trail error, raised loudly); grows
+        and deltas re-queue for the next tick's mutate phase; sheds and
+        cancels re-apply their counts/transitions. Process metrics
+        count live operations only — replay touches none."""
+        with self._cond:
+            if not self._replay_queue:
+                return None
+            rec = self._replay_queue.pop(0)
+            self._replay_apply_locked(rec)
+            return dict(rec)
+
+    def _replay_apply_locked(self, rec: dict) -> None:
+        seq = int(rec["seq"])
+        kind = rec.get("kind")
+        if kind == "submit":
+            tid = str(rec["ticket"])
+            want = f"t{self._next_ticket:08d}"
+            if tid != want:
+                raise RuntimeError(
+                    f"journal replay diverged: record {seq} "
+                    f"acknowledges ticket {tid!r} but this service "
+                    f"would issue {want!r} — the checkpoint pair and "
+                    "journal disagree (mixed trails?); refusing to "
+                    "re-issue an acknowledged id to different work")
+            tenant = str(rec.get("tenant", "default"))
+            if tenant in self._quotas:
+                self._buckets[tenant] = \
+                    self._buckets.get(tenant, 0.0) - 1.0
+            self._next_ticket += 1
+            self._tickets[tid] = {
+                "ticket": tid, "tenant": tenant,
+                "source": int(rec.get("source", 0)),
+                "target": float(rec.get("target", 0.99)),
+                "status": "queued",
+                "submitted_tick": int(rec.get("tick", self._tick)),
+                "submitted_round": int(rec.get("round", self._round)),
+                "admitted_tick": None, "admitted_round": None,
+                "lane": None, "rounds": None, "seen_count": None,
+                "coverage": None, "latency_rounds": None,
+            }
+            self._queue.append(tid)
+            # No _submit_walls entry: wall latency is a live-process
+            # observation; completion handlers tolerate the None.
+            self._counts["submitted"] += 1
+            self._dirty = True
+        elif kind == "shed":
+            self._counts["rejected"] += 1
+            self._dirty = True
+        elif kind == "cancel":
+            tid = str(rec.get("ticket"))
+            r = self._tickets.get(tid)
+            if r is not None and r["status"] == "queued":
+                r["status"] = "cancelled"
+                self._queue = [t for t in self._queue if t != tid]
+                self._mark_terminal_locked(tid)
+                self._counts["cancelled"] += 1
+                self._dirty = True
+            elif r is not None and r["status"] == "running":
+                r["status"] = "cancelled"
+                lane = r["lane"]
+                if lane is not None:
+                    self._lane_ticket.pop(lane, None)
+                    self._cancel_lanes.append(lane)
+                self._mark_terminal_locked(tid)
+                self._counts["cancelled"] += 1
+                self._dirty = True
+        elif kind == "grow":
+            self._mutations.append(("grow", int(rec.get("n", 0)), seq))
+            self._j_pending_mut.append(seq)
+        elif kind == "delta":
+            self._mutations.append(
+                ("delta", _delta_from_fields(rec), seq))
+            self._j_pending_mut.append(seq)
+        # Unknown kinds skip silently (forward compatibility) but still
+        # advance the acknowledged cover below — they WERE acknowledged.
+        if seq > self._j_acked:
+            self._j_acked = seq
+        self._cond.notify_all()  # graftlint: ignore[lock-open-call] -- Condition.notify_all/wait REQUIRE holding the condition's own lock (stdlib contract); wait releases it while blocked
+
     # ------------------------------------------------------ mutation plane
 
-    def _apply_mutations(self, muts: List[Tuple[str, Any]]) -> None:
+    def _apply_mutations(
+            self, muts: List[Tuple[str, Any, Optional[int]]]) -> None:
         """Drain one tick's queued mutations onto the served graph
         (driver-confined — the graph and batch are the driver's).
 
@@ -1553,7 +1977,7 @@ class SimService:
         silently skipped."""
         g = self.graph
         old_pad = g.n_nodes_padded
-        for kind, payload in muts:
+        for kind, payload, _seq in muts:
             if kind == "grow":
                 g = graph_mod.grow(g, payload)
                 self._growth_history.append({
@@ -1582,10 +2006,19 @@ class SimService:
                 self._healer.template = jax.tree_util.tree_map(
                     lambda x: np.zeros(x.shape, x.dtype), self._batch)
         n_live = int(np.sum(np.asarray(g.node_mask)))
+        applied = {seq for _, _, seq in muts if seq is not None}
         with self._cond:
             self._n_live = n_live
             self._counts["mutations"] += len(muts)
             self._dirty = True
+            if applied:
+                # These journaled intents are now IN the service state:
+                # the next published pair reflects them, so the cover
+                # may advance past their records (a failing mutation
+                # propagated above instead — its seq stays pending and
+                # the journal keeps the record for the next resume).
+                self._j_pending_mut = [
+                    s for s in self._j_pending_mut if s not in applied]
         self._m_capacity.set(float(new_pad))
 
     def _graph_fingerprint(self) -> str:
@@ -1680,19 +2113,36 @@ class SimService:
         the two leaves the previous consistent pair (the sidecar is the
         resume authority, pointing at a never-rewritten entry within the
         retention window)."""
+        # Fencing first (graftdur failover): a zombie primary must fail
+        # BEFORE its store entry lands, not after — the promoted epoch
+        # owns the trail outright.
+        self._check_fence()
         # Graph identity (computed outside the lock — it may pull edge
         # arrays to host): the fingerprint gate resume checks, plus the
         # growth steps that sanction a base-fingerprint resume.
         fp = self._graph_fingerprint()
         with self._cond:
             snap = self._snapshot_locked()
+            covered = self._j_covered_locked() \
+                if self._journal is not None else None
+            ours = self._epoch
         snap["graph_fingerprint"] = fp
         snap["graph_fingerprint_base"] = self._graph_fp_base
         snap["growth"] = [dict(s) for s in self._growth_history]
+        snap["epoch"] = ours
+        if covered is not None:
+            # The journal seqno this pair supersedes: resume replays
+            # exactly the records past it.
+            snap["journal_seqno"] = covered
         try:
             path = self._store.save(self._batch, self._base_key,
                                     snap["round"], snap["messages"])
             snap["checkpoint_file"] = os.path.basename(path)
+            if self._publish_fault is not None:
+                # Crash seam (chaos/crashstorm.py): between the store
+                # entry and the sidecar rename — the classic torn-pair
+                # window the previous consistent pair must survive.
+                self._publish_fault(snap["tick"])
             atomic_write_json(
                 os.path.join(self._store.directory, _SIDECAR), snap,
                 suffix=".side.tmp")
@@ -1703,10 +2153,56 @@ class SimService:
             with self._cond:
                 self._dirty = True
             raise
+        if self._journal is not None:
+            # The published pair supersedes the journal prefix up to
+            # `covered`: rotate the open segment out and drop every
+            # closed segment the pair covers. Best-effort — replay
+            # filters on journal_seqno anyway, so a failed unlink only
+            # costs disk, never correctness.
+            try:
+                self._journal.rotate()
+                self._journal.compact(covered)
+            except OSError:
+                pass
+            self._m_journal_lag.set(
+                float(self._journal.last_seq - covered))
         if spans.current_tracer() is not None:
             spans.emit("serve_checkpoint", tick=snap["tick"],
                        round=snap["round"])
         return path
+
+    def checkpoint(self) -> str:
+        """Force one durable (batch, sidecar) pair NOW, outside the
+        driver's boundary cadence; returns the store entry path. What
+        :meth:`~p2pnetwork_tpu.serve.standby.Standby.promote` calls to
+        publish its fencing token immediately. Raises
+        :class:`FencedEpoch` if a newer epoch owns the trail, and
+        ``ValueError`` without a store."""
+        if self._store is None:
+            raise ValueError("checkpoint() needs a store (pass store=...)")
+        return self._checkpoint()
+
+    def _check_fence(self) -> None:
+        """Refuse to publish over a trail a newer epoch owns: read the
+        current sidecar's fencing token; above ours means a standby
+        promoted while we were presumed dead — we are the zombie."""
+        if self._store is None:
+            return
+        with self._cond:
+            ours = self._epoch
+        side = os.path.join(self._store.directory, _SIDECAR)
+        try:
+            with open(side, "r", encoding="utf-8") as f:
+                current = int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError, TypeError):
+            return  # no/unreadable sidecar: nothing fences us
+        if current > ours:
+            raise FencedEpoch(
+                f"checkpoint refused: sidecar fencing token (epoch "
+                f"{current}) is newer than ours ({ours}) — a "
+                "standby promoted over this trail; this service is a "
+                "demoted zombie and must not publish",
+                ours=ours, current=current)
 
     def _clear_trail(self) -> None:
         self._store.clear()
@@ -1715,6 +2211,18 @@ class SimService:
             os.unlink(side)
         except OSError:
             pass
+        # The journal is part of the trail: a discarded pair must not
+        # leave a suffix that would replay onto unrelated fresh state.
+        if self._journal is not None:
+            self._journal.reset()
+        else:
+            _clear_journal(self._store.directory)
+        # Construction-time path, but these are lock-guarded everywhere
+        # else — keep the discipline uniform.
+        with self._cond:
+            self._replay_queue = []
+            self._j_acked = 0
+            self._j_pending_mut = []
 
     def _template(self):
         shapes = jax.eval_shape(
@@ -1769,6 +2277,14 @@ class SimService:
                         expected=side_fp, got=self._graph_fingerprint(),
                         directory=self._store.directory)
                 self._m_capacity.set(float(self.graph.n_nodes_padded))
+                # Coverage denominators must see the REGROWN live set:
+                # _n_live was computed from the constructed graph, and
+                # a stale value would report coverage against the
+                # pre-growth overlay (divergent vs an uninterrupted
+                # run — the crash-storm campaign caught exactly this).
+                n_live = int(np.sum(np.asarray(self.graph.node_mask)))
+                with self._cond:
+                    self._n_live = n_live
                 if spans.current_tracer() is not None:
                     spans.emit("serve_resume_regrow",
                                steps=len(growth),
@@ -1839,6 +2355,14 @@ class SimService:
             self._latencies = [float(x) for x in snap.get("latencies", [])]
             self._tickets = {str(tid): dict(rec)
                              for tid, rec in snap.get("tickets", {}).items()}
+            # graftdur: the seqno this pair covers — the journal-suffix
+            # replay starts right past it (built by __init__ once the
+            # journal is constructed).
+            self._j_acked = int(snap.get("journal_seqno", 0))
+            # Failover fencing: adopt the trail's epoch unless the
+            # caller pinned one (promote() pins observed+1).
+            if not self._epoch_pinned:
+                self._epoch = int(snap.get("epoch", 0))
             running = dict(self._lane_ticket)
         # Lanes admitted in the checkpoint but not running (harvested
         # done / cancelled, not yet recycled when the checkpoint landed)
@@ -1886,6 +2410,11 @@ class SimService:
                     target_coverage=float(
                         args.get("target_coverage", 0.99)),
                     tenant=str(args.get("tenant", "default")))
+            except DurabilityLost as e:
+                # Durability loss is a SERVER fault, not client load:
+                # 503 (retry elsewhere / after repair), never a 429
+                # back-off hint.
+                return 503, e.to_dict()
             except Rejected as e:
                 return 429, e.to_dict()
             except ServiceClosed as e:
@@ -1899,5 +2428,9 @@ class SimService:
                 return 404, {"error": "unknown ticket"}
             return 200, rec
         if route.startswith("/cancel/") and method == "POST":
-            return 200, {"cancelled": self.cancel(route[len("/cancel/"):])}
+            try:
+                ok = self.cancel(route[len("/cancel/"):])
+            except DurabilityLost as e:
+                return 503, e.to_dict()
+            return 200, {"cancelled": ok}
         return None

@@ -32,6 +32,25 @@ from p2pnetwork_tpu.serve.service import (TERMINAL_STATES,
 __all__ = ["TrafficPattern", "TrafficSchedule", "generate", "drive"]
 
 
+def _consume_replay(service: SimService, t: int) -> Optional[dict]:
+    """Consume the service's journal-replay suffix positionally for ONE
+    arrival slot at schedule tick ``t`` (graftdur resume): records for
+    later ticks stay queued; non-arrival intents (cancel/grow/delta)
+    due here replay in passing; an arrival record (submit/shed) due
+    here replays and returns — the drive then SKIPS the fresh
+    submission, because the crashed life already acknowledged exactly
+    this arrival (same ticket id, same position). ``None`` means the
+    arrival was never acknowledged: submit it fresh, and the persisted
+    ticket counter re-issues the id it would have gotten."""
+    while True:
+        head = service.replay_peek()
+        if head is None or int(head.get("tick", 0)) > t:
+            return None
+        if head.get("kind") in ("submit", "shed"):
+            return service.replay_next()
+        service.replay_next()
+
+
 @dataclasses.dataclass(frozen=True)
 class TrafficPattern:
     """Shape of the open-loop workload (all knobs deterministic given
@@ -180,7 +199,7 @@ def drive(service: SimService, schedule: TrafficSchedule, *,
     keeps ticking (no new arrivals) until nothing is queued or running.
 
     Returns ``{"tickets": {tid: record}, "shed": [...], "submitted",
-    "completed", "drain_ticks", "peak_concurrent_lanes",
+    "completed", "replayed", "drain_ticks", "peak_concurrent_lanes",
     "executed_rounds"}`` — every field deterministic for a given
     (schedule, service config). ``peak_concurrent_lanes`` is the most
     lanes in flight during any single engine chunk (the "sustains N
@@ -218,8 +237,25 @@ def drive(service: SimService, schedule: TrafficSchedule, *,
                 tickets[tid] = rec
                 pending.discard(tid)
 
+    replayed = 0
     for t in range(start, schedule.ticks):
         for source, tenant in schedule.arrivals_at(t):
+            rec = _consume_replay(service, t)
+            if rec is not None:
+                # The crashed life acknowledged this arrival: its
+                # journal record replayed in place of a fresh submit
+                # (same ticket id), or its shed re-counted.
+                replayed += 1
+                if rec["kind"] == "submit":
+                    tid = str(rec["ticket"])
+                    submitted.append(tid)
+                    pending.add(tid)
+                else:
+                    shed.append({"tick": t, "source": int(source),
+                                 "tenant": tenant,
+                                 "reason": str(rec.get("reason",
+                                                       "replayed"))})
+                continue
             try:
                 tid = service.submit(
                     source,
@@ -241,5 +277,6 @@ def drive(service: SimService, schedule: TrafficSchedule, *,
                     if rec is not None and rec["status"] == "done")
     return {"tickets": tickets, "shed": shed,
             "submitted": len(submitted), "completed": completed,
+            "replayed": replayed,
             "drain_ticks": drained, "peak_concurrent_lanes": peak,
             "executed_rounds": rounds}

@@ -42,22 +42,49 @@ _MANIFEST = "manifest.json"
 
 
 def atomic_write_json(path: str, doc: Any, *,
-                      suffix: str = ".json.tmp") -> None:
+                      suffix: str = ".json.tmp",
+                      durable: bool = True) -> None:
     """Rename-publish ``doc`` as JSON at ``path``: tmp file in the same
     directory, ``os.replace``, tmp unlinked on failure. The ONE home of
     this crash-safety pattern — the manifest below and graftserve's
-    sidecar (serve/service.py) both publish through it, so a future
-    hardening (fsync-before-rename, say) lands everywhere at once."""
+    sidecar (serve/service.py) both publish through it, so the graftdur
+    hardening below landed everywhere at once.
+
+    ``durable=True`` (default) closes the power-loss windows a bare
+    rename leaves open: the temp file is fsynced BEFORE the rename (so
+    the name can never point at unwritten bytes) and the directory
+    entry is fsynced AFTER it (so the publish itself survives the
+    cut). A SIGKILL never needed either — the rename is atomic in the
+    page cache — so callers on the hot path that only fear kills (not
+    power) may pass ``durable=False`` and skip both syncs. The
+    directory fsync is best-effort: some filesystems refuse
+    ``open(O_RDONLY)`` on directories, and losing IT costs only the
+    rename, never consistency."""
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(os.path.abspath(path)), suffix=suffix)
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    if durable:
+        try:
+            dfd = os.open(os.path.dirname(os.path.abspath(path)),
+                          os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass
+        finally:
+            os.close(dfd)
 
 
 def _file_sha256(path: str) -> str:

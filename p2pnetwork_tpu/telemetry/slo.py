@@ -97,10 +97,21 @@ class Objective:
 
 def serve_objectives(slo_rounds: float, wall_s: Optional[float] = None,
                      shed_goal: float = 0.95,
-                     heal_goal: float = 0.90) -> Tuple[Objective, ...]:
+                     heal_goal: float = 0.90,
+                     durability_goal: Optional[float] = None,
+                     ) -> Tuple[Objective, ...]:
     """The default graftserve objective set: p99 completion rounds
     (deterministic — the one AIMD may act on), optional p99 completion
-    wall latency (observability-only), shed rate, heal rate."""
+    wall latency (observability-only), shed rate, heal rate.
+
+    ``durability_goal`` (opt-in, graftdur) appends a ``durability``
+    objective over the service's per-tick durability stream (1.0 while
+    the journal is failed / the service sheds ``DurabilityLost``, else
+    0.0): a goal of e.g. 0.999 alerts when more than 0.1% of recent
+    ticks ran without a working write-ahead journal. Deterministic
+    (tick-derived), but observability-only by default — degraded
+    durability should page an operator, not throttle admission of the
+    work that IS still journalable."""
     objs = [
         Objective("completion_p99_rounds", metric="completion_rounds",
                   target=float(slo_rounds), mode="le", goal=0.99,
@@ -114,6 +125,10 @@ def serve_objectives(slo_rounds: float, wall_s: Optional[float] = None,
         objs.insert(1, Objective("completion_p99_wall_s",
                                  metric="completion_wall_s",
                                  target=float(wall_s), mode="le", goal=0.99))
+    if durability_goal is not None:
+        objs.append(Objective("durability", metric="durability",
+                              target=0.0, mode="le",
+                              goal=float(durability_goal)))
     return tuple(objs)
 
 

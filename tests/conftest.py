@@ -121,6 +121,14 @@ def pytest_configure(config):
         "arithmetic, capacity-planner extrapolation, SimService "
         "hbm_budget_bytes admission gate (select with -m mem; part of "
         "the default tier-1 run)")
+    config.addinivalue_line(
+        "markers",
+        "dur: graftdur durability tests — write-ahead intent journal "
+        "(CRC records, torn-tail fuzz, segment rotation/compaction), "
+        "crash-seam resume bit-identity, DurabilityLost shedding, "
+        "hot-standby promote + FencedEpoch fencing, and the "
+        "slow-marked crash-storm campaign + fsync overhead ratchet "
+        "(select with -m dur; part of the default tier-1 run)")
 
 
 @pytest.fixture(autouse=True, scope="module")

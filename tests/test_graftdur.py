@@ -1,0 +1,669 @@
+"""graftdur: the serving plane's durability contract.
+
+Under test (serve/journal.py, serve/standby.py, chaos/crashstorm.py,
+plus the SimService durability plumbing): every ACKNOWLEDGED
+admission-plane intent survives any SIGKILL — the write-ahead journal
+closes the sub-boundary window the checkpoint pair left open — with
+the SAME ticket ids and bit-identical per-ticket results; a torn tail
+costs exactly the one record that was never acknowledged; a journal
+append failure degrades LOUDLY (typed DurabilityLost 503s), never into
+silently un-journaled work; and hot-standby promotion fences the trail
+so a zombie primary's late publish dies as FencedEpoch. The slow tests
+run the acceptance row: the subprocess crash-storm campaign on a
+100k-node graph (≥5 seeded SIGKILLs, zero acked-ticket loss,
+bit-identity incl. seen hashes) and the fsync=tick overhead ratchet.
+"""
+
+import json
+import os
+import struct
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax  # noqa: F401  — device runtime required by the serve plane
+
+from p2pnetwork_tpu import telemetry
+from p2pnetwork_tpu.chaos import crashstorm
+from p2pnetwork_tpu.serve import (
+    DurabilityLost, FencedEpoch, Journal, Rejected, SimService, Standby,
+    TrafficPattern, drive, generate)
+from p2pnetwork_tpu.serve.journal import clear_segments, read_records
+from p2pnetwork_tpu.serve.service import _SIDECAR
+from p2pnetwork_tpu.sim import graph as G
+from p2pnetwork_tpu.supervise.store import atomic_write_json
+from p2pnetwork_tpu.telemetry.httpd import MetricsServer
+from p2pnetwork_tpu.telemetry.slo import serve_objectives
+
+pytestmark = pytest.mark.dur
+
+
+@pytest.fixture(scope="module")
+def ws300():
+    return G.watts_strogatz(300, 6, 0.2, seed=3, source_csr=True)
+
+
+def make_service(g, **kw):
+    kw.setdefault("capacity", 32)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("chunk_rounds", 4)
+    kw.setdefault("seed", 0)
+    kw.setdefault("record_seen_hash", True)
+    kw.setdefault("registry", telemetry.Registry())
+    return SimService(g, **kw)
+
+
+class _Kill(Exception):
+    """In-process stand-in for SIGKILL: raised out of a crash seam,
+    caught by the test, the service object abandoned un-closed."""
+
+
+# ------------------------------------------------------- journal unit
+
+
+class TestJournal:
+    def test_append_read_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        j = Journal(d, fsync="off")
+        assert j.append("submit", ticket="t0", source=3, tick=0) == 1
+        assert j.append("shed", reason="queue_full", tick=0) == 2
+        assert j.append("grow", n=8, tick=1) == 3
+        assert j.last_seq == 3
+        j.close()
+        records, corrupt = read_records(d)
+        assert corrupt == 0
+        assert [r["kind"] for r in records] == ["submit", "shed", "grow"]
+        assert [r["seq"] for r in records] == [1, 2, 3]
+        assert records[0]["ticket"] == "t0"
+        assert records[2]["n"] == 8
+
+    def test_reopen_recovers_and_continues_in_fresh_segment(
+            self, tmp_path):
+        d = str(tmp_path)
+        j = Journal(d, fsync="off")
+        j.append("submit", ticket="t0")
+        j.close()
+        j2 = Journal(d, fsync="off")
+        assert [r["seq"] for r in j2.records()] == [1]
+        assert j2.append("submit", ticket="t1") == 2  # seqs continue
+        j2.close()
+        # Two segment files: the first life's and the second's — a
+        # reopened journal NEVER appends to a possibly-torn tail.
+        segs = [n for n in os.listdir(d) if n.endswith(".wal")]
+        assert len(segs) == 2
+        records, corrupt = read_records(d)
+        assert corrupt == 0 and [r["seq"] for r in records] == [1, 2]
+
+    def test_rotate_compact_bounds_segments(self, tmp_path):
+        d = str(tmp_path)
+        j = Journal(d, fsync="off")
+        for i in range(3):
+            j.append("submit", ticket=f"t{i}")
+            j.rotate()
+        assert j.stats()["segments"] == 3
+        j.compact(2)  # covers seqs 1..2 → two segments reclaimed
+        assert j.stats()["segments"] == 1
+        records, _ = read_records(d)
+        assert [r["seq"] for r in records] == [3]
+        j.close()
+
+    def test_failed_journal_refuses_further_appends(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="off")
+
+        def hook(event, seq):
+            if event == "append_begin":
+                raise OSError(28, "No space left on device (injected)")
+        j.fault_hook = hook
+        with pytest.raises(OSError):
+            j.append("submit", ticket="t0")
+        assert j.failed is not None
+        j.fault_hook = None
+        with pytest.raises(OSError, match="failed previously"):
+            j.append("submit", ticket="t1")
+        assert j.stats()["failed"]
+
+    def test_closed_journal_refuses_appends(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="off")
+        j.append("submit", ticket="t0")
+        j.close()
+        with pytest.raises(OSError, match="closed"):
+            j.append("submit", ticket="t1")
+
+    def test_fsync_policy_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync policy"):
+            Journal(str(tmp_path), fsync="sometimes")
+
+    def test_record_policy_fsyncs_every_append(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="record")
+        j.append("submit", ticket="t0")
+        j.append("submit", ticket="t1")
+        assert j.stats()["fsyncs"] == 2
+        j.close()
+
+    def test_tick_policy_fsyncs_at_barrier_only(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="tick")
+        j.append("submit", ticket="t0")
+        j.append("submit", ticket="t1")
+        assert j.stats()["fsyncs"] == 0
+        j.tick_barrier()
+        assert j.stats()["fsyncs"] == 1
+        j.tick_barrier()  # nothing new appended: no extra sync
+        assert j.stats()["fsyncs"] == 1
+        j.close()
+
+    def test_clear_segments(self, tmp_path):
+        d = str(tmp_path)
+        j = Journal(d, fsync="off")
+        j.append("submit", ticket="t0")
+        j.close()
+        clear_segments(d)
+        assert read_records(d) == ([], 0)
+
+
+# --------------------------------------------- torn-write fuzz (satellite)
+
+
+class TestTornTail:
+    def _journal_blob(self, d):
+        j = Journal(d, fsync="off")
+        for i in range(5):
+            j.append("submit", ticket=f"t{i:08d}", source=i, tick=i)
+        j.close()
+        segs = [n for n in os.listdir(d) if n.endswith(".wal")]
+        assert len(segs) == 1
+        path = os.path.join(d, segs[0])
+        with open(path, "rb") as f:
+            blob = f.read()
+        # Record start offsets, parsed independently of the journal.
+        offsets, off = [], 0
+        while off < len(blob):
+            length, _ = struct.unpack_from("<II", blob, off)
+            offsets.append(off)
+            off += 8 + length
+        assert len(offsets) == 5
+        return path, blob, offsets
+
+    def test_truncation_at_every_tail_byte_recovers_prefix(
+            self, tmp_path):
+        d = str(tmp_path / "j")
+        path, blob, offsets = self._journal_blob(d)
+        tail_start = offsets[-1]
+        prefix = [f"t{i:08d}" for i in range(4)]
+        for cut in range(tail_start, len(blob)):
+            with open(path, "wb") as f:
+                f.write(blob[:cut])
+            records, corrupt = read_records(d)
+            assert [r["ticket"] for r in records] == prefix, cut
+            # cut == tail_start is a CLEAN end (the tail record simply
+            # never started); every byte past it is a torn record.
+            assert corrupt == (0 if cut == tail_start else 1), cut
+
+    def test_corrupt_tail_surfaces_in_stats_and_fresh_segment(
+            self, tmp_path):
+        d = str(tmp_path / "j")
+        path, blob, offsets = self._journal_blob(d)
+        with open(path, "wb") as f:
+            f.write(blob[:offsets[-1] + 11])  # mid-tail-record
+        j = Journal(d, fsync="off")
+        st = j.stats()
+        assert st["corrupt_tail"] == 1
+        assert st["recovered"] == 4
+        assert st["last_seq"] == 4
+        assert j.append("submit", ticket="t-next") == 5
+        j.close()
+
+    def test_bit_rot_in_tail_truncates_at_crc(self, tmp_path):
+        d = str(tmp_path / "j")
+        path, blob, offsets = self._journal_blob(d)
+        flipped = bytearray(blob)
+        flipped[offsets[-1] + 12] ^= 0xFF  # payload byte of the tail
+        with open(path, "wb") as f:
+            f.write(bytes(flipped))
+        records, corrupt = read_records(d)
+        assert len(records) == 4 and corrupt == 1
+
+
+# ------------------------------------------- service-side durability
+
+
+class TestServiceJournal:
+    def test_journal_requires_store(self, ws300):
+        with pytest.raises(ValueError, match="store"):
+            make_service(ws300, journal=True)
+
+    def test_journal_fsync_validated(self, ws300, tmp_path):
+        with pytest.raises(ValueError):
+            make_service(ws300, store=str(tmp_path),
+                         journal_fsync="bogus")
+
+    def test_stats_carry_durability_fields(self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False)
+        svc.submit(1)
+        svc.tick()
+        st = svc.stats()
+        assert st["epoch"] == 0
+        assert st["durability_lost"] is None
+        assert st["replay_pending"] == 0
+        assert st["journal"]["fsync_policy"] == "tick"
+        assert st["journal"]["appended"] >= 1
+        assert st["journal_covered"] >= 1
+        svc.close()
+
+    def test_acked_after_boundary_submits_survive_kill(
+            self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           checkpoint_every_ticks=10)
+        t0 = svc.submit(1)
+        svc.tick()  # no boundary yet (cadence 10)
+        t1 = svc.submit(2)
+        t2 = svc.submit(3)
+        # SIGKILL stand-in: abandon without close — nothing flushed,
+        # no final checkpoint. Only the journal knows t0..t2.
+        del svc
+        res = make_service(ws300, store=str(tmp_path), resume=True)
+        assert res.replay_pending() == 3
+        replayed = [res.replay_next()["ticket"]
+                    for _ in range(res.replay_pending())]
+        assert replayed == [t0, t1, t2]  # SAME acknowledged ids
+        for _ in range(40):
+            res.tick()
+            if not res.busy():
+                break
+        recs = res.tickets()
+        assert {recs[t]["status"] for t in (t0, t1, t2)} == {"done"}
+        res.close()
+
+    def test_replay_reissues_same_ids_bit_identical(
+            self, ws300, tmp_path):
+        pattern = TrafficPattern(ticks=10, rate=5.0, hot_fraction=0.6,
+                                 hot_keys=4, burst_prob=0.2)
+        sched = generate(pattern, ws300.n_nodes, seed=7)
+        ref = make_service(ws300)
+        drive(ref, sched)
+
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           checkpoint_every_ticks=3)
+        crashstorm.install(
+            svc, crashstorm.KillPoint("tick", 5),
+            action=lambda: (_ for _ in ()).throw(_Kill()))
+        with pytest.raises(_Kill):
+            drive(svc, sched)
+        del svc
+        res = make_service(ws300, store=str(tmp_path), resume=True)
+        assert res.replay_pending() > 0  # acked past the boundary
+        out = drive(res, sched)
+        assert out["replayed"] > 0
+        assert ref.tickets() == res.tickets()  # seen hashes included
+        ref.close()
+        res.close()
+
+    @pytest.mark.parametrize("seam,at", [("sidecar_publish", 4),
+                                         ("journal_append", 9)])
+    def test_kill_seams_resume_bit_identical(self, ws300, tmp_path,
+                                             seam, at):
+        pattern = TrafficPattern(ticks=8, rate=4.0, hot_fraction=0.5,
+                                 hot_keys=4)
+        sched = generate(pattern, ws300.n_nodes, seed=11)
+        ref = make_service(ws300)
+        drive(ref, sched)
+
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           checkpoint_every_ticks=2)
+
+        def die():
+            raise _Kill()
+        crashstorm.install(svc, crashstorm.KillPoint(seam, at),
+                           action=die)
+        with pytest.raises(_Kill):
+            drive(svc, sched)
+        del svc
+        res = make_service(ws300, store=str(tmp_path), resume=True)
+        if seam == "journal_append":
+            # The kill fired mid-record: the torn tail was truncated
+            # and its intent (never acknowledged) re-submits fresh.
+            assert res.stats()["journal"]["corrupt_tail"] == 1
+        drive(res, sched)
+        assert ref.tickets() == res.tickets()
+        ref.close()
+        res.close()
+
+    def test_pending_delta_survives_kill_via_replay(
+            self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False)
+        svc.submit(1)
+        svc.tick()
+        base_edges = int(svc.graph.n_edges)
+        delta = G.GraphDelta.undirected(add_senders=[0],
+                                        add_receivers=[7])
+        svc.apply_delta(delta)  # acknowledged: journaled, NOT applied
+        del svc  # killed before the next tick's mutate phase
+        res = make_service(ws300, store=str(tmp_path), resume=True)
+        assert res.replay_pending() == 1
+        assert res.replay_peek()["kind"] == "delta"
+        res.replay_next()
+        res.tick()  # mutate phase applies the replayed delta
+        assert int(res.graph.n_edges) == base_edges + 2
+        res.close()
+
+    def test_journal_compacted_at_boundaries(self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           checkpoint_every_ticks=1)
+        for i in range(6):
+            svc.submit(i)
+            svc.tick()
+        # Every boundary rotated + compacted its covered prefix: the
+        # journal holds a bounded suffix, not six ticks of history.
+        assert svc.stats()["journal"]["segments"] <= 2
+        svc.close()
+
+    def test_resume_false_clears_journal(self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           checkpoint_every_ticks=10)
+        svc.submit(1)
+        del svc
+        fresh = make_service(ws300, store=str(tmp_path), resume=False)
+        assert fresh.replay_pending() == 0
+        assert fresh.submit(2) == "t00000000"  # counter restarted
+        fresh.close()
+
+    def test_legacy_unjournaled_service(self, ws300, tmp_path):
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           journal=False)
+        svc.submit(1)
+        svc.tick()
+        st = svc.stats()
+        assert "journal" not in st
+        assert st["journal_covered"] is None
+        assert read_records(str(tmp_path)) == ([], 0)
+        svc.close()
+
+
+# ------------------------------------------------- loud degradation
+
+
+class TestDurabilityLost:
+    def _degraded(self, ws300, tmp_path, **kw):
+        reg = telemetry.Registry()
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           registry=reg, **kw)
+        crashstorm.install(svc, crashstorm.KillPoint("disk_full", 1))
+        return svc, reg
+
+    def test_disk_full_flips_to_shedding(self, ws300, tmp_path):
+        svc, reg = self._degraded(ws300, tmp_path)
+        with pytest.raises(DurabilityLost) as ei:
+            svc.submit(1)
+        assert ei.value.reason == "durability"
+        assert issubclass(DurabilityLost, Rejected)
+        assert svc.stats()["durability_lost"]
+        # Sticky: later submits shed immediately, no journal touched.
+        with pytest.raises(DurabilityLost):
+            svc.submit(2)
+        assert reg.value("serve_rejected_total",
+                         reason="durability") == 2
+        svc.close()
+
+    def test_mutations_and_cancel_refused_when_lost(
+            self, ws300, tmp_path):
+        svc, _ = self._degraded(ws300, tmp_path)
+        with pytest.raises(DurabilityLost):
+            svc.submit(1)
+        with pytest.raises(DurabilityLost):
+            svc.grow(4)
+        with pytest.raises(DurabilityLost):
+            svc.apply_delta(G.GraphDelta.undirected(
+                add_senders=[0], add_receivers=[7]))
+        svc.close()
+
+    def test_driver_survives_degradation(self, ws300, tmp_path):
+        svc, _ = self._degraded(ws300, tmp_path)
+        with pytest.raises(DurabilityLost):
+            svc.submit(1)
+        svc.tick()  # the driver keeps ticking (drains, checkpoints)
+        assert svc.stats()["durability_lost"]
+        svc.close()
+
+    def test_http_durability_surface(self, ws300, tmp_path):
+        reg = telemetry.Registry()
+        svc = make_service(ws300, store=str(tmp_path), resume=False,
+                           registry=reg)
+        crashstorm.install(svc, crashstorm.KillPoint("disk_full", 1))
+        with MetricsServer(registry=reg, port=0, service=svc) as srv:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, st = _get(base + "/stats")
+            assert code == 200
+            assert st["durability_lost"] is None
+            assert st["journal"]["fsync_policy"] == "tick"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/submit", {"source": 3})
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read().decode())
+            assert body["reason"] == "durability"
+            code, st = _get(base + "/stats")
+            assert st["durability_lost"]
+        svc.close()
+
+    def test_slo_objective_opt_in(self):
+        names = [o.name for o in serve_objectives(64.0)]
+        assert names == ["completion_p99_rounds", "shed_rate",
+                         "heal_rate"]
+        objs = serve_objectives(64.0, durability_goal=0.999)
+        dur = [o for o in objs if o.name == "durability"]
+        assert len(dur) == 1
+        assert dur[0].metric == "durability"
+        assert not dur[0].admission_signal
+
+
+# --------------------------------------------------- standby failover
+
+
+class TestFailover:
+    def test_promote_fences_zombie_and_replays_acks(
+            self, ws300, tmp_path):
+        d = str(tmp_path)
+        primary = make_service(ws300, store=d, resume=False,
+                               checkpoint_every_ticks=10)
+        t0 = primary.submit(1)
+        primary.tick()
+        sb = Standby(ws300, d, capacity=32, queue_depth=64,
+                     chunk_rounds=4, seed=0, record_seen_hash=True,
+                     registry=telemetry.Registry())
+        obs = sb.refresh()
+        assert obs["epoch"] == 0
+        assert obs["journal_last_seq"] >= 1
+        assert sb.last_observation == obs
+        t1 = primary.submit(2)  # acked after the boundary: journal-only
+        assert sb.refresh()["replay_pending"] >= 1
+        promoted = sb.promote()
+        assert promoted.stats()["epoch"] == 1
+        # The zombie's late publish is refused, typed and attributed.
+        with pytest.raises(FencedEpoch) as ei:
+            primary.checkpoint()
+        assert ei.value.ours == 0 and ei.value.current == 1
+        # The promoted service completes the dead primary's acks with
+        # the SAME ticket ids.
+        while promoted.replay_pending():
+            promoted.replay_next()
+        for _ in range(40):
+            promoted.tick()
+            if not promoted.busy():
+                break
+        recs = promoted.tickets()
+        assert recs[t0]["status"] == "done"
+        assert recs[t1]["status"] == "done"
+        # Zombie close(): the final dirty checkpoint fences too —
+        # close() reports it as a warning (the trail just ends) rather
+        # than masking the close.
+        with pytest.warns(RuntimeWarning,
+                          match="final close checkpoint failed"):
+            primary.close()
+        promoted.close()
+
+    def test_checkpoint_without_store_is_an_error(self, ws300):
+        svc = make_service(ws300)
+        with pytest.raises(ValueError, match="store"):
+            svc.checkpoint()
+        svc.close()
+
+    def test_standby_owns_trail_kwargs(self, ws300, tmp_path):
+        with pytest.raises(ValueError, match="resume"):
+            Standby(ws300, str(tmp_path), resume=False)
+
+    def test_pinned_epoch_survives_resume(self, ws300, tmp_path):
+        d = str(tmp_path)
+        svc = make_service(ws300, store=d, resume=False, epoch=7)
+        svc.submit(1)
+        svc.tick()
+        svc.close()
+        side = json.loads((tmp_path / _SIDECAR).read_text())
+        assert side["epoch"] == 7
+        res = make_service(ws300, store=d, resume=True)  # adopts
+        assert res.stats()["epoch"] == 7
+        res.close()
+
+
+# ------------------------------------------------ crash-storm schedule
+
+
+class TestCrashSchedule:
+    def test_generation_is_byte_replayable(self):
+        a = crashstorm.generate(6, seed=9, ticks=32)
+        b = crashstorm.generate(6, seed=9, ticks=32)
+        assert a.to_bytes() == b.to_bytes()
+        assert len(a) == 6
+
+    def test_required_kinds_present(self):
+        sched = crashstorm.generate(5, seed=0, ticks=24)
+        kinds = {k.kind for k in sched.kills}
+        assert "journal_append" in kinds
+        assert "sidecar_publish" in kinds
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            crashstorm.generate(1, require=("journal_append",
+                                            "sidecar_publish"))
+        with pytest.raises(ValueError):
+            crashstorm.generate(3, require=("disk_full",))
+        with pytest.raises(ValueError):
+            crashstorm.KillPoint("meteor", 3)
+        with pytest.raises(ValueError):
+            crashstorm.KillPoint("tick", 0)
+
+    def test_campaign_rejects_disk_full_kills(self, tmp_path):
+        sched = crashstorm.CrashSchedule(
+            kills=(crashstorm.KillPoint("disk_full", 1),), seed=0)
+        with pytest.raises(crashstorm.CampaignError,
+                           match="availability"):
+            crashstorm.run_campaign(str(tmp_path), sched)
+
+    def test_acked_tickets_reads_sidecar_and_journal(
+            self, ws300, tmp_path):
+        d = str(tmp_path)
+        svc = make_service(ws300, store=d, resume=False,
+                           checkpoint_every_ticks=10)
+        t0 = svc.submit(1)
+        svc.tick()
+        t1 = svc.submit(2)  # journal-only
+        assert crashstorm.acked_tickets(d) == {t0, t1}
+        del svc
+
+
+# ------------------------------------------------- store hardening
+
+
+class TestAtomicWriteDurable:
+    def test_durable_default_roundtrip(self, tmp_path):
+        path = str(tmp_path / "doc.json")
+        atomic_write_json(path, {"a": 1})
+        with open(path) as f:
+            assert json.load(f) == {"a": 1}
+        assert os.listdir(str(tmp_path)) == ["doc.json"]  # tmp gone
+
+    def test_durable_off_roundtrip(self, tmp_path):
+        path = str(tmp_path / "doc.json")
+        atomic_write_json(path, {"b": 2}, durable=False)
+        with open(path) as f:
+            assert json.load(f) == {"b": 2}
+
+    def test_failure_cleans_temp(self, tmp_path):
+        path = str(tmp_path / "doc.json")
+        with pytest.raises(TypeError):
+            atomic_write_json(path, {"bad": object()})
+        assert os.listdir(str(tmp_path)) == []
+
+
+# ------------------------------------------------- acceptance (slow)
+
+
+@pytest.mark.slow
+class TestCrashStormAcceptance:
+    def test_campaign_100k_zero_acked_loss_and_fencing(self, tmp_path):
+        sched = crashstorm.generate(5, seed=3, ticks=24)
+        kinds = [k.kind for k in sched.kills]
+        assert "journal_append" in kinds
+        assert "sidecar_publish" in kinds
+        report = crashstorm.run_campaign(
+            str(tmp_path), sched,
+            config={"n_nodes": 100_000, "capacity": 64, "rate": 8.0,
+                    "chunk_rounds": 8, "checkpoint_every_ticks": 4},
+            env={"JAX_PLATFORMS": "cpu"}, timeout=1200.0)
+        # run_campaign itself raises on acked loss / divergence; the
+        # report must additionally show the storm did real work.
+        assert report["tickets"] > 0
+        assert sum(1 for k in report["kills"] if k["landed"]) >= 3
+        assert report["acked_seen"] <= report["tickets"]
+
+        # Failover over the stormed trail: promote, then the zombie's
+        # publish dies as FencedEpoch — the acceptance row's last leg.
+        g = G.watts_strogatz(100_000, 6, 0.1, seed=3)
+        trail = os.path.join(str(tmp_path), "trail")
+        zombie = SimService(g, capacity=64, chunk_rounds=8, seed=0,
+                            store=trail, resume=True,
+                            record_seen_hash=True,
+                            registry=telemetry.Registry())
+        promoted = Standby(g, trail, capacity=64, chunk_rounds=8,
+                           seed=0, record_seen_hash=True,
+                           registry=telemetry.Registry()).promote()
+        assert promoted.stats()["epoch"] == zombie.stats()["epoch"] + 1
+        with pytest.raises(FencedEpoch):
+            zombie.checkpoint()
+        promoted.close()
+
+
+@pytest.mark.slow
+class TestJournalOverheadRatchet:
+    def test_fsync_tick_overhead_within_ratchet(self):
+        import bench
+        # Serving scale: the ratio is workload-dependent (a tiny drive
+        # is all fsync), and the ratchet pins the regime the service
+        # actually runs in — engine work per tick >> one fsync.
+        g = G.watts_strogatz(100_000, 6, 0.1, seed=1, source_csr=True)
+        ratio = None
+        for _ in range(3):  # retries: shared boxes jitter
+            col = bench.time_durability(g, cap=64, chunk=8, ticks=10,
+                                        rate=8.0)
+            ratio = col["fsync"]["tick"]["overhead_ratio"]
+            if ratio <= 1.10:
+                break
+        assert ratio <= 1.10, (
+            f"fsync=tick journaling cost {ratio}x an unjournaled "
+            "drive (ratchet: <= 1.10x)")
+        assert col["replay_scan_ms_per_1k"] < 1000.0
+
+
+# ----------------------------------------------------------- helpers
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _post(url, doc=None, timeout=10):
+    data = json.dumps(doc or {}).encode()
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
